@@ -1,0 +1,23 @@
+"""paddlebox_tpu — a TPU-native sparse-CTR training framework.
+
+A ground-up JAX/XLA/Pallas re-design of the capabilities of PaddleBox
+(Baidu's GPU sparse-CTR fork of PaddlePaddle 1.8, see SURVEY.md):
+
+- pass-based training with an HBM-sharded embedding table (the role of the
+  closed-source BoxPS GPU parameter server in the reference),
+- slot-formatted data ingestion with multi-threaded parse + global shuffle,
+- dense-parameter synchronization lowered to mesh collectives (psum /
+  reduce_scatter / all_gather over ICI+DCN mesh axes),
+- in-training AUC / bucket-error metrics with exact global reduction,
+- day/pass base+delta checkpointing for online serving.
+
+Layer map (vs. reference SURVEY.md §1): the Program/Scope/Executor +
+operator-registry machinery collapses into jitted functions over a
+`jax.sharding.Mesh`; the CUDA glue kernels become XLA-fused jnp code and
+Pallas kernels; libbox_ps becomes `paddlebox_tpu.embedding`.
+"""
+
+__version__ = "0.1.0"
+
+from paddlebox_tpu import config as config  # noqa: F401
+from paddlebox_tpu.config import flags as flags  # noqa: F401

@@ -1,0 +1,89 @@
+"""Global flag registry.
+
+Replaces the reference's three-tier config (gflags in
+paddle/fluid/platform/flags.cc, protobuf TrainerDesc/DataFeedDesc descriptors,
+and the external box_ps conf file — SURVEY.md §5 "Config / flag system") with a
+single typed registry. Flags can be set programmatically, or via environment
+variables ``PBTPU_<NAME>`` (mirroring how the reference exposes gflags through
+``pybind/global_value_getter_setter.cc``).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+import threading
+from typing import Any
+
+
+@dataclasses.dataclass
+class Flags:
+    """Framework-wide knobs.
+
+    Each field mirrors a reference gflag where one exists (citation in the
+    comment); new TPU-specific knobs are marked (new).
+    """
+
+    # --- data pipeline (reference platform/flags.cc:478-483) ---
+    record_pool_max_size: int = 50_000_000  # FLAGS_padbox_record_pool_max_size
+    dataset_shuffle_thread_num: int = 8     # FLAGS_padbox_dataset_shuffle_thread_num
+    dataset_merge_thread_num: int = 8       # FLAGS_padbox_dataset_merge_thread_num
+    dataset_load_thread_num: int = 8        # (new) parse/download threads
+    shuffle_by_searchid: bool = False       # FLAGS_enable_shuffle_by_searchid (flags.cc:605)
+    slot_pool_capacity: int = 4096          # channel capacity (new)
+
+    # --- embedding engine (role of libbox_ps; flags.cc:603,607) ---
+    pullpush_dedup_keys: bool = True        # FLAGS_enable_pullpush_dedup_keys
+    pull_padding_zero: bool = True          # FLAGS_enable_pull_box_padding_zero
+    use_replica_cache: bool = False         # FLAGS_use_gpu_replica_cache (flags.cc:486)
+    embedding_max_keys_per_pass: int = 1 << 26  # (new) working-set capacity guard
+
+    # --- trainer (trainer_desc.proto:100-108, flags.cc:591-597) ---
+    param_sync_step: int = 1                # BoxPSWorkerParameter.sync_dense_step
+    sync_dense_moment: bool = False         # FLAGS_enable_sync_dense_moment
+    check_nan_inf: bool = False             # FLAGS_check_nan_inf
+    binding_train_cpu: bool = False         # FLAGS_enable_binding_train_cpu
+
+    # --- pass/day (flags.cc:477,492) ---
+    fix_dayid: bool = False                 # FLAGS_fix_dayid
+    auc_runner_mode: bool = False           # FLAGS_padbox_auc_runner_mode
+
+    # --- numerics / TPU (new) ---
+    compute_dtype: str = "float32"          # bf16 for matmul-heavy towers
+    embedding_dtype: str = "float32"
+
+    def set(self, name: str, value: Any) -> None:
+        if not hasattr(self, name):
+            raise KeyError(f"unknown flag {name!r}")
+        setattr(self, name, value)
+
+    def get(self, name: str) -> Any:
+        if not hasattr(self, name):
+            raise KeyError(f"unknown flag {name!r}")
+        return getattr(self, name)
+
+    @classmethod
+    def from_env(cls) -> "Flags":
+        f = cls()
+        for field in dataclasses.fields(cls):
+            env_key = "PBTPU_" + field.name.upper()
+            if env_key in os.environ:
+                raw = os.environ[env_key]
+                if field.type in ("int", int):
+                    f.set(field.name, int(raw))
+                elif field.type in ("bool", bool):
+                    f.set(field.name, raw.lower() in ("1", "true", "yes"))
+                else:
+                    f.set(field.name, raw)
+        return f
+
+
+_lock = threading.Lock()
+flags = Flags.from_env()
+
+
+def set_flags(**kwargs: Any) -> None:
+    """Set multiple flags atomically (test-friendly)."""
+    with _lock:
+        for k, v in kwargs.items():
+            flags.set(k, v)

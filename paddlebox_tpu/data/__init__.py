@@ -1,0 +1,5 @@
+from paddlebox_tpu.data.schema import Slot, SlotType, DataFeedSchema  # noqa: F401
+from paddlebox_tpu.data.slot_record import (SlotRecordBatch, PackedBatch,  # noqa: F401
+                                            SparseLayout)
+from paddlebox_tpu.data.parser import parse_multislot_lines  # noqa: F401
+from paddlebox_tpu.data.dataset import SlotDataset  # noqa: F401

@@ -1,0 +1,179 @@
+"""Pass-scoped in-memory dataset — the PadBoxSlotDataset equivalent.
+
+Reference (data_set.{h,cc}; class at data_set.h:348-474): a pass's worth of
+``SlotRecord``s is downloaded+parsed by a thread pool, globally shuffled
+across nodes, merged, key-extracted into the parameter server's feed-pass
+agent, then sliced into per-device batch ranges for the trainers
+(``PrepareTrain``). ``PreLoadIntoMemory``/``WaitPreLoadDone`` overlap the next
+pass's ingest with the current pass's training (data_set.cc:1712-1786).
+
+TPU-native changes: records are columnar (``SlotRecordBatch``), shuffle rides
+host TCP over DCN (``shuffle.py``), and "key extraction into the PS agent"
+becomes handing the pass's unique keys to the embedding engine's
+``begin_pass`` working-set builder (see embedding/store.py).
+"""
+
+from __future__ import annotations
+
+import concurrent.futures
+import threading
+from typing import Iterator, Sequence
+
+import numpy as np
+
+from paddlebox_tpu.config import flags
+from paddlebox_tpu.data.reader import ParserPlugin, read_file
+from paddlebox_tpu.data.schema import DataFeedSchema
+from paddlebox_tpu.data.slot_record import PackedBatch, SlotRecordBatch, batch_iterator
+from paddlebox_tpu.data.shuffle import LocalShuffler, RoutingMode, TcpShuffleService, route_records
+
+
+class SlotDataset:
+    """One pass of training data, held columnar in host memory."""
+
+    def __init__(self, schema: DataFeedSchema,
+                 shuffle_service: TcpShuffleService | None = None,
+                 seed: int = 0):
+        self.schema = schema
+        self.filelist: list[str] = []
+        self.pipe_command: str | None = None
+        self.parser_plugin: ParserPlugin | None = None
+        self.with_ins_id = False
+        self.records: SlotRecordBatch | None = None
+        self.date: int | None = None
+        self._preload: concurrent.futures.Future | None = None
+        self._pool = None
+        self._shuffler = LocalShuffler(seed)
+        self._service = shuffle_service
+        self._lock = threading.Lock()
+        # per-device slices set by prepare_train
+        self._shards: list[SlotRecordBatch] = []
+
+    # ---- configuration (BoxPSDataset python API, dataset.py:1081-1191) ----
+
+    def set_filelist(self, files: Sequence[str]) -> None:
+        self.filelist = list(files)
+
+    def set_pipe_command(self, cmd: str | None) -> None:
+        self.pipe_command = cmd
+
+    def set_parser_plugin(self, plugin: ParserPlugin | None) -> None:
+        self.parser_plugin = plugin
+
+    def set_date(self, date: int) -> None:
+        """Reference BoxPSDataset.set_date (dataset.py:1101)."""
+        self.date = date
+
+    # ---- ingest (LoadIntoMemory, data_set.cc:1780) ----
+
+    def load_into_memory(self, global_shuffle: bool = True,
+                         routing: RoutingMode = "random") -> None:
+        n_threads = min(flags.dataset_load_thread_num, max(1, len(self.filelist)))
+        with concurrent.futures.ThreadPoolExecutor(n_threads) as pool:
+            parts = list(pool.map(self._read_one, self.filelist))
+        parts = [p for p in parts if p.num > 0]
+        batch = (SlotRecordBatch.concat(parts) if parts
+                 else SlotRecordBatch.empty(self.schema))
+        if global_shuffle and batch.num > 0:
+            batch = self._global_shuffle(batch, routing)
+        with self._lock:
+            self.records = batch
+
+    def preload_into_memory(self, **kw) -> None:
+        """Overlap next pass ingest with training (PreLoadIntoMemory,
+        data_set.cc:1712)."""
+        ex = concurrent.futures.ThreadPoolExecutor(1)
+        self._preload = ex.submit(self.load_into_memory, **kw)
+        ex.shutdown(wait=False)
+
+    def wait_preload_done(self) -> None:
+        if self._preload is not None:
+            self._preload.result()
+            self._preload = None
+
+    def _read_one(self, path: str) -> SlotRecordBatch:
+        return read_file(path, self.schema, pipe_command=self.pipe_command,
+                         parser_plugin=self.parser_plugin,
+                         with_ins_id=self.with_ins_id)
+
+    def _global_shuffle(self, batch: SlotRecordBatch,
+                        routing: RoutingMode) -> SlotRecordBatch:
+        if self._service is None:
+            return self._shuffler.shuffle(batch, routing)
+        routed = route_records(batch, self._service.world, routing)
+        received = self._service.exchange(routed, self.schema)
+        merged = (SlotRecordBatch.concat(received) if received
+                  else SlotRecordBatch.empty(self.schema))
+        return self._shuffler.shuffle(merged) if merged.num else merged
+
+    # ---- in-memory transforms ----
+
+    def local_shuffle(self) -> None:
+        if self.records is not None and self.records.num:
+            self.records = self._shuffler.shuffle(self.records)
+
+    def slots_shuffle(self, slot_names: Sequence[str], seed: int = 0) -> None:
+        """Shuffle the values of the given sparse slots *across examples*
+        (reference BoxPSDataset.slots_shuffle, dataset.py:1191 — used for
+        feature-ablation evaluation)."""
+        if self.records is None or self.records.num == 0:
+            return
+        rng = np.random.default_rng(seed)
+        rec = self.records
+        sparse_names = [s.name for s in self.schema.sparse_slots]
+        for name in slot_names:
+            s = sparse_names.index(name)
+            offs = rec.sparse_offsets[s]
+            lens = offs[1:] - offs[:-1]
+            # permute whole per-example value lists among examples of equal length
+            # (cheap approximation that preserves per-example counts exactly:
+            # permute the flat values)
+            rec.sparse_values[s] = rng.permutation(rec.sparse_values[s])
+            del lens
+
+    def merge_by_search_id(self) -> np.ndarray:
+        """Group examples into page views (PV merge, reference MergePvInstance):
+        returns group ids per example ordered so same-search_id examples are
+        adjacent; used to build rank_offset for rank_attention."""
+        assert self.records is not None
+        order = np.argsort(self.records.search_id, kind="stable")
+        self.records = self.records.select(order)
+        _, group = np.unique(self.records.search_id, return_inverse=True)
+        return group
+
+    # ---- hand-off to embedding engine + trainers ----
+
+    def unique_keys(self) -> np.ndarray:
+        """The pass's feature-sign working set (MergeInsKeys → PSAgent,
+        data_set.cc:1786)."""
+        assert self.records is not None
+        return self.records.unique_keys()
+
+    def prepare_train(self, num_shards: int) -> None:
+        """Slice records round-robin into per-device shards
+        (PadBoxSlotDataset::PrepareTrain, data_set.h:376)."""
+        assert self.records is not None
+        n = self.records.num
+        self._shards = [
+            self.records.select(np.arange(d, n, num_shards))
+            for d in range(num_shards)
+        ]
+
+    def shard_batches(self, shard: int, batch_size: int | None = None,
+                      drop_last: bool = True) -> Iterator[PackedBatch]:
+        bs = batch_size or self.schema.batch_size
+        return batch_iterator(self._shards[shard], bs, drop_last=drop_last)
+
+    def batches(self, batch_size: int | None = None,
+                drop_last: bool = True) -> Iterator[PackedBatch]:
+        assert self.records is not None
+        bs = batch_size or self.schema.batch_size
+        return batch_iterator(self.records, bs, drop_last=drop_last)
+
+    @property
+    def num_examples(self) -> int:
+        return 0 if self.records is None else self.records.num
+
+    def release_memory(self) -> None:
+        self.records = None
+        self._shards = []

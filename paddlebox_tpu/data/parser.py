@@ -1,0 +1,137 @@
+"""Slot-format text parsing.
+
+The reference parses slot-formatted examples three ways (SlotPaddleBoxDataFeed,
+reference data_feed.cc:3104-3115): built-in ``ParseOneInstance`` for the
+MultiSlot text protocol, a dlopen'd parser plugin (``ISlotParser``,
+data_feed.h:1283), or an arbitrary ``pipe_command`` whose stdout is the
+MultiSlot protocol. We keep all three ingestion modes (see ``reader.py``); this
+module holds the protocol parser itself, with two implementations:
+
+- a vectorized numpy fallback (pure Python), and
+- a native C++ parser (``paddlebox_tpu/native/slot_parser.cc``) loaded via
+  ctypes, which is the production path — the reference burns dozens of host
+  parser threads per node (platform/flags.cc:480-484) and host-side parse is
+  the known ingest bottleneck (SURVEY.md §7 "Hard parts").
+
+MultiSlot text protocol: for each example (one line), for each slot in schema
+order: ``<len> v_1 ... v_len`` separated by whitespace. uint64 slots carry
+feature signs, float slots carry floats. Lines may optionally be prefixed with
+``<ins_id>\\t`` when the schema's reader enables instance ids.
+"""
+
+from __future__ import annotations
+
+from typing import Iterable, Sequence
+
+import numpy as np
+
+from paddlebox_tpu.data.schema import DataFeedSchema, SlotType
+from paddlebox_tpu.data.slot_record import SlotRecordBatch
+from paddlebox_tpu.utils.hashing import hash64
+
+
+def parse_multislot_lines(
+    lines: Iterable[str],
+    schema: DataFeedSchema,
+    with_ins_id: bool = False,
+) -> SlotRecordBatch:
+    """Parse MultiSlot text lines into one columnar SlotRecordBatch."""
+    native = _maybe_native()
+    if native is not None and not with_ins_id:
+        out = native.parse_lines(lines, schema)
+        if out is not None:
+            return out
+    return _parse_python(lines, schema, with_ins_id)
+
+
+def _parse_python(lines: Iterable[str], schema: DataFeedSchema,
+                  with_ins_id: bool) -> SlotRecordBatch:
+    slots = schema.slots
+    n_sparse = len(schema.sparse_slots)
+    n_float = len(schema.float_slots)
+    sparse_vals: list[list[int]] = [[] for _ in range(n_sparse)]
+    sparse_lens: list[list[int]] = [[] for _ in range(n_sparse)]
+    float_vals: list[list[float]] = [[] for _ in range(n_float)]
+    ins_ids: list[int] = []
+    num = 0
+    for line in lines:
+        line = line.strip()
+        if not line:
+            continue
+        if with_ins_id:
+            ins_id_str, _, line = line.partition("\t")
+            ins_ids.append(hash64(ins_id_str))
+        toks = line.split()
+        pos = 0
+        si = fi = 0
+        for slot in slots:
+            if pos >= len(toks):
+                raise ValueError(
+                    f"malformed MultiSlot line (ran out of tokens at slot "
+                    f"{slot.name!r}, example {num}): {line[:120]!r}")
+            ln = int(toks[pos]); pos += 1
+            if pos + ln > len(toks):
+                raise ValueError(
+                    f"malformed MultiSlot line (slot {slot.name!r} declares "
+                    f"{ln} values but line ends, example {num}): {line[:120]!r}")
+            vals = toks[pos:pos + ln]; pos += ln
+            if slot.type == SlotType.UINT64:
+                if slot.is_used:
+                    sparse_vals[si].extend(int(v) for v in vals)
+                    sparse_lens[si].append(ln)
+                    si += 1
+            else:
+                if slot.is_used:
+                    w = slot.max_len
+                    fv = [float(v) for v in vals[:w]]
+                    fv += [0.0] * (w - len(fv))
+                    float_vals[fi].extend(fv)
+                    fi += 1
+        num += 1
+    sparse_values = [np.asarray(v, dtype=np.int64) for v in sparse_vals]
+    sparse_offsets = []
+    for lens in sparse_lens:
+        offs = np.zeros(num + 1, dtype=np.int64)
+        if lens:
+            np.cumsum(np.asarray(lens, dtype=np.int64), out=offs[1:])
+        sparse_offsets.append(offs)
+    if not with_ins_id:
+        ins = np.zeros(num, dtype=np.uint64)
+    else:
+        ins = np.asarray(ins_ids, dtype=np.uint64)
+    return SlotRecordBatch(
+        schema=schema, num=num,
+        sparse_values=sparse_values, sparse_offsets=sparse_offsets,
+        float_values=[np.asarray(v, dtype=np.float32) for v in float_vals],
+        ins_id=ins,
+        search_id=np.zeros(num, dtype=np.uint64),
+        rank=np.zeros(num, dtype=np.int32),
+        cmatch=np.zeros(num, dtype=np.int32),
+    )
+
+
+_native_cache: list = []
+
+
+def _maybe_native():
+    """Lazy-load the C++ parser; None if the shared lib isn't built."""
+    if not _native_cache:
+        try:
+            from paddlebox_tpu.native import slot_parser_binding
+            _native_cache.append(slot_parser_binding)
+        except Exception:
+            _native_cache.append(None)
+    return _native_cache[0]
+
+
+def format_multislot_example(slot_values: Sequence[tuple[str, Sequence]],
+                             schema: DataFeedSchema) -> str:
+    """Inverse of the parser — used by the data generator (the reference's
+    MultiSlotDataGenerator protocol, python/paddle/fluid/incubate/data_generator)."""
+    by_name = dict(slot_values)
+    parts: list[str] = []
+    for slot in schema.slots:
+        vals = by_name.get(slot.name, ())
+        parts.append(str(len(vals)))
+        parts.extend(str(v) for v in vals)
+    return " ".join(parts)

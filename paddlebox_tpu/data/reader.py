@@ -1,0 +1,71 @@
+"""File readers: the three ingestion modes of SlotPaddleBoxDataFeed.
+
+Reference (data_feed.cc:3104-3115):
+- ``LoadIntoMemoryByCommand`` — popen a ``pipe_command`` whose stdout is the
+  MultiSlot protocol;
+- ``LoadIntoMemoryByLib`` — dlopen'd parser plugin (``ISlotParser``);
+- built-in line parsing of local/HDFS files.
+
+Here a *parser plugin* is any Python callable
+``(iter[str], DataFeedSchema) -> SlotRecordBatch`` registered by module path
+(``"pkg.mod:func"``) — the dlopen moral equivalent without the .so contract —
+and pipe commands work identically (stdout → protocol parser). Gzip files are
+handled transparently, like the reference's file managers.
+"""
+
+from __future__ import annotations
+
+import gzip
+import importlib
+import subprocess
+from typing import Callable, Iterable, Iterator
+
+from paddlebox_tpu.data.parser import parse_multislot_lines
+from paddlebox_tpu.data.schema import DataFeedSchema
+from paddlebox_tpu.data.slot_record import SlotRecordBatch
+
+ParserPlugin = Callable[[Iterable[str], DataFeedSchema], SlotRecordBatch]
+
+
+def open_lines(path: str) -> Iterator[str]:
+    opener = gzip.open if path.endswith(".gz") else open
+    with opener(path, "rt") as f:  # type: ignore[arg-type]
+        yield from f
+
+
+def load_parser_plugin(spec: str) -> ParserPlugin:
+    """Resolve ``"package.module:callable"`` — our ISlotParser dlopen
+    equivalent (reference data_feed.cc:2812 caches dlopen'd .so parsers)."""
+    mod_name, _, attr = spec.partition(":")
+    mod = importlib.import_module(mod_name)
+    fn = getattr(mod, attr or "parse")
+    if not callable(fn):
+        raise TypeError(f"parser plugin {spec!r} is not callable")
+    return fn
+
+
+def read_file(
+    path: str,
+    schema: DataFeedSchema,
+    pipe_command: str | None = None,
+    parser_plugin: ParserPlugin | None = None,
+    with_ins_id: bool = False,
+) -> SlotRecordBatch:
+    """Read one file into a columnar batch via the configured ingestion mode."""
+    if pipe_command:
+        proc = subprocess.Popen(
+            f"{pipe_command} < {path}" if path else pipe_command,
+            shell=True, stdout=subprocess.PIPE, text=True,
+        )
+        assert proc.stdout is not None
+        try:
+            out = parse_multislot_lines(proc.stdout, schema, with_ins_id=with_ins_id)
+        finally:
+            ret = proc.wait()
+        if ret != 0:
+            raise RuntimeError(f"pipe_command {pipe_command!r} exited {ret}")
+        return out
+    lines = open_lines(path)
+    if parser_plugin is not None:
+        return parser_plugin(lines, schema)
+    return parse_multislot_lines(lines, schema, with_ins_id=with_ins_id)

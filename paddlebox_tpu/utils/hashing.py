@@ -1,0 +1,39 @@
+"""Stable 64-bit hashing.
+
+The reference routes global-shuffle records by ``XXH64(ins_id)`` and
+``search_id % mpi_size`` (reference data_set.cc:1934-1942) and signs features
+into a uint64 key space. We need a stable, fast 64-bit hash that is identical
+across hosts and across Python/C++ — FNV-1a 64 fits (xxhash isn't in the
+baked-in dependency set, and hash() is salted per process).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+_FNV_OFFSET = 0xCBF29CE484222325
+_FNV_PRIME = 0x100000001B3
+_MASK = 0xFFFFFFFFFFFFFFFF
+
+
+def hash64(s: str | bytes) -> int:
+    if isinstance(s, str):
+        s = s.encode("utf-8")
+    h = _FNV_OFFSET
+    for b in s:
+        h ^= b
+        h = (h * _FNV_PRIME) & _MASK
+    return h
+
+
+def hash64_array(a: np.ndarray) -> np.ndarray:
+    """Vectorized splitmix64 over a uint64/int64 array — used to hash raw
+    feature signs into table shards deterministically."""
+    x = a.astype(np.uint64, copy=True)
+    with np.errstate(over="ignore"):
+        x = (x + np.uint64(0x9E3779B97F4A7C15)) & np.uint64(_MASK)
+        z = x
+        z = ((z ^ (z >> np.uint64(30))) * np.uint64(0xBF58476D1CE4E5B9)) & np.uint64(_MASK)
+        z = ((z ^ (z >> np.uint64(27))) * np.uint64(0x94D049BB133111EB)) & np.uint64(_MASK)
+        z = z ^ (z >> np.uint64(31))
+    return z

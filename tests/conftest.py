@@ -1,0 +1,21 @@
+"""Test harness: simulate an 8-device TPU mesh on CPU.
+
+Mirrors the reference's test strategy (SURVEY.md §4): distributed paths must be
+testable without real hardware, so every test runs on the CPU backend with 8
+virtual XLA devices (`--xla_force_host_platform_device_count`).
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+# NOTE: x64 stays OFF — device code must work with TPU-default 32-bit ints.
+# Raw uint64 feature signs live host-side only (numpy); the pass working set
+# translates them to dense int32 indices before anything reaches jit
+# (SURVEY.md §7 design stance).
+
+import jax  # noqa: E402,F401

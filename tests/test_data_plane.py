@@ -1,0 +1,201 @@
+"""Data plane: schema, parsing, columnar batches, packing, shuffle, dataset."""
+
+import numpy as np
+import pytest
+
+from paddlebox_tpu.data import (DataFeedSchema, PackedBatch, Slot,
+                                SlotDataset, SlotRecordBatch, SlotType,
+                                parse_multislot_lines)
+from paddlebox_tpu.data.parser import format_multislot_example
+from paddlebox_tpu.data.shuffle import (LocalShuffler, deserialize_batch,
+                                        route_records, serialize_batch)
+from paddlebox_tpu.data.slot_record import batch_iterator
+
+
+def make_schema(num_sparse=3, max_len=4, batch_size=4):
+    return DataFeedSchema.ctr(num_sparse=num_sparse, num_float=2,
+                              batch_size=batch_size, max_len=max_len)
+
+
+def make_lines(schema, n, seed=0):
+    rng = np.random.default_rng(seed)
+    lines = []
+    for _ in range(n):
+        parts = []
+        for slot in schema.slots:
+            if slot.type == SlotType.FLOAT:
+                vals = [f"{rng.random():.4f}"] * slot.max_len
+            else:
+                k = rng.integers(1, slot.max_len + 2)
+                vals = [str(rng.integers(0, 10**9)) for _ in range(k)]
+            parts.append(str(len(vals)))
+            parts.extend(vals)
+        lines.append(" ".join(parts))
+    return lines
+
+
+def test_parse_roundtrip_counts():
+    schema = make_schema()
+    lines = make_lines(schema, 10)
+    batch = parse_multislot_lines(lines, schema)
+    assert batch.num == 10
+    assert len(batch.sparse_values) == 3
+    assert len(batch.float_values) == 3  # label + 2 dense
+    for offs in batch.sparse_offsets:
+        assert offs.shape == (11,)
+        assert offs[0] == 0
+        assert np.all(np.diff(offs) >= 1)
+
+
+def test_parse_exact_values():
+    schema = DataFeedSchema(
+        [Slot("label", SlotType.FLOAT, max_len=1),
+         Slot("s0", SlotType.UINT64, max_len=3)], batch_size=2)
+    lines = ["1 1.0 2 11 22", "1 0.0 3 5 6 7"]
+    b = parse_multislot_lines(lines, schema)
+    assert b.num == 2
+    np.testing.assert_array_equal(b.sparse_values[0], [11, 22, 5, 6, 7])
+    np.testing.assert_array_equal(b.sparse_offsets[0], [0, 2, 5])
+    np.testing.assert_allclose(b.float_values[0], [1.0, 0.0])
+
+
+def test_pack_pads_and_truncates():
+    schema = DataFeedSchema(
+        [Slot("label", SlotType.FLOAT, max_len=1),
+         Slot("s0", SlotType.UINT64, max_len=2)], batch_size=2)
+    lines = ["1 1.0 1 7", "1 0.0 4 1 2 3 4"]
+    b = parse_multislot_lines(lines, schema)
+    packed = b.pack(0, 2)
+    assert packed.ids.shape == (2, 2)
+    np.testing.assert_array_equal(packed.ids[0], [7, 0])   # padded
+    np.testing.assert_array_equal(packed.ids[1], [1, 2])   # truncated
+    np.testing.assert_array_equal(packed.mask[0], [True, False])
+    np.testing.assert_array_equal(packed.mask[1], [True, True])
+    np.testing.assert_allclose(packed.label(), [1.0, 0.0])
+
+
+def test_pack_heterogeneous_max_len():
+    schema = DataFeedSchema(
+        [Slot("label", SlotType.FLOAT, max_len=1),
+         Slot("short", SlotType.UINT64, max_len=1),
+         Slot("long", SlotType.UINT64, max_len=4)], batch_size=2)
+    lines = ["1 1.0 1 9 2 5 6", "1 0.0 1 8 1 3"]
+    b = parse_multislot_lines(lines, schema)
+    p = b.pack(0, 2)
+    assert p.ids.shape == (2, 5)        # T = 1 + 4
+    lay = p.layout()
+    np.testing.assert_array_equal(lay.segment_ids, [0, 1, 1, 1, 1])
+    ids_long, mask_long = p.slot_ids("long")
+    np.testing.assert_array_equal(ids_long[0], [5, 6, 0, 0])
+    np.testing.assert_array_equal(mask_long[1], [True, False, False, False])
+
+
+def test_concat_and_select():
+    schema = make_schema()
+    b1 = parse_multislot_lines(make_lines(schema, 5, seed=1), schema)
+    b2 = parse_multislot_lines(make_lines(schema, 7, seed=2), schema)
+    cat = SlotRecordBatch.concat([b1, b2])
+    assert cat.num == 12
+    sel = cat.select(np.array([0, 11, 5]))
+    assert sel.num == 3
+    # row 0 of sel == row 0 of b1; row 1 of sel == row 6 of b2
+    np.testing.assert_array_equal(
+        sel.sparse_values[0][:sel.sparse_offsets[0][1]],
+        b1.sparse_values[0][:b1.sparse_offsets[0][1]])
+
+
+def test_shuffle_preserves_multiset():
+    schema = make_schema()
+    b = parse_multislot_lines(make_lines(schema, 20), schema)
+    sh = LocalShuffler(seed=3).shuffle(b)
+    assert sh.num == b.num
+    np.testing.assert_array_equal(
+        np.sort(np.concatenate(sh.sparse_values)),
+        np.sort(np.concatenate(b.sparse_values)))
+
+
+def test_route_records_partition():
+    schema = make_schema()
+    b = parse_multislot_lines(make_lines(schema, 30), schema)
+    b.search_id = np.arange(30, dtype=np.uint64)
+    routed = route_records(b, 3, "search_id")
+    assert sum(r.num for r in routed if r is not None) == 30
+    for dest, sub in enumerate(routed):
+        assert np.all(sub.search_id % 3 == dest)
+
+
+def test_serialize_roundtrip():
+    schema = make_schema()
+    b = parse_multislot_lines(make_lines(schema, 8), schema)
+    b2 = deserialize_batch(serialize_batch(b), schema)
+    assert b2.num == b.num
+    for v1, v2 in zip(b.sparse_values, b2.sparse_values):
+        np.testing.assert_array_equal(v1, v2)
+    for f1, f2 in zip(b.float_values, b2.float_values):
+        np.testing.assert_allclose(f1, f2)
+
+
+def test_batch_iterator_shapes():
+    schema = make_schema(batch_size=4)
+    b = parse_multislot_lines(make_lines(schema, 10), schema)
+    batches = list(batch_iterator(b, 4, drop_last=True))
+    assert len(batches) == 2
+    assert all(isinstance(p, PackedBatch) and p.num == 4 for p in batches)
+
+
+def test_dataset_end_to_end(tmp_path):
+    schema = make_schema(batch_size=4)
+    for i in range(3):
+        (tmp_path / f"part-{i}").write_text(
+            "\n".join(make_lines(schema, 8, seed=i)) + "\n")
+    ds = SlotDataset(schema)
+    ds.set_filelist([str(tmp_path / f"part-{i}") for i in range(3)])
+    ds.set_date(20260729)
+    ds.load_into_memory()
+    assert ds.num_examples == 24
+    keys = ds.unique_keys()
+    assert keys.ndim == 1 and len(keys) > 0
+    ds.prepare_train(num_shards=2)
+    shard_batches = list(ds.shard_batches(0))
+    assert len(shard_batches) == 3  # 12 examples / bs 4
+
+
+def test_dataset_pipe_command(tmp_path):
+    schema = make_schema()
+    p = tmp_path / "raw"
+    p.write_text("\n".join(make_lines(schema, 6)) + "\n")
+    ds = SlotDataset(schema)
+    ds.set_filelist([str(p)])
+    ds.set_pipe_command("cat")
+    ds.load_into_memory(global_shuffle=False)
+    assert ds.num_examples == 6
+
+
+def test_dataset_preload(tmp_path):
+    schema = make_schema()
+    p = tmp_path / "raw"
+    p.write_text("\n".join(make_lines(schema, 6)) + "\n")
+    ds = SlotDataset(schema)
+    ds.set_filelist([str(p)])
+    ds.preload_into_memory(global_shuffle=False)
+    ds.wait_preload_done()
+    assert ds.num_examples == 6
+
+
+def test_format_example_roundtrip():
+    schema = DataFeedSchema(
+        [Slot("label", SlotType.FLOAT, max_len=1),
+         Slot("s0", SlotType.UINT64, max_len=3)])
+    line = format_multislot_example([("label", [1.0]), ("s0", [4, 5])], schema)
+    b = parse_multislot_lines([line], schema)
+    np.testing.assert_array_equal(b.sparse_values[0], [4, 5])
+
+
+def test_slots_shuffle_preserves_counts():
+    schema = make_schema()
+    ds = SlotDataset(schema)
+    ds.records = parse_multislot_lines(make_lines(schema, 12), schema)
+    before = np.sort(ds.records.sparse_values[1].copy())
+    ds.slots_shuffle(["slot_1"], seed=1)
+    after = np.sort(ds.records.sparse_values[1])
+    np.testing.assert_array_equal(before, after)
