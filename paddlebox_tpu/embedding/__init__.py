@@ -1,0 +1,4 @@
+from paddlebox_tpu.embedding.config import EmbeddingConfig  # noqa: F401
+from paddlebox_tpu.embedding.store import HostEmbeddingStore  # noqa: F401
+from paddlebox_tpu.embedding.working_set import PassWorkingSet  # noqa: F401
+from paddlebox_tpu.embedding import sharded  # noqa: F401

@@ -1,0 +1,90 @@
+"""Embedding-engine configuration and row layout.
+
+The reference's per-feature value struct (``FeaturePullValueGpu`` /
+``FeaturePushValueGpu``, used by box_wrapper_impl.h:122-245) carries
+``show, clk, embed_w`` (a scalar logit weight — the "wide"/LR component) plus
+an ``embedx`` vector, with optimizer state held inside the parameter server.
+We keep that layout, as one flat float32 row per feature:
+
+    col 0            show      (impression counter, drives CVM + shrink)
+    col 1            clk       (click counter)
+    col 2            embed_w   (scalar weight)
+    cols 3..3+dim    embedx    (embedding vector)
+    tail             optimizer state (per `optimizer`)
+
+Pull (what a lookup returns to the model) = cols [0, 3+dim) — show, clk, w,
+embedx; matching the reference's pull value. Push = (d_w, d_embedx) grads plus
+show/clk increments, applied *inside the table* like the reference's PS-side
+optimizer (box_wrapper_impl.h:229 "optimizer update inside the PS").
+
+Supported embedx dims mirror the reference's dispatch envelope
+(box_wrapper.cc:444-461): any dim works here (no template dispatch), the
+constant list is kept only for config validation parity.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+REFERENCE_EMBEDX_DIMS = (0, 2, 4, 8, 16, 32, 64, 128, 256, 280)
+
+# optimizer → number of state columns
+_OPT_SLOTS = {
+    "sgd": 0,
+    "adagrad": 2,       # w_g2sum, x_g2sum (per-feature scalar, CTR practice)
+    "ftrl": 3,          # w_z, w_n (FTRL on w) + x_g2sum (adagrad on embedx)
+    "adam": 4,          # w_m, w_v, x_m, x_v (per-feature scalar moments)
+}
+
+
+@dataclasses.dataclass(frozen=True)
+class EmbeddingConfig:
+    dim: int = 8                      # embedx dimension
+    optimizer: str = "adagrad"
+    learning_rate: float = 0.05
+    initial_g2sum: float = 3.0        # adagrad epsilon-like accumulator floor
+    initial_range: float = 0.02       # init scale for new embedx rows
+    beta1: float = 0.9                # adam
+    beta2: float = 0.999
+    ftrl_l1: float = 1.0
+    ftrl_l2: float = 1.0
+    ftrl_beta: float = 1.0
+    mf_create_threshold: float = 0.0  # min show before embedx trains (parity knob)
+    seed: int = 0
+
+    def __post_init__(self) -> None:
+        if self.optimizer not in _OPT_SLOTS:
+            raise ValueError(f"unknown embedding optimizer {self.optimizer!r}; "
+                             f"choose from {sorted(_OPT_SLOTS)}")
+        if self.dim < 0:
+            raise ValueError("dim must be >= 0")
+
+    # --- row geometry ---
+    @property
+    def n_opt_slots(self) -> int:
+        return _OPT_SLOTS[self.optimizer]
+
+    @property
+    def pull_width(self) -> int:
+        """show, clk, w, embedx — what lookup returns."""
+        return 3 + self.dim
+
+    @property
+    def grad_width(self) -> int:
+        """d_w, d_embedx — what push consumes."""
+        return 1 + self.dim
+
+    @property
+    def row_width(self) -> int:
+        return 3 + self.dim + self.n_opt_slots
+
+    # column helpers
+    SHOW, CLK, W = 0, 1, 2
+
+    @property
+    def embedx_cols(self) -> slice:
+        return slice(3, 3 + self.dim)
+
+    @property
+    def opt_cols(self) -> slice:
+        return slice(3 + self.dim, self.row_width)

@@ -1,0 +1,218 @@
+"""In-jit sharded embedding lookup/push.
+
+This module is the TPU replacement for the reference's device-side embedding
+path: ``BoxWrapper::PullSparse``/``PushSparseGrad`` dispatch
+(box_wrapper_impl.h:25,164), the ``PullCopy*``/``PushCopy*``/``PushMergeCopy*``
+CUDA kernel families (box_wrapper.cu:35-830), and the sharded
+``PullSparseGPU``/``PushSparseGPU`` lookups inside libbox_ps.
+
+Design (SURVEY.md §2.3 "TPU-native equivalents"): the pass working set is a
+dense ``(N, row_width)`` float32 table sharded contiguously over the mesh's
+device axis; batches carry dense int32 indices (index 0 = null/padding row).
+Three strategies:
+
+- ``lookup``/``push`` — single-shard (or fully-replicated) gather / dedup'd
+  scatter-update. Used standalone on one chip and as the per-shard core of
+  the routed path.
+- ``routed_lookup``/``routed_push`` — the distributed path inside
+  ``shard_map``: tokens are routed to the owning shard with a fixed-capacity
+  ``lax.all_to_all`` over ICI (the hand-built hierarchy of the reference's
+  NCCL+SyncDense collapses into mesh collectives).
+
+Duplicate keys are merged on-device before the optimizer applies (the role of
+``PushMergeCopy``): ``push`` sorts tokens, segment-sums grads per unique row,
+and applies the optimizer exactly once per row — so the math matches the
+reference's merge-then-update semantics, not scatter-add-racing.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from paddlebox_tpu.embedding.config import EmbeddingConfig
+from paddlebox_tpu.embedding.optim import apply_updates
+
+NULL_INDEX = 0  # reserved all-zero row; padding tokens point here
+
+
+# ---------------------------------------------------------------------------
+# single-shard core
+# ---------------------------------------------------------------------------
+
+def lookup(table: jnp.ndarray, idx: jnp.ndarray,
+           cfg: EmbeddingConfig) -> jnp.ndarray:
+    """Gather pull values (show, clk, w, embedx) for flat int32 indices.
+
+    idx may have any shape; returns idx.shape + (pull_width,). Null/padding
+    indices return the zero row (FLAGS_enable_pull_box_padding_zero
+    semantics, flags.cc:607).
+    """
+    return table[idx.reshape(-1), :cfg.pull_width].reshape(
+        (*idx.shape, cfg.pull_width))
+
+
+def push(table: jnp.ndarray, idx: jnp.ndarray, grads: jnp.ndarray,
+         shows: jnp.ndarray, clks: jnp.ndarray,
+         cfg: EmbeddingConfig) -> jnp.ndarray:
+    """Merge-and-update: apply summed grads + show/clk increments in-table.
+
+    idx   : (n,) int32 row indices (duplicates fine; 0 = null, must carry
+            zero grads/increments)
+    grads : (n, grad_width) d_w, d_embedx per token
+    shows, clks : (n,) counter increments per token
+    Returns the updated table.
+    """
+    n = idx.shape[0]
+    order = jnp.argsort(idx)
+    sidx = idx[order]
+    is_start = jnp.concatenate(
+        [jnp.ones((1,), jnp.bool_), sidx[1:] != sidx[:-1]])
+    # segment id: which unique-row slot each sorted token belongs to
+    seg = jnp.cumsum(is_start.astype(jnp.int32)) - 1
+    seg_grads = jnp.zeros((n, cfg.grad_width), grads.dtype).at[seg].add(
+        grads[order])
+    seg_show = jnp.zeros((n,), shows.dtype).at[seg].add(shows[order])
+    seg_clk = jnp.zeros((n,), clks.dtype).at[seg].add(clks[order])
+    # unique row index per slot; unused tail slots are sent out-of-bounds so
+    # the final scatter drops them (they'd otherwise collide with a real
+    # row-0 write — note shard-local row 0 is a real row on shards > 0).
+    uidx = jnp.zeros((n,), sidx.dtype).at[seg].max(sidx)
+    n_unique = seg[-1] + 1
+    used = jnp.arange(n, dtype=jnp.int32) < n_unique
+    uidx = jnp.where(used, uidx, table.shape[0])
+    rows = table[uidx]  # OOB gathers clamp; their slots are dropped below
+    new_rows = apply_updates(rows, seg_grads, seg_show, seg_clk, cfg)
+    # The null row only ever receives zero grads/increments (callers mask
+    # padding), and a fresh zero row is a fixed point of every optimizer —
+    # so it stays exactly zero without special-casing.
+    return table.at[uidx].set(new_rows, mode="drop")
+
+
+# ---------------------------------------------------------------------------
+# routed (multi-shard) path — runs inside shard_map
+# ---------------------------------------------------------------------------
+
+def _axis_size(axis_name) -> jnp.ndarray:
+    if isinstance(axis_name, (tuple, list)):
+        s = 1
+        for a in axis_name:
+            s *= lax.axis_size(a)
+        return s
+    return lax.axis_size(axis_name)
+
+
+def _route(idx: jnp.ndarray, rows_per_shard: int, n_shards: int, cap: int):
+    """Compute the fixed-capacity routing plan for a flat token vector.
+
+    Returns (order, sorted_owner, pos, valid, send_idx) where ``send_idx``
+    is the (n_shards, cap) per-destination index buffer (−1 = empty lane).
+    Tokens beyond a destination's capacity are dropped (monitor with
+    `routed_dropped`).
+    """
+    n = idx.shape[0]
+    owner = idx // rows_per_shard
+    order = jnp.argsort(owner)
+    sidx = idx[order]
+    sowner = owner[order]
+    counts = jnp.bincount(owner, length=n_shards)
+    starts = jnp.cumsum(counts) - counts
+    pos = jnp.arange(n, dtype=jnp.int32) - starts[sowner]
+    valid = pos < cap
+    send_idx = jnp.full((n_shards, cap), -1, dtype=idx.dtype)
+    send_idx = send_idx.at[sowner, pos].set(sidx, mode="drop")
+    return order, sowner, pos, valid, send_idx
+
+
+def routed_lookup(table_shard: jnp.ndarray, idx: jnp.ndarray,
+                  cfg: EmbeddingConfig, axis_name,
+                  capacity_factor: float = 2.0) -> jnp.ndarray:
+    """Distributed gather inside shard_map.
+
+    table_shard : (rows_per_shard, row_width) this device's contiguous shard
+    idx         : (n,) int32 *global* working-set indices for this device's
+                  local batch tokens
+    Returns (n, pull_width).
+    """
+    n = idx.shape[0]
+    D = _axis_size(axis_name)
+    rps = table_shard.shape[0]
+    cap = _capacity(n, D, capacity_factor)
+    order, sowner, pos, valid, send_idx = _route(idx, rps, D, cap)
+    recv_idx = lax.all_to_all(send_idx, axis_name, 0, 0, tiled=True)
+    local_row = jnp.where(recv_idx >= 0, recv_idx % rps, 0)
+    vals = table_shard[local_row.reshape(-1), :cfg.pull_width]
+    vals = vals.reshape(D, cap, cfg.pull_width)
+    vals = jnp.where((recv_idx >= 0)[:, :, None], vals, 0.0)
+    back = lax.all_to_all(vals, axis_name, 0, 0, tiled=True)
+    gathered = back[sowner, jnp.minimum(pos, cap - 1)]
+    gathered = jnp.where(valid[:, None], gathered, 0.0)
+    out = jnp.zeros((n, cfg.pull_width), gathered.dtype).at[order].set(gathered)
+    return out
+
+
+def routed_push(table_shard: jnp.ndarray, idx: jnp.ndarray,
+                grads: jnp.ndarray, shows: jnp.ndarray, clks: jnp.ndarray,
+                cfg: EmbeddingConfig, axis_name,
+                capacity_factor: float = 2.0) -> jnp.ndarray:
+    """Distributed merge-update inside shard_map (reverse of routed_lookup)."""
+    n = idx.shape[0]
+    D = _axis_size(axis_name)
+    rps = table_shard.shape[0]
+    cap = _capacity(n, D, capacity_factor)
+    order, sowner, pos, valid, send_idx = _route(idx, rps, D, cap)
+    payload = jnp.concatenate(
+        [grads, shows[:, None], clks[:, None]], axis=1)[order]
+    send_pay = jnp.zeros((D, cap, payload.shape[1]), payload.dtype)
+    send_pay = send_pay.at[sowner, pos].set(payload, mode="drop")
+    recv_idx = lax.all_to_all(send_idx, axis_name, 0, 0, tiled=True)
+    recv_pay = lax.all_to_all(send_pay, axis_name, 0, 0, tiled=True)
+    flat_idx = recv_idx.reshape(-1)
+    flat_pay = recv_pay.reshape(-1, payload.shape[1])
+    empty = flat_idx < 0
+    # Empty lanes go out-of-bounds so push's final scatter drops them.
+    # (Routing them to shard-local row 0 — a real row on shards > 0 — would
+    # let stateful optimizers like adam apply a zero-grad momentum-decay
+    # update to an untouched row.)
+    local_row = jnp.where(empty, rps, flat_idx % rps).astype(jnp.int32)
+    flat_pay = jnp.where(empty[:, None], 0.0, flat_pay)
+    return push(table_shard, local_row, flat_pay[:, :cfg.grad_width],
+                flat_pay[:, cfg.grad_width], flat_pay[:, cfg.grad_width + 1],
+                cfg)
+
+
+def routed_dropped(idx: jnp.ndarray, rows_per_shard: int, n_shards: int,
+                   capacity_factor: float = 2.0) -> jnp.ndarray:
+    """Number of tokens that exceed per-destination capacity (monitoring)."""
+    n = idx.shape[0]
+    cap = _capacity(n, n_shards, capacity_factor)
+    owner = idx // rows_per_shard
+    counts = jnp.bincount(owner, length=n_shards)
+    return jnp.maximum(counts - cap, 0).sum()
+
+
+def _capacity(n: int, n_shards: int, factor: float) -> int:
+    return max(1, min(n, int(-(-n * factor // n_shards))))
+
+
+# ---------------------------------------------------------------------------
+# dedup (FLAGS_enable_pullpush_dedup_keys, flags.cc:603)
+# ---------------------------------------------------------------------------
+
+def dedup_tokens(idx: jnp.ndarray) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """Fixed-capacity unique: returns (unique_idx, inverse) with the unused
+    tail of unique_idx set to NULL_INDEX — the masked-capacity equivalent of
+    the reference's DedupKeysAndFillIdx (box_wrapper_impl.h:103).
+
+    lookup(table, unique_idx)[inverse] == lookup(table, idx).
+    """
+    n = idx.shape[0]
+    order = jnp.argsort(idx)
+    sidx = idx[order]
+    is_start = jnp.concatenate(
+        [jnp.ones((1,), jnp.bool_), sidx[1:] != sidx[:-1]])
+    seg = jnp.cumsum(is_start.astype(jnp.int32)) - 1
+    unique_idx = jnp.zeros((n,), idx.dtype).at[seg].max(sidx)
+    inverse = jnp.zeros((n,), jnp.int32).at[order].set(seg)
+    return unique_idx, inverse

@@ -1,0 +1,245 @@
+"""Host-tier embedding store.
+
+This is the persistence/capacity tier of the embedding engine — the role of
+BoxPS's SSD + host-memory tiers behind ``PullSparseGPU``/``PushSparseGPU``
+and of ``SaveBase``/``SaveDelta``/``LoadSSD2Mem``/``ShrinkTable``
+(box_wrapper.h:487-494, box_wrapper.cc:1387-1420). HBM only ever holds a
+pass's *working set* (see working_set.py); between passes rows live here.
+
+Implementation: open-addressed via a python dict key→row index over one
+growing float32 rows array. Checkpointing is numpy-native:
+
+- ``save_base``  — full snapshot (keys + rows + config meta), the "batch
+  model"; also the serving "xbox" format in the reference — here one format
+  serves both.
+- ``save_delta`` — only rows dirtied since the last save, the incremental
+  online-serving delta.
+- ``load``       — base + ordered deltas.
+- ``shrink``     — drop cold rows by show-count threshold with decay
+  (ShrinkTable semantics).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+import threading
+
+import numpy as np
+
+from paddlebox_tpu.embedding.config import EmbeddingConfig
+
+
+class HostEmbeddingStore:
+    _GROW = 1.5
+
+    def __init__(self, cfg: EmbeddingConfig, initial_capacity: int = 1024):
+        self.cfg = cfg
+        self._index: dict[int, int] = {}
+        self._keys = np.zeros(initial_capacity, dtype=np.uint64)
+        self._rows = np.zeros((initial_capacity, cfg.row_width), dtype=np.float32)
+        self._n = 0
+        self._dirty: set[int] = set()
+        self._tombstones: set[int] = set()  # evicted since last save
+        self._lock = threading.Lock()
+        self._save_seq = 0
+
+    def __len__(self) -> int:
+        return self._n
+
+    # ---- row init (deterministic per key, reproducible across hosts) ----
+
+    def _init_rows(self, keys: np.ndarray) -> np.ndarray:
+        cfg = self.cfg
+        n = len(keys)
+        rows = np.zeros((n, cfg.row_width), dtype=np.float32)
+        if cfg.dim:
+            # hash-based uniform init in [-initial_range, initial_range):
+            # same key → same init on every host, no RNG state to sync.
+            k = keys.astype(np.uint64)[:, None]
+            j = np.arange(cfg.dim, dtype=np.uint64)[None, :]
+            with np.errstate(over="ignore"):
+                z = (k * np.uint64(0x9E3779B97F4A7C15)
+                     + (j + np.uint64(cfg.seed)) * np.uint64(0xBF58476D1CE4E5B9))
+                z ^= z >> np.uint64(30)
+                z *= np.uint64(0x94D049BB133111EB)
+                z ^= z >> np.uint64(27)
+            u = (z >> np.uint64(11)).astype(np.float64) / float(1 << 53)
+            rows[:, cfg.embedx_cols] = ((2.0 * u - 1.0)
+                                        * cfg.initial_range).astype(np.float32)
+        return rows
+
+    # ---- pull/push at pass granularity ----
+
+    def lookup_or_init(self, keys: np.ndarray) -> np.ndarray:
+        """Fetch rows for `keys`, creating fresh rows for unseen keys.
+
+        Called by the pass builder (BeginFeedPass equivalent) — not per batch.
+        """
+        keys = np.asarray(keys).astype(np.uint64)
+        with self._lock:
+            idx = np.empty(len(keys), dtype=np.int64)
+            missing: list[int] = []          # first occurrence of each new key
+            pending: dict[int, int] = {}     # new key -> provisional row index
+            for i, k in enumerate(keys.tolist()):
+                j = self._index.get(k, -1)
+                if j < 0:
+                    j = pending.get(k, -1)
+                    if j < 0:
+                        j = self._n + len(missing)
+                        pending[k] = j
+                        missing.append(i)
+                idx[i] = j
+            if missing:
+                new_keys = keys[missing]
+                self._reserve(self._n + len(missing))
+                init = self._init_rows(new_keys)
+                for off, i in enumerate(missing):
+                    j = self._n + off
+                    self._index[int(new_keys[off])] = j
+                    self._keys[j] = new_keys[off]
+                self._rows[self._n:self._n + len(missing)] = init
+                self._n += len(missing)
+            return self._rows[idx].copy()
+
+    def write_back(self, keys: np.ndarray, rows: np.ndarray) -> None:
+        """Persist updated rows after a pass (EndPass equivalent)."""
+        keys = np.asarray(keys).astype(np.uint64)
+        with self._lock:
+            idx = np.fromiter((self._index[int(k)] for k in keys),
+                              dtype=np.int64, count=len(keys))
+            self._rows[idx] = rows
+            self._dirty.update(int(k) for k in keys)
+
+    def get_rows(self, keys: np.ndarray) -> np.ndarray:
+        keys = np.asarray(keys).astype(np.uint64)
+        with self._lock:
+            idx = np.fromiter((self._index[int(k)] for k in keys),
+                              dtype=np.int64, count=len(keys))
+            return self._rows[idx].copy()
+
+    def _reserve(self, need: int) -> None:
+        cap = len(self._keys)
+        if need <= cap:
+            return
+        new_cap = max(need, int(cap * self._GROW))
+        self._keys = np.resize(self._keys, new_cap)
+        rows = np.zeros((new_cap, self.cfg.row_width), dtype=np.float32)
+        rows[:self._n] = self._rows[:self._n]
+        self._rows = rows
+
+    # ---- hygiene (ShrinkTable, box_wrapper.h:492) ----
+
+    def shrink(self, min_show: float, decay: float = 1.0) -> int:
+        """Decay show counters and evict rows below `min_show`.
+
+        Returns the number of evicted rows.
+        """
+        with self._lock:
+            if decay != 1.0:
+                self._rows[:self._n, 0] *= decay
+                # decayed counters must reach the next delta checkpoint
+                self._dirty.update(int(k) for k in
+                                   self._keys[:self._n].tolist())
+            keep = self._rows[:self._n, 0] >= min_show
+            evicted = int((~keep).sum())
+            if evicted:
+                gone = self._keys[:self._n][~keep]
+                kept_keys = self._keys[:self._n][keep]
+                kept_rows = self._rows[:self._n][keep]
+                self._index = {int(k): i for i, k in enumerate(kept_keys.tolist())}
+                self._n = len(kept_keys)
+                self._keys[:self._n] = kept_keys
+                self._rows[:self._n] = kept_rows
+                self._dirty.intersection_update(self._index.keys())
+                # tombstone evictions so load(base + deltas) does not
+                # resurrect them
+                self._tombstones.update(int(k) for k in gone.tolist())
+            return evicted
+
+    # ---- checkpoint (SaveBase/SaveDelta/Load, box_wrapper.cc:1387-1420) ----
+
+    def save_base(self, path: str) -> str:
+        os.makedirs(path, exist_ok=True)
+        with self._lock:
+            fname = os.path.join(path, "base.npz")
+            np.savez_compressed(fname, keys=self._keys[:self._n],
+                                rows=self._rows[:self._n])
+            self._write_meta(path)
+            self._dirty.clear()
+            self._tombstones.clear()
+            self._save_seq = 0
+        return fname
+
+    def save_delta(self, path: str) -> str:
+        os.makedirs(path, exist_ok=True)
+        with self._lock:
+            self._save_seq += 1
+            keys = np.fromiter(self._dirty, dtype=np.uint64,
+                               count=len(self._dirty))
+            idx = np.fromiter((self._index[int(k)] for k in keys),
+                              dtype=np.int64, count=len(keys))
+            fname = os.path.join(path, f"delta-{self._save_seq:05d}.npz")
+            removed = np.fromiter(self._tombstones, dtype=np.uint64,
+                                  count=len(self._tombstones))
+            np.savez_compressed(fname, keys=keys, rows=self._rows[idx],
+                                removed=removed)
+            self._write_meta(path)
+            self._dirty.clear()
+            self._tombstones.clear()
+        return fname
+
+    def _write_meta(self, path: str) -> None:
+        meta = dataclasses.asdict(self.cfg)
+        meta["save_seq"] = self._save_seq
+        meta["num_keys"] = self._n
+        with open(os.path.join(path, "meta.json"), "w") as f:
+            json.dump(meta, f, indent=1)
+
+    @classmethod
+    def load(cls, path: str, cfg: EmbeddingConfig | None = None
+             ) -> "HostEmbeddingStore":
+        with open(os.path.join(path, "meta.json")) as f:
+            meta = json.load(f)
+        if cfg is None:
+            fields = {f.name for f in dataclasses.fields(EmbeddingConfig)}
+            cfg = EmbeddingConfig(**{k: v for k, v in meta.items()
+                                     if k in fields})
+        store = cls(cfg)
+        base = np.load(os.path.join(path, "base.npz"))
+        store._ingest(base["keys"], base["rows"])
+        deltas = sorted(f for f in os.listdir(path) if f.startswith("delta-"))
+        for d in deltas[:meta["save_seq"]]:
+            z = np.load(os.path.join(path, d))
+            store._ingest(z["keys"], z["rows"])
+            if "removed" in z and len(z["removed"]):
+                store._remove(z["removed"])
+        store._save_seq = meta["save_seq"]
+        return store
+
+    def _remove(self, keys: np.ndarray) -> None:
+        with self._lock:
+            gone = {int(k) for k in keys.tolist() if int(k) in self._index}
+            if not gone:
+                return
+            keep = np.array([int(k) not in gone
+                             for k in self._keys[:self._n].tolist()])
+            kept_keys = self._keys[:self._n][keep]
+            kept_rows = self._rows[:self._n][keep]
+            self._index = {int(k): i for i, k in enumerate(kept_keys.tolist())}
+            self._n = len(kept_keys)
+            self._keys[:self._n] = kept_keys
+            self._rows[:self._n] = kept_rows
+
+    def _ingest(self, keys: np.ndarray, rows: np.ndarray) -> None:
+        with self._lock:
+            for k, r in zip(keys.tolist(), rows):
+                j = self._index.get(k, -1)
+                if j < 0:
+                    self._reserve(self._n + 1)
+                    j = self._n
+                    self._index[k] = j
+                    self._keys[j] = k
+                    self._n += 1
+                self._rows[j] = r

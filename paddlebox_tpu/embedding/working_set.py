@@ -1,0 +1,104 @@
+"""Per-pass embedding working set.
+
+The load-bearing trick of BoxPS (SURVEY.md §2.3 "Sparse model parallelism"):
+HBM never holds the whole 10^10-key table — only the keys seen in the current
+pass. ``BeginFeedPass``/``EndFeedPass`` build the pass's working set from SSD
+into GPU HBM; ``EndPass`` applies/persists it (box_wrapper.h:419-424).
+
+TPU equivalent:
+
+- ``PassWorkingSet.begin_pass(store, keys, mesh)`` — dedup the pass's keys,
+  assign dense indices 1..K (0 = null/padding row), fetch rows from the host
+  store, lay them out as one (N_pad, row_width) float32 array sharded
+  contiguously over the mesh (row i lives on shard i // rows_per_shard).
+- ``translate(ids, mask)`` — vectorized uint64 key → int32 index translation
+  (np.searchsorted over the sorted key array); this runs in the host data
+  pipeline so jit only ever sees dense int32 indices.
+- ``end_pass(store, table)`` — pull the table back and write rows into the
+  host store (the EndPass persist).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from paddlebox_tpu.embedding.config import EmbeddingConfig
+from paddlebox_tpu.embedding.store import HostEmbeddingStore
+from paddlebox_tpu.parallel import mesh as mesh_lib
+
+
+class PassWorkingSet:
+    def __init__(self, cfg: EmbeddingConfig, sorted_keys: np.ndarray,
+                 table: jax.Array, rows_per_shard: int, n_shards: int):
+        self.cfg = cfg
+        self.sorted_keys = sorted_keys      # uint64 (K,), ascending
+        self.table = table                  # (N_pad, row_width) sharded
+        self.rows_per_shard = rows_per_shard
+        self.n_shards = n_shards
+
+    @property
+    def num_keys(self) -> int:
+        return len(self.sorted_keys)
+
+    @property
+    def padded_rows(self) -> int:
+        return self.rows_per_shard * self.n_shards
+
+    # ---- lifecycle ----
+
+    @classmethod
+    def begin_pass(cls, store: HostEmbeddingStore, keys: np.ndarray,
+                   mesh: jax.sharding.Mesh | None = None,
+                   min_rows_per_shard: int = 8) -> "PassWorkingSet":
+        """Build the pass working set on device (BeginFeedPass/EndFeedPass)."""
+        cfg = store.cfg
+        keys = np.unique(np.asarray(keys).astype(np.uint64))
+        rows = store.lookup_or_init(keys)
+        n_shards = mesh_lib.num_shards(mesh) if mesh is not None else 1
+        need = len(keys) + 1                       # +1 for the null row
+        rps = max(min_rows_per_shard, -(-need // n_shards))
+        n_pad = rps * n_shards
+        host_table = np.zeros((n_pad, cfg.row_width), dtype=np.float32)
+        host_table[1:1 + len(keys)] = rows
+        if mesh is not None:
+            sharding = mesh_lib.table_sharding(mesh)
+            table = jax.device_put(host_table, sharding)
+        else:
+            table = jnp.asarray(host_table)
+        return cls(cfg, keys, table, rps, n_shards)
+
+    def translate(self, ids: np.ndarray, mask: np.ndarray | None = None
+                  ) -> np.ndarray:
+        """uint64 feature signs → dense int32 working-set indices.
+
+        Unknown keys (not in this pass) and masked positions map to the null
+        index 0. Vectorized host-side; this is the key→index hop that keeps
+        64-bit keys out of jit entirely.
+        """
+        ids_arr = np.asarray(ids)
+        if len(self.sorted_keys) == 0:
+            idx = np.zeros(ids_arr.shape, dtype=np.int32)
+            return idx
+        flat = ids_arr.astype(np.uint64).reshape(-1)
+        pos = np.searchsorted(self.sorted_keys, flat)
+        pos_c = np.minimum(pos, len(self.sorted_keys) - 1)
+        hit = self.sorted_keys[pos_c] == flat
+        idx = np.where(hit, pos_c + 1, 0).astype(np.int32)
+        idx = idx.reshape(ids_arr.shape)
+        if mask is not None:
+            idx = np.where(mask, idx, 0).astype(np.int32)
+        return idx
+
+    def end_pass(self, store: HostEmbeddingStore,
+                 table: jax.Array | None = None) -> None:
+        """Persist the (possibly updated) device table back to the host store."""
+        t = table if table is not None else self.table
+        host = np.asarray(jax.device_get(t))
+        store.write_back(self.sorted_keys, host[1:1 + self.num_keys])
+
+    # convenience for single-host training loops
+    def update_table(self, table: jax.Array) -> None:
+        self.table = table

@@ -1,0 +1,2 @@
+from paddlebox_tpu.parallel.mesh import (make_mesh, table_sharding,  # noqa: F401
+                                         batch_sharding, replicated_sharding)

@@ -18,4 +18,10 @@ if "xla_force_host_platform_device_count" not in _flags:
 # translates them to dense int32 indices before anything reaches jit
 # (SURVEY.md §7 design stance).
 
-import jax  # noqa: E402,F401
+import jax  # noqa: E402
+
+# The environment's sitecustomize force-registers the axon TPU plugin and
+# overwrites JAX_PLATFORMS; config.update after import wins over it.
+jax.config.update("jax_platforms", "cpu")
+assert len(jax.devices()) >= 8, (
+    "tests expect >=8 virtual CPU devices; XLA_FLAGS not applied?")

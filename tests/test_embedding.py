@@ -1,0 +1,375 @@
+"""Embedding engine: config, optimizers, host store, working set, sharded ops."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from paddlebox_tpu.embedding import (EmbeddingConfig, HostEmbeddingStore,
+                                     PassWorkingSet, sharded)
+from paddlebox_tpu.embedding.optim import apply_updates
+from paddlebox_tpu.parallel import make_mesh, mesh
+
+
+def cfg_small(**kw):
+    kw.setdefault("dim", 4)
+    kw.setdefault("optimizer", "adagrad")
+    kw.setdefault("learning_rate", 0.1)
+    return EmbeddingConfig(**kw)
+
+
+# ---------------- config ----------------
+
+def test_row_geometry():
+    c = cfg_small()
+    assert c.pull_width == 7      # show, clk, w, 4x embedx
+    assert c.grad_width == 5
+    assert c.row_width == 9       # + w_g2sum, x_g2sum
+
+
+def test_bad_optimizer_rejected():
+    with pytest.raises(ValueError):
+        EmbeddingConfig(optimizer="adamw")
+
+
+# ---------------- optimizers ----------------
+
+def np_adagrad_reference(row, g, show_inc, clk_inc, c):
+    d = c.dim
+    out = row.copy()
+    out[0] += show_inc
+    out[1] += clk_inc
+    wg2 = row[3 + d] + g[0] ** 2
+    gx = g[1:]
+    xg2 = row[4 + d] + np.mean(gx ** 2)
+    out[2] = row[2] - c.learning_rate * np.sqrt(
+        c.initial_g2sum / (c.initial_g2sum + wg2)) * g[0]
+    out[3:3 + d] = row[3:3 + d] - c.learning_rate * np.sqrt(
+        c.initial_g2sum / (c.initial_g2sum + xg2)) * gx
+    out[3 + d], out[4 + d] = wg2, xg2
+    return out
+
+
+def test_adagrad_matches_numpy():
+    c = cfg_small()
+    rng = np.random.default_rng(0)
+    rows = rng.normal(size=(6, c.row_width)).astype(np.float32)
+    rows[:, 3 + c.dim:] = np.abs(rows[:, 3 + c.dim:])  # g2sum >= 0
+    grads = rng.normal(size=(6, c.grad_width)).astype(np.float32)
+    shows = rng.integers(0, 3, 6).astype(np.float32)
+    clks = rng.integers(0, 2, 6).astype(np.float32)
+    got = np.asarray(apply_updates(jnp.asarray(rows), jnp.asarray(grads),
+                                   jnp.asarray(shows), jnp.asarray(clks), c))
+    want = np.stack([np_adagrad_reference(rows[i], grads[i], shows[i],
+                                          clks[i], c) for i in range(6)])
+    np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-6)
+
+
+@pytest.mark.parametrize("opt", ["sgd", "adagrad", "adam", "ftrl"])
+def test_all_optimizers_zero_grad_preserves_fresh_rows(opt):
+    # Zero grad on a *fresh* row (zero counters/optimizer state) must be a
+    # no-op — this is what keeps null/padding rows at zero forever. (With
+    # arbitrary state adam/ftrl legitimately move: momentum decay, proximal
+    # w-from-z.)
+    c = cfg_small(optimizer=opt)
+    rng = np.random.default_rng(1)
+    rows = np.zeros((4, c.row_width), dtype=np.float32)
+    rows[:, c.embedx_cols] = rng.normal(size=(4, c.dim))
+    if opt in ("adam", "adagrad", "sgd"):
+        rows[:, 2] = rng.normal(size=4)  # ftrl's w is derived from z state
+    zeros_g = jnp.zeros((4, c.grad_width))
+    z = jnp.zeros((4,))
+    out = np.asarray(apply_updates(jnp.asarray(rows), zeros_g, z, z, c))
+    np.testing.assert_allclose(out[:, :3 + c.dim], rows[:, :3 + c.dim],
+                               rtol=1e-5, atol=1e-6)
+
+
+@pytest.mark.parametrize("opt", ["sgd", "adagrad", "adam", "ftrl"])
+def test_all_optimizers_reduce_loss_direction(opt):
+    # One update with grad g must move <params, g> down (descent direction).
+    c = cfg_small(optimizer=opt, learning_rate=0.1)
+    rng = np.random.default_rng(2)
+    rows = np.zeros((8, c.row_width), dtype=np.float32)
+    rows[:, 2] = rng.normal(size=8)
+    rows[:, c.embedx_cols] = rng.normal(size=(8, c.dim))
+    g = rng.normal(size=(8, c.grad_width)).astype(np.float32)
+    out = np.asarray(apply_updates(jnp.asarray(rows), jnp.asarray(g),
+                                   jnp.zeros(8), jnp.zeros(8), c))
+    delta = out[:, 2:3 + c.dim] - rows[:, 2:3 + c.dim]
+    if opt == "ftrl":
+        delta = delta[:, 1:]  # w jumps to the proximal point on first step
+        g = g[:, 1:]
+    assert float(np.sum(delta * g)) < 0.0
+
+
+def test_sgd_direction():
+    c = cfg_small(optimizer="sgd", learning_rate=1.0)
+    rows = jnp.zeros((1, c.row_width))
+    grads = jnp.ones((1, c.grad_width))
+    out = apply_updates(rows, grads, jnp.zeros(1), jnp.zeros(1), c)
+    np.testing.assert_allclose(out[0, 2:3 + c.dim], -1.0)
+
+
+# ---------------- host store ----------------
+
+def test_store_init_deterministic():
+    c = cfg_small()
+    s1, s2 = HostEmbeddingStore(c), HostEmbeddingStore(c)
+    keys = np.array([5, 9, 12345678901234], dtype=np.uint64)
+    r1, r2 = s1.lookup_or_init(keys), s2.lookup_or_init(keys)
+    np.testing.assert_array_equal(r1, r2)
+    assert np.all(np.abs(r1[:, c.embedx_cols]) <= c.initial_range)
+    assert np.any(r1[:, c.embedx_cols] != 0)
+    # counters and optimizer state start at zero
+    np.testing.assert_array_equal(r1[:, :3], 0)
+
+
+def test_store_write_back_and_growth():
+    c = cfg_small()
+    s = HostEmbeddingStore(c, initial_capacity=2)
+    keys = np.arange(100, dtype=np.uint64)
+    rows = s.lookup_or_init(keys)
+    assert len(s) == 100
+    rows[:, 2] = 7.0
+    s.write_back(keys, rows)
+    np.testing.assert_allclose(s.get_rows(keys)[:, 2], 7.0)
+    # same keys again: no new rows
+    s.lookup_or_init(keys[:10])
+    assert len(s) == 100
+
+
+def test_store_save_load_roundtrip(tmp_path):
+    c = cfg_small()
+    s = HostEmbeddingStore(c)
+    keys = np.array([3, 1, 4, 1, 5], dtype=np.uint64)
+    s.lookup_or_init(keys)
+    s.save_base(str(tmp_path))
+    # mutate two keys, save delta
+    rows = s.get_rows(np.array([3, 4], dtype=np.uint64))
+    rows[:, 2] = 42.0
+    s.write_back(np.array([3, 4], dtype=np.uint64), rows)
+    s.save_delta(str(tmp_path))
+    s2 = HostEmbeddingStore.load(str(tmp_path))
+    assert len(s2) == len(s)
+    np.testing.assert_allclose(
+        s2.get_rows(np.array([3, 4], dtype=np.uint64))[:, 2], 42.0)
+    np.testing.assert_array_equal(
+        s2.get_rows(np.array([5], dtype=np.uint64)),
+        s.get_rows(np.array([5], dtype=np.uint64)))
+
+
+def test_store_shrink():
+    c = cfg_small()
+    s = HostEmbeddingStore(c)
+    keys = np.arange(10, dtype=np.uint64)
+    rows = s.lookup_or_init(keys)
+    rows[:5, 0] = 10.0   # hot
+    s.write_back(keys, rows)
+    evicted = s.shrink(min_show=1.0)
+    assert evicted == 5
+    assert len(s) == 5
+    np.testing.assert_allclose(s.get_rows(keys[:5])[:, 0], 10.0)
+
+
+# ---------------- working set ----------------
+
+def test_working_set_translate_and_roundtrip():
+    c = cfg_small()
+    store = HostEmbeddingStore(c)
+    keys = np.array([100, 7, 555, 31], dtype=np.uint64)
+    ws = PassWorkingSet.begin_pass(store, keys)
+    # translate known, unknown, masked
+    ids = np.array([[7, 555], [999, 100]], dtype=np.uint64)
+    mask = np.array([[True, True], [True, False]])
+    idx = ws.translate(ids, mask)
+    assert idx.dtype == np.int32
+    assert idx[0, 0] > 0 and idx[0, 1] > 0
+    assert idx[1, 0] == 0   # unknown key -> null
+    assert idx[1, 1] == 0   # masked -> null
+    # device table row for key 7 equals store row
+    np.testing.assert_allclose(
+        np.asarray(ws.table)[idx[0, 0]], store.get_rows([7])[0], rtol=1e-6)
+    # mutate device table, end_pass persists
+    t = ws.table.at[:, 2].set(3.5)
+    ws.end_pass(store, t)
+    np.testing.assert_allclose(store.get_rows(keys)[:, 2], 3.5)
+
+
+def test_working_set_null_row_zero():
+    c = cfg_small()
+    store = HostEmbeddingStore(c)
+    ws = PassWorkingSet.begin_pass(store, np.array([9], dtype=np.uint64))
+    np.testing.assert_array_equal(np.asarray(ws.table)[0], 0)
+
+
+# ---------------- sharded lookup/push (single shard) ----------------
+
+def test_lookup_null_and_values():
+    c = cfg_small()
+    store = HostEmbeddingStore(c)
+    keys = np.array([11, 22, 33], dtype=np.uint64)
+    ws = PassWorkingSet.begin_pass(store, keys)
+    idx = ws.translate(np.array([11, 22, 33, 0], dtype=np.uint64),
+                       np.array([True, True, True, False]))
+    out = np.asarray(sharded.lookup(ws.table, jnp.asarray(idx), c))
+    assert out.shape == (4, c.pull_width)
+    np.testing.assert_array_equal(out[3], 0)  # null -> zeros
+    np.testing.assert_allclose(out[0], store.get_rows([11])[0, :c.pull_width])
+
+
+def test_push_merges_duplicates():
+    c = cfg_small(optimizer="sgd", learning_rate=1.0)
+    store = HostEmbeddingStore(c)
+    keys = np.array([5, 6], dtype=np.uint64)
+    ws = PassWorkingSet.begin_pass(store, keys)
+    i5 = int(ws.translate(np.array([5], dtype=np.uint64))[0])
+    i6 = int(ws.translate(np.array([6], dtype=np.uint64))[0])
+    idx = jnp.asarray([i5, i5, i6, 0], dtype=jnp.int32)
+    grads = jnp.asarray([[1.0] * c.grad_width, [2.0] * c.grad_width,
+                         [4.0] * c.grad_width, [0.0] * c.grad_width])
+    shows = jnp.asarray([1.0, 1.0, 1.0, 0.0])
+    clks = jnp.asarray([1.0, 0.0, 0.0, 0.0])
+    before = np.asarray(ws.table).copy()
+    after = np.asarray(sharded.push(ws.table, idx, grads, shows, clks, c))
+    # key 5: merged grad 3.0 -> w -= 3; show += 2; clk += 1
+    np.testing.assert_allclose(after[i5, 2], before[i5, 2] - 3.0, rtol=1e-6)
+    np.testing.assert_allclose(after[i5, 0], 2.0)
+    np.testing.assert_allclose(after[i5, 1], 1.0)
+    np.testing.assert_allclose(after[i6, 2], before[i6, 2] - 4.0, rtol=1e-6)
+    np.testing.assert_array_equal(after[0], 0)  # null row untouched
+
+
+def test_dedup_tokens():
+    idx = jnp.asarray([7, 3, 7, 0, 3, 3], dtype=jnp.int32)
+    uniq, inv = sharded.dedup_tokens(idx)
+    out = np.asarray(uniq)[np.asarray(inv)]
+    np.testing.assert_array_equal(out, np.asarray(idx))
+
+
+# ---------------- routed (multi-shard) path ----------------
+
+@pytest.fixture(scope="module")
+def mesh8():
+    return make_mesh(8)
+
+
+def _build_ws(c, n_keys, mesh_):
+    store = HostEmbeddingStore(c)
+    keys = np.random.default_rng(7).choice(1 << 40, size=n_keys,
+                                           replace=False).astype(np.uint64)
+    ws = PassWorkingSet.begin_pass(store, keys, mesh_)
+    return store, ws
+
+
+def test_routed_lookup_matches_local(mesh8):
+    c = cfg_small()
+    store, ws = _build_ws(c, 100, mesh8)
+    rng = np.random.default_rng(3)
+    # 8 devices x 16 tokens each, with duplicates and nulls
+    idx_global = rng.integers(0, ws.num_keys + 1, size=(8, 16)).astype(np.int32)
+    flat = jnp.asarray(idx_global.reshape(-1))
+
+    def body(table_shard, idx_local):
+        # capacity_factor = n_shards guarantees losslessness (cap == n_local)
+        return sharded.routed_lookup(table_shard, idx_local, c, mesh.DP_AXIS,
+                                     capacity_factor=8.0)
+
+    out = jax.jit(jax.shard_map(
+        body, mesh=mesh8,
+        in_specs=(P(mesh.DP_AXIS), P(mesh.DP_AXIS)),
+        out_specs=P(mesh.DP_AXIS)))(ws.table, flat)
+    want = np.asarray(sharded.lookup(ws.table, flat, c))
+    np.testing.assert_allclose(np.asarray(out), want, rtol=1e-5, atol=1e-6)
+
+
+def test_routed_push_matches_local(mesh8):
+    c = cfg_small(optimizer="adagrad")
+    store, ws = _build_ws(c, 60, mesh8)
+    rng = np.random.default_rng(4)
+    n_tok = 8 * 12
+    idx = rng.integers(0, ws.num_keys + 1, size=n_tok).astype(np.int32)
+    grads = rng.normal(size=(n_tok, c.grad_width)).astype(np.float32)
+    shows = (idx > 0).astype(np.float32)
+    clks = rng.integers(0, 2, n_tok).astype(np.float32) * shows
+    # null tokens must carry zero grads
+    grads[idx == 0] = 0.0
+    jidx, jg = jnp.asarray(idx), jnp.asarray(grads)
+    js, jc = jnp.asarray(shows), jnp.asarray(clks)
+
+    def body(table_shard, i, g, s, k):
+        return sharded.routed_push(table_shard, i, g, s, k, c, mesh.DP_AXIS,
+                                   capacity_factor=8.0)
+
+    out = jax.jit(jax.shard_map(
+        body, mesh=mesh8,
+        in_specs=(P(mesh.DP_AXIS), P(mesh.DP_AXIS), P(mesh.DP_AXIS),
+                  P(mesh.DP_AXIS), P(mesh.DP_AXIS)),
+        out_specs=P(mesh.DP_AXIS)))(ws.table, jidx, jg, js, jc)
+    want = np.asarray(sharded.push(ws.table, jidx, jg, js, jc, c))
+    np.testing.assert_allclose(np.asarray(out), want, rtol=1e-4, atol=1e-5)
+
+
+def test_routed_push_adam_empty_lanes_no_corruption(mesh8):
+    # Regression: empty all-to-all lanes must not touch shard-local row 0
+    # (adam applies momentum decay even on zero grads).
+    c = cfg_small(optimizer="adam")
+    store, ws = _build_ws(c, 40, mesh8)
+    # tokens that never reference rows k*rows_per_shard
+    rps = ws.rows_per_shard
+    idx = np.array([i for i in range(1, rps * 8) if i % rps != 0][:32],
+                   dtype=np.int32)
+    assert len(idx) == 32
+    grads = np.zeros((32, c.grad_width), np.float32)
+    grads[:, 0] = 0.01
+    shows = np.ones(32, np.float32)
+    clks = np.zeros(32, np.float32)
+
+    def body(t, i, g, s, k):
+        return sharded.routed_push(t, i, g, s, k, c, mesh.DP_AXIS, 8.0)
+
+    out = jax.jit(jax.shard_map(
+        body, mesh=mesh8,
+        in_specs=(P(mesh.DP_AXIS),) * 5,
+        out_specs=P(mesh.DP_AXIS)))(
+            ws.table, jnp.asarray(idx), jnp.asarray(grads),
+            jnp.asarray(shows), jnp.asarray(clks))
+    want = np.asarray(sharded.push(ws.table, jnp.asarray(idx),
+                                   jnp.asarray(grads), jnp.asarray(shows),
+                                   jnp.asarray(clks), c))
+    np.testing.assert_allclose(np.asarray(out), want, rtol=1e-5, atol=1e-6)
+
+
+def test_store_shrink_survives_delta_checkpoint(tmp_path):
+    # Regression: evictions + decay must reach load(base + deltas).
+    c = cfg_small()
+    s = HostEmbeddingStore(c)
+    keys = np.arange(1, 11, dtype=np.uint64)
+    rows = s.lookup_or_init(keys)
+    rows[:, 0] = 10.0
+    rows[5:, 0] = 0.5
+    s.write_back(keys, rows)
+    s.save_base(str(tmp_path))
+    s.shrink(min_show=1.0, decay=0.5)   # evicts the 5 cold keys, decays hot
+    s.save_delta(str(tmp_path))
+    s2 = HostEmbeddingStore.load(str(tmp_path))
+    assert len(s2) == len(s) == 5
+    np.testing.assert_allclose(s2.get_rows(keys[:5])[:, 0], 5.0)
+
+
+def test_translate_empty_working_set():
+    c = cfg_small()
+    store = HostEmbeddingStore(c)
+    ws = PassWorkingSet.begin_pass(store, np.array([], dtype=np.uint64))
+    idx = ws.translate(np.array([5, 6], dtype=np.uint64))
+    np.testing.assert_array_equal(idx, 0)
+
+
+def test_routed_dropped_counts():
+    idx = jnp.asarray([0, 0, 0, 0, 8, 9], dtype=jnp.int32)
+    # 2 shards of 8 rows, capacity factor 1.0 -> cap=3 per dest; 4 tokens to
+    # shard 0 -> 1 dropped
+    n = sharded.routed_dropped(idx, rows_per_shard=8, n_shards=2,
+                               capacity_factor=1.0)
+    assert int(n) == 1
