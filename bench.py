@@ -1,0 +1,109 @@
+"""Benchmark: DeepFM training throughput on the available chip(s).
+
+Prints ONE JSON line:
+  {"metric": ..., "value": N, "unit": "examples/sec/chip", "vs_baseline": N}
+
+vs_baseline is measured against the north-star target of 1M examples/sec/chip
+(BASELINE.md; the reference publishes no numbers of its own). The measured
+path is the full jitted train step: routed embedding lookup (all_to_all on
+multi-chip meshes, direct gather on one), DeepFM forward/backward, dense-grad
+pmean, sparse push with in-table adagrad, exactly as `Trainer` runs it.
+Host-side batch translate is pre-staged (the reference's log_for_profile
+likewise separates read/trans from cal time; boxps_worker.cc:746-759).
+"""
+
+from __future__ import annotations
+
+import json
+import time
+
+import numpy as np
+
+TARGET_PER_CHIP = 1_000_000.0  # BASELINE.md north star
+
+
+def main() -> None:
+    import os
+
+    import jax
+
+    small = os.environ.get("PBTPU_BENCH_SMALL") == "1"  # CPU smoke mode
+    if small:
+        jax.config.update("jax_platforms", "cpu")
+    devices = jax.devices()
+    n_dev = len(devices)
+
+    from paddlebox_tpu.data import DataFeedSchema
+    from paddlebox_tpu.embedding import (EmbeddingConfig, HostEmbeddingStore,
+                                         PassWorkingSet)
+    from paddlebox_tpu.models import DeepFMModel
+    from paddlebox_tpu.parallel import make_mesh, mesh as mesh_lib
+    from paddlebox_tpu.train import Trainer, TrainerConfig
+
+    # Criteo-like geometry: 26 categorical slots (L=1) + 13 dense floats
+    num_slots, emb_dim = 26, 8
+    batch = (256 if small else 8192) * n_dev
+    schema = DataFeedSchema.ctr(num_sparse=num_slots, num_float=13,
+                                batch_size=batch, max_len=1)
+    emb_cfg = EmbeddingConfig(dim=emb_dim, optimizer="adagrad",
+                              learning_rate=0.05)
+    store = HostEmbeddingStore(emb_cfg)
+    mesh = make_mesh(n_dev)
+    model = DeepFMModel(num_slots=num_slots, emb_dim=emb_dim, dense_dim=13,
+                        hidden=(400, 400, 400))
+    tr = Trainer(model, store, schema, mesh,
+                 TrainerConfig(global_batch_size=batch, auc_buckets=1 << 16))
+
+    rng = np.random.default_rng(0)
+    n_keys = 1 << (14 if small else 20)
+    keys = rng.choice(1 << 50, n_keys, replace=False).astype(np.uint64)
+    ws = PassWorkingSet.begin_pass(store, keys, mesh)
+    T = tr.layout.total_len
+    sh = mesh_lib.batch_sharding(mesh)
+
+    # pre-staged batches (device-path throughput)
+    n_staged = 8
+    staged = []
+    for _ in range(n_staged):
+        raw = rng.choice(keys, size=(batch, T))
+        mask = np.ones((batch, T), dtype=bool)
+        idx = ws.translate(raw, mask)
+        dense = rng.normal(size=(batch, 13)).astype(np.float32)
+        labels = (rng.random(batch) < 0.25).astype(np.float32)
+        staged.append(tuple(jax.device_put(a, sh) for a in
+                            (idx, mask, dense, labels)))
+
+    table, params, opt = ws.table, tr.params, tr.opt_state
+    # warmup/compile
+    table, params, opt, loss, preds = tr._step_fn(table, params, opt,
+                                                  *staged[0])
+    jax.block_until_ready(loss)
+
+    n_steps = 5 if small else 30
+    t0 = time.perf_counter()
+    for i in range(n_steps):
+        table, params, opt, loss, preds = tr._step_fn(
+            table, params, opt, *staged[i % n_staged])
+    jax.block_until_ready(loss)
+    dt = time.perf_counter() - t0
+
+    eps = n_steps * batch / dt
+    eps_chip = eps / n_dev
+    print(json.dumps({
+        "metric": "deepfm_train_examples_per_sec_per_chip",
+        "value": round(eps_chip, 1),
+        "unit": "examples/sec/chip",
+        "vs_baseline": round(eps_chip / TARGET_PER_CHIP, 4),
+        "detail": {
+            "devices": n_dev,
+            "global_batch": batch,
+            "steps": n_steps,
+            "seconds": round(dt, 3),
+            "working_set_keys": n_keys,
+            "loss_final": float(loss),
+        },
+    }))
+
+
+if __name__ == "__main__":
+    main()

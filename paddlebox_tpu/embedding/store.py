@@ -112,6 +112,19 @@ class HostEmbeddingStore:
             self._rows[idx] = rows
             self._dirty.update(int(k) for k in keys)
 
+    def peek_rows(self, keys: np.ndarray) -> np.ndarray:
+        """Fetch rows without creating missing ones (test/eval mode —
+        SetTestMode semantics). Unseen keys get their deterministic init row
+        but are NOT inserted, so eval passes never grow the store."""
+        keys = np.asarray(keys).astype(np.uint64)
+        rows = self._init_rows(keys)
+        with self._lock:
+            for i, k in enumerate(keys.tolist()):
+                j = self._index.get(k, -1)
+                if j >= 0:
+                    rows[i] = self._rows[j]
+        return rows
+
     def get_rows(self, keys: np.ndarray) -> np.ndarray:
         keys = np.asarray(keys).astype(np.uint64)
         with self._lock:

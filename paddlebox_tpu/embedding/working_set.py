@@ -52,11 +52,17 @@ class PassWorkingSet:
     @classmethod
     def begin_pass(cls, store: HostEmbeddingStore, keys: np.ndarray,
                    mesh: jax.sharding.Mesh | None = None,
-                   min_rows_per_shard: int = 8) -> "PassWorkingSet":
-        """Build the pass working set on device (BeginFeedPass/EndFeedPass)."""
+                   min_rows_per_shard: int = 8,
+                   test_mode: bool = False) -> "PassWorkingSet":
+        """Build the pass working set on device (BeginFeedPass/EndFeedPass).
+
+        test_mode=True reads rows without inserting unseen keys into the
+        store (eval passes must not grow or dirty it).
+        """
         cfg = store.cfg
         keys = np.unique(np.asarray(keys).astype(np.uint64))
-        rows = store.lookup_or_init(keys)
+        rows = (store.peek_rows(keys) if test_mode
+                else store.lookup_or_init(keys))
         n_shards = mesh_lib.num_shards(mesh) if mesh is not None else 1
         need = len(keys) + 1                       # +1 for the null row
         rps = max(min_rows_per_shard, -(-need // n_shards))

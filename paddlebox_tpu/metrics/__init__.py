@@ -1,0 +1,3 @@
+from paddlebox_tpu.metrics.auc import (AucState, auc_update, auc_compute,  # noqa: F401
+                                       merge_states, psum_state, new_state)
+from paddlebox_tpu.metrics.metric import MetricRegistry, parse_cmatch_rank  # noqa: F401

@@ -1,0 +1,136 @@
+"""Metric registry — the MetricMsg family + init_metric/get_metric_msg API.
+
+Reference (box_wrapper.h:281-361, 630-683; pybind box_helper_py.cc:87-95):
+metrics are registered by name with a method selector —
+
+- plain AUC over (label, pred),
+- **cmatch-rank**: only examples whose (cmatch, rank) pair is in a
+  configured list (parse_cmatch_rank box_wrapper.h:349; string format
+  "cmatch:rank,cmatch:rank,..." or bare "cmatch,cmatch"),
+- **mask**: only examples where an explicit mask var equals 1,
+- **sample-scale**: per-example weight multiplier,
+- multi-task variants combine the above.
+
+Each metric owns an AucState; `add_data` is called per batch (the
+AddAucMonitor hook, boxps_worker.cc:530) and `get_metric_msg` runs the
+host-side compute (box_wrapper.cc:1254).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any
+
+import jax.numpy as jnp
+import numpy as np
+
+from paddlebox_tpu.metrics import auc as auc_lib
+
+
+def parse_cmatch_rank(spec: str) -> list[tuple[int, int]]:
+    """"223:0,224:1" → [(223,0),(224,1)]; bare "223,224" → rank wildcard -1."""
+    out: list[tuple[int, int]] = []
+    for part in spec.split(","):
+        part = part.strip()
+        if not part:
+            continue
+        if ":" in part:
+            c, r = part.split(":")
+            out.append((int(c), int(r)))
+        else:
+            out.append((int(part), -1))
+    return out
+
+
+@dataclasses.dataclass
+class _Metric:
+    name: str
+    method: str                       # plain | cmatch_rank | mask | sample_scale
+    label_var: str = "label"
+    pred_var: str = "pred"
+    cmatch_rank: list[tuple[int, int]] = dataclasses.field(default_factory=list)
+    mask_var: str = ""
+    scale_var: str = ""
+    n_buckets: int = auc_lib.DEFAULT_BUCKETS
+    state: Any = None
+
+    def __post_init__(self):
+        if self.state is None:
+            self.state = auc_lib.new_state(self.n_buckets)
+
+
+class MetricRegistry:
+    """init_metric/get_metric_msg/flip_phase surface (box_helper_py.cc:87-110).
+
+    Phases mirror the join/update flip: metrics registered for a phase only
+    accumulate while that phase is current (FlipPhase, box_wrapper.h:625).
+    """
+
+    def __init__(self):
+        self._metrics: dict[str, _Metric] = {}
+        self._phases: dict[str, int] = {}
+        self.phase = 1  # reference starts in join phase
+
+    def init_metric(self, name: str, method: str = "plain",
+                    label_var: str = "label", pred_var: str = "pred",
+                    cmatch_rank_spec: str = "", mask_var: str = "",
+                    scale_var: str = "", phase: int = -1,
+                    n_buckets: int = auc_lib.DEFAULT_BUCKETS) -> None:
+        self._metrics[name] = _Metric(
+            name=name, method=method, label_var=label_var, pred_var=pred_var,
+            cmatch_rank=parse_cmatch_rank(cmatch_rank_spec),
+            mask_var=mask_var, scale_var=scale_var, n_buckets=n_buckets)
+        self._phases[name] = phase
+
+    def flip_phase(self) -> None:
+        self.phase = 1 - self.phase
+
+    def names(self) -> list[str]:
+        return list(self._metrics)
+
+    def add_data(self, name: str, preds, labels, cmatch=None, rank=None,
+                 mask=None, sample_scale=None) -> None:
+        """Accumulate one batch into metric `name` (AddAucMonitor hook)."""
+        m = self._metrics[name]
+        ph = self._phases[name]
+        if ph >= 0 and ph != self.phase:
+            return
+        eff_mask = None
+        if m.method == "cmatch_rank":
+            if cmatch is None:
+                raise ValueError(f"metric {name} needs cmatch input")
+            cm = np.asarray(cmatch).reshape(-1)
+            rk = (np.asarray(rank).reshape(-1) if rank is not None
+                  else np.zeros_like(cm))
+            sel = np.zeros(cm.shape, dtype=bool)
+            for c, r in m.cmatch_rank:
+                sel |= (cm == c) if r < 0 else ((cm == c) & (rk == r))
+            eff_mask = jnp.asarray(sel)
+        elif m.method == "mask":
+            if mask is None:
+                raise ValueError(f"metric {name} needs mask input")
+            eff_mask = jnp.asarray(np.asarray(mask).reshape(-1) == 1)
+        scale = None
+        if m.method == "sample_scale" or m.scale_var:
+            if sample_scale is None:
+                raise ValueError(f"metric {name} needs sample_scale input")
+            scale = jnp.asarray(sample_scale)
+        m.state = auc_lib.auc_update(m.state, jnp.asarray(preds),
+                                     jnp.asarray(labels), mask=eff_mask,
+                                     sample_scale=scale)
+
+    def set_state(self, name: str, state) -> None:
+        """Install an externally-accumulated (e.g. in-jit) state."""
+        self._metrics[name].state = state
+
+    def get_state(self, name: str):
+        return self._metrics[name].state
+
+    def get_metric_msg(self, name: str) -> dict[str, float]:
+        return auc_lib.auc_compute(self._metrics[name].state)
+
+    def reset(self, name: str | None = None) -> None:
+        targets = [name] if name else list(self._metrics)
+        for t in targets:
+            m = self._metrics[t]
+            m.state = auc_lib.new_state(m.n_buckets)

@@ -1,0 +1,2 @@
+from paddlebox_tpu.models.dnn_ctr import DNNCTRModel  # noqa: F401
+from paddlebox_tpu.models.deepfm import DeepFMModel  # noqa: F401

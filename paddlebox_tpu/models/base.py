@@ -1,0 +1,30 @@
+"""Model interface.
+
+A CTR model consumes:
+- ``pulled``  (B, T, P) raw pull values for all sparse tokens (P = show, clk,
+  w, embedx — see embedding/config.py), with ``mask`` (B, T) and the static
+  SparseLayout, and
+- ``dense``   (B, F) float slot columns (label excluded),
+
+and produces logits (B,). Models own their dense parameters; the embedding
+table is the trainer's (it lives in the sharded working set). This mirrors
+the reference's split: pull_box_sparse feeds slot tensors into a
+fluid-layers graph while the table lives in BoxPS (SURVEY.md §3.2).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Protocol
+
+import jax.numpy as jnp
+import numpy as np
+
+
+class CTRModel(Protocol):
+    name: str
+
+    def init(self, key) -> Any: ...
+
+    def apply(self, params: Any, pulled: jnp.ndarray, mask: jnp.ndarray,
+              dense: jnp.ndarray, segment_ids: np.ndarray,
+              num_slots: int) -> jnp.ndarray: ...

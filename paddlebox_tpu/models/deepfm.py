@@ -1,0 +1,60 @@
+"""DeepFM — the flagship benchmark model (BASELINE.md north star).
+
+wide: per-feature scalar weight w summed per example (the embed_w column the
+reference dedicates to exactly this role);
+FM second order: 0.5 * ((Σ_s v_s)² - Σ_s v_s²) over slot embedding vectors
+(sum-square trick);
+deep: MLP over [CVM features, dense].
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from paddlebox_tpu.models.nn import mlp_apply, mlp_init
+from paddlebox_tpu.ops import fused_seqpool_cvm
+
+
+class DeepFMModel:
+    name = "deepfm"
+
+    def __init__(self, num_slots: int, emb_dim: int, dense_dim: int = 0,
+                 hidden: tuple[int, ...] = (400, 400, 400),
+                 use_cvm: bool = True, compute_dtype=jnp.float32):
+        self.num_slots = num_slots
+        self.emb_dim = emb_dim
+        self.dense_dim = dense_dim
+        self.use_cvm = use_cvm
+        self.compute_dtype = compute_dtype
+        slot_feat = (3 + emb_dim) if use_cvm else (1 + emb_dim)
+        self.deep_in = num_slots * slot_feat + dense_dim
+        self.dims = (self.deep_in, *hidden, 1)
+
+    def init(self, key):
+        k1, k2 = jax.random.split(key)
+        params = {"mlp": mlp_init(k1, self.dims),
+                  "bias": jnp.zeros((1,), jnp.float32)}
+        if self.dense_dim:
+            params["wide_dense"] = (
+                jax.random.normal(k2, (self.dense_dim,), jnp.float32) * 0.01)
+        return params
+
+    def apply(self, params, pulled, mask, dense, segment_ids, num_slots=None):
+        feats = fused_seqpool_cvm(pulled, mask, segment_ids, self.num_slots,
+                                  use_cvm=self.use_cvm, flatten=False)
+        # feats (B, S, slot_feat): [log show, log ctr, w, embedx] if cvm
+        off = 2 if self.use_cvm else 0
+        w = feats[..., off]                     # (B, S) summed scalar weights
+        v = feats[..., off + 1:]                # (B, S, emb_dim)
+        wide = jnp.sum(w, axis=1)
+        sum_v = jnp.sum(v, axis=1)
+        fm = 0.5 * jnp.sum(sum_v * sum_v - jnp.sum(v * v, axis=1), axis=1)
+        x = feats.reshape(feats.shape[0], -1)
+        if self.dense_dim:
+            x = jnp.concatenate([x, dense], axis=1)
+            wide = wide + dense @ params["wide_dense"]
+        deep = mlp_apply(params["mlp"], x,
+                         compute_dtype=self.compute_dtype)[:, 0]
+        return wide + fm + deep + params["bias"][0]

@@ -1,0 +1,44 @@
+"""DNN-CTR: the Criteo-Kaggle baseline tower.
+
+The reference's canonical slot-DNN (the model family behind
+ctr_dataset_reader.py / dist_fleet_ctr.py tests): per-slot embeddings are
+seqpool+CVM'd, concatenated with dense features, and fed through a ReLU MLP
+to a sigmoid CTR head.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from paddlebox_tpu.models.nn import mlp_apply, mlp_init
+from paddlebox_tpu.ops import fused_seqpool_cvm
+
+
+class DNNCTRModel:
+    name = "dnn_ctr"
+
+    def __init__(self, num_slots: int, emb_dim: int, dense_dim: int = 0,
+                 hidden: tuple[int, ...] = (512, 256, 128),
+                 use_cvm: bool = True, compute_dtype=jnp.float32):
+        self.num_slots = num_slots
+        self.emb_dim = emb_dim
+        self.dense_dim = dense_dim
+        self.use_cvm = use_cvm
+        self.compute_dtype = compute_dtype
+        slot_feat = (3 + emb_dim) if use_cvm else (1 + emb_dim)
+        self.in_dim = num_slots * slot_feat + dense_dim
+        self.dims = (self.in_dim, *hidden, 1)
+
+    def init(self, key):
+        return {"mlp": mlp_init(key, self.dims)}
+
+    def apply(self, params, pulled, mask, dense, segment_ids, num_slots=None):
+        feats = fused_seqpool_cvm(pulled, mask, segment_ids,
+                                  self.num_slots, use_cvm=self.use_cvm)
+        x = (jnp.concatenate([feats, dense], axis=1)
+             if self.dense_dim else feats)
+        logits = mlp_apply(params["mlp"], x,
+                           compute_dtype=self.compute_dtype)
+        return logits[:, 0]

@@ -1,0 +1,51 @@
+"""Minimal functional NN building blocks.
+
+The reference's dense towers are static-graph ``fluid.layers.fc`` stacks
+(python/paddle/fluid/layers); here parameters are plain pytrees (dicts of
+arrays) built/applied by pure functions — no module framework needed, and
+everything jits/shards transparently. bfloat16 compute is applied at the
+matmul boundary (MXU-friendly) while params stay float32.
+"""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+import jax
+import jax.numpy as jnp
+
+
+def dense_init(key, in_dim: int, out_dim: int, scale: str = "glorot"):
+    if scale == "glorot":
+        std = (2.0 / (in_dim + out_dim)) ** 0.5
+    else:
+        std = 0.01
+    w = jax.random.normal(key, (in_dim, out_dim), jnp.float32) * std
+    return {"w": w, "b": jnp.zeros((out_dim,), jnp.float32)}
+
+
+def dense_apply(p, x: jnp.ndarray, activation: str | None = None,
+                compute_dtype=jnp.float32) -> jnp.ndarray:
+    y = jnp.asarray(x, compute_dtype) @ jnp.asarray(p["w"], compute_dtype)
+    y = y.astype(jnp.float32) + p["b"]
+    if activation == "relu":
+        y = jnp.maximum(y, 0.0)
+    elif activation == "tanh":
+        y = jnp.tanh(y)
+    elif activation is not None:
+        raise ValueError(activation)
+    return y
+
+
+def mlp_init(key, dims: Sequence[int]):
+    keys = jax.random.split(key, len(dims) - 1)
+    return [dense_init(k, dims[i], dims[i + 1]) for i, k in enumerate(keys)]
+
+
+def mlp_apply(layers, x: jnp.ndarray, final_activation: str | None = None,
+              compute_dtype=jnp.float32) -> jnp.ndarray:
+    for i, p in enumerate(layers):
+        last = i == len(layers) - 1
+        act = final_activation if last else "relu"
+        x = dense_apply(p, x, activation=act, compute_dtype=compute_dtype)
+    return x

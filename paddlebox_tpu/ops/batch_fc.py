@@ -1,0 +1,25 @@
+"""Batch FC — per-group fully-connected layers in one op.
+
+Reference: ``batch_fc`` op (operators/batch_fc_op.cu): input
+(slot_pairs_num, ins_num, in_dim) runs `slot_pairs_num` independent FCs with
+weights (slot_pairs_num, in_dim, out_dim) and bias (slot_pairs_num, out_dim),
+optionally ReLU. Used for per-rank towers. One einsum on TPU — the MXU
+batches it natively.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+
+def batch_fc(x: jnp.ndarray, w: jnp.ndarray, b: jnp.ndarray | None = None,
+             activation: str | None = None) -> jnp.ndarray:
+    """x (G, N, I) @ w (G, I, O) [+ b (G, O)] → (G, N, O)."""
+    out = jnp.einsum("gni,gio->gno", x, w)
+    if b is not None:
+        out = out + b[:, None, :]
+    if activation == "relu":
+        out = jnp.maximum(out, 0.0)
+    elif activation is not None:
+        raise ValueError(f"unsupported activation {activation!r}")
+    return out

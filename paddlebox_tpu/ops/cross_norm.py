@@ -1,0 +1,87 @@
+"""Summary-statistics normalization ops: data_norm and cross_norm_hadamard.
+
+Reference data_norm (operators/data_norm_op.{cc,cu}): per-column running
+summary (batch_size, batch_sum, batch_square_sum);
+``mean = batch_sum / batch_size``, ``scale = sqrt(batch_size /
+batch_square_sum)``, ``out = (x - mean) * scale``. In multi-GPU training the
+summary deltas are c_allreduce'd before applying (data_norm_op.cu
+sync_stats; SURVEY.md §2.1 "CTR fused ops").
+
+Reference cross_norm_hadamard (operators/cross_norm_hadamard.cu.h:43-95):
+input is n field-pairs of embed_dim vectors (a_i, b_i) concatenated; per pair
+the op emits [norm(a), norm(b), norm(a⊙b), norm(<a,b>)] — 3*embed_dim+1
+columns — normalized with the same summary-stat scheme
+(kernel_mean_scale: cu.h:124-129).
+
+Both are pure functions over an explicit ``summary`` array (3, C):
+row 0 = count, row 1 = sum, row 2 = square_sum — the caller owns it as a
+model parameter (non-trainable, updated via `summary_update` and psum'd
+across data-parallel replicas like any other stat).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+
+def init_summary(num_cols: int, eps: float = 1e-4) -> jnp.ndarray:
+    """count=eps, sum=0, square_sum=eps: scale starts at 1, mean at 0."""
+    s = jnp.zeros((3, num_cols), jnp.float32)
+    s = s.at[0].set(eps)
+    s = s.at[2].set(eps)
+    return s
+
+
+def _mean_scale(summary: jnp.ndarray):
+    mean = summary[1] / summary[0]
+    scale = jnp.sqrt(summary[0] / summary[2])
+    return mean, scale
+
+
+def data_norm(x: jnp.ndarray, summary: jnp.ndarray) -> jnp.ndarray:
+    """x (B, C) normalized by running summary (3, C)."""
+    mean, scale = _mean_scale(summary)
+    return (x - mean) * scale
+
+
+def summary_update(summary: jnp.ndarray, x: jnp.ndarray,
+                   decay: float = 0.9999999) -> jnp.ndarray:
+    """Accumulate a batch into the summary with exponential decay
+    (summary_decay_rate attr, data_norm/cross_norm ops)."""
+    b = x.shape[0]
+    batch = jnp.stack([
+        jnp.full((x.shape[-1],), float(b), x.dtype),
+        x.sum(axis=0),
+        (x * x).sum(axis=0),
+    ])
+    return summary * decay + batch
+
+
+def cross_norm_hadamard(x: jnp.ndarray, summary: jnp.ndarray,
+                        fields_num: int, embed_dim: int) -> jnp.ndarray:
+    """x (B, 2*embed_dim*fields_num) → (B, fields_num*(3*embed_dim+1)).
+
+    Per field-pair i with vectors a=x[:, 2i*d:(2i+1)*d], b=next d cols:
+    emit [a, b, a*b, <a,b>] then summary-normalize all columns.
+    """
+    B = x.shape[0]
+    d = embed_dim
+    xr = x.reshape(B, fields_num, 2, d)
+    a, b = xr[:, :, 0], xr[:, :, 1]             # (B, n, d)
+    had = a * b
+    dot = jnp.sum(had, axis=-1, keepdims=True)  # (B, n, 1)
+    raw = jnp.concatenate([a, b, had, dot], axis=-1)   # (B, n, 3d+1)
+    raw = raw.reshape(B, fields_num * (3 * d + 1))
+    return data_norm(raw, summary)
+
+
+def cross_norm_raw(x: jnp.ndarray, fields_num: int, embed_dim: int
+                   ) -> jnp.ndarray:
+    """The un-normalized [a, b, a⊙b, <a,b>] features (for summary updates)."""
+    B = x.shape[0]
+    d = embed_dim
+    xr = x.reshape(B, fields_num, 2, d)
+    a, b = xr[:, :, 0], xr[:, :, 1]
+    had = a * b
+    dot = jnp.sum(had, axis=-1, keepdims=True)
+    return jnp.concatenate([a, b, had, dot], axis=-1).reshape(B, -1)

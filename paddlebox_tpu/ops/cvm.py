@@ -1,0 +1,27 @@
+"""Standalone CVM op.
+
+Reference: ``cvm_op`` (operators/cvm_op.{cc,cu}): given per-example feature
+rows whose leading two columns are show/click, either apply the log transform
+(use_cvm=True) or strip the two columns (use_cvm=False). Appears outside the
+fused seqpool path when models consume per-example (already pooled) values.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+
+def cvm(x: jnp.ndarray, use_cvm: bool = True) -> jnp.ndarray:
+    """x (..., D) with x[..., 0]=show, x[..., 1]=click."""
+    if not use_cvm:
+        return x[..., 2:]
+    log_show = jnp.log(x[..., 0:1] + 1.0)
+    log_ctr = jnp.log(x[..., 1:2] + 1.0) - log_show
+    return jnp.concatenate([log_show, log_ctr, x[..., 2:]], axis=-1)
+
+
+def cvm_inverse(y: jnp.ndarray) -> jnp.ndarray:
+    """Inverse of the log transform (used by tests / debugging)."""
+    show = jnp.exp(y[..., 0:1]) - 1.0
+    clk = jnp.exp(y[..., 1:2] + y[..., 0:1]) - 1.0
+    return jnp.concatenate([show, clk, y[..., 2:]], axis=-1)

@@ -1,0 +1,23 @@
+"""Fused concat.
+
+Reference: ``fused_concat`` (operators/fused/fused_concat_op.cu) concatenates
+per-slot column ranges of many inputs in one kernel. Under XLA a plain
+concatenate fuses identically; the op exists here for API parity and for the
+column-range slicing variant (``length``/``offset`` attrs).
+"""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+import jax.numpy as jnp
+
+
+def fused_concat(xs: Sequence[jnp.ndarray], offset: int = 0,
+                 length: int = -1, axis: int = -1) -> jnp.ndarray:
+    """Concatenate [x[..., offset:offset+length] for x in xs] along axis."""
+    if length >= 0:
+        xs = [x[..., offset:offset + length] for x in xs]
+    elif offset:
+        xs = [x[..., offset:] for x in xs]
+    return jnp.concatenate(list(xs), axis=axis)

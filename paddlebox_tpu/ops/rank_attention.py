@@ -1,0 +1,69 @@
+"""Rank attention — page-view cross-ad attention.
+
+Reference: ``rank_attention`` op (operators/rank_attention_op.cc,
+rank_attention.cu.h:27-115): each example (an ad impression) attends over the
+other ads in the same page view (PV). A ``rank_offset`` int matrix
+(B, 2*max_rank+1) encodes, per example: col 0 = its own rank (1-based, 0 =
+invalid); for k in [0, max_rank): col 2k+1 = rank of the k-th PV peer (0 =
+absent), col 2k+2 = that peer's row index in the batch. A learnable
+``rank_param`` of shape (max_rank*max_rank*in_dim, out_dim) holds one
+(in_dim, out_dim) block per (own_rank, peer_rank) pair.
+
+The CUDA implementation materializes expanded input/param helper tensors and
+runs a batched GEMM; here it is one gather + one einsum that XLA maps
+straight onto the MXU.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+
+def rank_attention(x: jnp.ndarray, rank_offset: jnp.ndarray,
+                   rank_param: jnp.ndarray, max_rank: int) -> jnp.ndarray:
+    """x (B, in_dim), rank_offset (B, 2*max_rank+1) int32,
+    rank_param (max_rank*max_rank*in_dim, out_dim) → (B, out_dim)."""
+    B, in_dim = x.shape
+    out_dim = rank_param.shape[1]
+    ins_rank = rank_offset[:, 0]                     # (B,)
+    peer_rank = rank_offset[:, 1::2]                 # (B, K)
+    peer_idx = rank_offset[:, 2::2]                  # (B, K)
+    valid = (ins_rank > 0)[:, None] & (peer_rank > 0)
+    xg = x[jnp.clip(peer_idx, 0, B - 1)]             # (B, K, in_dim)
+    xg = jnp.where(valid[..., None], xg, 0.0)
+    blk = (ins_rank[:, None] - 1) * max_rank + (peer_rank - 1)
+    blk = jnp.clip(blk, 0, max_rank * max_rank - 1)  # (B, K)
+    params = rank_param.reshape(max_rank * max_rank, in_dim, out_dim)
+    pb = params[blk]                                 # (B, K, in_dim, out_dim)
+    # xg is already zeroed at invalid positions, so invalid einsum terms
+    # vanish without masking pb too
+    return jnp.einsum("bki,bkio->bo", xg, pb)
+
+
+def build_rank_offset(ranks: np.ndarray, pv_groups: np.ndarray,
+                      max_rank: int) -> np.ndarray:
+    """Host-side construction of the rank_offset matrix from per-example
+    rank + PV group ids (the GetRankOffset[GPU] path of
+    SlotPaddleBoxDataFeed, data_feed.cu:208 CopyRankOffsetKernel).
+
+    ranks     : (B,) int 1-based ad rank within its PV (0 = invalid)
+    pv_groups : (B,) int group id, equal for examples of the same PV
+    Returns (B, 2*max_rank+1) int32.
+    """
+    B = len(ranks)
+    out = np.zeros((B, 2 * max_rank + 1), dtype=np.int32)
+    out[:, 0] = ranks
+    by_group: dict[int, list[int]] = {}
+    for i, g in enumerate(pv_groups.tolist()):
+        by_group.setdefault(g, []).append(i)
+    for g, members in by_group.items():
+        for i in members:
+            if ranks[i] <= 0:
+                continue
+            for j in members:
+                r = int(ranks[j])
+                if 1 <= r <= max_rank:
+                    out[i, 2 * (r - 1) + 1] = r
+                    out[i, 2 * (r - 1) + 2] = j
+    return out

@@ -1,0 +1,80 @@
+"""Fused sequence-pool + CVM transform.
+
+Reference: ``fused_seqpool_cvm`` and variants
+(operators/fused/fused_seqpool_cvm_op.{cc,cu}): for every sparse slot,
+sum-pool the slot's pulled embedding rows per example, then apply the CVM
+(click-value-model) transform to the leading show/click columns:
+
+- "join" phase (use_cvm=True, fused_seqpool_cvm_op.cu:166-189):
+  out[0] = log(show+1); out[1] = log(click+1) - log(show+1); rest unchanged.
+- "update" phase (use_cvm=False, cu:212-228): drop the cvm_offset leading
+  columns.
+- optional per-id filters before pooling (cu:90-163): need_filter drops ids
+  with (show-click)*show_coeff + click*clk_coeff < threshold;
+  embed_threshold_filter drops ids whose |embed_w| < embed_threshold once
+  show > embed_threshold; quant_ratio quantizes embedx values.
+
+The reference fuses all slots into one kernel by hand; here the whole thing
+is a handful of jnp ops over the flat (B, T) token layout — one masked
+multiply, one segment-sum scatter, one log transform — which XLA fuses into
+the surrounding matmuls (SURVEY.md §7 design stance).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+
+def fused_seqpool_cvm(
+    pulled: jnp.ndarray,
+    mask: jnp.ndarray,
+    segment_ids: np.ndarray | jnp.ndarray,
+    num_slots: int,
+    use_cvm: bool = True,
+    cvm_offset: int = 2,
+    need_filter: bool = False,
+    show_coeff: float = 0.2,
+    clk_coeff: float = 1.0,
+    threshold: float = 0.96,
+    embed_threshold: float = 0.0,
+    quant_ratio: int = 0,
+    flatten: bool = True,
+) -> jnp.ndarray:
+    """pulled (B, T, P) × mask (B, T) → pooled+CVM features.
+
+    P = pull width: [show, clk, embed_w, embedx...]. segment_ids (T,) maps
+    token columns to slots (SparseLayout.segment_ids). Returns (B, S*out_dim)
+    if flatten else (B, S, out_dim), out_dim = P if use_cvm else P-cvm_offset.
+    """
+    B, T, P = pulled.shape
+    keep = mask
+    if need_filter:
+        show, clk = pulled[..., 0], pulled[..., 1]
+        keep = keep & ((show - clk) * show_coeff + clk * clk_coeff >= threshold)
+    if embed_threshold > 0.0:
+        show, w = pulled[..., 0], pulled[..., cvm_offset]
+        keep = keep & ~((show > embed_threshold)
+                        & (jnp.abs(w) < embed_threshold))
+    x = pulled
+    if quant_ratio > 0:
+        # quantize embedx only (cu:143-151 quantizes past cvm_offset+1)
+        q = jnp.round(x[..., cvm_offset + 1:] * quant_ratio) / quant_ratio
+        x = jnp.concatenate([x[..., :cvm_offset + 1], q], axis=-1)
+    x = x * keep[..., None]
+    # pool via a constant one-hot (T, S) matmul — rides the MXU and avoids a
+    # scatter op (scatters carry a large fixed per-op cost on TPU)
+    seg_np = np.asarray(segment_ids, dtype=np.int64)
+    pool_mat = jnp.asarray(
+        np.eye(num_slots, dtype=np.float32)[seg_np])        # (T, S)
+    pooled = jnp.einsum("btp,ts->bsp", x, pool_mat)
+    if use_cvm:
+        log_show = jnp.log(pooled[..., 0:1] + 1.0)
+        log_ctr = jnp.log(pooled[..., 1:2] + 1.0) - log_show
+        out = jnp.concatenate([log_show, log_ctr, pooled[..., cvm_offset:]],
+                              axis=-1)
+    else:
+        out = pooled[..., cvm_offset:]
+    if flatten:
+        out = out.reshape(B, -1)
+    return out

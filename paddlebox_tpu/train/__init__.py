@@ -1,0 +1,1 @@
+from paddlebox_tpu.train.trainer import Trainer, TrainerConfig  # noqa: F401

@@ -1,0 +1,260 @@
+"""The trainer — BoxPSTrainer/BoxPSWorker collapsed into one jitted step.
+
+Reference hot loop (SURVEY.md §3.1, boxps_worker.cc:542-598): one pinned
+thread per GPU runs `PackBatchTask → ops → dense sync → nan check → AUC`.
+On TPU the whole per-batch pipeline is ONE jitted SPMD function over the
+mesh: routed embedding lookup (shard_map all_to_all), model forward/backward
+(XLA-fused), dense-grad pmean (the NCCL allreduce path), sparse push with
+in-table optimizer, AUC accumulation — no thread pool, no op scheduler.
+
+Dense sync modes (trainer_desc.proto:100-108 → here):
+- "allreduce": per-step pmean of dense grads — DenseKStepALL with k=1 and the
+  c_mixallgather fused path; the 2D (node, dp) mesh gives the reference's
+  hierarchical reduce-scatter → inter-node → all-gather automatically.
+- K-step/async modes live in parallel/dense_sync.py.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+from jax import lax
+from jax.sharding import PartitionSpec as P
+
+from paddlebox_tpu.data.schema import DataFeedSchema
+from paddlebox_tpu.data.slot_record import PackedBatch, SparseLayout
+from paddlebox_tpu.embedding import (EmbeddingConfig, HostEmbeddingStore,
+                                     PassWorkingSet, sharded)
+from paddlebox_tpu.metrics import auc as auc_lib
+from paddlebox_tpu.parallel import mesh as mesh_lib
+from paddlebox_tpu.utils.timer import StageTimers
+
+
+@dataclasses.dataclass
+class TrainerConfig:
+    dense_lr: float = 1e-3
+    dense_optimizer: str = "adam"          # adam | sgd | adagrad
+    global_batch_size: int = 256
+    capacity_factor: float = 2.0           # all_to_all routing slack
+    auc_buckets: int = 1 << 16
+    label_slot: str = "label"
+    check_nan_inf: bool = False            # FLAGS_check_nan_inf
+    scale_sparse_grad_by_global_mean: bool = True
+    join_phase: bool = True                # use_cvm on (join) vs off (update)
+
+
+def _dense_tx(cfg: TrainerConfig) -> optax.GradientTransformation:
+    if cfg.dense_optimizer == "adam":
+        return optax.adam(cfg.dense_lr)
+    if cfg.dense_optimizer == "sgd":
+        return optax.sgd(cfg.dense_lr)
+    if cfg.dense_optimizer == "adagrad":
+        return optax.adagrad(cfg.dense_lr)
+    raise ValueError(cfg.dense_optimizer)
+
+
+class Trainer:
+    """Pass-oriented trainer over a (node, dp) mesh."""
+
+    def __init__(self, model, store: HostEmbeddingStore,
+                 schema: DataFeedSchema, mesh: jax.sharding.Mesh,
+                 config: TrainerConfig | None = None, seed: int = 0):
+        self.model = model
+        self.store = store
+        self.schema = schema
+        self.mesh = mesh
+        self.cfg = config or TrainerConfig()
+        self.layout = SparseLayout.from_schema(schema)
+        self.n_shards = mesh_lib.num_shards(mesh)
+        if self.cfg.global_batch_size % self.n_shards:
+            raise ValueError("global_batch_size must divide by mesh size")
+        self.params = model.init(jax.random.PRNGKey(seed))
+        self.tx = _dense_tx(self.cfg)
+        self.opt_state = self.tx.init(self.params)
+        self.timers = StageTimers(["read", "translate", "train", "auc"])
+        self._step_fn = self._build_train_step()
+        self._eval_fn = self._build_eval_step()
+        self._auc_fn = jax.jit(auc_lib.auc_update)
+        self.global_step = 0
+
+    # ------------------------------------------------------------------
+    def _float_split(self) -> tuple[int, int, int]:
+        """(label_col_start, label_width, total_float_width)."""
+        col = 0
+        label_col, label_w = -1, 0
+        for slot in self.schema.float_slots:
+            if slot.name == self.cfg.label_slot:
+                label_col, label_w = col, slot.max_len
+            col += slot.max_len
+        if label_col < 0:
+            raise ValueError(f"label slot {self.cfg.label_slot!r} not found")
+        return label_col, label_w, col
+
+    def split_floats(self, floats: np.ndarray) -> tuple[np.ndarray, np.ndarray]:
+        lc, lw, total = self._float_split()
+        labels = floats[:, lc:lc + lw].reshape(-1)
+        dense = np.concatenate([floats[:, :lc], floats[:, lc + lw:]], axis=1)
+        return labels, dense
+
+    # ------------------------------------------------------------------
+    def _build_train_step(self) -> Callable:
+        cfg = self.cfg
+        emb_cfg = self.store.cfg
+        axes = tuple(self.mesh.axis_names)
+        seg = self.layout.segment_ids
+        T = self.layout.total_len
+        D = self.n_shards
+        model = self.model
+        tx = self.tx
+        capf = cfg.capacity_factor
+
+        def body(tshard, idx_l, mask_l, dense_l, labels_l, params):
+            B_l = idx_l.shape[0]
+            flat_idx = idx_l.reshape(-1)
+            pulled = sharded.routed_lookup(tshard, flat_idx, emb_cfg, axes,
+                                           capf)
+            pulled = pulled.reshape(B_l, T, emb_cfg.pull_width)
+
+            def loss_fn(p, pulled_in):
+                logits = model.apply(p, pulled_in, mask_l, dense_l, seg,
+                                     self.layout.num_slots)
+                loss = jnp.mean(
+                    optax.sigmoid_binary_cross_entropy(logits, labels_l))
+                return loss, jax.nn.sigmoid(logits)
+
+            grad_fn = jax.value_and_grad(loss_fn, argnums=(0, 1),
+                                         has_aux=True)
+            (loss, preds), (gp, gpull) = grad_fn(params, pulled)
+            gp = lax.pmean(gp, axes)
+            loss_g = lax.pmean(loss, axes)
+            # sparse grads: only (w, embedx) columns train; show/clk are
+            # counters (CVM grads to them are dropped, like cvm_op's grad)
+            sgrad = gpull[..., 2:].reshape(B_l * T, emb_cfg.grad_width)
+            if cfg.scale_sparse_grad_by_global_mean:
+                sgrad = sgrad / D
+            show_inc = mask_l.reshape(-1).astype(jnp.float32)
+            clk_inc = (mask_l.astype(jnp.float32)
+                       * labels_l[:, None]).reshape(-1)
+            new_shard = sharded.routed_push(tshard, flat_idx, sgrad,
+                                           show_inc, clk_inc, emb_cfg,
+                                           axes, capf)
+            return new_shard, gp, loss_g, preds
+
+        batch_spec = P(axes)
+
+        @jax.jit
+        def step(table, params, opt_state, idx, mask, dense, labels):
+            new_table, gp, loss, preds = jax.shard_map(
+                body, mesh=self.mesh,
+                in_specs=(batch_spec, batch_spec, batch_spec, batch_spec,
+                          batch_spec, P()),
+                out_specs=(batch_spec, P(), P(), batch_spec),
+            )(table, idx, mask, dense, labels, params)
+            updates, new_opt = tx.update(gp, opt_state, params)
+            new_params = optax.apply_updates(params, updates)
+            return new_table, new_params, new_opt, loss, preds
+
+        return step
+
+    def _build_eval_step(self) -> Callable:
+        emb_cfg = self.store.cfg
+        axes = tuple(self.mesh.axis_names)
+        seg = self.layout.segment_ids
+        T = self.layout.total_len
+        model = self.model
+        capf = self.cfg.capacity_factor
+
+        def body(tshard, idx_l, mask_l, dense_l, params):
+            B_l = idx_l.shape[0]
+            pulled = sharded.routed_lookup(tshard, idx_l.reshape(-1),
+                                           emb_cfg, axes, capf)
+            pulled = pulled.reshape(B_l, T, emb_cfg.pull_width)
+            logits = model.apply(params, pulled, mask_l, dense_l, seg,
+                                 self.layout.num_slots)
+            return jax.nn.sigmoid(logits)
+
+        batch_spec = P(axes)
+
+        @jax.jit
+        def step(table, params, idx, mask, dense):
+            return jax.shard_map(
+                body, mesh=self.mesh,
+                in_specs=(batch_spec, batch_spec, batch_spec, batch_spec, P()),
+                out_specs=batch_spec,
+            )(table, idx, mask, dense, params)
+
+        return step
+
+    # ------------------------------------------------------------------
+    def _put_batch(self, ws: PassWorkingSet, pb: PackedBatch):
+        with self.timers("translate"):
+            idx = ws.translate(pb.ids, pb.mask)
+            labels, dense = self.split_floats(pb.floats)
+        sh = mesh_lib.batch_sharding(self.mesh)
+        return (jax.device_put(idx, sh),
+                jax.device_put(pb.mask, sh),
+                jax.device_put(dense.astype(np.float32), sh),
+                jax.device_put(labels.astype(np.float32), sh))
+
+    def train_pass(self, dataset, metrics: Any = None
+                   ) -> dict[str, float]:
+        """One pass over the dataset (§3.1 hot loop + §3.4 lifecycle).
+
+        `metrics`: optional MetricRegistry; every registered metric gets
+        this pass's (pred, label, cmatch, rank) per batch — the
+        AddAucMonitor hook (boxps_worker.cc:582).
+        """
+        cfg = self.cfg
+        ws = PassWorkingSet.begin_pass(self.store, dataset.unique_keys(),
+                                       self.mesh)
+        table = ws.table
+        params, opt_state = self.params, self.opt_state
+        auc_state = auc_lib.new_state(cfg.auc_buckets)
+        losses: list[float] = []
+        for pb in dataset.batches(cfg.global_batch_size, drop_last=True):
+            idx, mask, dense, labels = self._put_batch(ws, pb)
+            with self.timers("train"):
+                table, params, opt_state, loss, preds = self._step_fn(
+                    table, params, opt_state, idx, mask, dense, labels)
+            with self.timers("auc"):
+                auc_state = self._auc_fn(auc_state, preds, labels)
+                if metrics is not None:
+                    for name in metrics.names():
+                        # mask/sample-scale metrics need vars the batch
+                        # doesn't carry; callers feed those explicitly
+                        if metrics._metrics[name].method in ("plain",
+                                                             "cmatch_rank"):
+                            metrics.add_data(name, preds, labels,
+                                             cmatch=pb.cmatch, rank=pb.rank)
+            if cfg.check_nan_inf:
+                lv = float(loss)
+                if not np.isfinite(lv):
+                    raise FloatingPointError(
+                        f"nan/inf loss at step {self.global_step}")
+            losses.append(float(loss))
+            self.global_step += 1
+        ws.end_pass(self.store, table)
+        self.params, self.opt_state = params, opt_state
+        out = auc_lib.auc_compute(auc_state)
+        out["loss_first"] = losses[0] if losses else float("nan")
+        out["loss_last"] = losses[-1] if losses else float("nan")
+        out["loss_mean"] = float(np.mean(losses)) if losses else float("nan")
+        out["steps"] = len(losses)
+        return out
+
+    def eval_pass(self, dataset) -> dict[str, float]:
+        """Test-mode pass: no pushes, no dense updates, and the store is
+        neither grown nor dirtied by unseen keys (SetTestMode)."""
+        ws = PassWorkingSet.begin_pass(self.store, dataset.unique_keys(),
+                                       self.mesh, test_mode=True)
+        auc_state = auc_lib.new_state(self.cfg.auc_buckets)
+        for pb in dataset.batches(self.cfg.global_batch_size, drop_last=True):
+            idx, mask, dense, labels = self._put_batch(ws, pb)
+            preds = self._eval_fn(ws.table, self.params, idx, mask, dense)
+            auc_state = self._auc_fn(auc_state, preds, labels)
+        return auc_lib.auc_compute(auc_state)

@@ -1,0 +1,183 @@
+"""AUC family: exactness vs rank-statistic AUC, variants, global reduction."""
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from paddlebox_tpu.metrics import (MetricRegistry, auc_compute, auc_update,
+                                   merge_states, new_state, psum_state,
+                                   parse_cmatch_rank)
+from paddlebox_tpu.parallel import make_mesh
+
+
+def rank_auc(preds, labels):
+    """Exact AUC via the Mann-Whitney rank statistic."""
+    order = np.argsort(preds, kind="stable")
+    ranks = np.empty_like(order, dtype=np.float64)
+    # average ranks for ties
+    sp = preds[order]
+    i = 0
+    r = 1.0
+    while i < len(sp):
+        j = i
+        while j + 1 < len(sp) and sp[j + 1] == sp[i]:
+            j += 1
+        avg = (r + r + (j - i)) / 2.0
+        ranks[order[i:j + 1]] = avg
+        r += j - i + 1
+        i = j + 1
+    pos = labels == 1
+    n_pos, n_neg = pos.sum(), (~pos).sum()
+    return (ranks[pos].sum() - n_pos * (n_pos + 1) / 2) / (n_pos * n_neg)
+
+
+def test_auc_matches_rank_statistic():
+    rng = np.random.default_rng(0)
+    n_buckets = 1 << 12
+    # quantize preds onto the bucket grid so histogram AUC is exact
+    preds = rng.integers(0, n_buckets, 2000).astype(np.float64) / n_buckets
+    labels = (rng.random(2000) < preds).astype(np.float32)  # informative preds
+    st = new_state(n_buckets)
+    st = auc_update(st, jnp.asarray(preds, dtype=jnp.float32),
+                    jnp.asarray(labels))
+    got = auc_compute(st)
+    want = rank_auc(preds + 0.5 / n_buckets, labels)  # bucket centers tie-equal
+    assert abs(got["auc"] - want) < 1e-6
+    assert got["size"] == 2000
+    np.testing.assert_allclose(got["actual_ctr"], labels.mean(), rtol=1e-6)
+    np.testing.assert_allclose(got["predicted_ctr"], preds.mean(), rtol=1e-4)
+
+
+def test_auc_degenerate_all_one_class():
+    st = new_state(64)
+    st = auc_update(st, jnp.asarray([0.3, 0.6]), jnp.asarray([1.0, 1.0]))
+    assert auc_compute(st)["auc"] == -0.5  # reference convention cc:348-350
+
+
+def test_auc_incremental_equals_bulk():
+    rng = np.random.default_rng(1)
+    preds = rng.random(300).astype(np.float32)
+    labels = (rng.random(300) < 0.3).astype(np.float32)
+    bulk = auc_update(new_state(1024), jnp.asarray(preds), jnp.asarray(labels))
+    inc = new_state(1024)
+    for i in range(0, 300, 50):
+        inc = auc_update(inc, jnp.asarray(preds[i:i + 50]),
+                         jnp.asarray(labels[i:i + 50]))
+    for k in bulk:
+        np.testing.assert_allclose(np.asarray(inc[k]), np.asarray(bulk[k]),
+                                   rtol=1e-5)
+
+
+def test_auc_psum_over_mesh_equals_host_merge():
+    mesh = make_mesh(8)
+    rng = np.random.default_rng(2)
+    preds = rng.random(8 * 32).astype(np.float32)
+    labels = (rng.random(8 * 32) < 0.4).astype(np.float32)
+
+    def body(p, y):
+        st = auc_update(new_state(512), p, y)
+        return psum_state(st, "dp")
+
+    out = jax.jit(jax.shard_map(
+        body, mesh=mesh, in_specs=(P("dp"), P("dp")),
+        out_specs=P()))(jnp.asarray(preds), jnp.asarray(labels))
+    got = auc_compute(out)
+    want = auc_compute(auc_update(new_state(512), jnp.asarray(preds),
+                                  jnp.asarray(labels)))
+    assert abs(got["auc"] - want["auc"]) < 1e-9
+    assert got["size"] == want["size"]
+
+
+def test_merge_states_host():
+    rng = np.random.default_rng(3)
+    parts = []
+    for i in range(3):
+        p = rng.random(50).astype(np.float32)
+        y = (rng.random(50) < 0.5).astype(np.float32)
+        parts.append(auc_update(new_state(256), jnp.asarray(p), jnp.asarray(y)))
+    merged = merge_states(parts)
+    assert auc_compute(merged)["size"] == 150
+
+
+def brute_force_bucket_error(pos, neg, n, max_span=0.01, rel_bound=0.05):
+    """Literal full-table loop (reference box_wrapper.cc:542-574)."""
+    last_ctr = -1.0
+    impression_sum = ctr_sum = click_sum = 0.0
+    error_sum = error_count = 0.0
+    for i in range(n):
+        click = pos[i]
+        show = pos[i] + neg[i]
+        ctr = float(i) / n
+        if abs(ctr - last_ctr) > max_span:
+            last_ctr = ctr
+            impression_sum = ctr_sum = click_sum = 0.0
+        impression_sum += show
+        ctr_sum += ctr * show
+        click_sum += click
+        if impression_sum == 0:
+            continue
+        adjust_ctr = ctr_sum / impression_sum
+        if adjust_ctr <= 0 or adjust_ctr >= 1:
+            continue
+        relative_error = np.sqrt((1 - adjust_ctr) /
+                                 (adjust_ctr * impression_sum))
+        if relative_error < rel_bound:
+            actual_ctr = click_sum / impression_sum
+            error_sum += abs(actual_ctr / adjust_ctr - 1) * impression_sum
+            error_count += impression_sum
+            last_ctr = -1.0
+    return error_sum / error_count if error_count > 0 else 0.0
+
+
+def test_bucket_error_matches_brute_force():
+    from paddlebox_tpu.metrics.auc import _bucket_error
+    rng = np.random.default_rng(7)
+    n = 4096
+    for density, scale in [(0.002, 3000), (0.05, 500), (0.5, 50)]:
+        pos = np.zeros(n)
+        neg = np.zeros(n)
+        hot = rng.random(n) < density
+        pos[hot] = rng.integers(0, scale, hot.sum())
+        neg[hot] = rng.integers(0, scale * 3, hot.sum())
+        got = _bucket_error(pos, neg, n, 0.01, 0.05)
+        want = brute_force_bucket_error(pos, neg, n)
+        np.testing.assert_allclose(got, want, rtol=1e-12, atol=1e-15)
+
+
+def test_parse_cmatch_rank():
+    assert parse_cmatch_rank("223:0,224:1") == [(223, 0), (224, 1)]
+    assert parse_cmatch_rank("223,224") == [(223, -1), (224, -1)]
+
+
+def test_metric_registry_variants():
+    reg = MetricRegistry()
+    reg.init_metric("plain_auc", n_buckets=256)
+    reg.init_metric("cm_auc", method="cmatch_rank", cmatch_rank_spec="2:1",
+                    n_buckets=256)
+    reg.init_metric("mask_auc", method="mask", mask_var="m", n_buckets=256)
+    preds = np.array([0.9, 0.1, 0.8, 0.2], np.float32)
+    labels = np.array([1, 0, 1, 0], np.float32)
+    cmatch = np.array([2, 2, 3, 3])
+    rank = np.array([1, 0, 1, 0])
+    mask = np.array([1, 1, 0, 0])
+    reg.add_data("plain_auc", preds, labels)
+    reg.add_data("cm_auc", preds, labels, cmatch=cmatch, rank=rank)
+    reg.add_data("mask_auc", preds, labels, mask=mask)
+    assert reg.get_metric_msg("plain_auc")["size"] == 4
+    assert reg.get_metric_msg("cm_auc")["size"] == 1    # only (2,1)
+    assert reg.get_metric_msg("mask_auc")["size"] == 2
+    reg.reset()
+    assert reg.get_metric_msg("plain_auc")["size"] == 0
+
+
+def test_metric_registry_phase_gating():
+    reg = MetricRegistry()
+    reg.init_metric("join_auc", phase=1, n_buckets=64)
+    preds = np.array([0.5], np.float32)
+    labels = np.array([1.0], np.float32)
+    reg.add_data("join_auc", preds, labels)      # phase 1 == current -> counts
+    reg.flip_phase()
+    reg.add_data("join_auc", preds, labels)      # gated off
+    assert reg.get_metric_msg("join_auc")["size"] == 1

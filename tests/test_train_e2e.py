@@ -1,0 +1,127 @@
+"""End-to-end: synthetic CTR data → passes of training → AUC lifts off 0.5."""
+
+import numpy as np
+import pytest
+
+from paddlebox_tpu.data import DataFeedSchema, SlotDataset
+from paddlebox_tpu.data.parser import parse_multislot_lines
+from paddlebox_tpu.embedding import EmbeddingConfig, HostEmbeddingStore
+from paddlebox_tpu.models import DeepFMModel, DNNCTRModel
+from paddlebox_tpu.parallel import make_mesh
+from paddlebox_tpu.train import Trainer, TrainerConfig
+
+NUM_SLOTS = 4
+VOCAB = 50   # ids per slot
+
+
+def synth_dataset(n, seed=0, schema=None):
+    """CTR data with real signal: each id has a latent weight; the label is
+    bernoulli(sigmoid(sum of weights)). Learnable by embeddings alone."""
+    rng = np.random.default_rng(seed)
+    schema = schema or DataFeedSchema.ctr(num_sparse=NUM_SLOTS, num_float=1,
+                                          batch_size=64, max_len=2)
+    id_weight = np.random.default_rng(99).normal(size=(NUM_SLOTS, VOCAB)) * 1.5
+    lines = []
+    for _ in range(n):
+        logits = 0.0
+        parts = []
+        ids_per_slot = []
+        for s in range(NUM_SLOTS):
+            k = rng.integers(1, 3)
+            ids = rng.integers(0, VOCAB, size=k)
+            ids_per_slot.append(ids)
+            logits += id_weight[s, ids].sum()
+        dense_val = rng.normal()
+        p = 1.0 / (1.0 + np.exp(-(logits * 0.8)))
+        label = float(rng.random() < p)
+        parts.append(f"1 {label}")
+        parts.append(f"1 {dense_val:.4f}")
+        for s, ids in enumerate(ids_per_slot):
+            # feature signs: slot-salted so slots don't collide
+            signs = [str(int(i) + s * 1000003) for i in ids]
+            parts.append(f"{len(signs)} {' '.join(signs)}")
+        lines.append(" ".join(parts))
+    ds = SlotDataset(schema)
+    ds.records = parse_multislot_lines(lines, schema)
+    return ds, schema
+
+
+@pytest.fixture(scope="module")
+def mesh8():
+    return make_mesh(8)
+
+
+@pytest.mark.parametrize("model_cls", [DNNCTRModel, DeepFMModel])
+def test_training_lifts_auc(mesh8, model_cls):
+    ds, schema = synth_dataset(2048)
+    emb_cfg = EmbeddingConfig(dim=8, learning_rate=0.15)
+    store = HostEmbeddingStore(emb_cfg)
+    model = model_cls(num_slots=NUM_SLOTS, emb_dim=8, dense_dim=1,
+                      hidden=(32, 16))
+    tr = Trainer(model, store, schema, mesh8,
+                 TrainerConfig(global_batch_size=128, dense_lr=3e-3,
+                               auc_buckets=1 << 12))
+    results = [tr.train_pass(ds) for _ in range(3)]
+    assert results[0]["steps"] == 16
+    # training must lift AUC well above chance by the last pass
+    assert results[-1]["auc"] > 0.62, results
+    # and reduce loss vs the start
+    assert results[-1]["loss_mean"] < results[0]["loss_first"]
+    # eval pass (no updates) should agree roughly with train AUC
+    ev = tr.eval_pass(ds)
+    assert ev["auc"] > 0.62
+    # store persisted learned weights
+    assert len(store) > 0
+    keys = ds.unique_keys()
+    rows = store.get_rows(keys[:10])
+    assert np.abs(rows[:, 2]).sum() > 0  # w moved
+    assert rows[:, 0].sum() > 0          # show counters accumulated
+
+
+def test_eval_pass_does_not_mutate(mesh8):
+    ds, schema = synth_dataset(512, seed=5)
+    emb_cfg = EmbeddingConfig(dim=4)
+    store = HostEmbeddingStore(emb_cfg)
+    model = DNNCTRModel(num_slots=NUM_SLOTS, emb_dim=4, dense_dim=1,
+                        hidden=(16,))
+    tr = Trainer(model, store, schema, mesh8,
+                 TrainerConfig(global_batch_size=64, auc_buckets=1 << 10))
+    tr.train_pass(ds)
+    before = store.get_rows(ds.unique_keys())
+    n_before = len(store)
+    # eval on held-out data with NOVEL keys must not grow the store
+    ds_eval, _ = synth_dataset(256, seed=77)
+    tr.eval_pass(ds_eval)
+    assert len(store) == n_before
+    after = store.get_rows(ds.unique_keys())
+    np.testing.assert_array_equal(before, after)
+
+
+def test_train_pass_feeds_metric_registry(mesh8):
+    from paddlebox_tpu.metrics import MetricRegistry
+    ds, schema = synth_dataset(256, seed=8)
+    store = HostEmbeddingStore(EmbeddingConfig(dim=4))
+    model = DNNCTRModel(num_slots=NUM_SLOTS, emb_dim=4, dense_dim=1,
+                        hidden=(16,))
+    tr = Trainer(model, store, schema, mesh8,
+                 TrainerConfig(global_batch_size=64, auc_buckets=1 << 10))
+    reg = MetricRegistry()
+    reg.init_metric("pass_auc", n_buckets=256)
+    tr.train_pass(ds, metrics=reg)
+    assert reg.get_metric_msg("pass_auc")["size"] == 256
+
+
+def test_check_nan_inf_raises(mesh8):
+    # Inject a NaN dense feature — the check_nan_inf guard
+    # (FLAGS_check_nan_inf, boxps_worker.cc:575-580) must trip.
+    ds, schema = synth_dataset(256, seed=6)
+    ds.records.float_values[1][7] = np.nan
+    emb_cfg = EmbeddingConfig(dim=4)
+    store = HostEmbeddingStore(emb_cfg)
+    model = DNNCTRModel(num_slots=NUM_SLOTS, emb_dim=4, dense_dim=1,
+                        hidden=(16,))
+    tr = Trainer(model, store, schema, mesh8,
+                 TrainerConfig(global_batch_size=64, check_nan_inf=True,
+                               auc_buckets=1 << 10))
+    with pytest.raises(FloatingPointError):
+        tr.train_pass(ds)
