@@ -247,6 +247,26 @@ class PackedBatch:
     def layout(self) -> SparseLayout:
         return SparseLayout.from_schema(self.schema)
 
+    def pad_to(self, batch_size: int) -> "PackedBatch":
+        """Pad to `batch_size` rows with masked-out examples (tail batches
+        keep the jitted step's static shape; padded rows carry mask=False
+        everywhere so pulls resolve to padding and metrics can exclude them).
+        """
+        n = len(self.floats)
+        if n >= batch_size:
+            return self
+        pad = batch_size - n
+
+        def _pad(a, fill=0):
+            shape = (pad,) + a.shape[1:]
+            return np.concatenate([a, np.full(shape, fill, dtype=a.dtype)])
+
+        return PackedBatch(
+            schema=self.schema, num=self.num,
+            ids=_pad(self.ids), mask=_pad(self.mask, False),
+            floats=_pad(self.floats), rank=_pad(self.rank),
+            cmatch=_pad(self.cmatch))
+
     def slot_ids(self, name: str) -> tuple[np.ndarray, np.ndarray]:
         """(ids, mask) view of one sparse slot, shape (B, max_len)."""
         lay = self.layout()

@@ -97,7 +97,11 @@ class HostEmbeddingStore:
                 init = self._init_rows(new_keys)
                 for off, i in enumerate(missing):
                     j = self._n + off
-                    self._index[int(new_keys[off])] = j
+                    k_int = int(new_keys[off])
+                    self._index[k_int] = j
+                    # a re-created key is live again — its pending tombstone
+                    # must not delete it at delta-replay time
+                    self._tombstones.discard(k_int)
                     self._keys[j] = new_keys[off]
                 self._rows[self._n:self._n + len(missing)] = init
                 self._n += len(missing)
@@ -210,6 +214,16 @@ class HostEmbeddingStore:
         with open(os.path.join(path, "meta.json"), "w") as f:
             json.dump(meta, f, indent=1)
 
+    def apply_delta_file(self, fname: str) -> None:
+        """Replay one delta-*.npz (written by save_delta, possibly into a
+        different directory) on top of the current state — lets a resume path
+        reconstruct `base + ordered deltas` when deltas were checkpointed
+        into self-contained per-pass directories."""
+        z = np.load(fname)
+        self._ingest(z["keys"], z["rows"])
+        if "removed" in z and len(z["removed"]):
+            self._remove(z["removed"])
+
     @classmethod
     def load(cls, path: str, cfg: EmbeddingConfig | None = None
              ) -> "HostEmbeddingStore":
@@ -224,10 +238,7 @@ class HostEmbeddingStore:
         store._ingest(base["keys"], base["rows"])
         deltas = sorted(f for f in os.listdir(path) if f.startswith("delta-"))
         for d in deltas[:meta["save_seq"]]:
-            z = np.load(os.path.join(path, d))
-            store._ingest(z["keys"], z["rows"])
-            if "removed" in z and len(z["removed"]):
-                store._remove(z["removed"])
+            store.apply_delta_file(os.path.join(path, d))
         store._save_seq = meta["save_seq"]
         return store
 

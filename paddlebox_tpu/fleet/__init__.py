@@ -1,0 +1,4 @@
+from paddlebox_tpu.fleet.boxps import BoxPS
+from paddlebox_tpu.fleet.fleet_util import FleetUtil
+
+__all__ = ["BoxPS", "FleetUtil"]

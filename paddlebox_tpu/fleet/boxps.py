@@ -1,0 +1,95 @@
+"""Pass/day lifecycle façade — the BoxWrapper/BoxHelper singleton surface.
+
+Reference (box_wrapper.h:419-424, 487-494, 625; pybind box_helper_py.cc:40-110):
+the user-facing lifecycle is
+
+    dataset.set_date(d)        → BoxHelper::SetDate
+    dataset.begin_pass()       → BoxWrapper::BeginPass
+    exe.train_from_dataset(..) → hot loop (§3.1), join/update FlipPhase
+    dataset.end_pass(save)     → BoxWrapper::EndPass
+    box.save_base/save_delta   → sparse checkpoint planes
+
+Here the singleton owns the host embedding store, the metric registry, and
+the phase bit; `Trainer.train_pass` does the per-pass HBM working-set build
+(BeginFeedPass/EndFeedPass equivalent) internally, so BeginPass/EndPass at
+this level is bookkeeping + persistence policy, which matches the reference's
+split of labor between BoxHelper (data) and BoxPS (table).
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Any
+
+from paddlebox_tpu.embedding import HostEmbeddingStore
+from paddlebox_tpu.metrics.metric import MetricRegistry
+
+JOIN_PHASE = 1
+UPDATE_PHASE = 0
+
+
+class BoxPS:
+    """Owns the sparse store + metrics + pass/phase state for one job."""
+
+    def __init__(self, store: HostEmbeddingStore,
+                 metrics: MetricRegistry | None = None):
+        self.store = store
+        self.metrics = metrics or MetricRegistry()
+        self.metrics.phase = JOIN_PHASE
+        self.date: int | None = None
+        self.pass_id = 0
+        self.in_pass = False
+        self._pass_t0 = 0.0
+
+    @property
+    def phase(self) -> int:
+        """Single source of truth lives in the metric registry, which gates
+        accumulation by phase."""
+        return self.metrics.phase
+
+    # ---- lifecycle (box_wrapper.h:419-424) ----
+
+    def set_date(self, date: int) -> None:
+        self.date = int(date)
+
+    def begin_pass(self) -> None:
+        if self.in_pass:
+            raise RuntimeError("begin_pass while a pass is open")
+        self.in_pass = True
+        self.pass_id += 1
+        self._pass_t0 = time.time()
+
+    def end_pass(self, need_save_delta: bool = False,
+                 delta_path: str | None = None) -> dict[str, Any]:
+        """Close the pass; optionally snapshot the delta plane
+        (BoxPSDataset.end_pass(need_save_delta), dataset.py:1124)."""
+        if not self.in_pass:
+            raise RuntimeError("end_pass without begin_pass")
+        self.in_pass = False
+        out: dict[str, Any] = {"pass_id": self.pass_id,
+                               "seconds": time.time() - self._pass_t0}
+        if need_save_delta:
+            if delta_path is None:
+                raise ValueError("need_save_delta requires delta_path")
+            out["delta_file"] = self.store.save_delta(delta_path)
+        return out
+
+    def flip_phase(self) -> None:
+        """Join↔update flip (box_wrapper.h:625); metrics follow the phase.
+
+        (The reference's SetTestMode is covered by Trainer.eval_pass /
+        PassWorkingSet(test_mode=True) — no separate box-level flag.)"""
+        self.metrics.flip_phase()
+
+    # ---- table hygiene ----
+
+    def shrink_table(self, min_show: float, decay: float = 1.0) -> int:
+        return self.store.shrink(min_show, decay)
+
+    # ---- metric surface (box_helper_py.cc:87-110) ----
+
+    def init_metric(self, name: str, **kw) -> None:
+        self.metrics.init_metric(name, **kw)
+
+    def get_metric_msg(self, name: str) -> dict[str, float]:
+        return self.metrics.get_metric_msg(name)

@@ -1,0 +1,130 @@
+"""Day/pass model persistence layout + donefiles — fleet_util semantics.
+
+Reference (python/paddle/fluid/incubate/fleet/utils/fleet_util.py:649-745):
+models are organized by day and pass under one output root —
+
+    {root}/{day}/base/              full "batch model" (save_base):
+                                    sparse/ snapshot + dense.npz
+    {root}/{day}/delta-{pass}/      self-contained serving delta (save_delta):
+                                    sparse delta-*.npz + dense.npz
+
+with donefiles listing completed checkpoints so downstream (serving, resume)
+can discover the newest model. A mid-day crash is recovered by loading the
+newest base and replaying every delta donefile entry recorded after it —
+the reference's pass-granularity restart model (SURVEY.md §5 "Failure
+detection").
+"""
+
+from __future__ import annotations
+
+import glob
+import json
+import os
+import time
+from typing import Any
+
+from paddlebox_tpu.embedding import HostEmbeddingStore
+from paddlebox_tpu.utils import checkpoint as ckpt_lib
+
+
+class FleetUtil:
+    def __init__(self, output_root: str):
+        self.root = output_root
+        os.makedirs(output_root, exist_ok=True)
+
+    # ---- paths ----
+
+    def base_dir(self, day: int) -> str:
+        return os.path.join(self.root, str(day), "base")
+
+    def delta_dir(self, day: int, pass_id: int) -> str:
+        return os.path.join(self.root, str(day), f"delta-{pass_id}")
+
+    # ---- save (fleet_util.save_model:674 / save_delta_model:722) ----
+
+    def save_model(self, store: HostEmbeddingStore, dense_state: Any,
+                   day: int) -> str:
+        """Full day-level base model: sparse base + dense snapshot."""
+        path = self.base_dir(day)
+        os.makedirs(path, exist_ok=True)
+        store.save_base(os.path.join(path, "sparse"))
+        ckpt_lib.save_pytree(dense_state, os.path.join(path, "dense.npz"))
+        self._write_donefile("base_model.donefile", day, 0, path)
+        return path
+
+    def save_delta_model(self, store: HostEmbeddingStore, dense_state: Any,
+                         day: int, pass_id: int) -> str:
+        """Pass-level delta (the reference's "xbox" online-serving delta).
+
+        Self-contained: the directory named in the donefile holds BOTH the
+        sparse delta plane and the dense snapshot, so a serving consumer can
+        fetch exactly entry["path"].
+        """
+        path = self.delta_dir(day, pass_id)
+        sparse_dir = os.path.join(path, "sparse")
+        os.makedirs(sparse_dir, exist_ok=True)
+        store.save_delta(sparse_dir)
+        ckpt_lib.save_pytree(dense_state, os.path.join(path, "dense.npz"))
+        self._write_donefile("delta_model.donefile", day, pass_id, path)
+        return path
+
+    def _write_donefile(self, name: str, day: int, pass_id: int,
+                        path: str) -> None:
+        line = json.dumps({"day": day, "pass": pass_id, "path": path,
+                           "ts": int(time.time())})
+        with open(os.path.join(self.root, name), "a") as f:
+            f.write(line + "\n")
+
+    def _entries(self, donefile: str) -> list[dict[str, Any]]:
+        fname = os.path.join(self.root, donefile)
+        if not os.path.exists(fname):
+            return []
+        out = []
+        with open(fname) as f:
+            for line in f:
+                line = line.strip()
+                if line:
+                    out.append(json.loads(line))
+        return out
+
+    def latest(self, donefile: str = "base_model.donefile"
+               ) -> dict[str, Any] | None:
+        entries = self._entries(donefile)
+        return entries[-1] if entries else None
+
+    # ---- load (fleet_util.load_model:649) ----
+
+    def load_model(self, dense_template: Any, day: int | None = None
+                   ) -> tuple[HostEmbeddingStore, Any, int]:
+        """Load the newest base model (or the given day's) and replay every
+        delta checkpointed after it, in donefile order.
+
+        Returns (store, dense_state, day). `dense_template` supplies the
+        pytree structure for the dense plane.
+        """
+        bases = self._entries("base_model.donefile")
+        if day is not None:
+            bases = [b for b in bases if int(b["day"]) == day]
+        if not bases:
+            raise FileNotFoundError(
+                f"no base model{f' for day {day}' if day else ''} in {self.root}")
+        base = bases[-1]
+        day = int(base["day"])
+        store = HostEmbeddingStore.load(os.path.join(base["path"], "sparse"))
+        dense_file = os.path.join(base["path"], "dense.npz")
+        # replay deltas recorded after this base (mid-day-crash recovery:
+        # yesterday's base + today's pass deltas)
+        for d in self._entries("delta_model.donefile"):
+            if int(d["ts"]) < int(base["ts"]) or d["path"] == base["path"]:
+                continue
+            if int(d["day"]) < day:
+                continue
+            for f in sorted(glob.glob(os.path.join(d["path"], "sparse",
+                                                   "delta-*.npz"))):
+                store.apply_delta_file(f)
+            cand = os.path.join(d["path"], "dense.npz")
+            if os.path.exists(cand):
+                dense_file = cand
+            day = max(day, int(d["day"]))
+        dense = ckpt_lib.load_pytree(dense_template, dense_file)
+        return store, dense, day

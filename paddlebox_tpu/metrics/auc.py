@@ -68,6 +68,45 @@ def auc_update(state: AucState, preds: jnp.ndarray, labels: jnp.ndarray,
     }
 
 
+class AucAccumulator:
+    """Two-tier accumulator: device float32 state updated in-jit, drained
+    into a host float64 sink every `drain_every` batches.
+
+    float32 histogram adds stop counting once a bucket crosses 2^24; the
+    reference avoids this by accumulating in double on CPU
+    (box_wrapper.cc:321). On TPU x64 is off, so instead the device state is
+    bounded (drain_every × batch ≪ 2^24 per bucket) and exactness lives in
+    the float64 host sink.
+    """
+
+    def __init__(self, n_buckets: int = DEFAULT_BUCKETS,
+                 drain_every: int = 256):
+        self.n_buckets = n_buckets
+        self.drain_every = drain_every
+        self.host = {k: np.zeros_like(np.asarray(v), dtype=np.float64)
+                     for k, v in new_state(n_buckets).items()}
+        self.dev: AucState = new_state(n_buckets)
+        self._updates = 0
+
+    def update(self, fn, *args) -> None:
+        """dev_state = fn(dev_state, *args); fn is typically a jitted
+        auc_update partial. Non-blocking except on drain boundaries."""
+        self.dev = fn(self.dev, *args)
+        self._updates += 1
+        if self._updates >= self.drain_every:
+            self.drain()
+
+    def drain(self) -> None:
+        for k, v in self.dev.items():
+            self.host[k] += np.asarray(v, dtype=np.float64)
+        self.dev = new_state(self.n_buckets)
+        self._updates = 0
+
+    def compute(self, **kw) -> dict[str, float]:
+        self.drain()
+        return auc_compute(self.host, **kw)
+
+
 def psum_state(state: AucState, axis_name) -> AucState:
     """Exact global reduction over mesh axes (replaces collect_data_nccl +
     MPICluster::allreduce_sum, box_wrapper.cc:230-332)."""

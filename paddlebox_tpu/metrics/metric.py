@@ -69,6 +69,7 @@ class MetricRegistry:
     def __init__(self):
         self._metrics: dict[str, _Metric] = {}
         self._phases: dict[str, int] = {}
+        self._starved_warned: set[str] = set()
         self.phase = 1  # reference starts in join phase
 
     def init_metric(self, name: str, method: str = "plain",
@@ -118,6 +119,31 @@ class MetricRegistry:
         m.state = auc_lib.auc_update(m.state, jnp.asarray(preds),
                                      jnp.asarray(labels), mask=eff_mask,
                                      sample_scale=scale)
+
+    def add_batch(self, preds, labels, cmatch=None, rank=None, mask=None,
+                  sample_scale=None) -> None:
+        """Feed one batch to every phase-active metric whose inputs are
+        available; warn once per metric that is starved of a required input
+        (instead of silently reporting size=0)."""
+        import warnings
+        for name, m in self._metrics.items():
+            ph = self._phases[name]
+            if ph >= 0 and ph != self.phase:
+                continue
+            needs = {"cmatch_rank": cmatch, "mask": mask,
+                     "sample_scale": sample_scale}.get(m.method, True)
+            if m.scale_var and sample_scale is None:
+                needs = None
+            if needs is None:
+                if name not in self._starved_warned:
+                    self._starved_warned.add(name)
+                    warnings.warn(
+                        f"metric {name!r} ({m.method}) got no "
+                        f"{m.method}/scale input this pass; it will not "
+                        f"accumulate", stacklevel=2)
+                continue
+            self.add_data(name, preds, labels, cmatch=cmatch, rank=rank,
+                          mask=mask, sample_scale=sample_scale)
 
     def set_state(self, name: str, state) -> None:
         """Install an externally-accumulated (e.g. in-jit) state."""

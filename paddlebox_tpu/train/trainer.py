@@ -80,6 +80,8 @@ class Trainer:
         self._step_fn = self._build_train_step()
         self._eval_fn = self._build_eval_step()
         self._auc_fn = jax.jit(auc_lib.auc_update)
+        self._auc_masked_fn = jax.jit(
+            lambda s, p, y, m: auc_lib.auc_update(s, p, y, mask=m))
         self.global_step = 0
 
     # ------------------------------------------------------------------
@@ -214,33 +216,31 @@ class Trainer:
                                        self.mesh)
         table = ws.table
         params, opt_state = self.params, self.opt_state
-        auc_state = auc_lib.new_state(cfg.auc_buckets)
-        losses: list[float] = []
+        auc_acc = auc_lib.AucAccumulator(cfg.auc_buckets)
+        # device arrays collected without per-step host sync (the hot loop
+        # must stay dispatch-async to overlap host pack with device compute)
+        dev_losses: list[Any] = []
         for pb in dataset.batches(cfg.global_batch_size, drop_last=True):
             idx, mask, dense, labels = self._put_batch(ws, pb)
             with self.timers("train"):
                 table, params, opt_state, loss, preds = self._step_fn(
                     table, params, opt_state, idx, mask, dense, labels)
             with self.timers("auc"):
-                auc_state = self._auc_fn(auc_state, preds, labels)
+                auc_acc.update(self._auc_fn, preds, labels)
                 if metrics is not None:
-                    for name in metrics.names():
-                        # mask/sample-scale metrics need vars the batch
-                        # doesn't carry; callers feed those explicitly
-                        if metrics._metrics[name].method in ("plain",
-                                                             "cmatch_rank"):
-                            metrics.add_data(name, preds, labels,
-                                             cmatch=pb.cmatch, rank=pb.rank)
+                    metrics.add_batch(preds, labels, cmatch=pb.cmatch,
+                                      rank=pb.rank)
             if cfg.check_nan_inf:
                 lv = float(loss)
                 if not np.isfinite(lv):
                     raise FloatingPointError(
                         f"nan/inf loss at step {self.global_step}")
-            losses.append(float(loss))
+            dev_losses.append(loss)
             self.global_step += 1
         ws.end_pass(self.store, table)
         self.params, self.opt_state = params, opt_state
-        out = auc_lib.auc_compute(auc_state)
+        losses = [float(l) for l in dev_losses]  # one sync, post-loop
+        out = auc_acc.compute()
         out["loss_first"] = losses[0] if losses else float("nan")
         out["loss_last"] = losses[-1] if losses else float("nan")
         out["loss_mean"] = float(np.mean(losses)) if losses else float("nan")
@@ -250,11 +250,16 @@ class Trainer:
     def eval_pass(self, dataset) -> dict[str, float]:
         """Test-mode pass: no pushes, no dense updates, and the store is
         neither grown nor dirtied by unseen keys (SetTestMode)."""
+        bs = self.cfg.global_batch_size
         ws = PassWorkingSet.begin_pass(self.store, dataset.unique_keys(),
                                        self.mesh, test_mode=True)
-        auc_state = auc_lib.new_state(self.cfg.auc_buckets)
-        for pb in dataset.batches(self.cfg.global_batch_size, drop_last=True):
+        auc_acc = auc_lib.AucAccumulator(self.cfg.auc_buckets)
+        for pb in dataset.batches(bs, drop_last=False):
+            n_valid = len(pb.floats)
+            if n_valid < bs:
+                pb = pb.pad_to(bs)  # tail batch: pad + mask, don't drop
             idx, mask, dense, labels = self._put_batch(ws, pb)
             preds = self._eval_fn(ws.table, self.params, idx, mask, dense)
-            auc_state = self._auc_fn(auc_state, preds, labels)
-        return auc_lib.auc_compute(auc_state)
+            valid = jnp.arange(bs) < n_valid
+            auc_acc.update(self._auc_masked_fn, preds, labels, valid)
+        return auc_acc.compute()

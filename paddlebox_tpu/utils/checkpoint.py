@@ -1,0 +1,57 @@
+"""Dense-parameter checkpointing — pytree ↔ npz.
+
+The reference persists dense params by copying the thread-0 scope back to the
+root scope at trainer Finalize (boxps_trainer.cc:123-131) and then calling
+``fluid.io.save_persistables``. Here the dense state is a JAX pytree
+(params + optimizer state); we serialize it keyed by tree path so load is
+order-independent and shape-checked.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any
+
+import jax
+import numpy as np
+
+
+def _path_str(path) -> str:
+    parts = []
+    for p in path:
+        if hasattr(p, "key"):
+            parts.append(str(p.key))
+        elif hasattr(p, "idx"):
+            parts.append(str(p.idx))
+        elif hasattr(p, "name"):
+            parts.append(str(p.name))
+        else:
+            parts.append(str(p))
+    return "/".join(parts)
+
+
+def save_pytree(tree: Any, fname: str) -> str:
+    leaves = jax.tree_util.tree_flatten_with_path(tree)[0]
+    arrays = {_path_str(path): np.asarray(leaf) for path, leaf in leaves}
+    os.makedirs(os.path.dirname(fname) or ".", exist_ok=True)
+    np.savez_compressed(fname, **arrays)
+    return fname
+
+
+def load_pytree(template: Any, fname: str) -> Any:
+    """Load into the structure of `template` (shapes must match)."""
+    z = np.load(fname)
+    leaves, treedef = jax.tree_util.tree_flatten_with_path(template)
+    out = []
+    for path, leaf in leaves:
+        key = _path_str(path)
+        if key not in z:
+            raise KeyError(f"checkpoint {fname} missing leaf {key!r}")
+        arr = z[key]
+        want = np.shape(leaf)
+        if tuple(arr.shape) != tuple(want):
+            raise ValueError(
+                f"leaf {key!r}: checkpoint shape {arr.shape} != {want}")
+        out.append(arr)
+    return jax.tree_util.tree_unflatten(
+        treedef, [jax.numpy.asarray(a) for a in out])

@@ -1,0 +1,181 @@
+"""Day/pass orchestration: BoxPS lifecycle + FleetUtil save/load round-trips.
+
+Mirrors the reference's day loop (SURVEY.md §3.4): set_date → begin_pass →
+train → end_pass(save_delta) → save day base model; resume from the newest
+donefile entry.
+"""
+
+import numpy as np
+import pytest
+
+from paddlebox_tpu.embedding import EmbeddingConfig, HostEmbeddingStore
+from paddlebox_tpu.fleet import BoxPS, FleetUtil
+from paddlebox_tpu.models import DNNCTRModel
+from paddlebox_tpu.parallel import make_mesh
+from paddlebox_tpu.train import Trainer, TrainerConfig
+from tests.test_train_e2e import NUM_SLOTS, synth_dataset
+
+
+@pytest.fixture(scope="module")
+def mesh8():
+    return make_mesh(8)
+
+
+def _make_trainer(mesh, schema, store, seed=0):
+    model = DNNCTRModel(num_slots=NUM_SLOTS, emb_dim=4, dense_dim=1,
+                        hidden=(16,))
+    return Trainer(model, store, schema, mesh,
+                   TrainerConfig(global_batch_size=64, auc_buckets=1 << 10),
+                   seed=seed)
+
+
+def test_day_loop_save_load_resume(mesh8, tmp_path):
+    ds, schema = synth_dataset(512, seed=3)
+    store = HostEmbeddingStore(EmbeddingConfig(dim=4))
+    tr = _make_trainer(mesh8, schema, store)
+    box = BoxPS(store)
+    util = FleetUtil(str(tmp_path))
+
+    day = 20260729
+    box.set_date(day)
+    for pass_id in (1, 2):
+        box.begin_pass()
+        tr.train_pass(ds)
+        info = box.end_pass()
+        assert info["pass_id"] == pass_id
+        util.save_delta_model(store, (tr.params, tr.opt_state), day, pass_id)
+    util.save_model(store, (tr.params, tr.opt_state), day)
+
+    # donefiles recorded both planes
+    assert util.latest("base_model.donefile")["day"] == day
+    assert util.latest("delta_model.donefile")["pass"] == 2
+
+    # resume into a FRESH store/trainer from the newest base model
+    store2, (params2, opt2), got_day = util.load_model(
+        (tr.params, tr.opt_state))
+    assert got_day == day
+    assert len(store2) == len(store)
+    keys = ds.unique_keys()
+    np.testing.assert_allclose(store2.get_rows(keys), store.get_rows(keys))
+    import jax
+    jax.tree_util.tree_map(
+        lambda a, b: np.testing.assert_array_equal(np.asarray(a),
+                                                   np.asarray(b)),
+        params2, tr.params)
+
+    # resumed trainer keeps training without error
+    tr2 = _make_trainer(mesh8, schema, store2)
+    tr2.params, tr2.opt_state = params2, opt2
+    out = tr2.train_pass(ds)
+    assert np.isfinite(out["loss_mean"])
+
+
+def test_delta_log_replay(tmp_path):
+    """save_base → train-ish mutations → save_delta → load replays deltas."""
+    cfg = EmbeddingConfig(dim=2)
+    store = HostEmbeddingStore(cfg)
+    keys = np.arange(1, 50, dtype=np.uint64)
+    store.lookup_or_init(keys)
+    path = str(tmp_path / "sparse")
+    store.save_base(path)
+    rows = store.get_rows(keys)
+    rows[:, 2] += 1.0
+    store.write_back(keys, rows)
+    store.save_delta(path)
+    loaded = HostEmbeddingStore.load(path)
+    np.testing.assert_allclose(loaded.get_rows(keys), store.get_rows(keys))
+
+
+def test_midday_crash_recovery(tmp_path):
+    """Yesterday's base + today's pass deltas, no base for today yet (crash
+    mid-day): load_model must replay today's deltas on top of yesterday."""
+    cfg = EmbeddingConfig(dim=2)
+    store = HostEmbeddingStore(cfg)
+    util = FleetUtil(str(tmp_path))
+    keys = np.arange(1, 30, dtype=np.uint64)
+    store.lookup_or_init(keys)
+    dense = {"w": np.zeros(3, dtype=np.float32)}
+    util.save_model(store, dense, day=1)
+
+    # day 2: two passes of mutations, deltas only — then "crash"
+    for p in (1, 2):
+        rows = store.get_rows(keys)
+        rows[:, 2] += p
+        store.write_back(keys, rows)
+        dense = {"w": np.full(3, float(p), dtype=np.float32)}
+        util.save_delta_model(store, dense, day=2, pass_id=p)
+        # delta dir is self-contained: sparse plane + dense plane together
+        import os
+        d = util.delta_dir(2, p)
+        assert os.path.exists(os.path.join(d, "dense.npz"))
+        assert any(f.startswith("delta-")
+                   for f in os.listdir(os.path.join(d, "sparse")))
+
+    store2, dense2, day = util.load_model({"w": np.zeros(3, dtype=np.float32)})
+    assert day == 2
+    np.testing.assert_allclose(store2.get_rows(keys), store.get_rows(keys))
+    np.testing.assert_allclose(np.asarray(dense2["w"]), 2.0)
+
+
+def test_phase_flip_gates_metrics():
+    store = HostEmbeddingStore(EmbeddingConfig(dim=2))
+    box = BoxPS(store)
+    box.init_metric("join_auc", phase=1, n_buckets=64)
+    box.init_metric("update_auc", phase=0, n_buckets=64)
+    preds = np.array([0.2, 0.8]); labels = np.array([0.0, 1.0])
+    box.metrics.add_data("join_auc", preds, labels)
+    box.metrics.add_data("update_auc", preds, labels)
+    assert box.get_metric_msg("join_auc")["size"] == 2
+    assert box.get_metric_msg("update_auc")["size"] == 0
+    box.flip_phase()
+    box.metrics.add_data("update_auc", preds, labels)
+    assert box.get_metric_msg("update_auc")["size"] == 2
+
+
+def test_evicted_then_recreated_key_survives_delta_replay(tmp_path):
+    """shrink() tombstones a key; re-creating it must cancel the tombstone so
+    delta replay does not delete the live row."""
+    cfg = EmbeddingConfig(dim=2)
+    store = HostEmbeddingStore(cfg)
+    keys = np.array([7, 8], dtype=np.uint64)
+    rows = store.lookup_or_init(keys)
+    rows[:, 0] = 5.0  # shows
+    store.write_back(keys, rows)
+    path = str(tmp_path / "sp")
+    store.save_base(path)
+    store.shrink(min_show=10.0)          # evicts both
+    assert len(store) == 0
+    rows = store.lookup_or_init(keys[:1])  # re-create key 7
+    rows[:, 2] = 3.25
+    store.write_back(keys[:1], rows)
+    store.save_delta(path)
+    loaded = HostEmbeddingStore.load(path)
+    assert len(loaded) == 1              # 8 stays evicted, 7 lives
+    np.testing.assert_allclose(loaded.get_rows(keys[:1])[:, 2], 3.25)
+
+
+def test_auc_accumulator_matches_single_state():
+    import jax
+    from paddlebox_tpu.metrics import auc as auc_lib
+    rng = np.random.default_rng(0)
+    acc = auc_lib.AucAccumulator(256, drain_every=3)
+    ref = auc_lib.new_state(256)
+    fn = jax.jit(auc_lib.auc_update)
+    for _ in range(10):
+        p = rng.random(64).astype(np.float32)
+        y = (rng.random(64) < 0.4).astype(np.float32)
+        acc.update(fn, p, y)
+        ref = fn(ref, p, y)
+    a, b = acc.compute(), auc_lib.auc_compute(ref)
+    for k in ("auc", "mae", "size"):
+        assert abs(a[k] - b[k]) < 1e-5, (k, a[k], b[k])
+
+
+def test_begin_end_pass_guards():
+    box = BoxPS(HostEmbeddingStore(EmbeddingConfig(dim=2)))
+    with pytest.raises(RuntimeError):
+        box.end_pass()
+    box.begin_pass()
+    with pytest.raises(RuntimeError):
+        box.begin_pass()
+    box.end_pass()
