@@ -40,6 +40,7 @@ _OPT_SLOTS = {
 @dataclasses.dataclass(frozen=True)
 class EmbeddingConfig:
     dim: int = 8                      # embedx dimension
+    expand_dim: int = 0               # expand embedding (pull_box_extended_sparse)
     optimizer: str = "adagrad"
     learning_rate: float = 0.05
     initial_g2sum: float = 3.0        # adagrad epsilon-like accumulator floor
@@ -56,35 +57,46 @@ class EmbeddingConfig:
         if self.optimizer not in _OPT_SLOTS:
             raise ValueError(f"unknown embedding optimizer {self.optimizer!r}; "
                              f"choose from {sorted(_OPT_SLOTS)}")
-        if self.dim < 0:
-            raise ValueError("dim must be >= 0")
+        if self.dim < 0 or self.expand_dim < 0:
+            raise ValueError("dim/expand_dim must be >= 0")
 
     # --- row geometry ---
+    @property
+    def total_dim(self) -> int:
+        """embedx + expand columns — one contiguous trained vector.
+
+        The reference stores the expand embedding in the same per-feature
+        value struct ({EmbedxDim, ExpandDim} templates, box_wrapper.cc:444-461)
+        and trains both with the PS-side optimizer; here the split point is
+        config metadata and ops/extended.py slices the pulled vector.
+        """
+        return self.dim + self.expand_dim
+
     @property
     def n_opt_slots(self) -> int:
         return _OPT_SLOTS[self.optimizer]
 
     @property
     def pull_width(self) -> int:
-        """show, clk, w, embedx — what lookup returns."""
-        return 3 + self.dim
+        """show, clk, w, embedx(+expand) — what lookup returns."""
+        return 3 + self.total_dim
 
     @property
     def grad_width(self) -> int:
-        """d_w, d_embedx — what push consumes."""
-        return 1 + self.dim
+        """d_w, d_embedx(+expand) — what push consumes."""
+        return 1 + self.total_dim
 
     @property
     def row_width(self) -> int:
-        return 3 + self.dim + self.n_opt_slots
+        return 3 + self.total_dim + self.n_opt_slots
 
     # column helpers
     SHOW, CLK, W = 0, 1, 2
 
     @property
     def embedx_cols(self) -> slice:
-        return slice(3, 3 + self.dim)
+        return slice(3, 3 + self.total_dim)
 
     @property
     def opt_cols(self) -> slice:
-        return slice(3 + self.dim, self.row_width)
+        return slice(3 + self.total_dim, self.row_width)

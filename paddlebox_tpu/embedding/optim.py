@@ -27,7 +27,7 @@ def apply_updates(rows: jnp.ndarray, grads: jnp.ndarray,
     Returns new rows. Rows whose grad is all-zero are unchanged (up to
     counter increments), so padded/null rows are safe to pass through.
     """
-    d = cfg.dim
+    d = cfg.total_dim
     show = rows[:, 0] + show_inc
     clk = rows[:, 1] + clk_inc
     w = rows[:, 2]

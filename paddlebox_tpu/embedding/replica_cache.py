@@ -1,0 +1,104 @@
+"""Full-replica embedding cache + CPU string-keyed input table.
+
+Reference (box_wrapper.h:140-248):
+
+- ``GpuReplicaCache`` — a small embedding table mirrored in full to every
+  GPU's HBM (``ToHBM``), read by the ``pull_cache_value`` op; used for
+  high-frequency features whose whole table fits on-chip, skipping the
+  sharded PS round-trip entirely (FLAGS_use_gpu_replica_cache, flags.cc:486).
+- ``InputTable`` — a CPU table mapping content-feature *strings* to dense
+  indices (``LookupInput``), fed by ``InputTableDataFeed`` (data_feed.h:1718);
+  the indices then address the replica cache or a dense parameter.
+
+TPU design: the cache is a plain (N, D) jnp array placed with a replicated
+sharding — every chip holds the full copy, lookups are local gathers (no
+collectives); the host-side dict does key→row translation at batch-translate
+time, same place the pass working set translates uint64 signs to int32.
+"""
+
+from __future__ import annotations
+
+import threading
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from paddlebox_tpu.parallel import mesh as mesh_lib
+
+
+class ReplicaCache:
+    """Host-built, fully-replicated device cache (GpuReplicaCache)."""
+
+    def __init__(self, dim: int):
+        self.dim = dim
+        self._index: dict[int, int] = {}
+        self._rows: list[np.ndarray] = [np.zeros(dim, np.float32)]  # row 0 = null
+        self._device_table: jnp.ndarray | None = None
+
+    def __len__(self) -> int:
+        return len(self._rows)
+
+    def add(self, keys: np.ndarray, values: np.ndarray) -> None:
+        """Install/overwrite rows host-side (the feed-pass build)."""
+        values = np.asarray(values, np.float32)
+        for k, v in zip(np.asarray(keys).astype(np.uint64).tolist(), values):
+            j = self._index.get(int(k), -1)
+            if j < 0:
+                self._index[int(k)] = len(self._rows)
+                self._rows.append(v.copy())
+            else:
+                self._rows[j] = v.copy()
+        self._device_table = None  # stale
+
+    def translate(self, keys: np.ndarray) -> np.ndarray:
+        """uint64 keys → int32 cache rows (0 for misses), host-side."""
+        flat = np.asarray(keys).astype(np.uint64).reshape(-1)
+        out = np.fromiter((self._index.get(int(k), 0) for k in flat.tolist()),
+                          dtype=np.int32, count=len(flat))
+        return out.reshape(np.asarray(keys).shape)
+
+    def to_hbm(self, mesh: jax.sharding.Mesh) -> jnp.ndarray:
+        """Mirror the table to every device (ToHBM, box_wrapper.h:159)."""
+        if self._device_table is None:
+            host = np.stack(self._rows)
+            self._device_table = jax.device_put(
+                host, mesh_lib.replicated_sharding(mesh))
+        return self._device_table
+
+
+def pull_cache_value(cache_table: jnp.ndarray, idx: jnp.ndarray
+                     ) -> jnp.ndarray:
+    """Replicated-gather op (operators/pull_box_sparse_op.cc variant
+    `pull_cache_value`): idx any shape → idx.shape + (dim,). Local on every
+    chip — no collective, the point of the replica cache."""
+    return cache_table[idx.reshape(-1)].reshape(
+        (*idx.shape, cache_table.shape[1]))
+
+
+class InputTable:
+    """CPU string→index table (LookupInput, box_wrapper.h:215).
+
+    Thread-safe append-on-miss, mirroring the data-feed path that assigns
+    dense ids to content-feature strings while parsing
+    (InputTableDataFeed, data_feed.cc:3308-3460). Index 0 is reserved for
+    miss/padding.
+    """
+
+    def __init__(self):
+        self._index: dict[str, int] = {}
+        self._lock = threading.Lock()
+
+    def __len__(self) -> int:
+        return len(self._index)
+
+    def lookup(self, tokens: list[str], insert: bool = True) -> np.ndarray:
+        out = np.zeros(len(tokens), np.int32)
+        with self._lock:
+            for i, t in enumerate(tokens):
+                j = self._index.get(t, 0)
+                if j == 0 and insert:
+                    j = len(self._index) + 1
+                    self._index[t] = j
+                out[i] = j
+        return out

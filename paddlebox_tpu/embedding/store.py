@@ -54,11 +54,11 @@ class HostEmbeddingStore:
         cfg = self.cfg
         n = len(keys)
         rows = np.zeros((n, cfg.row_width), dtype=np.float32)
-        if cfg.dim:
+        if cfg.total_dim:
             # hash-based uniform init in [-initial_range, initial_range):
             # same key → same init on every host, no RNG state to sync.
             k = keys.astype(np.uint64)[:, None]
-            j = np.arange(cfg.dim, dtype=np.uint64)[None, :]
+            j = np.arange(cfg.total_dim, dtype=np.uint64)[None, :]
             with np.errstate(over="ignore"):
                 z = (k * np.uint64(0x9E3779B97F4A7C15)
                      + (j + np.uint64(cfg.seed)) * np.uint64(0xBF58476D1CE4E5B9))

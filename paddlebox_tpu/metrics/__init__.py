@@ -1,3 +1,5 @@
-from paddlebox_tpu.metrics.auc import (AucState, auc_update, auc_compute,  # noqa: F401
+from paddlebox_tpu.metrics.auc import (AucState, AucAccumulator,  # noqa: F401
+                                       auc_update, auc_compute,
                                        merge_states, psum_state, new_state)
 from paddlebox_tpu.metrics.metric import MetricRegistry, parse_cmatch_rank  # noqa: F401
+from paddlebox_tpu.metrics.auc_runner import AucRunner  # noqa: F401

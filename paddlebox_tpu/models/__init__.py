@@ -1,2 +1,11 @@
 from paddlebox_tpu.models.dnn_ctr import DNNCTRModel  # noqa: F401
 from paddlebox_tpu.models.deepfm import DeepFMModel  # noqa: F401
+from paddlebox_tpu.models.wide_deep import WideDeepModel  # noqa: F401
+from paddlebox_tpu.models.dcn import DCNv2Model  # noqa: F401
+from paddlebox_tpu.models.dlrm import DLRMModel  # noqa: F401
+from paddlebox_tpu.models.mmoe import MMoEModel  # noqa: F401
+
+MODEL_REGISTRY = {
+    m.name: m for m in (DNNCTRModel, DeepFMModel, WideDeepModel,
+                        DCNv2Model, DLRMModel, MMoEModel)
+}

@@ -1,0 +1,64 @@
+"""DCN-v2 — deep & cross network with full-matrix cross layers.
+
+Reference scope: SURVEY.md §7.6 names DCN-v2 in the model-zoo milestone
+(BASELINE.json configs). Cross layer: x_{l+1} = x0 ⊙ (W_l x_l + b_l) + x_l —
+each layer is one (D, D) matmul, MXU-friendly; the deep tower runs in
+parallel and both heads concatenate into the logit layer.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from paddlebox_tpu.models.nn import dense_init, mlp_apply, mlp_init
+from paddlebox_tpu.ops import fused_seqpool_cvm
+
+
+class DCNv2Model:
+    name = "dcn_v2"
+
+    def __init__(self, num_slots: int, emb_dim: int, dense_dim: int = 0,
+                 hidden: tuple[int, ...] = (256, 128),
+                 num_cross_layers: int = 3, use_cvm: bool = True,
+                 compute_dtype=jnp.float32):
+        self.num_slots = num_slots
+        self.emb_dim = emb_dim
+        self.dense_dim = dense_dim
+        self.use_cvm = use_cvm
+        self.num_cross = num_cross_layers
+        self.compute_dtype = compute_dtype
+        slot_feat = (3 + emb_dim) if use_cvm else (1 + emb_dim)
+        self.in_dim = num_slots * slot_feat + dense_dim
+        self.deep_dims = (self.in_dim, *hidden)
+        self.head_in = self.in_dim + hidden[-1]
+
+    def init(self, key):
+        kc, kd, kh = jax.random.split(key, 3)
+        cross = [dense_init(k, self.in_dim, self.in_dim)
+                 for k in jax.random.split(kc, self.num_cross)]
+        return {
+            "cross": cross,
+            "deep": mlp_init(kd, self.deep_dims),
+            "head": dense_init(kh, self.head_in, 1),
+        }
+
+    def apply(self, params, pulled, mask, dense, segment_ids, num_slots=None):
+        feats = fused_seqpool_cvm(pulled, mask, segment_ids, self.num_slots,
+                                  use_cvm=self.use_cvm)
+        x0 = (jnp.concatenate([feats, dense], axis=1)
+              if self.dense_dim else feats)
+        cd = self.compute_dtype
+        # cross tower
+        x = x0
+        for layer in params["cross"]:
+            xw = (jnp.asarray(x, cd) @ jnp.asarray(layer["w"], cd)
+                  ).astype(jnp.float32) + layer["b"]
+            x = x0 * xw + x
+        # deep tower (parallel structure)
+        deep = mlp_apply(params["deep"], x0, final_activation="relu",
+                         compute_dtype=cd)
+        h = jnp.concatenate([x, deep], axis=1)
+        logits = (jnp.asarray(h, cd) @ jnp.asarray(params["head"]["w"], cd)
+                  ).astype(jnp.float32) + params["head"]["b"]
+        return logits[:, 0]

@@ -1,0 +1,67 @@
+"""DLRM — bottom dense MLP + pairwise dot-product feature interactions.
+
+Reference scope: SURVEY.md §7.6 ("DCN-v2/DLRM multi-hot"). Sparse slots are
+sum-pooled (multi-hot → one vector per slot); the dense features pass
+through a bottom MLP into the same embedding space; the interaction is the
+upper triangle of the (S+1)×(S+1) Gram matrix of all vectors — one batched
+matmul, MXU-friendly; top MLP over [dense_vec, interactions].
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from paddlebox_tpu.models.nn import mlp_apply, mlp_init
+from paddlebox_tpu.ops import fused_seqpool_cvm
+
+
+class DLRMModel:
+    name = "dlrm"
+
+    def __init__(self, num_slots: int, emb_dim: int, dense_dim: int,
+                 bottom_hidden: tuple[int, ...] = (64,),
+                 top_hidden: tuple[int, ...] = (256, 128),
+                 use_cvm: bool = False, compute_dtype=jnp.float32):
+        self.num_slots = num_slots
+        self.emb_dim = emb_dim
+        self.dense_dim = dense_dim
+        self.use_cvm = use_cvm
+        self.compute_dtype = compute_dtype
+        # bottom MLP maps dense floats → emb_dim so it joins the interaction
+        self.bottom_dims = (max(dense_dim, 1), *bottom_hidden, emb_dim)
+        n_vec = num_slots + 1
+        n_pairs = n_vec * (n_vec - 1) // 2
+        # top input carries the per-slot first-order w column too — the pull
+        # layout dedicates it to exactly this role, and pure pairwise
+        # interactions have no first-order path
+        self.top_in = emb_dim + n_pairs + num_slots
+        self.top_dims = (self.top_in, *top_hidden, 1)
+
+    def init(self, key):
+        kb, kt = jax.random.split(key)
+        return {"bottom": mlp_init(kb, self.bottom_dims),
+                "top": mlp_init(kt, self.top_dims)}
+
+    def apply(self, params, pulled, mask, dense, segment_ids, num_slots=None):
+        cd = self.compute_dtype
+        feats = fused_seqpool_cvm(pulled, mask, segment_ids, self.num_slots,
+                                  use_cvm=self.use_cvm, flatten=False)
+        off = 3 if self.use_cvm else 1
+        w = feats[..., off - 1]                           # (B, S) first-order
+        v = feats[..., off:]                              # (B, S, E) pooled
+        B = v.shape[0]
+        if self.dense_dim:
+            d_in = dense
+        else:
+            d_in = jnp.zeros((B, 1), jnp.float32)
+        d_vec = mlp_apply(params["bottom"], d_in, final_activation="relu",
+                          compute_dtype=cd)               # (B, E)
+        allv = jnp.concatenate([d_vec[:, None, :], v], axis=1)  # (B, S+1, E)
+        gram = jnp.einsum("bse,bte->bst", jnp.asarray(allv, cd),
+                          jnp.asarray(allv, cd)).astype(jnp.float32)
+        n = allv.shape[1]
+        iu, ju = jnp.triu_indices(n, k=1)
+        inter = gram[:, iu, ju]                           # (B, n_pairs)
+        x = jnp.concatenate([d_vec, inter, w], axis=1)
+        return mlp_apply(params["top"], x, compute_dtype=cd)[:, 0]

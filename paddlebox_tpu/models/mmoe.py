@@ -1,0 +1,78 @@
+"""MMoE — multi-gate mixture-of-experts multi-task CTR tower.
+
+Reference scope: SURVEY.md §7.6 (MMoE in the model-zoo milestone; the
+reference runs MMoE-style models as plain dense towers — SURVEY.md §2.3
+"Expert parallelism: absent"). Experts are small MLPs evaluated for every
+example (one batched einsum over the expert axis — no routing sparsity, so
+no load-balancing machinery needed at CTR expert counts); each task has a
+softmax gate over experts and its own tower head.
+
+`apply` returns the primary task's logits (trainer-compatible);
+`apply_tasks` returns all task logits (B, num_tasks).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from paddlebox_tpu.models.nn import dense_init, mlp_apply, mlp_init
+from paddlebox_tpu.ops import fused_seqpool_cvm
+
+
+class MMoEModel:
+    name = "mmoe"
+
+    def __init__(self, num_slots: int, emb_dim: int, dense_dim: int = 0,
+                 num_experts: int = 4, num_tasks: int = 2,
+                 expert_hidden: tuple[int, ...] = (64,),
+                 expert_out: int = 32,
+                 tower_hidden: tuple[int, ...] = (32,),
+                 use_cvm: bool = True, compute_dtype=jnp.float32):
+        self.num_slots = num_slots
+        self.emb_dim = emb_dim
+        self.dense_dim = dense_dim
+        self.num_experts = num_experts
+        self.num_tasks = num_tasks
+        self.use_cvm = use_cvm
+        self.compute_dtype = compute_dtype
+        slot_feat = (3 + emb_dim) if use_cvm else (1 + emb_dim)
+        self.in_dim = num_slots * slot_feat + dense_dim
+        self.expert_dims = (self.in_dim, *expert_hidden, expert_out)
+        self.tower_dims = (expert_out, *tower_hidden, 1)
+
+    def init(self, key):
+        ke, kg, kt = jax.random.split(key, 3)
+        experts = [mlp_init(k, self.expert_dims)
+                   for k in jax.random.split(ke, self.num_experts)]
+        gates = [dense_init(k, self.in_dim, self.num_experts)
+                 for k in jax.random.split(kg, self.num_tasks)]
+        towers = [mlp_init(k, self.tower_dims)
+                  for k in jax.random.split(kt, self.num_tasks)]
+        return {"experts": experts, "gates": gates, "towers": towers}
+
+    def _features(self, pulled, mask, dense, segment_ids):
+        feats = fused_seqpool_cvm(pulled, mask, segment_ids, self.num_slots,
+                                  use_cvm=self.use_cvm)
+        return (jnp.concatenate([feats, dense], axis=1)
+                if self.dense_dim else feats)
+
+    def apply_tasks(self, params, pulled, mask, dense, segment_ids,
+                    num_slots=None) -> jnp.ndarray:
+        cd = self.compute_dtype
+        x = self._features(pulled, mask, dense, segment_ids)
+        expert_out = jnp.stack(
+            [mlp_apply(e, x, final_activation="relu", compute_dtype=cd)
+             for e in params["experts"]], axis=1)        # (B, E, O)
+        logits = []
+        for gate, tower in zip(params["gates"], params["towers"]):
+            g = jax.nn.softmax(
+                (jnp.asarray(x, cd) @ jnp.asarray(gate["w"], cd)
+                 ).astype(jnp.float32) + gate["b"], axis=-1)  # (B, E)
+            mixed = jnp.einsum("be,beo->bo", g, expert_out)
+            logits.append(mlp_apply(tower, mixed, compute_dtype=cd)[:, 0])
+        return jnp.stack(logits, axis=1)                 # (B, T)
+
+    def apply(self, params, pulled, mask, dense, segment_ids, num_slots=None):
+        return self.apply_tasks(params, pulled, mask, dense,
+                                segment_ids, num_slots)[:, 0]

@@ -1,0 +1,58 @@
+"""Wide&Deep — linear wide part over per-slot scalar weights + deep MLP.
+
+One of the stock CTR families the reference's fleet tests exercise
+(dist_fleet_ctr.py model zoo lineage). The wide part consumes the dedicated
+per-feature scalar weight column (the same `w` column DeepFM's first-order
+term uses); the deep part consumes seqpool+CVM features and dense floats.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from paddlebox_tpu.models.nn import mlp_apply, mlp_init
+from paddlebox_tpu.ops import fused_seqpool_cvm
+
+
+class WideDeepModel:
+    name = "wide_deep"
+
+    def __init__(self, num_slots: int, emb_dim: int, dense_dim: int = 0,
+                 hidden: tuple[int, ...] = (256, 128, 64),
+                 use_cvm: bool = True, compute_dtype=jnp.float32):
+        self.num_slots = num_slots
+        self.emb_dim = emb_dim
+        self.dense_dim = dense_dim
+        self.use_cvm = use_cvm
+        self.compute_dtype = compute_dtype
+        slot_feat = (3 + emb_dim) if use_cvm else (1 + emb_dim)
+        self.deep_in = num_slots * slot_feat + dense_dim
+        self.dims = (self.deep_in, *hidden, 1)
+
+    def init(self, key):
+        k1, k2 = jax.random.split(key)
+        params = {
+            "mlp": mlp_init(k1, self.dims),
+            # per-slot scale on the summed w column — the wide weights
+            "wide_slot": jnp.ones((self.num_slots,), jnp.float32),
+            "bias": jnp.zeros((1,), jnp.float32),
+        }
+        if self.dense_dim:
+            params["wide_dense"] = (
+                jax.random.normal(k2, (self.dense_dim,), jnp.float32) * 0.01)
+        return params
+
+    def apply(self, params, pulled, mask, dense, segment_ids, num_slots=None):
+        feats = fused_seqpool_cvm(pulled, mask, segment_ids, self.num_slots,
+                                  use_cvm=self.use_cvm, flatten=False)
+        off = 2 if self.use_cvm else 0
+        w = feats[..., off]                       # (B, S)
+        wide = w @ params["wide_slot"]
+        x = feats.reshape(feats.shape[0], -1)
+        if self.dense_dim:
+            x = jnp.concatenate([x, dense], axis=1)
+            wide = wide + dense @ params["wide_dense"]
+        deep = mlp_apply(params["mlp"], x,
+                         compute_dtype=self.compute_dtype)[:, 0]
+        return wide + deep + params["bias"][0]

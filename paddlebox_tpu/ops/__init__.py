@@ -1,7 +1,9 @@
-from paddlebox_tpu.ops.seqpool_cvm import fused_seqpool_cvm  # noqa: F401
+from paddlebox_tpu.ops.seqpool_cvm import (fused_seqpool_cvm,  # noqa: F401
+                                           fused_seqpool_cvm_with_conv)
 from paddlebox_tpu.ops.cvm import cvm, cvm_inverse  # noqa: F401
 from paddlebox_tpu.ops.rank_attention import rank_attention, build_rank_offset  # noqa: F401
 from paddlebox_tpu.ops.batch_fc import batch_fc  # noqa: F401
 from paddlebox_tpu.ops.cross_norm import (cross_norm_hadamard, data_norm,  # noqa: F401
                                           summary_update, init_summary)
 from paddlebox_tpu.ops.fused_concat import fused_concat  # noqa: F401
+from paddlebox_tpu.ops.extended import pull_box_extended_sparse  # noqa: F401

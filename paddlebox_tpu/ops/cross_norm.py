@@ -45,15 +45,23 @@ def data_norm(x: jnp.ndarray, summary: jnp.ndarray) -> jnp.ndarray:
 
 
 def summary_update(summary: jnp.ndarray, x: jnp.ndarray,
-                   decay: float = 0.9999999) -> jnp.ndarray:
+                   decay: float = 0.9999999,
+                   axis_name=None) -> jnp.ndarray:
     """Accumulate a batch into the summary with exponential decay
-    (summary_decay_rate attr, data_norm/cross_norm ops)."""
+    (summary_decay_rate attr, data_norm/cross_norm ops).
+
+    axis_name: inside shard_map, psum the batch contribution across
+    replicas — the reference's sync_stats c_allreduce of summary deltas
+    (data_norm_op.cu multi-trainer path)."""
+    from jax import lax
     b = x.shape[0]
     batch = jnp.stack([
         jnp.full((x.shape[-1],), float(b), x.dtype),
         x.sum(axis=0),
         (x * x).sum(axis=0),
     ])
+    if axis_name is not None:
+        batch = lax.psum(batch, axis_name)
     return summary * decay + batch
 
 

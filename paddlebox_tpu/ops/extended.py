@@ -1,0 +1,29 @@
+"""Extended (expand) embedding pull — pull_box_extended_sparse semantics.
+
+Reference (operators/pull_box_extended_sparse_op.{cc,cu,h}): one lookup
+returns TWO tensors per slot — the base embedding `Out` and an expand
+embedding `OutExtend` of a second dimension, both stored in the same
+per-feature PS row ({EmbedxDim, ExpandDim} dispatch, box_wrapper.cc:444-461).
+Here the table row already carries dim+expand_dim contiguous trained columns
+(EmbeddingConfig.total_dim); this op is the view split, applied after the
+(routed) lookup, so it fuses away under jit.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from paddlebox_tpu.embedding.config import EmbeddingConfig
+
+
+def pull_box_extended_sparse(pulled: jnp.ndarray, cfg: EmbeddingConfig
+                             ) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """pulled (..., pull_width) → (base (..., 3+dim), expand (..., expand_dim)).
+
+    Base keeps the [show, clk, w, embedx] layout every downstream op expects;
+    expand is the trailing expand_dim columns.
+    """
+    if cfg.expand_dim == 0:
+        raise ValueError("pull_box_extended_sparse needs expand_dim > 0")
+    split = 3 + cfg.dim
+    return pulled[..., :split], pulled[..., split:]

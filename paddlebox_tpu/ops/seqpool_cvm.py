@@ -26,6 +26,44 @@ import jax.numpy as jnp
 import numpy as np
 
 
+def _filter_and_quant(pulled, mask, seg_np, cvm_offset, need_filter,
+                      show_coeff, clk_coeff, threshold, embed_threshold,
+                      quant_ratio):
+    """Shared per-id filter + quantization stage.
+
+    cvm_offset is the column index of embed_w — 2 for the [show, clk, w]
+    layout, 3 for the conv layout [show, clk, conv, w].
+    """
+    keep = mask
+    if need_filter:
+        show, clk = pulled[..., 0], pulled[..., 1]
+        # threshold may be scalar, or per-slot (S,) — broadcast through
+        # segment_ids to tokens (fused_seqpool_cvm_with_diff_thres,
+        # operators/fused/fused_seqpool_cvm_with_diff_thres_op.cu)
+        thr = jnp.asarray(threshold, jnp.float32)
+        if thr.ndim == 1:
+            thr = thr[seg_np]
+        keep = keep & ((show - clk) * show_coeff + clk * clk_coeff >= thr)
+    if embed_threshold > 0.0:
+        show, w = pulled[..., 0], pulled[..., cvm_offset]
+        keep = keep & ~((show > embed_threshold)
+                        & (jnp.abs(w) < embed_threshold))
+    x = pulled
+    if quant_ratio > 0:
+        # quantize embedx only (cu:143-151 quantizes past cvm_offset+1)
+        q = jnp.round(x[..., cvm_offset + 1:] * quant_ratio) / quant_ratio
+        x = jnp.concatenate([x[..., :cvm_offset + 1], q], axis=-1)
+    return x * keep[..., None]
+
+
+def _pool(x, seg_np, num_slots):
+    """Sum-pool tokens into slots via a constant one-hot (T, S) matmul — rides
+    the MXU and avoids a scatter op (scatters carry a large fixed per-op cost
+    on TPU)."""
+    pool_mat = jnp.asarray(np.eye(num_slots, dtype=np.float32)[seg_np])
+    return jnp.einsum("btp,ts->bsp", x, pool_mat)
+
+
 def fused_seqpool_cvm(
     pulled: jnp.ndarray,
     mask: jnp.ndarray,
@@ -48,26 +86,11 @@ def fused_seqpool_cvm(
     if flatten else (B, S, out_dim), out_dim = P if use_cvm else P-cvm_offset.
     """
     B, T, P = pulled.shape
-    keep = mask
-    if need_filter:
-        show, clk = pulled[..., 0], pulled[..., 1]
-        keep = keep & ((show - clk) * show_coeff + clk * clk_coeff >= threshold)
-    if embed_threshold > 0.0:
-        show, w = pulled[..., 0], pulled[..., cvm_offset]
-        keep = keep & ~((show > embed_threshold)
-                        & (jnp.abs(w) < embed_threshold))
-    x = pulled
-    if quant_ratio > 0:
-        # quantize embedx only (cu:143-151 quantizes past cvm_offset+1)
-        q = jnp.round(x[..., cvm_offset + 1:] * quant_ratio) / quant_ratio
-        x = jnp.concatenate([x[..., :cvm_offset + 1], q], axis=-1)
-    x = x * keep[..., None]
-    # pool via a constant one-hot (T, S) matmul — rides the MXU and avoids a
-    # scatter op (scatters carry a large fixed per-op cost on TPU)
     seg_np = np.asarray(segment_ids, dtype=np.int64)
-    pool_mat = jnp.asarray(
-        np.eye(num_slots, dtype=np.float32)[seg_np])        # (T, S)
-    pooled = jnp.einsum("btp,ts->bsp", x, pool_mat)
+    x = _filter_and_quant(pulled, mask, seg_np, cvm_offset, need_filter,
+                          show_coeff, clk_coeff, threshold, embed_threshold,
+                          quant_ratio)
+    pooled = _pool(x, seg_np, num_slots)
     if use_cvm:
         log_show = jnp.log(pooled[..., 0:1] + 1.0)
         log_ctr = jnp.log(pooled[..., 1:2] + 1.0) - log_show
@@ -77,4 +100,46 @@ def fused_seqpool_cvm(
         out = pooled[..., cvm_offset:]
     if flatten:
         out = out.reshape(B, -1)
+    return out
+
+
+def fused_seqpool_cvm_with_conv(
+    pulled: jnp.ndarray,
+    mask: jnp.ndarray,
+    segment_ids: np.ndarray | jnp.ndarray,
+    num_slots: int,
+    use_cvm: bool = True,
+    need_filter: bool = False,
+    show_coeff: float = 0.2,
+    clk_coeff: float = 1.0,
+    threshold: float = 0.96,
+    embed_threshold: float = 0.0,
+    quant_ratio: int = 0,
+    flatten: bool = True,
+) -> jnp.ndarray:
+    """Conversion-aware variant (fused_seqpool_cvm_with_conv_op.cu).
+
+    The pull layout carries a third leading counter — conv(ersion) — after
+    show/clk, so embed_w sits at column 3: [show, clk, conv, w, embedx...].
+    Join phase emits [log(show+1), log(clk+1)-log(show+1),
+    log(conv+1)-log(clk+1)] (the CVR chain); update phase drops all three.
+    Filters/quantization run at the conv layout's column offsets.
+    """
+    CVM_OFFSET = 3  # embed_w column in the conv layout
+    seg_np = np.asarray(segment_ids, dtype=np.int64)
+    x = _filter_and_quant(pulled, mask, seg_np, CVM_OFFSET, need_filter,
+                          show_coeff, clk_coeff, threshold, embed_threshold,
+                          quant_ratio)
+    pooled = _pool(x, seg_np, num_slots)
+    if use_cvm:
+        log_show = jnp.log(pooled[..., 0:1] + 1.0)
+        log_ctr = jnp.log(pooled[..., 1:2] + 1.0) - log_show
+        log_cvr = (jnp.log(pooled[..., 2:3] + 1.0)
+                   - jnp.log(pooled[..., 1:2] + 1.0))
+        out = jnp.concatenate([log_show, log_ctr, log_cvr,
+                               pooled[..., CVM_OFFSET:]], axis=-1)
+    else:
+        out = pooled[..., CVM_OFFSET:]
+    if flatten:
+        out = out.reshape(out.shape[0], -1)
     return out

@@ -73,6 +73,15 @@ class Trainer:
         self.n_shards = mesh_lib.num_shards(mesh)
         if self.cfg.global_batch_size % self.n_shards:
             raise ValueError("global_batch_size must divide by mesh size")
+        model_dim = getattr(model, "emb_dim", None)
+        if model_dim is not None and model_dim != self.store.cfg.total_dim:
+            raise ValueError(
+                f"model emb_dim={model_dim} must equal the table's trained "
+                f"vector width total_dim={self.store.cfg.total_dim} "
+                f"(dim={self.store.cfg.dim} + expand_dim="
+                f"{self.store.cfg.expand_dim}); zoo models consume the full "
+                f"pulled vector — a model that reads the expand part "
+                f"separately should split with ops.pull_box_extended_sparse")
         self.params = model.init(jax.random.PRNGKey(seed))
         self.tx = _dense_tx(self.cfg)
         self.opt_state = self.tx.init(self.params)

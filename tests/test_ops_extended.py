@@ -1,0 +1,172 @@
+"""Extended sparse pull, seqpool conv variant, per-slot thresholds,
+replica cache, input table, summary sync, AUC runner."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from paddlebox_tpu.embedding import (EmbeddingConfig, HostEmbeddingStore,
+                                     InputTable, ReplicaCache,
+                                     pull_cache_value)
+from paddlebox_tpu.ops import (fused_seqpool_cvm, fused_seqpool_cvm_with_conv,
+                               pull_box_extended_sparse, summary_update,
+                               init_summary, data_norm)
+from paddlebox_tpu.parallel import make_mesh
+
+
+def test_expand_dim_geometry_and_split():
+    cfg = EmbeddingConfig(dim=8, expand_dim=4)
+    assert cfg.pull_width == 3 + 12
+    assert cfg.grad_width == 1 + 12
+    pulled = jnp.arange(2 * 3 * cfg.pull_width, dtype=jnp.float32).reshape(
+        2, 3, cfg.pull_width)
+    base, expand = pull_box_extended_sparse(pulled, cfg)
+    assert base.shape == (2, 3, 11)
+    assert expand.shape == (2, 3, 4)
+    np.testing.assert_array_equal(np.asarray(pulled[..., 11:]),
+                                  np.asarray(expand))
+
+
+def test_expand_dim_store_roundtrip():
+    cfg = EmbeddingConfig(dim=4, expand_dim=2)
+    store = HostEmbeddingStore(cfg)
+    keys = np.array([11, 22], dtype=np.uint64)
+    rows = store.lookup_or_init(keys)
+    assert rows.shape == (2, cfg.row_width)
+    # expand columns are initialized like embedx (nonzero)
+    assert np.abs(rows[:, 3 + cfg.dim:3 + cfg.total_dim]).sum() > 0
+
+
+def test_extended_requires_expand():
+    with pytest.raises(ValueError):
+        pull_box_extended_sparse(jnp.zeros((1, 1, 11)), EmbeddingConfig(dim=8))
+
+
+def test_trainer_rejects_mismatched_expand_dim():
+    """expand_dim>0 with a model sized only for dim must fail loudly at
+    Trainer init, not with a shape error deep inside jit."""
+    from paddlebox_tpu.data import DataFeedSchema
+    from paddlebox_tpu.models import DNNCTRModel
+    from paddlebox_tpu.train import Trainer, TrainerConfig
+    schema = DataFeedSchema.ctr(num_sparse=2, num_float=1, batch_size=8,
+                                max_len=1)
+    store = HostEmbeddingStore(EmbeddingConfig(dim=8, expand_dim=4))
+    model = DNNCTRModel(num_slots=2, emb_dim=8, dense_dim=0, hidden=(8,))
+    with pytest.raises(ValueError, match="expand_dim"):
+        Trainer(model, store, schema, make_mesh(8),
+                TrainerConfig(global_batch_size=8))
+
+
+def test_conv_variant_filters_at_conv_offsets():
+    """embed_threshold must read w at column 3 (conv layout), not show."""
+    # token: show=100, clk=5, conv=1, w=1e-6, emb=7 → must be filtered
+    pulled = jnp.asarray(np.array(
+        [[[100.0, 5.0, 1.0, 1e-6, 7.0]]], dtype=np.float32))
+    mask = jnp.ones((1, 1), bool)
+    seg = np.zeros(1, np.int64)
+    out = fused_seqpool_cvm_with_conv(pulled, mask, seg, 1, use_cvm=False,
+                                      flatten=False, embed_threshold=0.5)
+    np.testing.assert_allclose(np.asarray(out[0, 0]), [0.0, 0.0])
+    # quant_ratio quantizes embedx only, counters/w untouched
+    out2 = fused_seqpool_cvm_with_conv(pulled, mask, seg, 1, use_cvm=False,
+                                       flatten=False, quant_ratio=2)
+    np.testing.assert_allclose(np.asarray(out2[0, 0]), [1e-6, 7.0])
+
+
+def test_seqpool_cvm_with_conv():
+    # P = [show, clk, conv, w]: one slot, 2 tokens
+    pulled = jnp.asarray(np.array([
+        [[3.0, 2.0, 1.0, 0.5], [1.0, 0.0, 0.0, 0.25]],
+    ], dtype=np.float32))
+    mask = jnp.ones((1, 2), bool)
+    seg = np.zeros(2, np.int64)
+    out = fused_seqpool_cvm_with_conv(pulled, mask, seg, 1, use_cvm=True,
+                                      flatten=False)
+    show, clk, conv = 4.0, 2.0, 1.0
+    np.testing.assert_allclose(np.asarray(out[0, 0]), [
+        np.log(show + 1), np.log(clk + 1) - np.log(show + 1),
+        np.log(conv + 1) - np.log(clk + 1), 0.75], rtol=1e-6)
+    # update phase drops the three counters
+    out2 = fused_seqpool_cvm_with_conv(pulled, mask, seg, 1, use_cvm=False,
+                                       flatten=False)
+    assert out2.shape == (1, 1, 1)
+
+
+def test_seqpool_per_slot_threshold():
+    # two slots; slot 0 threshold low (keeps), slot 1 high (filters)
+    pulled = jnp.asarray(np.array([
+        [[5.0, 5.0, 1.0, 2.0], [5.0, 5.0, 1.0, 4.0]],
+    ], dtype=np.float32))
+    mask = jnp.ones((1, 2), bool)
+    seg = np.array([0, 1], np.int64)
+    out = fused_seqpool_cvm(pulled, mask, seg, 2, use_cvm=False,
+                            need_filter=True, show_coeff=0.2, clk_coeff=1.0,
+                            threshold=np.array([1.0, 100.0], np.float32),
+                            flatten=False)
+    # slot 0 kept: w=1, emb=2; slot 1 filtered: zeros
+    np.testing.assert_allclose(np.asarray(out[0, 0]), [1.0, 2.0])
+    np.testing.assert_allclose(np.asarray(out[0, 1]), [0.0, 0.0])
+
+
+def test_replica_cache_and_input_table():
+    mesh = make_mesh(8)
+    cache = ReplicaCache(dim=3)
+    keys = np.array([100, 200], dtype=np.uint64)
+    cache.add(keys, np.array([[1, 2, 3], [4, 5, 6]], np.float32))
+    table = cache.to_hbm(mesh)
+    idx = cache.translate(np.array([[200, 100], [999, 100]], dtype=np.uint64))
+    out = np.asarray(pull_cache_value(table, jnp.asarray(idx)))
+    np.testing.assert_allclose(out[0, 0], [4, 5, 6])
+    np.testing.assert_allclose(out[0, 1], [1, 2, 3])
+    np.testing.assert_allclose(out[1, 0], [0, 0, 0])  # miss → null row
+
+    it = InputTable()
+    a = it.lookup(["cat", "dog", "cat"])
+    assert a[0] == a[2] != a[1]
+    b = it.lookup(["bird"], insert=False)
+    assert b[0] == 0  # miss without insert
+
+
+def test_summary_update_psum():
+    mesh = make_mesh(8)
+    from jax.sharding import PartitionSpec as P
+
+    summary = init_summary(2)
+    x = np.random.default_rng(0).normal(size=(16, 2)).astype(np.float32)
+
+    def body(s, xl):
+        return summary_update(s, xl, axis_name="dp")
+
+    out = jax.jit(jax.shard_map(body, mesh=mesh, in_specs=(P(), P("dp")),
+                                out_specs=P()))(summary, jnp.asarray(x))
+    # psum'd batch contribution equals the full-batch single-host update
+    ref = summary_update(summary, jnp.asarray(x))
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), rtol=1e-5)
+    # and normalization with the synced summary is well-formed
+    y = data_norm(jnp.asarray(x), out)
+    assert np.isfinite(np.asarray(y)).all()
+
+
+def test_auc_runner_ranks_informative_slot():
+    from paddlebox_tpu.metrics import AucRunner
+    from paddlebox_tpu.models import DNNCTRModel
+    from paddlebox_tpu.train import Trainer, TrainerConfig
+    from tests.test_train_e2e import NUM_SLOTS, synth_dataset
+
+    ds, schema = synth_dataset(1024, seed=11)
+    store = HostEmbeddingStore(EmbeddingConfig(dim=8, learning_rate=0.15))
+    mesh = make_mesh(8)
+    model = DNNCTRModel(num_slots=NUM_SLOTS, emb_dim=8, dense_dim=1,
+                        hidden=(32,))
+    tr = Trainer(model, store, schema, mesh,
+                 TrainerConfig(global_batch_size=128, dense_lr=3e-3,
+                               auc_buckets=1 << 12))
+    for _ in range(3):
+        tr.train_pass(ds)
+    runner = AucRunner(tr, pool_size=5000, seed=0)
+    res = runner.run(ds, slots=[schema.sparse_slots[0].name])
+    s0 = schema.sparse_slots[0].name
+    assert res["__baseline__"]["auc"] > 0.6
+    # ablating an informative slot must cost AUC
+    assert res[s0]["auc_drop"] > 0.01, res
