@@ -80,6 +80,9 @@ class AucRunner:
         base = self.trainer.eval_pass(dataset)
         out: dict[str, dict[str, float]] = {"__baseline__": base}
         for name in slots:
+            if name not in self._pools:  # slot had no feasigns this pass
+                out[name] = {"auc_drop": 0.0, "skipped": 1.0}
+                continue
             m = self.trainer.eval_pass(self._ablated_dataset(dataset, name))
             m["auc_drop"] = base["auc"] - m["auc"]
             out[name] = m
