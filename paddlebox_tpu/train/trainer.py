@@ -82,9 +82,17 @@ class Trainer:
                 f"{self.store.cfg.expand_dim}); zoo models consume the full "
                 f"pulled vector — a model that reads the expand part "
                 f"separately should split with ops.pull_box_extended_sparse")
-        self.params = model.init(jax.random.PRNGKey(seed))
+        # Dense params/opt state are replicated over the mesh (the reference
+        # copies dense params to every GPU, boxps_worker.cc:403-480). Placing
+        # them explicitly — and pinning the step's out_shardings to match —
+        # keeps the fed-back step signature bit-stable: without this, XLA's
+        # sharding propagation picks its own output shardings and step #2
+        # recompiles (~20s on a real chip).
+        repl = mesh_lib.replicated_sharding(mesh)
+        self.params = jax.device_put(model.init(jax.random.PRNGKey(seed)),
+                                     repl)
         self.tx = _dense_tx(self.cfg)
-        self.opt_state = self.tx.init(self.params)
+        self.opt_state = jax.device_put(self.tx.init(self.params), repl)
         self.timers = StageTimers(["read", "translate", "train", "auc"])
         self._step_fn = self._build_train_step()
         self._eval_fn = self._build_eval_step()
@@ -157,8 +165,10 @@ class Trainer:
             return new_shard, gp, loss_g, preds
 
         batch_spec = P(axes)
+        repl = mesh_lib.replicated_sharding(self.mesh)
+        tbl_sh = mesh_lib.table_sharding(self.mesh)
+        bat_sh = mesh_lib.batch_sharding(self.mesh)
 
-        @jax.jit
         def step(table, params, opt_state, idx, mask, dense, labels):
             new_table, gp, loss, preds = jax.shard_map(
                 body, mesh=self.mesh,
@@ -170,7 +180,11 @@ class Trainer:
             new_params = optax.apply_updates(params, updates)
             return new_table, new_params, new_opt, loss, preds
 
-        return step
+        # Donation aliases the (large) table and the dense state in place;
+        # pinned out_shardings make output signatures identical to the inputs
+        # so the train_pass feedback loop never retraces.
+        return jax.jit(step, donate_argnums=(0, 1, 2),
+                       out_shardings=(tbl_sh, repl, repl, repl, bat_sh))
 
     def _build_eval_step(self) -> Callable:
         emb_cfg = self.store.cfg
@@ -229,25 +243,33 @@ class Trainer:
         # device arrays collected without per-step host sync (the hot loop
         # must stay dispatch-async to overlap host pack with device compute)
         dev_losses: list[Any] = []
-        for pb in dataset.batches(cfg.global_batch_size, drop_last=True):
-            idx, mask, dense, labels = self._put_batch(ws, pb)
-            with self.timers("train"):
-                table, params, opt_state, loss, preds = self._step_fn(
-                    table, params, opt_state, idx, mask, dense, labels)
-            with self.timers("auc"):
-                auc_acc.update(self._auc_fn, preds, labels)
-                if metrics is not None:
-                    metrics.add_batch(preds, labels, cmatch=pb.cmatch,
-                                      rank=pb.rank)
-            if cfg.check_nan_inf:
-                lv = float(loss)
-                if not np.isfinite(lv):
-                    raise FloatingPointError(
-                        f"nan/inf loss at step {self.global_step}")
-            dev_losses.append(loss)
-            self.global_step += 1
+        try:
+            for pb in dataset.batches(cfg.global_batch_size, drop_last=True):
+                idx, mask, dense, labels = self._put_batch(ws, pb)
+                with self.timers("train"):
+                    table, params, opt_state, loss, preds = self._step_fn(
+                        table, params, opt_state, idx, mask, dense, labels)
+                with self.timers("auc"):
+                    auc_acc.update(self._auc_fn, preds, labels)
+                    if metrics is not None:
+                        metrics.add_batch(preds, labels, cmatch=pb.cmatch,
+                                          rank=pb.rank)
+                if cfg.check_nan_inf:
+                    lv = float(loss)
+                    if not np.isfinite(lv):
+                        raise FloatingPointError(
+                            f"nan/inf loss at step {self.global_step}")
+                dev_losses.append(loss)
+                self.global_step += 1
+        finally:
+            # The step donates table/params/opt_state, so the objects bound
+            # before the loop are dead buffers; rebind to the last good step
+            # even when a batch raised (the pass/day crash-recovery flow
+            # catches and resumes from checkpoint — the Trainer must stay
+            # usable).
+            ws.table = table
+            self.params, self.opt_state = params, opt_state
         ws.end_pass(self.store, table)
-        self.params, self.opt_state = params, opt_state
         losses = [float(l) for l in dev_losses]  # one sync, post-loop
         out = auc_acc.compute()
         out["loss_first"] = losses[0] if losses else float("nan")
