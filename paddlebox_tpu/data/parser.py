@@ -37,11 +37,32 @@ def parse_multislot_lines(
 ) -> SlotRecordBatch:
     """Parse MultiSlot text lines into one columnar SlotRecordBatch."""
     native = _maybe_native()
-    if native is not None and not with_ins_id:
-        out = native.parse_lines(lines, schema)
+    if native is not None:
+        out = native.parse_lines(lines, schema, with_ins_id=with_ins_id)
         if out is not None:
             return out
     return _parse_python(lines, schema, with_ins_id)
+
+
+def parse_multislot_buffer(
+    buf: bytes,
+    schema: DataFeedSchema,
+    with_ins_id: bool = False,
+) -> SlotRecordBatch:
+    """Parse a whole raw text buffer — the zero-copy native fast path (the
+    file reader hands bytes straight to C++, no Python line iteration)."""
+    native = _maybe_native()
+    if native is not None:
+        out = native.parse_buffer(buf, schema, with_ins_id=with_ins_id)
+        if out is not None:
+            return out
+    return _parse_python(buf.decode("utf-8").splitlines(), schema,
+                         with_ins_id)
+
+
+_U64_MASK = (1 << 64) - 1
+_U64_WRAP = 1 << 64
+_I64_MAX1 = 1 << 63
 
 
 def _parse_python(lines: Iterable[str], schema: DataFeedSchema,
@@ -77,7 +98,13 @@ def _parse_python(lines: Iterable[str], schema: DataFeedSchema,
             vals = toks[pos:pos + ln]; pos += ln
             if slot.type == SlotType.UINT64:
                 if slot.is_used:
-                    sparse_vals[si].extend(int(v) for v in vals)
+                    # Feature signs are full-range uint64; storage is int64
+                    # bit patterns (reinterpret, like the native parser), so
+                    # signs >= 2^63 wrap instead of overflowing.
+                    sparse_vals[si].extend(
+                        (int(v) & _U64_MASK) - _U64_WRAP
+                        if (int(v) & _U64_MASK) >= _I64_MAX1 else
+                        (int(v) & _U64_MASK) for v in vals)
                     sparse_lens[si].append(ln)
                     si += 1
             else:

@@ -20,7 +20,7 @@ import importlib
 import subprocess
 from typing import Callable, Iterable, Iterator
 
-from paddlebox_tpu.data.parser import parse_multislot_lines
+from paddlebox_tpu.data.parser import parse_multislot_buffer
 from paddlebox_tpu.data.schema import DataFeedSchema
 from paddlebox_tpu.data.slot_record import SlotRecordBatch
 
@@ -52,20 +52,25 @@ def read_file(
     with_ins_id: bool = False,
 ) -> SlotRecordBatch:
     """Read one file into a columnar batch via the configured ingestion mode."""
+    if path.endswith(".pbar"):  # pre-tokenized binary archive
+        from paddlebox_tpu.data.archive import read_archive
+        return read_archive(path, schema)
     if pipe_command:
         proc = subprocess.Popen(
             f"{pipe_command} < {path}" if path else pipe_command,
-            shell=True, stdout=subprocess.PIPE, text=True,
+            shell=True, stdout=subprocess.PIPE,
         )
         assert proc.stdout is not None
         try:
-            out = parse_multislot_lines(proc.stdout, schema, with_ins_id=with_ins_id)
+            buf = proc.stdout.read()
         finally:
             ret = proc.wait()
         if ret != 0:
             raise RuntimeError(f"pipe_command {pipe_command!r} exited {ret}")
-        return out
-    lines = open_lines(path)
+        return parse_multislot_buffer(buf, schema, with_ins_id=with_ins_id)
     if parser_plugin is not None:
-        return parser_plugin(lines, schema)
-    return parse_multislot_lines(lines, schema, with_ins_id=with_ins_id)
+        return parser_plugin(open_lines(path), schema)
+    opener = gzip.open if path.endswith(".gz") else open
+    with opener(path, "rb") as f:
+        buf = f.read()
+    return parse_multislot_buffer(buf, schema, with_ins_id=with_ins_id)

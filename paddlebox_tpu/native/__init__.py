@@ -1,0 +1,8 @@
+"""Native (C++) host-side helpers, bound over ctypes.
+
+The compute path is JAX/XLA; the host runtime around it follows the
+reference's language split — its parser/shuffler/archive are C++
+(reference framework/data_feed.cc, data_set.cc). Everything here is
+optional: each consumer falls back to a vectorized numpy implementation
+when the shared library is absent.
+"""

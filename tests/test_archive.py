@@ -1,0 +1,78 @@
+"""Binary archive (.pbar) roundtrip + dataset integration."""
+
+import numpy as np
+import pytest
+
+from paddlebox_tpu.data import (DataFeedSchema, Slot, SlotType, SlotDataset,
+                                archive_filelist, read_archive, write_archive)
+from paddlebox_tpu.data.parser import _parse_python
+
+
+def make_schema():
+    return DataFeedSchema([
+        Slot("label", SlotType.FLOAT, max_len=1),
+        Slot("dense", SlotType.FLOAT, max_len=2),
+        Slot("s0", SlotType.UINT64, max_len=3),
+        Slot("s1", SlotType.UINT64, max_len=2),
+    ], batch_size=4)
+
+
+def make_lines(n, seed=0):
+    rng = np.random.default_rng(seed)
+    lines = []
+    for _ in range(n):
+        parts = [f"1 {rng.integers(0, 2)}", f"2 {rng.random():.4f} {rng.random():.4f}"]
+        for _s in range(2):
+            ln = int(rng.integers(1, 4))
+            parts.append(f"{ln} " + " ".join(
+                str(int(k)) for k in rng.integers(0, 1 << 40, ln)))
+        lines.append(" ".join(parts))
+    return lines
+
+
+def test_roundtrip(tmp_path):
+    schema = make_schema()
+    batch = _parse_python(make_lines(32, seed=5), schema, with_ins_id=False)
+    p = str(tmp_path / "x.pbar")
+    write_archive(p, batch)
+    got = read_archive(p, schema)
+    assert got.num == batch.num
+    for a, b in zip(got.sparse_values, batch.sparse_values):
+        np.testing.assert_array_equal(a, b)
+    for a, b in zip(got.sparse_offsets, batch.sparse_offsets):
+        np.testing.assert_array_equal(a, b)
+    for a, b in zip(got.float_values, batch.float_values):
+        np.testing.assert_array_equal(a, b)
+
+
+def test_schema_mismatch_rejected(tmp_path):
+    schema = make_schema()
+    batch = _parse_python(make_lines(4), schema, with_ins_id=False)
+    p = str(tmp_path / "x.pbar")
+    write_archive(p, batch)
+    other = DataFeedSchema([Slot("label", SlotType.FLOAT, max_len=1),
+                            Slot("zz", SlotType.UINT64, max_len=2)])
+    with pytest.raises(ValueError, match="do not match schema"):
+        read_archive(p, other)
+
+
+def test_dataset_loads_archives(tmp_path):
+    schema = make_schema()
+    texts = []
+    for i in range(2):
+        p = tmp_path / f"part-{i}.txt"
+        p.write_text("\n".join(make_lines(16, seed=i)) + "\n")
+        texts.append(str(p))
+    pbars = archive_filelist(texts, schema, str(tmp_path / "arch"))
+    assert all(f.endswith(".pbar") for f in pbars)
+
+    ds_txt = SlotDataset(schema)
+    ds_txt.set_filelist(texts)
+    ds_txt.load_into_memory(global_shuffle=False)
+    ds_bin = SlotDataset(schema)
+    ds_bin.set_filelist(pbars)
+    ds_bin.load_into_memory(global_shuffle=False)
+    assert ds_bin.num_examples == ds_txt.num_examples
+    np.testing.assert_array_equal(
+        np.sort(np.concatenate(ds_bin.records.sparse_values)),
+        np.sort(np.concatenate(ds_txt.records.sparse_values)))

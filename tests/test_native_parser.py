@@ -1,0 +1,140 @@
+"""Native C++ parser vs the Python reference implementation.
+
+The two must be byte-identical on every field (the reference keeps one
+parser in C++; we keep two and pin them together here)."""
+
+import numpy as np
+import pytest
+
+from paddlebox_tpu.data.parser import _parse_python
+from paddlebox_tpu.data.schema import DataFeedSchema, Slot, SlotType
+from paddlebox_tpu.native import slot_parser_binding as native
+
+pytestmark = pytest.mark.skipif(not native.available(),
+                                reason="native lib not built")
+
+
+def make_schema():
+    return DataFeedSchema([
+        Slot("label", SlotType.FLOAT, max_len=1),
+        Slot("dense", SlotType.FLOAT, max_len=3),
+        Slot("skip_me", SlotType.UINT64, max_len=5, is_used=False),
+        Slot("s0", SlotType.UINT64, max_len=4),
+        Slot("s1", SlotType.UINT64, max_len=2),
+    ], batch_size=8)
+
+
+def make_lines(n, seed=0, with_ins_id=False):
+    rng = np.random.default_rng(seed)
+    lines = []
+    for i in range(n):
+        parts = []
+        if with_ins_id:
+            parts.append(f"ins_{i}\t1")
+        else:
+            parts.append("1")
+        parts.append(str(int(rng.integers(0, 2))))
+        ln = int(rng.integers(0, 5))  # dense: pad/truncate vs width 3
+        parts.append(str(ln))
+        parts.extend(f"{rng.random():.6f}" for _ in range(ln))
+        for _slot in range(3):  # skip_me, s0, s1
+            ln = int(rng.integers(0, 6))
+            parts.append(str(ln))
+            parts.extend(str(int(k)) for k in
+                         rng.integers(0, 1 << 63, ln, dtype=np.int64))
+        lines.append(" ".join(parts))
+    return lines
+
+
+def assert_batches_equal(a, b):
+    assert a.num == b.num
+    for x, y in zip(a.sparse_values, b.sparse_values):
+        np.testing.assert_array_equal(x, y)
+    for x, y in zip(a.sparse_offsets, b.sparse_offsets):
+        np.testing.assert_array_equal(x, y)
+    for x, y in zip(a.float_values, b.float_values):
+        np.testing.assert_array_equal(x, y)
+    np.testing.assert_array_equal(a.ins_id, b.ins_id)
+
+
+def test_matches_python_parser():
+    schema = make_schema()
+    lines = make_lines(200, seed=1)
+    got = native.parse_lines(lines, schema)
+    want = _parse_python(lines, schema, with_ins_id=False)
+    assert_batches_equal(got, want)
+
+
+def test_matches_python_parser_with_ins_id():
+    schema = make_schema()
+    lines = make_lines(50, seed=2, with_ins_id=True)
+    got = native.parse_lines(lines, schema, with_ins_id=True)
+    want = _parse_python(lines, schema, with_ins_id=True)
+    assert_batches_equal(got, want)
+    assert got.ins_id.any()  # FNV hashes actually computed
+
+
+def test_blank_lines_and_crlf():
+    schema = make_schema()
+    lines = make_lines(10, seed=3)
+    buf = ("\n\n" + "\r\n".join(lines) + "\n\n").encode()
+    got = native.parse_buffer(buf, schema)
+    want = _parse_python(lines, schema, with_ins_id=False)
+    assert_batches_equal(got, want)
+
+
+def test_multithreaded_matches_single():
+    schema = make_schema()
+    buf = "\n".join(make_lines(500, seed=4)).encode()
+    got1 = native.parse_buffer(buf, schema, n_threads=1)
+    got4 = native.parse_buffer(buf, schema, n_threads=4)
+    assert_batches_equal(got1, got4)
+
+
+def test_malformed_line_raises():
+    schema = make_schema()
+    with pytest.raises(ValueError, match="malformed"):
+        native.parse_buffer(b"1 0 2 5\n", schema)
+    with pytest.raises(ValueError, match="malformed"):
+        native.parse_buffer(b"not a number\n", schema)
+
+
+def test_hash_matches_python():
+    from paddlebox_tpu.utils.hashing import hash64
+    for s in ["", "a", "ins_123", "ünicode-☃"]:
+        assert native.hash64_native(s) == hash64(s)
+
+
+def test_uint64_range_roundtrip():
+    # feasigns up to 2^63-1 survive exactly (int64 storage)
+    schema = DataFeedSchema([Slot("s", SlotType.UINT64, max_len=2)])
+    big = (1 << 63) - 1
+    got = native.parse_buffer(f"2 {big} 7".encode(), schema)
+    np.testing.assert_array_equal(got.sparse_values[0], [big, 7])
+
+
+def test_generator_input_not_consumed_on_fallback(monkeypatch):
+    # parse_multislot_lines must not hand an exhausted iterator to the
+    # Python fallback when the native lib is unavailable
+    from paddlebox_tpu.data import parser as parser_mod
+    monkeypatch.setattr(parser_mod, "_native_cache", [None])
+    schema = DataFeedSchema([Slot("s", SlotType.UINT64, max_len=2)])
+    got = parser_mod.parse_multislot_lines(
+        (l for l in ["1 5", "1 6"]), schema)
+    assert got.num == 2
+
+
+def test_u64_above_2_63_parity():
+    schema = DataFeedSchema([Slot("s", SlotType.UINT64, max_len=2)])
+    line = "2 9223372036854775813 18446744073709551615"
+    a = _parse_python([line], schema, False).sparse_values[0]
+    b = native.parse_buffer(line.encode(), schema).sparse_values[0]
+    np.testing.assert_array_equal(a, b)
+
+
+def test_error_reports_global_line_number():
+    schema = make_schema()
+    good = "\n".join(make_lines(300, seed=7))
+    with pytest.raises(ValueError, match=r"line 301"):
+        native.parse_buffer((good + "\nbogus\n").encode(), schema,
+                            n_threads=4)
