@@ -79,13 +79,23 @@ def read_archive(path: str, schema: DataFeedSchema) -> SlotRecordBatch:
             f"{path}: archive slots {header['sparse_slots']}/"
             f"{header['float_slots']} do not match schema "
             f"{want_sparse}/{want_float}")
+    num = int(header["num"])
+    float_widths = {s.name: s.max_len for s in schema.float_slots}
     arrays: dict[str, np.ndarray] = {}
     for col in header["columns"]:
         dt = np.dtype(col["dtype"])
         n = int(col["len"])
+        group, _, name = col["name"].partition("/")
+        if group == "float_values":
+            want = num * float_widths[name]
+            if n != want or dt != np.float32:
+                raise ValueError(
+                    f"{path}: float slot {name!r} was archived with "
+                    f"{n // max(num, 1)} values/example "
+                    f"({dt}), schema expects {float_widths[name]} "
+                    "(float32) — stale archive?")
         arrays[col["name"]] = np.frombuffer(buf, dt, n, off).copy()
         off += n * dt.itemsize
-    num = int(header["num"])
     return SlotRecordBatch(
         schema=schema, num=num,
         sparse_values=[arrays[f"sparse_values/{n}"] for n in want_sparse],

@@ -65,6 +65,11 @@ _U64_WRAP = 1 << 64
 _I64_MAX1 = 1 << 63
 
 
+def _wrap_i64(v: str) -> int:
+    u = int(v) & _U64_MASK
+    return u - _U64_WRAP if u >= _I64_MAX1 else u
+
+
 def _parse_python(lines: Iterable[str], schema: DataFeedSchema,
                   with_ins_id: bool) -> SlotRecordBatch:
     slots = schema.slots
@@ -101,10 +106,7 @@ def _parse_python(lines: Iterable[str], schema: DataFeedSchema,
                     # Feature signs are full-range uint64; storage is int64
                     # bit patterns (reinterpret, like the native parser), so
                     # signs >= 2^63 wrap instead of overflowing.
-                    sparse_vals[si].extend(
-                        (int(v) & _U64_MASK) - _U64_WRAP
-                        if (int(v) & _U64_MASK) >= _I64_MAX1 else
-                        (int(v) & _U64_MASK) for v in vals)
+                    sparse_vals[si].extend(map(_wrap_i64, vals))
                     sparse_lens[si].append(ln)
                     si += 1
             else:

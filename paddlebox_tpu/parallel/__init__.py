@@ -1,2 +1,4 @@
 from paddlebox_tpu.parallel.mesh import (make_mesh, table_sharding,  # noqa: F401
                                          batch_sharding, replicated_sharding)
+from paddlebox_tpu.parallel.dense_sync import (AsyncDenseTable,  # noqa: F401
+                                               flatten_dense)

@@ -31,6 +31,7 @@ from paddlebox_tpu.data.slot_record import PackedBatch, SparseLayout
 from paddlebox_tpu.embedding import (EmbeddingConfig, HostEmbeddingStore,
                                      PassWorkingSet, sharded)
 from paddlebox_tpu.metrics import auc as auc_lib
+from paddlebox_tpu.parallel import dense_sync
 from paddlebox_tpu.parallel import mesh as mesh_lib
 from paddlebox_tpu.utils.timer import StageTimers
 
@@ -46,6 +47,28 @@ class TrainerConfig:
     check_nan_inf: bool = False            # FLAGS_check_nan_inf
     scale_sparse_grad_by_global_mean: bool = True
     join_phase: bool = True                # use_cvm on (join) vs off (update)
+    # Dense sync (BoxPSWorkerParameter.sync_mode, trainer_desc.proto:100-108)
+    dense_sync_mode: str = "allreduce"     # allreduce | kstep | async
+    param_sync_step: int = 1               # K for kstep mode
+    sync_dense_moment: bool = False        # FLAGS_enable_sync_dense_moment
+    async_merge_limit: int = 4             # async table grad-merge bound
+    async_betas: tuple = (0.99, 0.9999)    # reference's hard-coded betas
+
+
+def _mean_replicated_grad(gp, axes):
+    """Global MEAN of per-device dense grads, for grads of a replicated
+    (in_spec P()) shard_map input.
+
+    shard_map's autodiff psums the cotangent of replicated inputs to keep
+    them replication-invariant, so `gp` already holds the cross-device SUM
+    of local-mean grads when it reaches here (a pmean would be a no-op on
+    the already-replicated value — and silently scale the effective LR by
+    the mesh size). Dividing by the axis size yields the true global mean.
+    """
+    d = 1
+    for a in axes:
+        d = d * lax.axis_size(a)
+    return jax.tree.map(lambda g: g / d, gp)
 
 
 def _dense_tx(cfg: TrainerConfig) -> optax.GradientTransformation:
@@ -82,6 +105,8 @@ class Trainer:
                 f"{self.store.cfg.expand_dim}); zoo models consume the full "
                 f"pulled vector — a model that reads the expand part "
                 f"separately should split with ops.pull_box_extended_sparse")
+        if self.cfg.dense_sync_mode not in ("allreduce", "kstep", "async"):
+            raise ValueError(self.cfg.dense_sync_mode)
         # Dense params/opt state are replicated over the mesh (the reference
         # copies dense params to every GPU, boxps_worker.cc:403-480). Placing
         # them explicitly — and pinning the step's out_shardings to match —
@@ -89,10 +114,35 @@ class Trainer:
         # sharding propagation picks its own output shardings and step #2
         # recompiles (~20s on a real chip).
         repl = mesh_lib.replicated_sharding(mesh)
-        self.params = jax.device_put(model.init(jax.random.PRNGKey(seed)),
-                                     repl)
+        init_params = model.init(jax.random.PRNGKey(seed))
         self.tx = _dense_tx(self.cfg)
-        self.opt_state = jax.device_put(self.tx.init(self.params), repl)
+        self.dense_table = None
+        self._stacked_sh = jax.sharding.NamedSharding(
+            mesh, P(tuple(mesh.axis_names)))
+        if self.cfg.dense_sync_mode == "kstep":
+            # per-device dense copies: leading shard axis, local updates
+            # between parameter-averaging syncs (local SGD)
+            stacked = dense_sync.stack_for_shards(init_params, self.n_shards)
+            self.params = jax.device_put(stacked, self._stacked_sh)
+            self.opt_state = jax.device_put(
+                dense_sync.stack_for_shards(self.tx.init(init_params),
+                                            self.n_shards),
+                self._stacked_sh)
+            self._sync_fn = self._build_param_sync()
+        elif self.cfg.dense_sync_mode == "async":
+            self.params = jax.device_put(init_params, repl)
+            self.opt_state = self.tx.init(init_params)  # unused in async
+            flat, self._unravel = dense_sync.flatten_dense(init_params)
+            self.dense_table = dense_sync.AsyncDenseTable(
+                flat, lr=self.cfg.dense_lr, betas=self.cfg.async_betas,
+                merge_limit=self.cfg.async_merge_limit)
+        else:
+            self.params = jax.device_put(init_params, repl)
+            self.opt_state = jax.device_put(self.tx.init(init_params), repl)
+        if self.cfg.dense_sync_mode == "kstep":
+            self._collapse_fn = jax.jit(
+                lambda p: jax.tree.map(lambda a: a[0], p),
+                out_shardings=repl)
         self.timers = StageTimers(["read", "translate", "train", "auc"])
         self._step_fn = self._build_train_step()
         self._eval_fn = self._build_eval_step()
@@ -121,7 +171,11 @@ class Trainer:
         return labels, dense
 
     # ------------------------------------------------------------------
-    def _build_train_step(self) -> Callable:
+    def _fwd_bwd_push(self):
+        """Shared shard_map core: routed pull → fwd/bwd → routed push.
+
+        Returns a fn(tshard, idx_l, mask_l, dense_l, labels_l, params_local)
+        → (new_shard, local_dense_grads, local_loss, preds)."""
         cfg = self.cfg
         emb_cfg = self.store.cfg
         axes = tuple(self.mesh.axis_names)
@@ -129,10 +183,10 @@ class Trainer:
         T = self.layout.total_len
         D = self.n_shards
         model = self.model
-        tx = self.tx
         capf = cfg.capacity_factor
+        num_slots = self.layout.num_slots
 
-        def body(tshard, idx_l, mask_l, dense_l, labels_l, params):
+        def core(tshard, idx_l, mask_l, dense_l, labels_l, params):
             B_l = idx_l.shape[0]
             flat_idx = idx_l.reshape(-1)
             pulled = sharded.routed_lookup(tshard, flat_idx, emb_cfg, axes,
@@ -141,7 +195,7 @@ class Trainer:
 
             def loss_fn(p, pulled_in):
                 logits = model.apply(p, pulled_in, mask_l, dense_l, seg,
-                                     self.layout.num_slots)
+                                     num_slots)
                 loss = jnp.mean(
                     optax.sigmoid_binary_cross_entropy(logits, labels_l))
                 return loss, jax.nn.sigmoid(logits)
@@ -149,8 +203,6 @@ class Trainer:
             grad_fn = jax.value_and_grad(loss_fn, argnums=(0, 1),
                                          has_aux=True)
             (loss, preds), (gp, gpull) = grad_fn(params, pulled)
-            gp = lax.pmean(gp, axes)
-            loss_g = lax.pmean(loss, axes)
             # sparse grads: only (w, embedx) columns train; show/clk are
             # counters (CVM grads to them are dropped, like cvm_op's grad)
             sgrad = gpull[..., 2:].reshape(B_l * T, emb_cfg.grad_width)
@@ -162,12 +214,79 @@ class Trainer:
             new_shard = sharded.routed_push(tshard, flat_idx, sgrad,
                                            show_inc, clk_inc, emb_cfg,
                                            axes, capf)
-            return new_shard, gp, loss_g, preds
+            return new_shard, gp, loss, preds
 
+        return core
+
+    def _build_train_step(self) -> Callable:
+        cfg = self.cfg
+        axes = tuple(self.mesh.axis_names)
+        tx = self.tx
+        core = self._fwd_bwd_push()
         batch_spec = P(axes)
         repl = mesh_lib.replicated_sharding(self.mesh)
         tbl_sh = mesh_lib.table_sharding(self.mesh)
         bat_sh = mesh_lib.batch_sharding(self.mesh)
+        mode = cfg.dense_sync_mode
+
+        if mode == "kstep":
+            # local dense update inside shard_map; params carry a leading
+            # shard axis (each device trains its own copy between syncs)
+            def body(tshard, idx_l, mask_l, dense_l, labels_l, p_st, o_st):
+                p = jax.tree.map(lambda a: a[0], p_st)
+                o = jax.tree.map(lambda a: a[0], o_st)
+                new_shard, gp, loss, preds = core(
+                    tshard, idx_l, mask_l, dense_l, labels_l, p)
+                updates, new_o = tx.update(gp, o, p)
+                new_p = optax.apply_updates(p, updates)
+                loss_g = lax.pmean(loss, axes)
+                lift = lambda t: jax.tree.map(lambda a: a[None], t)
+                return new_shard, lift(new_p), lift(new_o), loss_g, preds
+
+            def step(table, params, opt_state, idx, mask, dense, labels):
+                return jax.shard_map(
+                    body, mesh=self.mesh,
+                    in_specs=(batch_spec, batch_spec, batch_spec, batch_spec,
+                              batch_spec, batch_spec, batch_spec),
+                    out_specs=(batch_spec, batch_spec, batch_spec, P(),
+                               batch_spec),
+                )(table, idx, mask, dense, labels, params, opt_state)
+
+            return jax.jit(step, donate_argnums=(0, 1, 2),
+                           out_shardings=(tbl_sh, self._stacked_sh,
+                                          self._stacked_sh, repl, bat_sh))
+
+        if mode == "async":
+            # grads are globally averaged and returned flat; the host-side
+            # AsyncDenseTable owns the optimizer (BoxPSAsynDenseTable)
+            from jax.flatten_util import ravel_pytree
+
+            def body(tshard, idx_l, mask_l, dense_l, labels_l, params):
+                new_shard, gp, loss, preds = core(
+                    tshard, idx_l, mask_l, dense_l, labels_l, params)
+                gp = _mean_replicated_grad(gp, axes)
+                loss_g = lax.pmean(loss, axes)
+                return new_shard, gp, loss_g, preds
+
+            def step(table, params, idx, mask, dense, labels):
+                new_table, gp, loss, preds = jax.shard_map(
+                    body, mesh=self.mesh,
+                    in_specs=(batch_spec, batch_spec, batch_spec, batch_spec,
+                              batch_spec, P()),
+                    out_specs=(batch_spec, P(), P(), batch_spec),
+                )(table, idx, mask, dense, labels, params)
+                gp_flat = ravel_pytree(gp)[0]
+                return new_table, gp_flat, loss, preds
+
+            return jax.jit(step, donate_argnums=(0,),
+                           out_shardings=(tbl_sh, repl, repl, bat_sh))
+
+        def body(tshard, idx_l, mask_l, dense_l, labels_l, params):
+            new_shard, gp, loss, preds = core(
+                tshard, idx_l, mask_l, dense_l, labels_l, params)
+            gp = _mean_replicated_grad(gp, axes)
+            loss_g = lax.pmean(loss, axes)
+            return new_shard, gp, loss_g, preds
 
         def step(table, params, opt_state, idx, mask, dense, labels):
             new_table, gp, loss, preds = jax.shard_map(
@@ -185,6 +304,32 @@ class Trainer:
         # so the train_pass feedback loop never retraces.
         return jax.jit(step, donate_argnums=(0, 1, 2),
                        out_shardings=(tbl_sh, repl, repl, repl, bat_sh))
+
+    def _build_param_sync(self) -> Callable:
+        """K-step parameter averaging (SyncParam, boxps_worker.cc:481-521).
+
+        One pmean over every mesh axis — XLA decomposes it into the
+        reference's intra-node reduce-scatter → inter-node → all-gather
+        hierarchy on a 2D (node, dp) mesh."""
+        axes = tuple(self.mesh.axis_names)
+        batch_spec = P(axes)
+        sync_moment = self.cfg.sync_dense_moment
+
+        def body(p_st, o_st):
+            avg = jax.tree.map(lambda a: lax.pmean(a, axes), p_st)
+            if sync_moment:  # FLAGS_enable_sync_dense_moment
+                o_st = jax.tree.map(lambda a: lax.pmean(a, axes), o_st)
+            return avg, o_st
+
+        def sync(params, opt_state):
+            return jax.shard_map(
+                body, mesh=self.mesh,
+                in_specs=(batch_spec, batch_spec),
+                out_specs=(batch_spec, batch_spec),
+            )(params, opt_state)
+
+        return jax.jit(sync, donate_argnums=(0, 1),
+                       out_shardings=(self._stacked_sh, self._stacked_sh))
 
     def _build_eval_step(self) -> Callable:
         emb_cfg = self.store.cfg
@@ -242,13 +387,32 @@ class Trainer:
         auc_acc = auc_lib.AucAccumulator(cfg.auc_buckets)
         # device arrays collected without per-step host sync (the hot loop
         # must stay dispatch-async to overlap host pack with device compute)
+        mode = cfg.dense_sync_mode
+        if mode == "async":
+            assert self.dense_table is not None
+            self.dense_table.start()
+        repl = mesh_lib.replicated_sharding(self.mesh)
+        pass_step = 0
         dev_losses: list[Any] = []
         try:
             for pb in dataset.batches(cfg.global_batch_size, drop_last=True):
                 idx, mask, dense, labels = self._put_batch(ws, pb)
                 with self.timers("train"):
-                    table, params, opt_state, loss, preds = self._step_fn(
-                        table, params, opt_state, idx, mask, dense, labels)
+                    if mode == "async":
+                        params = jax.device_put(
+                            self._unravel(self.dense_table.pull()), repl)
+                        table, gp_flat, loss, preds = self._step_fn(
+                            table, params, idx, mask, dense, labels)
+                        self.dense_table.push(np.asarray(gp_flat))
+                    else:
+                        table, params, opt_state, loss, preds = self._step_fn(
+                            table, params, opt_state, idx, mask, dense,
+                            labels)
+                        pass_step += 1
+                        if (mode == "kstep"
+                                and pass_step % cfg.param_sync_step == 0):
+                            params, opt_state = self._sync_fn(params,
+                                                              opt_state)
                 with self.timers("auc"):
                     auc_acc.update(self._auc_fn, preds, labels)
                     if metrics is not None:
@@ -268,7 +432,14 @@ class Trainer:
             # catches and resumes from checkpoint — the Trainer must stay
             # usable).
             ws.table = table
-            self.params, self.opt_state = params, opt_state
+            if mode == "async":
+                self.dense_table.flush()
+                self.params = jax.device_put(
+                    self._unravel(self.dense_table.pull()), repl)
+            else:
+                if mode == "kstep":  # end-of-pass sync (trainer Finalize)
+                    params, opt_state = self._sync_fn(params, opt_state)
+                self.params, self.opt_state = params, opt_state
         ws.end_pass(self.store, table)
         losses = [float(l) for l in dev_losses]  # one sync, post-loop
         out = auc_acc.compute()
@@ -277,6 +448,13 @@ class Trainer:
         out["loss_mean"] = float(np.mean(losses)) if losses else float("nan")
         out["steps"] = len(losses)
         return out
+
+    def eval_params(self):
+        """Replicated dense params for eval/export — collapses the kstep
+        per-shard copies (equal right after a sync) to one."""
+        if self.cfg.dense_sync_mode == "kstep":
+            return self._collapse_fn(self.params)
+        return self.params
 
     def eval_pass(self, dataset) -> dict[str, float]:
         """Test-mode pass: no pushes, no dense updates, and the store is
@@ -290,7 +468,8 @@ class Trainer:
             if n_valid < bs:
                 pb = pb.pad_to(bs)  # tail batch: pad + mask, don't drop
             idx, mask, dense, labels = self._put_batch(ws, pb)
-            preds = self._eval_fn(ws.table, self.params, idx, mask, dense)
+            preds = self._eval_fn(ws.table, self.eval_params(), idx, mask,
+                                  dense)
             valid = jnp.arange(bs) < n_valid
             auc_acc.update(self._auc_masked_fn, preds, labels, valid)
         return auc_acc.compute()

@@ -76,3 +76,18 @@ def test_dataset_loads_archives(tmp_path):
     np.testing.assert_array_equal(
         np.sort(np.concatenate(ds_bin.records.sparse_values)),
         np.sort(np.concatenate(ds_txt.records.sparse_values)))
+
+
+def test_float_width_mismatch_rejected(tmp_path):
+    schema = make_schema()
+    batch = _parse_python(make_lines(4), schema, with_ins_id=False)
+    p = str(tmp_path / "x.pbar")
+    write_archive(p, batch)
+    wider = DataFeedSchema([
+        Slot("label", SlotType.FLOAT, max_len=1),
+        Slot("dense", SlotType.FLOAT, max_len=3),  # was 2 when archived
+        Slot("s0", SlotType.UINT64, max_len=3),
+        Slot("s1", SlotType.UINT64, max_len=2),
+    ], batch_size=4)
+    with pytest.raises(ValueError, match="stale archive"):
+        read_archive(p, wider)
