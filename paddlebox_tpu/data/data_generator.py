@@ -1,0 +1,49 @@
+"""User-side data generator protocol.
+
+Reference: ``MultiSlotDataGenerator``
+(python/paddle/fluid/incubate/data_generator, and
+python/paddle/distributed/fleet/data_generator): users subclass it, define
+``generate_sample(line)`` yielding ``[(slot_name, values), ...]`` per
+example, and run the script as a ``pipe_command`` — the framework consumes
+the MultiSlot text it prints on stdout.
+
+Identical contract here; the output is exactly what
+``parse_multislot_lines`` / the native parser read.
+"""
+
+from __future__ import annotations
+
+import sys
+from typing import Iterable, Iterator, Sequence
+
+from paddlebox_tpu.data.parser import format_multislot_example
+from paddlebox_tpu.data.schema import DataFeedSchema
+
+
+class MultiSlotDataGenerator:
+    """Subclass and override ``generate_sample``."""
+
+    def __init__(self, schema: DataFeedSchema):
+        self.schema = schema
+
+    def generate_sample(self, line: str) -> Iterator[
+            Sequence[tuple[str, Sequence]]]:
+        """Yield zero or more examples for one raw input line; each example
+        is a sequence of (slot_name, values) pairs."""
+        raise NotImplementedError
+
+    # ---- the pipe_command entry points ----
+
+    def process(self, lines: Iterable[str], out=None) -> int:
+        out = out or sys.stdout
+        n = 0
+        for line in lines:
+            for example in self.generate_sample(line.rstrip("\n")):
+                out.write(format_multislot_example(example, self.schema))
+                out.write("\n")
+                n += 1
+        return n
+
+    def run_from_stdin(self) -> None:
+        """`cat raw | python my_generator.py` as the dataset pipe_command."""
+        self.process(sys.stdin)

@@ -1,0 +1,22 @@
+"""Host-side distributed control plane.
+
+The reference's control plane is spread over boxps::MPICluster
+(size/rank/barrier/allreduce, box_wrapper.h:415-568), GlooWrapper with an
+HDFS rendezvous store (gloo_wrapper.h:45-200), and the fleet role makers
+(role_maker.py:1265). Here it is one small stack:
+
+- ``FileStore`` — shared-filesystem rendezvous KV (the HdfsStore moral
+  equivalent; any NFS/FUSE mount works).
+- ``HostCollectives`` — barrier / allreduce / allgather / broadcast for
+  small host-side values (global AUC tables, donefile coordination).
+- ``RoleMaker`` — rank/world from env, optional jax.distributed init for
+  real multi-host TPU pods.
+- ``launch`` — one-process-per-host launcher (fleetrun equivalent).
+
+Device-side collectives never touch this: they are XLA psum/all_gather
+over the mesh inside jit.
+"""
+
+from paddlebox_tpu.distributed.store import FileStore  # noqa: F401
+from paddlebox_tpu.distributed.collectives import HostCollectives  # noqa: F401
+from paddlebox_tpu.distributed.role_maker import RoleMaker  # noqa: F401

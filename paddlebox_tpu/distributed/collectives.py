@@ -1,0 +1,105 @@
+"""Host-side collectives over a rendezvous store.
+
+Reference: ``GlooWrapper::Barrier/AllReduce/AllGather``
+(gloo_wrapper.h:151-200) and ``boxps::MPICluster``'s host
+barrier/allreduce_sum (box_wrapper.h:415, .cc:331-356 — the global-AUC
+reduction path). These move small host values (metric tables, counters,
+donefile decisions); bulk tensors go over ICI/DCN inside jit, never here.
+
+Every collective gets a fresh sequence number so the same store can host
+unlimited rounds; rank 0 reduces and publishes, others wait (the
+tree-reduce the reference gets from gloo is overkill at these sizes).
+"""
+
+from __future__ import annotations
+
+import io
+import pickle
+from typing import Any, Callable
+
+import numpy as np
+
+from paddlebox_tpu.distributed.store import FileStore
+
+
+def _dump(obj: Any) -> bytes:
+    if isinstance(obj, np.ndarray):
+        buf = io.BytesIO()
+        np.save(buf, obj)
+        return b"npy" + buf.getvalue()
+    return b"pkl" + pickle.dumps(obj)
+
+
+def _load(raw: bytes) -> Any:
+    tag, body = raw[:3], raw[3:]
+    if tag == b"npy":
+        return np.load(io.BytesIO(body))
+    return pickle.loads(body)
+
+
+_REDUCERS: dict[str, Callable] = {
+    "sum": lambda xs: sum(xs[1:], xs[0]),
+    "max": lambda xs: np.maximum.reduce(xs),
+    "min": lambda xs: np.minimum.reduce(xs),
+}
+
+
+class HostCollectives:
+    def __init__(self, store: FileStore, rank: int, world: int,
+                 run_id: str = ""):
+        if not (0 <= rank < world):
+            raise ValueError(f"rank {rank} outside world {world}")
+        self.store = store
+        self.rank = rank
+        self.world = world
+        # run_id namespaces keys so a relaunched job against the same
+        # persistent store dir never consumes a dead run's published values
+        # (the launcher stamps PBTPU_RUN_ID per launch)
+        self.run_id = run_id
+        self._seq = 0
+
+    def _next(self, name: str) -> str:
+        self._seq += 1
+        prefix = f"{self.run_id}." if self.run_id else ""
+        return f"{prefix}{name}.{self._seq}"
+
+    def barrier(self, name: str = "barrier") -> None:
+        if self.world == 1:
+            return
+        key = self._next(name)
+        self.store.add(key, self.rank)
+        self.store.wait_count(key, self.world)
+
+    def all_gather(self, value: Any, name: str = "gather") -> list[Any]:
+        if self.world == 1:
+            return [value]
+        key = self._next(name)
+        self.store.set(f"{key}.v{self.rank}", _dump(value))
+        return [_load(self.store.wait(f"{key}.v{r}"))
+                for r in range(self.world)]
+
+    def all_reduce(self, value: np.ndarray, op: str = "sum",
+                   name: str = "reduce") -> np.ndarray:
+        """Exact reduction of a small array (AUC tables etc.)."""
+        value = np.asarray(value)
+        if self.world == 1:
+            return value
+        key = self._next(name)
+        self.store.set(f"{key}.v{self.rank}", _dump(value))
+        if self.rank == 0:
+            parts = [_load(self.store.wait(f"{key}.v{r}"))
+                     for r in range(self.world)]
+            out = _REDUCERS[op](parts)
+            self.store.set(f"{key}.out", _dump(out))
+            return out
+        return _load(self.store.wait(f"{key}.out"))
+
+    def broadcast(self, value: Any, root: int = 0,
+                  name: str = "bcast") -> Any:
+        if self.world == 1:
+            return value
+        key = self._next(name)
+        if self.rank == root:
+            self.store.set(f"{key}.out", _dump(value))
+            return value
+        return _load(self.store.wait(f"{key}.out"))

@@ -1,0 +1,97 @@
+"""Multi-process launcher — ``python -m paddlebox_tpu.distributed.launch``.
+
+Reference: ``paddle.distributed.launch`` / ``fleetrun``
+(python/paddle/distributed/launch.py): spawn one worker process per device
+with rank/endpoint env. On TPU the unit is one process per *host*; this
+launcher covers (a) real multi-host startup scripts and (b) local
+simulation of an N-host cluster for tests (each process gets a CPU backend
+and a private rank).
+
+Usage:
+    python -m paddlebox_tpu.distributed.launch --nprocs 2 -- \
+        python train_script.py --epochs 1
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import signal
+import socket
+import subprocess
+import sys
+import tempfile
+import time
+import uuid
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def launch(nprocs: int, cmd: list[str], store_dir: str | None = None,
+           base_env: dict | None = None) -> int:
+    """Spawn `nprocs` worker processes; returns first nonzero exit code.
+
+    Fail-stop: the moment any worker exits nonzero, the survivors are
+    terminated (a hung peer would otherwise block on its next collective
+    until the store timeout)."""
+    store_dir = store_dir or tempfile.mkdtemp(prefix="pbtpu_store_")
+    endpoints = ",".join(f"127.0.0.1:{_free_port()}" for _ in range(nprocs))
+    run_id = uuid.uuid4().hex[:12]
+    procs: list[subprocess.Popen] = []
+    for rank in range(nprocs):
+        env = dict(os.environ)
+        env.update(base_env or {})
+        env["PBTPU_TRAINER_ID"] = str(rank)
+        env["PBTPU_TRAINER_ENDPOINTS"] = endpoints
+        env["PBTPU_STORE_DIR"] = store_dir
+        env["PBTPU_RUN_ID"] = run_id
+        procs.append(subprocess.Popen(cmd, env=env))
+    code = 0
+    try:
+        live = set(range(nprocs))
+        while live and code == 0:
+            for i in sorted(live):
+                rc = procs[i].poll()
+                if rc is None:
+                    continue
+                live.discard(i)
+                if rc != 0:
+                    code = rc
+                    break
+            else:
+                time.sleep(0.05)
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.send_signal(signal.SIGTERM)
+        for p in procs:
+            try:
+                p.wait(timeout=10)
+            except subprocess.TimeoutExpired:
+                p.kill()
+    return code
+
+
+def main(argv: list[str] | None = None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--nprocs", type=int, required=True,
+                    help="worker processes (hosts) to spawn")
+    ap.add_argument("--store-dir", default=None,
+                    help="shared rendezvous dir (default: fresh tmpdir)")
+    ap.add_argument("cmd", nargs=argparse.REMAINDER,
+                    help="worker command (prefix with --)")
+    args = ap.parse_args(argv)
+    cmd = args.cmd
+    if cmd and cmd[0] == "--":
+        cmd = cmd[1:]
+    if not cmd:
+        ap.error("missing worker command")
+    return launch(args.nprocs, cmd, store_dir=args.store_dir)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

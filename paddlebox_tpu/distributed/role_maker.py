@@ -1,0 +1,73 @@
+"""Cluster role discovery + optional JAX distributed init.
+
+Reference: the fleet role makers (``PaddleCloudRoleMaker`` et al.,
+incubate/fleet/base/role_maker.py:1265) parse ``PADDLE_TRAINER_ID`` /
+``PADDLE_TRAINER_ENDPOINTS`` env set by the launcher. Same protocol here
+with PBTPU_* names, plus the TPU-pod specialization: when running on real
+multi-host TPU hardware, ``init_distributed`` calls
+``jax.distributed.initialize`` so all hosts form one global device mesh
+(the NCCL-id exchange + MPICluster bootstrap collapse into this one call).
+"""
+
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass, field
+
+from paddlebox_tpu.distributed.collectives import HostCollectives
+from paddlebox_tpu.distributed.store import FileStore
+
+ENV_RANK = "PBTPU_TRAINER_ID"
+ENV_ENDPOINTS = "PBTPU_TRAINER_ENDPOINTS"
+ENV_STORE = "PBTPU_STORE_DIR"
+ENV_RUN_ID = "PBTPU_RUN_ID"
+
+
+@dataclass
+class RoleMaker:
+    rank: int = 0
+    endpoints: list[str] = field(default_factory=lambda: ["localhost:0"])
+    store_dir: str | None = None
+    run_id: str = ""
+
+    @classmethod
+    def from_env(cls) -> "RoleMaker":
+        rank = int(os.environ.get(ENV_RANK, "0"))
+        eps = os.environ.get(ENV_ENDPOINTS, "localhost:0").split(",")
+        return cls(rank=rank, endpoints=[e.strip() for e in eps if e.strip()],
+                   store_dir=os.environ.get(ENV_STORE),
+                   run_id=os.environ.get(ENV_RUN_ID, ""))
+
+    @property
+    def world_size(self) -> int:
+        return len(self.endpoints)
+
+    @property
+    def is_first_worker(self) -> bool:
+        return self.rank == 0
+
+    def collectives(self, timeout_s: float = 300.0) -> HostCollectives:
+        if self.world_size > 1 and not self.store_dir:
+            raise ValueError(
+                f"multi-host run needs {ENV_STORE} (shared filesystem dir) "
+                "for the rendezvous store")
+        store = FileStore(self.store_dir or "/tmp/pbtpu_store",
+                          timeout_s=timeout_s)
+        return HostCollectives(store, self.rank, self.world_size,
+                               run_id=self.run_id)
+
+    def init_distributed(self) -> None:
+        """Join the global JAX process group (real multi-host pods).
+
+        After this, jax.devices() spans every host and a Mesh built from it
+        gives the 2D (node, dp) topology whose collectives ride ICI within
+        a host's chips and DCN across hosts.
+        """
+        if self.world_size == 1:
+            return
+        import jax
+        jax.distributed.initialize(
+            coordinator_address=self.endpoints[0],
+            num_processes=self.world_size,
+            process_id=self.rank,
+        )

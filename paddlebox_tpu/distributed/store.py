@@ -1,0 +1,69 @@
+"""Filesystem rendezvous KV store.
+
+Reference: gloo's ``HdfsStore`` (gloo_wrapper.h:45) — set/get/wait on a
+shared filesystem so hosts can rendezvous without a standing service. Works
+on any mount every host can see (NFS, FUSE'd object store, /tmp for
+single-machine tests).
+"""
+
+from __future__ import annotations
+
+import os
+import time
+
+
+class FileStore:
+    def __init__(self, root: str, timeout_s: float = 300.0,
+                 poll_s: float = 0.02):
+        self.root = root
+        self.timeout_s = timeout_s
+        self.poll_s = poll_s
+        os.makedirs(root, exist_ok=True)
+
+    def _path(self, key: str) -> str:
+        safe = key.replace("/", "_")
+        return os.path.join(self.root, safe)
+
+    def set(self, key: str, value: bytes) -> None:
+        path = self._path(key)
+        tmp = f"{path}.tmp.{os.getpid()}"
+        with open(tmp, "wb") as f:
+            f.write(value)
+        os.replace(tmp, path)  # atomic publish
+
+    def get(self, key: str) -> bytes | None:
+        try:
+            with open(self._path(key), "rb") as f:
+                return f.read()
+        except FileNotFoundError:
+            return None
+
+    def wait(self, key: str, timeout_s: float | None = None) -> bytes:
+        deadline = time.monotonic() + (timeout_s or self.timeout_s)
+        while True:
+            v = self.get(key)
+            if v is not None:
+                return v
+            if time.monotonic() > deadline:
+                raise TimeoutError(f"store key {key!r} not set within "
+                                   f"{timeout_s or self.timeout_s}s")
+            time.sleep(self.poll_s)
+
+    def add(self, key: str, rank: int) -> None:
+        """Register `rank` under a multi-writer key (barrier membership)."""
+        self.set(f"{key}.{rank}", b"1")
+
+    def count(self, key: str, world: int) -> int:
+        return sum(
+            1 for r in range(world)
+            if os.path.exists(self._path(f"{key}.{r}")))
+
+    def wait_count(self, key: str, world: int,
+                   timeout_s: float | None = None) -> None:
+        deadline = time.monotonic() + (timeout_s or self.timeout_s)
+        while self.count(key, world) < world:
+            if time.monotonic() > deadline:
+                raise TimeoutError(
+                    f"barrier {key!r}: {self.count(key, world)}/{world} "
+                    "ranks arrived")
+            time.sleep(self.poll_s)

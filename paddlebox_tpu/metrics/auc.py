@@ -106,6 +106,18 @@ class AucAccumulator:
         self.drain()
         return auc_compute(self.host, **kw)
 
+    def compute_global(self, collectives, **kw) -> dict[str, float]:
+        """Exact multi-host AUC: all_reduce the histogram tables over the
+        control plane first (the MPICluster::allreduce_sum path,
+        box_wrapper.cc:331-356; fleet_util.get_global_auc semantics)."""
+        self.drain()
+        tot = {k: np.asarray(collectives.all_reduce(
+                   np.atleast_1d(np.asarray(v, np.float64)), op="sum"))
+               for k, v in self.host.items()}
+        tot = {k: v if self.host[k].ndim else v.reshape(())
+               for k, v in tot.items()}
+        return auc_compute(tot, **kw)
+
 
 def psum_state(state: AucState, axis_name) -> AucState:
     """Exact global reduction over mesh axes (replaces collect_data_nccl +

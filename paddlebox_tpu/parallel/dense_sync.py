@@ -54,7 +54,7 @@ class AsyncDenseTable:
     def __init__(self, flat_params: np.ndarray, lr: float = 1e-3,
                  betas: tuple[float, float] = (0.99, 0.9999),
                  eps: float = 1e-8, merge_limit: int = 4,
-                 lr_map: dict[slice, float] | None = None):
+                 lr_map: list[tuple[slice, float]] | None = None):
         self._params = np.array(flat_params, dtype=np.float32)
         self._mom1 = np.zeros_like(self._params)
         self._mom2 = np.zeros_like(self._params)
@@ -62,9 +62,10 @@ class AsyncDenseTable:
         self.betas = betas
         self.eps = eps
         self.merge_limit = max(1, merge_limit)
-        # per-range LR override (the GetLRMap per-param-name map, flattened)
+        # per-range LR overrides (the GetLRMap per-param-name map, flattened;
+        # (slice, lr) pairs — slices aren't hashable before 3.12)
         self._lr_vec = np.full_like(self._params, lr)
-        for sl, r in (lr_map or {}).items():
+        for sl, r in (lr_map or []):
             self._lr_vec[sl] = r
         self._queue: queue.Queue[np.ndarray | None] = queue.Queue()
         self._lock = threading.Lock()
@@ -124,6 +125,21 @@ class AsyncDenseTable:
             self._apply(merged, n)
             for _ in range(n):
                 self._queue.task_done()
+
+    # ---- checkpoint plane (the dense half of SaveBase/LoadModel) ----
+
+    def state_dict(self) -> dict[str, np.ndarray]:
+        with self._lock:
+            return {"params": self._params.copy(),
+                    "mom1": self._mom1.copy(), "mom2": self._mom2.copy(),
+                    "steps": np.asarray([self.steps_applied])}
+
+    def load_state_dict(self, state: dict[str, np.ndarray]) -> None:
+        with self._lock:
+            self._params[:] = state["params"]
+            self._mom1[:] = state["mom1"]
+            self._mom2[:] = state["mom2"]
+            self.steps_applied = int(np.asarray(state["steps"]).reshape(-1)[0])
 
     def _apply(self, grad_sum: np.ndarray, n: int) -> None:
         g = grad_sum / n

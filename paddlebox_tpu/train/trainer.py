@@ -107,6 +107,10 @@ class Trainer:
                 f"separately should split with ops.pull_box_extended_sparse")
         if self.cfg.dense_sync_mode not in ("allreduce", "kstep", "async"):
             raise ValueError(self.cfg.dense_sync_mode)
+        if self.cfg.param_sync_step < 1:
+            raise ValueError(
+                f"param_sync_step must be >= 1, got "
+                f"{self.cfg.param_sync_step}")
         # Dense params/opt state are replicated over the mesh (the reference
         # copies dense params to every GPU, boxps_worker.cc:403-480). Placing
         # them explicitly — and pinning the step's out_shardings to match —
@@ -129,20 +133,22 @@ class Trainer:
                                             self.n_shards),
                 self._stacked_sh)
             self._sync_fn = self._build_param_sync()
+            self._collapse_fn = jax.jit(
+                lambda p: jax.tree.map(lambda a: a[0], p),
+                out_shardings=repl)
         elif self.cfg.dense_sync_mode == "async":
             self.params = jax.device_put(init_params, repl)
-            self.opt_state = self.tx.init(init_params)  # unused in async
             flat, self._unravel = dense_sync.flatten_dense(init_params)
             self.dense_table = dense_sync.AsyncDenseTable(
                 flat, lr=self.cfg.dense_lr, betas=self.cfg.async_betas,
                 merge_limit=self.cfg.async_merge_limit)
+            # In async mode the REAL optimizer state lives in the table;
+            # expose it as opt_state so the (params, opt_state) checkpoint
+            # pattern captures the Adam moments (refreshed at pass end).
+            self.opt_state = self.dense_table.state_dict()
         else:
             self.params = jax.device_put(init_params, repl)
             self.opt_state = jax.device_put(self.tx.init(init_params), repl)
-        if self.cfg.dense_sync_mode == "kstep":
-            self._collapse_fn = jax.jit(
-                lambda p: jax.tree.map(lambda a: a[0], p),
-                out_shardings=repl)
         self.timers = StageTimers(["read", "translate", "train", "auc"])
         self._step_fn = self._build_train_step()
         self._eval_fn = self._build_eval_step()
@@ -436,6 +442,7 @@ class Trainer:
                 self.dense_table.flush()
                 self.params = jax.device_put(
                     self._unravel(self.dense_table.pull()), repl)
+                self.opt_state = self.dense_table.state_dict()
             else:
                 if mode == "kstep":  # end-of-pass sync (trainer Finalize)
                     params, opt_state = self._sync_fn(params, opt_state)
@@ -455,6 +462,49 @@ class Trainer:
         if self.cfg.dense_sync_mode == "kstep":
             return self._collapse_fn(self.params)
         return self.params
+
+    def restore_dense(self, params, opt_state=None) -> None:
+        """Load dense state from a checkpoint, mode-aware.
+
+        `params` may be the replicated tree (from ``eval_params``/a
+        checkpoint) or, for kstep, the stacked per-shard tree. In async
+        mode `opt_state` is an AsyncDenseTable state dict (what
+        ``self.opt_state`` holds after a pass); omitting it keeps fresh
+        zero moments.
+        """
+        mode = self.cfg.dense_sync_mode
+        repl = mesh_lib.replicated_sharding(self.mesh)
+        if mode == "async":
+            self.params = jax.device_put(params, repl)
+            if opt_state is not None:
+                self.dense_table.load_state_dict(opt_state)
+            else:
+                flat, _ = dense_sync.flatten_dense(params)
+                self.dense_table.load_state_dict(
+                    {"params": flat, "mom1": np.zeros_like(flat),
+                     "mom2": np.zeros_like(flat), "steps": np.asarray([0])})
+            self.opt_state = self.dense_table.state_dict()
+            return
+        if mode == "kstep":
+            tmpl = jax.tree.leaves(self.params)
+            got = jax.tree.leaves(params)
+            stacked_already = all(
+                np.shape(a) == np.shape(b) for a, b in zip(got, tmpl))
+            if not stacked_already:
+                params = dense_sync.stack_for_shards(params, self.n_shards)
+            self.params = jax.device_put(params, self._stacked_sh)
+            if opt_state is not None:
+                ot = jax.tree.leaves(self.opt_state)
+                og = jax.tree.leaves(opt_state)
+                if not all(np.shape(a) == np.shape(b)
+                           for a, b in zip(og, ot)):
+                    opt_state = dense_sync.stack_for_shards(opt_state,
+                                                            self.n_shards)
+                self.opt_state = jax.device_put(opt_state, self._stacked_sh)
+            return
+        self.params = jax.device_put(params, repl)
+        if opt_state is not None:
+            self.opt_state = jax.device_put(opt_state, repl)
 
     def eval_pass(self, dataset) -> dict[str, float]:
         """Test-mode pass: no pushes, no dense updates, and the store is

@@ -63,7 +63,7 @@ def test_async_table_merges_queued_grads():
 def test_async_table_lr_map():
     p0 = np.zeros(4, np.float32)
     tbl = AsyncDenseTable(p0, lr=1.0, betas=(0.0, 0.0),
-                          lr_map={slice(2, 4): 0.5})
+                          lr_map=[(slice(2, 4), 0.5)])
     tbl.start(); tbl.push(np.ones(4, np.float32)); tbl.flush(); tbl.stop()
     got = tbl.pull()
     assert abs(got[0] / got[2] - 2.0) < 1e-5
@@ -168,3 +168,39 @@ def test_async_table_stop_mid_merge_then_flush():
     tbl._run()
     tbl.flush()  # must return immediately
     assert tbl.grads_merged == 2
+
+
+def test_async_checkpoint_roundtrip():
+    import jax
+    tr = _make("async")
+    _run_steps(tr, n_steps=4)
+    saved_params = jax.tree.map(np.asarray, tr.params)
+    saved_opt = {k: np.asarray(v) for k, v in tr.opt_state.items()}
+    assert saved_opt["steps"][0] > 0  # real table state, not a dummy
+    tr.dense_table.stop()
+
+    tr2 = _make("async")
+    tr2.restore_dense(saved_params, saved_opt)
+    np.testing.assert_allclose(tr2.dense_table.pull(),
+                               tr.dense_table.pull())
+    np.testing.assert_allclose(tr2.dense_table._mom1, tr.dense_table._mom1)
+    _run_steps(tr2, n_steps=1)  # and training continues
+    tr2.dense_table.stop()
+
+
+def test_kstep_restore_from_collapsed():
+    import jax
+    tr = _make("kstep", param_sync_step=2)
+    _run_steps(tr, n_steps=4)
+    collapsed = jax.tree.map(np.asarray, tr.eval_params())
+    tr2 = _make("kstep", param_sync_step=2)
+    tr2.restore_dense(collapsed)
+    for a, b in zip(jax.tree.leaves(tr2.eval_params()),
+                    jax.tree.leaves(collapsed)):
+        np.testing.assert_allclose(np.asarray(a), b)
+    _run_steps(tr2, n_steps=1)
+
+
+def test_param_sync_step_validated():
+    with pytest.raises(ValueError, match="param_sync_step"):
+        _make("kstep", param_sync_step=0)
