@@ -46,7 +46,7 @@ _REDUCERS: dict[str, Callable] = {
 
 class HostCollectives:
     def __init__(self, store: FileStore, rank: int, world: int,
-                 run_id: str = ""):
+                 run_id: str = "", cleanup_lag: int = 8):
         if not (0 <= rank < world):
             raise ValueError(f"rank {rank} outside world {world}")
         self.store = store
@@ -56,18 +56,33 @@ class HostCollectives:
         # persistent store dir never consumes a dead run's published values
         # (the launcher stamps PBTPU_RUN_ID per launch)
         self.run_id = run_id
+        # Files this rank wrote, per round, unlinked `cleanup_lag` rounds
+        # later so a long run doesn't grow the store without bound. The lag
+        # is safe as long as no rank falls cleanup_lag collective rounds
+        # behind — only possible via long chains of non-synchronizing
+        # broadcasts (all_reduce/all_gather/barrier are full syncs).
+        self.cleanup_lag = max(2, cleanup_lag)
+        self._written: dict[int, list[str]] = {}
         self._seq = 0
 
     def _next(self, name: str) -> str:
         self._seq += 1
+        old = self._written.pop(self._seq - self.cleanup_lag, None)
+        if old:
+            for key in old:
+                self.store.delete(key)
         prefix = f"{self.run_id}." if self.run_id else ""
         return f"{prefix}{name}.{self._seq}"
+
+    def _wrote(self, key: str) -> None:
+        self._written.setdefault(self._seq, []).append(key)
 
     def barrier(self, name: str = "barrier") -> None:
         if self.world == 1:
             return
         key = self._next(name)
         self.store.add(key, self.rank)
+        self._wrote(f"{key}.{self.rank}")
         self.store.wait_count(key, self.world)
 
     def all_gather(self, value: Any, name: str = "gather") -> list[Any]:
@@ -75,6 +90,7 @@ class HostCollectives:
             return [value]
         key = self._next(name)
         self.store.set(f"{key}.v{self.rank}", _dump(value))
+        self._wrote(f"{key}.v{self.rank}")
         return [_load(self.store.wait(f"{key}.v{r}"))
                 for r in range(self.world)]
 
@@ -86,11 +102,13 @@ class HostCollectives:
             return value
         key = self._next(name)
         self.store.set(f"{key}.v{self.rank}", _dump(value))
+        self._wrote(f"{key}.v{self.rank}")
         if self.rank == 0:
             parts = [_load(self.store.wait(f"{key}.v{r}"))
                      for r in range(self.world)]
             out = _REDUCERS[op](parts)
             self.store.set(f"{key}.out", _dump(out))
+            self._wrote(f"{key}.out")
             return out
         return _load(self.store.wait(f"{key}.out"))
 
@@ -101,5 +119,6 @@ class HostCollectives:
         key = self._next(name)
         if self.rank == root:
             self.store.set(f"{key}.out", _dump(value))
+            self._wrote(f"{key}.out")
             return value
         return _load(self.store.wait(f"{key}.out"))

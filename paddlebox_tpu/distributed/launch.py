@@ -25,10 +25,17 @@ import time
 import uuid
 
 
-def _free_port() -> int:
-    with socket.socket() as s:
-        s.bind(("127.0.0.1", 0))
-        return s.getsockname()[1]
+def _free_ports(n: int) -> list[int]:
+    # all probe sockets stay open until every port is collected, or the
+    # kernel can hand a just-released port out twice
+    socks = [socket.socket() for _ in range(n)]
+    try:
+        for s in socks:
+            s.bind(("127.0.0.1", 0))
+        return [s.getsockname()[1] for s in socks]
+    finally:
+        for s in socks:
+            s.close()
 
 
 def launch(nprocs: int, cmd: list[str], store_dir: str | None = None,
@@ -39,7 +46,7 @@ def launch(nprocs: int, cmd: list[str], store_dir: str | None = None,
     terminated (a hung peer would otherwise block on its next collective
     until the store timeout)."""
     store_dir = store_dir or tempfile.mkdtemp(prefix="pbtpu_store_")
-    endpoints = ",".join(f"127.0.0.1:{_free_port()}" for _ in range(nprocs))
+    endpoints = ",".join(f"127.0.0.1:{p}" for p in _free_ports(nprocs))
     run_id = uuid.uuid4().hex[:12]
     procs: list[subprocess.Popen] = []
     for rank in range(nprocs):

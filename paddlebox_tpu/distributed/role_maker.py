@@ -51,6 +51,13 @@ class RoleMaker:
             raise ValueError(
                 f"multi-host run needs {ENV_STORE} (shared filesystem dir) "
                 "for the rendezvous store")
+        if self.world_size > 1 and not self.run_id:
+            raise ValueError(
+                f"multi-host run needs {ENV_RUN_ID}: without a per-launch "
+                "run id, a restart against the same store dir would consume "
+                "the dead run's published collective results (the launcher "
+                "stamps this automatically; site scripts must set it, e.g. "
+                "to the scheduler job id)")
         store = FileStore(self.store_dir or "/tmp/pbtpu_store",
                           timeout_s=timeout_s)
         return HostCollectives(store, self.rank, self.world_size,

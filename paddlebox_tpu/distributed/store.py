@@ -31,6 +31,12 @@ class FileStore:
             f.write(value)
         os.replace(tmp, path)  # atomic publish
 
+    def delete(self, key: str) -> None:
+        try:
+            os.remove(self._path(key))
+        except FileNotFoundError:
+            pass
+
     def get(self, key: str) -> bytes | None:
         try:
             with open(self._path(key), "rb") as f:

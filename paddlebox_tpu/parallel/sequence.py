@@ -1,0 +1,153 @@
+"""Sequence/context parallelism: ring attention + Ulysses all-to-all.
+
+The reference predates long-sequence towers (SURVEY.md §5: CTR slots are
+pooled, never attended over at length), but this framework treats
+long-context as first-class: user-behavior towers routinely attend over
+10k+ events, and a single chip's HBM bounds S^2 attention. Two standard
+TPU-native schemes, both written to run inside ``shard_map`` over a mesh
+axis that shards the sequence dimension:
+
+- ``ring_attention`` — K/V blocks rotate around the ring via
+  ``lax.ppermute`` while each device keeps its Q shard; softmax is
+  accumulated online (flash-style running max/denominator), so memory is
+  O(S_local^2) and the K/V transfer rides ICI neighbor links.
+- ``ulysses_attention`` — two ``lax.all_to_all``s re-shard from
+  sequence-parallel to head-parallel, run full local attention per head
+  group, and shard back. Cheaper collectives when heads >= devices.
+
+Both match single-device full attention bit-for-bit up to fp tolerance
+(tests/test_sequence_parallel.py) including causal masking and autodiff.
+"""
+
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+def attention_reference(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
+                        causal: bool = False) -> jnp.ndarray:
+    """Plain full attention. Shapes: (B, S, H, D) -> (B, S, H, D)."""
+    scale = 1.0 / math.sqrt(q.shape[-1])
+    s = jnp.einsum("bqhd,bkhd->bhqk", q, k) * scale
+    if causal:
+        S_q, S_k = s.shape[-2], s.shape[-1]
+        mask = jnp.arange(S_k)[None, :] > jnp.arange(S_q)[:, None]
+        s = jnp.where(mask, -jnp.inf, s)
+    p = jax.nn.softmax(s, axis=-1)
+    return jnp.einsum("bhqk,bkhd->bqhd", p, v)
+
+
+def ring_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
+                   axis_name, causal: bool = False) -> jnp.ndarray:
+    """Ring attention inside shard_map; sequence dim sharded over axis_name.
+
+    q, k, v: (B, S_local, H, D) — this device's sequence shard.
+    Returns (B, S_local, H, D), identical to full attention over the global
+    sequence. K/V blocks travel the ring once (D-1 ppermutes), overlapping
+    compute with neighbor transfers; the online-softmax carry keeps exact
+    results without materializing the (S, S) score matrix.
+    """
+    n_dev = lax.axis_size(axis_name)
+    my = lax.axis_index(axis_name)
+    B, S_l, H, Dh = q.shape
+    scale = 1.0 / math.sqrt(Dh)
+    q_pos = my * S_l + jnp.arange(S_l)  # global query positions
+
+    def accumulate(o, m, l, kb, vb, i):
+        # kb originated on device (my - i) mod n_dev
+        src = (my - i) % n_dev
+        s = jnp.einsum("bqhd,bkhd->bhqk", q, kb) * scale
+        mask = None
+        if causal:
+            k_pos = src * S_l + jnp.arange(S_l)
+            mask = k_pos[None, :] > q_pos[:, None]          # (S_l, S_l)
+            s = jnp.where(mask[None, None], -jnp.inf, s)
+        m_blk = jnp.max(s, axis=-1)
+        m_new = jnp.maximum(m, m_blk)
+        # fully-masked rows keep m = -inf; guard the exp shift
+        shift = jnp.where(jnp.isneginf(m_new), 0.0, m_new)
+        p = jnp.exp(s - shift[..., None])
+        if causal:
+            p = jnp.where(mask[None, None], 0.0, p)
+        corr = jnp.exp(jnp.where(jnp.isneginf(m), 0.0, m) - shift)
+        corr = jnp.where(jnp.isneginf(m), 0.0, corr)
+        l_new = l * corr + jnp.sum(p, axis=-1)
+        o_new = (o * corr[..., None]
+                 + jnp.einsum("bhqk,bkhd->bqhd", p, vb).transpose(0, 2, 1, 3))
+        return o_new, m_new, l_new
+
+    def one_block(carry, i):
+        o, m, l, kb, vb = carry
+        o, m, l = accumulate(o, m, l, kb, vb, i)
+        kb, vb = lax.ppermute(
+            (kb, vb), axis_name,
+            perm=[(d, (d + 1) % n_dev) for d in range(n_dev)])
+        return (o, m, l, kb, vb), None
+
+    # pcast to varying: the zero inits must carry the same device-varying
+    # type as the loop outputs or scan rejects the carry
+    vary = lambda x: lax.pcast(x, axis_name, to="varying")
+    o0 = vary(jnp.zeros((B, H, S_l, Dh), q.dtype))
+    m0 = vary(jnp.full((B, H, S_l), -jnp.inf, q.dtype))
+    l0 = vary(jnp.zeros((B, H, S_l), q.dtype))
+    # D-1 rotations; the final held block is consumed without another hop
+    (o, m, l, kb, vb), _ = lax.scan(one_block, (o0, m0, l0, k, v),
+                                    jnp.arange(n_dev - 1))
+    o, m, l = accumulate(o, m, l, kb, vb, n_dev - 1)
+    denom = jnp.where(l == 0.0, 1.0, l)
+    out = o / denom[..., None]
+    return out.transpose(0, 2, 1, 3)  # (B, H, S_l, D) -> (B, S_l, H, D)
+
+
+def ulysses_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
+                      axis_name, causal: bool = False) -> jnp.ndarray:
+    """Ulysses (all-to-all) attention inside shard_map.
+
+    Re-shards (B, S_local, H, D) sequence-parallel inputs to
+    (B, S_global, H_local, D) head-parallel, runs exact full attention on
+    each device's head group, and shards back. Requires
+    H %% axis_size == 0.
+    """
+    n_dev = lax.axis_size(axis_name)
+    H = q.shape[2]
+    if H % n_dev:
+        raise ValueError(f"heads {H} not divisible by axis size {n_dev}")
+
+    def to_heads(x):  # split heads, concat sequence
+        return lax.all_to_all(x, axis_name, split_axis=2, concat_axis=1,
+                              tiled=True)
+
+    def to_seq(x):    # split sequence, concat heads
+        return lax.all_to_all(x, axis_name, split_axis=1, concat_axis=2,
+                              tiled=True)
+
+    qh, kh, vh = to_heads(q), to_heads(k), to_heads(v)
+    out = attention_reference(qh, kh, vh, causal=causal)
+    return to_seq(out)
+
+
+def make_sequence_parallel_attention(mesh: jax.sharding.Mesh, axis_name: str,
+                                     mode: str = "ring",
+                                     causal: bool = False):
+    """Jitted (B, S, H, D) attention with S sharded over `axis_name`.
+
+    The returned fn takes/returns GLOBAL arrays; sharding in/out is
+    P(None, axis_name) on the sequence dim — drop-in for a model that was
+    using full attention but whose sequences stopped fitting one chip.
+    """
+    from jax.sharding import NamedSharding, PartitionSpec as P
+    inner = {"ring": ring_attention, "ulysses": ulysses_attention}[mode]
+    spec = P(None, axis_name)
+    sh = NamedSharding(mesh, spec)
+
+    def body(q, k, v):
+        return inner(q, k, v, axis_name, causal=causal)
+
+    fn = jax.jit(jax.shard_map(body, mesh=mesh,
+                               in_specs=(spec, spec, spec), out_specs=spec),
+                 out_shardings=sh)
+    return fn

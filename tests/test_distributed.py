@@ -190,3 +190,22 @@ def test_global_auc_across_ranks(tmp_path):
         # fp32 on-device accumulation order differs between one full batch
         # and two halves; the cross-rank reduction itself is exact
         assert got["mae"] == pytest.approx(want["mae"], rel=1e-6)
+
+
+def test_collectives_store_cleanup(tmp_path):
+    # files from old rounds are unlinked cleanup_lag rounds later
+    def body(col, r):
+        for i in range(12):
+            col.all_reduce(np.asarray([1.0]))
+        return None
+
+    store = FileStore(str(tmp_path), timeout_s=20)
+    results, errs = [], []
+    cols = [HostCollectives(store, r, 2, cleanup_lag=3) for r in range(2)]
+    ts = [threading.Thread(target=lambda c=c, r=r: body(c, r))
+          for r, c in enumerate(cols)]
+    [t.start() for t in ts]
+    [t.join() for t in ts]
+    files = os.listdir(str(tmp_path))
+    # 12 rounds x 3 files each would be 36; cleanup keeps only ~last lag
+    assert len(files) <= 3 * 4, sorted(files)
