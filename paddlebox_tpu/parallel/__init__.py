@@ -2,3 +2,6 @@ from paddlebox_tpu.parallel.mesh import (make_mesh, table_sharding,  # noqa: F40
                                          batch_sharding, replicated_sharding)
 from paddlebox_tpu.parallel.dense_sync import (AsyncDenseTable,  # noqa: F401
                                                flatten_dense)
+from paddlebox_tpu.parallel.pipeline import (gpipe_spmd,  # noqa: F401
+                                             make_pipeline, split_stages,
+                                             stack_stage_params)

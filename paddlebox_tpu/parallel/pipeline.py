@@ -1,0 +1,164 @@
+"""Pipeline parallelism: GPipe-style microbatched stage execution.
+
+The reference runs pipelines with ``PipelineTrainer`` + ``SectionWorker``
+(trainer.h:281-309, device_worker.h:541-583): the program is split into
+sections by ``BoxPSOptimizer._split_program``'s cut_list (optimizer.py:5374-
+5450), each section owns a device, and microbatch scopes flow section to
+section over queues.
+
+TPU-native redesign: the "sections" are one jitted stage function whose
+parameters are stacked with a leading stage axis and sharded over a ``pp``
+mesh axis; activations hop stage→stage with ``lax.ppermute`` over ICI
+neighbor links inside ``shard_map``; the microbatch loop is a ``lax.scan``.
+Because every op in the schedule (scan, ppermute, dynamic slices) is
+differentiable, ``jax.grad`` of a loss around :func:`gpipe_spmd` yields the
+reverse pipeline schedule automatically — there is no hand-written backward
+section the way SectionWorker replays backward ops.
+
+The schedule is plain GPipe: with S stages and M microbatches the loop runs
+M+S-1 ticks, every stage computes each tick, and the bubble fraction is
+(S-1)/(M+S-1) — pick M >= 4*S to amortize. Stages must be shape-homogeneous
+(activation in == activation out), which CTR towers with equal hidden widths
+satisfy; heterogeneous cuts belong at the model level (pad widths).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+PP_AXIS = "pp"
+
+
+def split_stages(layers: Sequence[Any],
+                 num_stages: int | None = None,
+                 cut_list: Sequence[int] | None = None) -> list[list[Any]]:
+    """Group a flat layer list into pipeline stages.
+
+    Mirrors BoxPSOptimizer cut_list semantics (optimizer.py:5374): cut_list
+    gives the index of the first layer of each stage after the zeroth.
+    Without a cut_list, layers split into ``num_stages`` near-equal groups.
+    """
+    n = len(layers)
+    if cut_list is not None:
+        cuts = [0, *cut_list, n]
+        if any(b <= a for a, b in zip(cuts[:-1], cuts[1:])):
+            raise ValueError(
+                f"cut_list {cut_list} must be strictly increasing within "
+                f"(0,{n}) — every stage needs at least one layer")
+        return [list(layers[a:b]) for a, b in zip(cuts[:-1], cuts[1:])]
+    if not num_stages or num_stages < 1:
+        raise ValueError("need num_stages or cut_list")
+    bounds = np.linspace(0, n, num_stages + 1).round().astype(int)
+    return [list(layers[a:b]) for a, b in zip(bounds[:-1], bounds[1:])]
+
+
+def stack_stage_params(per_stage: Sequence[Any]) -> Any:
+    """Stack per-stage pytrees (identical structure) along a new leading
+    stage axis — the array the ``pp`` mesh axis shards."""
+    return jax.tree.map(lambda *xs: jnp.stack(xs), *per_stage)
+
+
+def gpipe_spmd(stage_fn: Callable[[Any, jnp.ndarray], jnp.ndarray],
+               stage_params: Any,
+               x: jnp.ndarray,
+               num_microbatches: int,
+               axis_name: str = PP_AXIS) -> jnp.ndarray:
+    """Run the GPipe schedule. Call inside shard_map over ``axis_name``.
+
+    stage_params : this device's stage slice — pytree whose leaves carry a
+                   leading stage axis of local size 1 (shard_map slicing of
+                   the stacked params).
+    x            : (B, ...) this device's full local batch (replicated over
+                   the pp axis; shard it over dp when composing with data
+                   parallelism).
+    Returns stage S-1's outputs for all microbatches, reassembled to (B, ...)
+    and replicated over the pp axis.
+    """
+    S = lax.axis_size(axis_name)
+    stage = lax.axis_index(axis_name)
+    params = jax.tree.map(lambda a: a[0], stage_params)  # drop stage axis
+    M = num_microbatches
+    B = x.shape[0]
+    if B % M:
+        raise ValueError(f"batch {B} not divisible by microbatches {M}")
+    mb = B // M
+    xm = x.reshape(M, mb, *x.shape[1:])
+
+    fwd_perm = [(d, (d + 1) % S) for d in range(S)]
+
+    def tick(carry, t):
+        recv, outs = carry
+        # stage 0 feeds microbatch t (clamped — garbage ticks are masked at
+        # the output write); later stages consume what arrived last tick
+        x_t = lax.dynamic_index_in_dim(xm, jnp.clip(t, 0, M - 1), 0,
+                                       keepdims=False)
+        inp = jnp.where(stage == 0, x_t.astype(recv.dtype), recv)
+        y = stage_fn(params, inp)
+        # rotate activations one hop forward around the ring
+        recv_next = lax.ppermute(y, axis_name, perm=fwd_perm)
+        # last stage banks microbatch t-(S-1) once it's real; bubble ticks
+        # (slot < 0) clamp to slot 0 and write zeros over its initial zeros,
+        # then t = S-1 overwrites slot 0 with the real first microbatch
+        slot = t - (S - 1)
+        y_masked = jnp.where(slot >= 0, y, 0.0)
+        outs = lax.dynamic_update_index_in_dim(
+            outs, y_masked, jnp.clip(slot, 0, M - 1), 0)
+        return (recv_next, outs), None
+
+    # the ring constrains activations to one shape: stage input == stage
+    # output == a microbatch of x (pad widths at the model level otherwise).
+    # Deriving the zero inits from x keeps whatever other mesh axes x varies
+    # over (e.g. dp) in their type; pcast adds the pp axis.
+    vary = lambda a: lax.pcast(a, axis_name, to="varying")
+    recv0 = vary(xm[0] * 0.0)
+    outs0 = vary(xm * 0.0)
+    (_, outs), _ = lax.scan(tick, (recv0, outs0), jnp.arange(M + S - 1))
+    # only stage S-1 holds real outputs; psum broadcasts them to the whole
+    # pp ring so downstream loss code is stage-agnostic
+    outs = lax.psum(jnp.where(stage == S - 1, outs, 0.0), axis_name)
+    return outs.reshape(B, *x.shape[1:])
+
+
+def make_pipeline(mesh: Mesh,
+                  stage_fn: Callable[[Any, jnp.ndarray], jnp.ndarray],
+                  num_microbatches: int,
+                  pp_axis: str = PP_AXIS,
+                  dp_axis: str | None = None):
+    """Jitted pipelined apply over GLOBAL arrays.
+
+    Returns ``fn(stacked_params, x) -> y`` where stacked_params' leaves carry
+    the leading stage axis (sharded over ``pp_axis``) and x/y are the global
+    batch (sharded over ``dp_axis`` when given, replicated otherwise). The
+    returned fn is differentiable — wrap a loss and ``jax.grad`` it to train.
+    """
+    param_spec = P(pp_axis)
+    batch_spec = P(dp_axis) if dp_axis else P()
+
+    def body(stacked, x):
+        return gpipe_spmd(stage_fn, stacked, x, num_microbatches,
+                          axis_name=pp_axis)
+
+    # specs are prefix pytrees: one spec covers every leaf of the params tree
+    fn = jax.shard_map(body, mesh=mesh, in_specs=(param_spec, batch_spec),
+                       out_specs=batch_spec)
+    return jax.jit(fn, out_shardings=NamedSharding(mesh, batch_spec))
+
+
+def mlp_stage_fn(activation: Callable[[jnp.ndarray], jnp.ndarray]
+                 = jax.nn.relu):
+    """Stage function for a homogeneous dense tower: params
+    {"w": (L, D, D), "b": (L, D)} — L layers per stage, width D."""
+    def fn(params, x):
+        def layer(h, wb):
+            w, b = wb
+            return activation(
+                jnp.dot(h, w, preferred_element_type=jnp.float32) + b), None
+        h, _ = lax.scan(layer, x, (params["w"], params["b"]))
+        return h
+    return fn

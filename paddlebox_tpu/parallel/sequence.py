@@ -55,12 +55,17 @@ def ring_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
     my = lax.axis_index(axis_name)
     B, S_l, H, Dh = q.shape
     scale = 1.0 / math.sqrt(Dh)
+    in_dtype = q.dtype
     q_pos = my * S_l + jnp.arange(S_l)  # global query positions
 
     def accumulate(o, m, l, kb, vb, i):
         # kb originated on device (my - i) mod n_dev
         src = (my - i) % n_dev
-        s = jnp.einsum("bqhd,bkhd->bhqk", q, kb) * scale
+        # scores and the (o, m, l) running state accumulate in f32: with
+        # bf16 inputs the corr-rescale + re-sum repeats once per ring hop
+        # and would compound bf16 rounding with ring size otherwise
+        s = jnp.einsum("bqhd,bkhd->bhqk", q, kb,
+                       preferred_element_type=jnp.float32) * scale
         mask = None
         if causal:
             k_pos = src * S_l + jnp.arange(S_l)
@@ -77,7 +82,9 @@ def ring_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
         corr = jnp.where(jnp.isneginf(m), 0.0, corr)
         l_new = l * corr + jnp.sum(p, axis=-1)
         o_new = (o * corr[..., None]
-                 + jnp.einsum("bhqk,bkhd->bqhd", p, vb).transpose(0, 2, 1, 3))
+                 + jnp.einsum("bhqk,bkhd->bqhd", p, vb,
+                              preferred_element_type=jnp.float32)
+                 .transpose(0, 2, 1, 3))
         return o_new, m_new, l_new
 
     def one_block(carry, i):
@@ -91,15 +98,15 @@ def ring_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
     # pcast to varying: the zero inits must carry the same device-varying
     # type as the loop outputs or scan rejects the carry
     vary = lambda x: lax.pcast(x, axis_name, to="varying")
-    o0 = vary(jnp.zeros((B, H, S_l, Dh), q.dtype))
-    m0 = vary(jnp.full((B, H, S_l), -jnp.inf, q.dtype))
-    l0 = vary(jnp.zeros((B, H, S_l), q.dtype))
+    o0 = vary(jnp.zeros((B, H, S_l, Dh), jnp.float32))
+    m0 = vary(jnp.full((B, H, S_l), -jnp.inf, jnp.float32))
+    l0 = vary(jnp.zeros((B, H, S_l), jnp.float32))
     # D-1 rotations; the final held block is consumed without another hop
     (o, m, l, kb, vb), _ = lax.scan(one_block, (o0, m0, l0, k, v),
                                     jnp.arange(n_dev - 1))
     o, m, l = accumulate(o, m, l, kb, vb, n_dev - 1)
     denom = jnp.where(l == 0.0, 1.0, l)
-    out = o / denom[..., None]
+    out = (o / denom[..., None]).astype(in_dtype)
     return out.transpose(0, 2, 1, 3)  # (B, H, S_l, D) -> (B, S_l, H, D)
 
 

@@ -27,14 +27,14 @@ def test_filestore_set_get_wait(tmp_path):
         st.wait("missing", timeout_s=0.1)
 
 
-def _threaded_ranks(tmp_path, world, fn):
+def _threaded_ranks(tmp_path, world, fn, **col_kwargs):
     store = FileStore(str(tmp_path), timeout_s=20)
     results = [None] * world
     errs = []
 
     def run(r):
         try:
-            results[r] = fn(HostCollectives(store, r, world), r)
+            results[r] = fn(HostCollectives(store, r, world, **col_kwargs), r)
         except Exception as e:  # pragma: no cover
             errs.append(e)
 
@@ -199,13 +199,7 @@ def test_collectives_store_cleanup(tmp_path):
             col.all_reduce(np.asarray([1.0]))
         return None
 
-    store = FileStore(str(tmp_path), timeout_s=20)
-    results, errs = [], []
-    cols = [HostCollectives(store, r, 2, cleanup_lag=3) for r in range(2)]
-    ts = [threading.Thread(target=lambda c=c, r=r: body(c, r))
-          for r, c in enumerate(cols)]
-    [t.start() for t in ts]
-    [t.join() for t in ts]
+    _threaded_ranks(tmp_path, 2, body, cleanup_lag=3)
     files = os.listdir(str(tmp_path))
     # 12 rounds x 3 files each would be 36; cleanup keeps only ~last lag
     assert len(files) <= 3 * 4, sorted(files)

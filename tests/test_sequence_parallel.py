@@ -70,3 +70,18 @@ def test_ulysses_rejects_bad_heads():
     fn = make_sequence_parallel_attention(mesh, "dp", mode="ulysses")
     with pytest.raises(ValueError, match="not divisible"):
         fn(q, k, v)
+
+
+def test_ring_bf16_accumulates_in_f32():
+    # the (o, m, l) online-softmax state stays f32 even for bf16 inputs, so
+    # ring results track the f32 reference to bf16 resolution regardless of
+    # how many hops the ring has
+    mesh = make_mesh(8)
+    q, k, v = _qkv(S=128)
+    want = attention_reference(jnp.asarray(q), jnp.asarray(k), jnp.asarray(v))
+    fn = make_sequence_parallel_attention(mesh, "dp", mode="ring")
+    got = fn(jnp.asarray(q, jnp.bfloat16), jnp.asarray(k, jnp.bfloat16),
+             jnp.asarray(v, jnp.bfloat16))
+    assert got.dtype == jnp.bfloat16
+    np.testing.assert_allclose(np.asarray(got, np.float32),
+                               np.asarray(want), rtol=0.06, atol=0.06)
