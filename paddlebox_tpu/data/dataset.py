@@ -26,6 +26,7 @@ from paddlebox_tpu.data.reader import ParserPlugin, read_file
 from paddlebox_tpu.data.schema import DataFeedSchema
 from paddlebox_tpu.data.slot_record import PackedBatch, SlotRecordBatch, batch_iterator
 from paddlebox_tpu.data.shuffle import LocalShuffler, RoutingMode, TcpShuffleService, route_records
+from paddlebox_tpu.utils.profiler import stat_add
 
 
 class SlotDataset:
@@ -76,6 +77,10 @@ class SlotDataset:
                  else SlotRecordBatch.empty(self.schema))
         if global_shuffle and batch.num > 0:
             batch = self._global_shuffle(batch, routing)
+        # STAT_ADD counters, like data_feed's feasign stats (monitor.h:129)
+        stat_add("dataset.records_loaded", batch.num)
+        stat_add("dataset.feasigns_loaded",
+                 float(sum(len(v) for v in batch.sparse_values)))
         with self._lock:
             self.records = batch
 

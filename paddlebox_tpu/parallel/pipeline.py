@@ -54,6 +54,9 @@ def split_stages(layers: Sequence[Any],
         return [list(layers[a:b]) for a, b in zip(cuts[:-1], cuts[1:])]
     if not num_stages or num_stages < 1:
         raise ValueError("need num_stages or cut_list")
+    if num_stages > n:
+        raise ValueError(f"num_stages={num_stages} > {n} layers — every "
+                         f"stage needs at least one layer")
     bounds = np.linspace(0, n, num_stages + 1).round().astype(int)
     return [list(layers[a:b]) for a, b in zip(bounds[:-1], bounds[1:])]
 

@@ -33,6 +33,7 @@ from paddlebox_tpu.embedding import (EmbeddingConfig, HostEmbeddingStore,
 from paddlebox_tpu.metrics import auc as auc_lib
 from paddlebox_tpu.parallel import dense_sync
 from paddlebox_tpu.parallel import mesh as mesh_lib
+from paddlebox_tpu.utils.profiler import RecordEvent, DumpStream, dump_tree
 from paddlebox_tpu.utils.timer import StageTimers
 
 
@@ -45,6 +46,8 @@ class TrainerConfig:
     auc_buckets: int = 1 << 16
     label_slot: str = "label"
     check_nan_inf: bool = False            # FLAGS_check_nan_inf
+    nan_dump_dir: str | None = None        # dump-all-scope dir on nan trip
+    dump_fields_path: str | None = None    # DumpField per-instance stream
     scale_sparse_grad_by_global_mean: bool = True
     join_phase: bool = True                # use_cvm on (join) vs off (update)
     # Dense sync (BoxPSWorkerParameter.sync_mode, trainer_desc.proto:100-108)
@@ -400,10 +403,19 @@ class Trainer:
         repl = mesh_lib.replicated_sharding(self.mesh)
         pass_step = 0
         dev_losses: list[Any] = []
+        # DumpField stream: the PREVIOUS batch's (step, preds, labels) is
+        # written each iteration — by then those arrays are ready, so the
+        # D2H copy doesn't stall the freshly-dispatched step — and the
+        # writer thread does the file IO (dump threads,
+        # boxps_trainer.cc:96-108)
+        dump_stream = (DumpStream(cfg.dump_fields_path, mode="a")
+                       if cfg.dump_fields_path else None)
+        dump_pending: tuple[int, Any, Any] | None = None
         try:
             for pb in dataset.batches(cfg.global_batch_size, drop_last=True):
-                idx, mask, dense, labels = self._put_batch(ws, pb)
-                with self.timers("train"):
+                with RecordEvent("pack_batch"):
+                    idx, mask, dense, labels = self._put_batch(ws, pb)
+                with self.timers("train"), RecordEvent("train_step"):
                     if mode == "async":
                         params = jax.device_put(
                             self._unravel(self.dense_table.pull()), repl)
@@ -419,14 +431,28 @@ class Trainer:
                                 and pass_step % cfg.param_sync_step == 0):
                             params, opt_state = self._sync_fn(params,
                                                               opt_state)
-                with self.timers("auc"):
+                with self.timers("auc"), RecordEvent("auc_update"):
                     auc_acc.update(self._auc_fn, preds, labels)
                     if metrics is not None:
                         metrics.add_batch(preds, labels, cmatch=pb.cmatch,
                                           rank=pb.rank)
+                if dump_stream is not None:
+                    if dump_pending is not None:
+                        s, p, y = dump_pending
+                        dump_stream.write_fields(s, np.asarray(p),
+                                                 np.asarray(y))
+                    dump_pending = (self.global_step, preds, labels)
                 if cfg.check_nan_inf:
                     lv = float(loss)
                     if not np.isfinite(lv):
+                        # dump-all-scope before raising (nan_inf_utils trip
+                        # handler, boxps_worker.cc:575-580)
+                        if cfg.nan_dump_dir:
+                            dump_tree(
+                                f"{cfg.nan_dump_dir}/nan_step"
+                                f"{self.global_step}",
+                                {"params": params, "loss": loss,
+                                 "preds": preds, "labels": labels})
                         raise FloatingPointError(
                             f"nan/inf loss at step {self.global_step}")
                 dev_losses.append(loss)
@@ -447,6 +473,13 @@ class Trainer:
                 if mode == "kstep":  # end-of-pass sync (trainer Finalize)
                     params, opt_state = self._sync_fn(params, opt_state)
                 self.params, self.opt_state = params, opt_state
+            if dump_stream is not None:
+                # flush the tail batch even when the pass raised — a nan
+                # trip must keep the debug stream it exists for
+                if dump_pending is not None:
+                    s, p, y = dump_pending
+                    dump_stream.write_fields(s, np.asarray(p), np.asarray(y))
+                dump_stream.close()
         ws.end_pass(self.store, table)
         losses = [float(l) for l in dev_losses]  # one sync, post-loop
         out = auc_acc.compute()

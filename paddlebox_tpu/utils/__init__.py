@@ -1,0 +1,7 @@
+from paddlebox_tpu.utils.profiler import (RecordEvent, STATS,  # noqa: F401
+                                          DumpStream, StatRegistry,
+                                          disable_profiler, dump_tree,
+                                          enable_profiler,
+                                          export_chrome_trace,
+                                          find_nonfinite, stat_add, stat_get)
+from paddlebox_tpu.utils.timer import StageTimers  # noqa: F401

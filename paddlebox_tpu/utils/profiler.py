@@ -1,0 +1,266 @@
+"""Tracing, counters, nan guards, and debug dump streams.
+
+The reference's observability stack (SURVEY.md §5):
+
+- ``RecordEvent`` RAII spans + chrome-trace timelines — platform/profiler.{h,cc}
+  (RecordEvent, profiler.cc:303) and device_tracer.cc:815 (CUPTI → chrome
+  trace). Here: :class:`RecordEvent` spans collected by a process-global
+  profiler, exported with :func:`export_chrome_trace`; device-side traces
+  delegate to ``jax.profiler`` (:func:`start_device_trace`), whose TensorBoard
+  dumps play the CUPTI role on TPU.
+- global stat counters — platform/monitor.h ``StatRegistry``/``STAT_ADD``
+  (monitor.h:76,129; data_feed uses them for feasign counts). Here:
+  :class:`StatRegistry` + module-level :func:`stat_add`/:func:`stat_get`.
+- nan/inf safety net — ``FLAGS_check_nan_inf`` + details/nan_inf_utils
+  (CheckBatchNanOrInfRet dumps the whole scope on trip,
+  boxps_worker.cc:575-580). Here: :func:`find_nonfinite` walks a pytree and
+  :func:`dump_tree` snapshots it to an .npz next to the raised error.
+- per-batch field/param dump threads — DumpField/DumpParam
+  (device_worker.cc; dump channel + threads boxps_trainer.cc:96-108, proto
+  knobs trainer_desc.proto:39-45). Here: :class:`DumpStream`, a
+  background-thread line writer the trainer feeds per batch.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import queue
+import threading
+import time
+from typing import Any, Iterable
+
+import numpy as np
+
+# ---------------------------------------------------------------------------
+# RecordEvent spans + chrome trace
+# ---------------------------------------------------------------------------
+
+_events: list[dict] = []
+_events_lock = threading.Lock()
+_enabled = False
+_t0 = time.perf_counter()
+
+
+def enable_profiler() -> None:
+    """Start collecting RecordEvent spans (profiler.cc EnableProfiler)."""
+    global _enabled, _t0
+    with _events_lock:
+        _events.clear()
+        _t0 = time.perf_counter()
+    _enabled = True
+
+
+def disable_profiler() -> None:
+    global _enabled
+    _enabled = False
+
+
+def profiler_events() -> list[dict]:
+    with _events_lock:
+        return list(_events)
+
+
+class RecordEvent:
+    """Named span: context manager or decorator.
+
+    ``with RecordEvent("translate"): ...`` records a complete-event when the
+    profiler is enabled; negligible cost when disabled.
+    """
+
+    def __init__(self, name: str):
+        self.name = name
+        self._start: float | None = None
+
+    def __enter__(self):
+        # latch enabled-ness here: if the profiler flips on mid-span the
+        # half-open span is skipped rather than emitted with a garbage start
+        self._start = time.perf_counter() if _enabled else None
+        return self
+
+    def __exit__(self, *exc):
+        if _enabled and self._start is not None:
+            end = time.perf_counter()
+            ev = {
+                "name": self.name,
+                "ph": "X",
+                "ts": (self._start - _t0) * 1e6,   # chrome trace is in µs
+                "dur": (end - self._start) * 1e6,
+                "pid": os.getpid(),
+                "tid": threading.get_ident() & 0xFFFFFFFF,
+            }
+            with _events_lock:
+                _events.append(ev)
+        return False
+
+    def __call__(self, fn):
+        def wrapped(*a, **kw):
+            with RecordEvent(self.name):
+                return fn(*a, **kw)
+        wrapped.__name__ = getattr(fn, "__name__", self.name)
+        return wrapped
+
+
+def export_chrome_trace(path: str) -> int:
+    """Write collected spans as a chrome://tracing / Perfetto JSON file.
+
+    Returns the number of events written (the profiler.proto → chrome-trace
+    path of device_tracer.cc:815, host spans only)."""
+    evs = profiler_events()
+    os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)
+    with open(path, "w") as f:
+        json.dump({"traceEvents": evs, "displayTimeUnit": "ms"}, f)
+    return len(evs)
+
+
+def start_device_trace(logdir: str) -> None:
+    """Begin a device-level trace via jax.profiler (CUPTI's role on TPU —
+    the dump is read with TensorBoard or xprof)."""
+    import jax
+    jax.profiler.start_trace(logdir)
+
+
+def stop_device_trace() -> None:
+    import jax
+    jax.profiler.stop_trace()
+
+
+# ---------------------------------------------------------------------------
+# StatRegistry (platform/monitor.h)
+# ---------------------------------------------------------------------------
+
+class StatRegistry:
+    """Thread-safe named counters (monitor.h:76 StatRegistry singleton)."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._stats: dict[str, float] = {}
+
+    def add(self, name: str, value: float = 1.0) -> None:
+        with self._lock:
+            self._stats[name] = self._stats.get(name, 0.0) + value
+
+    def set(self, name: str, value: float) -> None:
+        with self._lock:
+            self._stats[name] = value
+
+    def get(self, name: str) -> float:
+        with self._lock:
+            return self._stats.get(name, 0.0)
+
+    def snapshot(self) -> dict[str, float]:
+        with self._lock:
+            return dict(self._stats)
+
+    def reset(self) -> None:
+        with self._lock:
+            self._stats.clear()
+
+    def report(self) -> str:
+        snap = self.snapshot()
+        return " ".join(f"{k}={snap[k]:g}" for k in sorted(snap))
+
+
+STATS = StatRegistry()            # process-global, like the reference
+
+
+def stat_add(name: str, value: float = 1.0) -> None:  # STAT_ADD(name, v)
+    STATS.add(name, value)
+
+
+def stat_get(name: str) -> float:
+    return STATS.get(name)
+
+
+# ---------------------------------------------------------------------------
+# nan/inf guard (details/nan_inf_utils)
+# ---------------------------------------------------------------------------
+
+def find_nonfinite(tree: Any) -> list[str]:
+    """Paths of pytree leaves containing nan/inf (empty list = all finite)."""
+    import jax
+    bad = []
+    for path, leaf in jax.tree_util.tree_flatten_with_path(tree)[0]:
+        arr = np.asarray(leaf)
+        if np.issubdtype(arr.dtype, np.floating) and not np.isfinite(arr).all():
+            bad.append(jax.tree_util.keystr(path))
+    return bad
+
+
+def dump_tree(path: str, tree: Any) -> str:
+    """Snapshot a pytree to ``<path>.npz`` (the dump-all-scope behavior of
+    CheckBatchNanOrInfRet's trip handler). Returns the file written."""
+    import jax
+    flat = {}
+    for p, leaf in jax.tree_util.tree_flatten_with_path(tree)[0]:
+        flat[jax.tree_util.keystr(p)] = np.asarray(leaf)
+    out = path if path.endswith(".npz") else path + ".npz"
+    os.makedirs(os.path.dirname(os.path.abspath(out)), exist_ok=True)
+    np.savez(out, **flat)
+    return out
+
+
+# ---------------------------------------------------------------------------
+# DumpStream (DumpField/DumpParam channel + threads)
+# ---------------------------------------------------------------------------
+
+class DumpStream:
+    """Background-thread line dumper.
+
+    The trainer enqueues formatted lines per batch; a writer thread drains
+    the queue to ``path`` — same shape as the reference's dump channel +
+    dump_thread_num threads writing debug fields to (HDFS-bound) files
+    (boxps_trainer.cc:96-108). Local filesystem here; pluggable later.
+    """
+
+    def __init__(self, path: str, mode: str = "w"):
+        self.path = path
+        os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)
+        self._q: queue.Queue[str | None] = queue.Queue(maxsize=4096)
+        self._error: BaseException | None = None
+        self._f = open(path, mode)
+        self._thread = threading.Thread(target=self._drain, daemon=True)
+        self._thread.start()
+
+    def _drain(self):
+        while True:
+            line = self._q.get()
+            if line is None:
+                break
+            if self._error is None:  # after a write error: keep consuming
+                try:                 # so producers never block on a full q
+                    self._f.write(line)
+                except BaseException as e:
+                    self._error = e
+
+    def write(self, line: str) -> None:
+        if not line.endswith("\n"):
+            line += "\n"
+        self._q.put(line)
+
+    def write_fields(self, step: int, preds: Iterable[float],
+                     labels: Iterable[float],
+                     extra: dict[str, Iterable[Any]] | None = None) -> None:
+        """Per-instance dump: ``step <i> pred label [k:v ...]`` lines —
+        DumpField's instance-major text format."""
+        preds = np.asarray(preds).reshape(-1)
+        labels = np.asarray(labels).reshape(-1)
+        cols = {k: np.asarray(v).reshape(-1) for k, v in (extra or {}).items()}
+        for i in range(len(preds)):
+            tail = "".join(f" {k}:{cols[k][i]}" for k in cols)
+            self.write(f"{step} {i} {preds[i]:.6f} {labels[i]:g}{tail}")
+
+    def close(self) -> None:
+        self._q.put(None)
+        self._thread.join()
+        self._f.close()
+        if self._error is not None:  # surface a mid-stream write failure
+            raise RuntimeError(
+                f"DumpStream writer failed for {self.path}") from self._error
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+        return False

@@ -1,0 +1,131 @@
+"""Observability: RecordEvent spans, chrome trace, stats, nan guard, dumps."""
+
+import json
+import os
+import threading
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from paddlebox_tpu.utils import profiler as prof
+
+
+def test_record_event_spans_and_chrome_trace(tmp_path):
+    prof.enable_profiler()
+    try:
+        with prof.RecordEvent("outer"):
+            with prof.RecordEvent("inner"):
+                pass
+        @prof.RecordEvent("decorated")
+        def f(x):
+            return x + 1
+        assert f(1) == 2
+    finally:
+        prof.disable_profiler()
+    evs = prof.profiler_events()
+    names = [e["name"] for e in evs]
+    assert names == ["inner", "outer", "decorated"]  # inner closes first
+    assert all(e["ph"] == "X" and e["dur"] >= 0 for e in evs)
+    # nesting: outer must contain inner
+    by = {e["name"]: e for e in evs}
+    assert by["outer"]["ts"] <= by["inner"]["ts"]
+    assert (by["outer"]["ts"] + by["outer"]["dur"]
+            >= by["inner"]["ts"] + by["inner"]["dur"])
+
+    path = str(tmp_path / "trace.json")
+    n = prof.export_chrome_trace(path)
+    assert n == 3
+    with open(path) as f:
+        doc = json.load(f)
+    assert len(doc["traceEvents"]) == 3
+
+
+def test_record_event_disabled_is_free():
+    prof.disable_profiler()
+    before = len(prof.profiler_events())
+    with prof.RecordEvent("ignored"):
+        pass
+    assert len(prof.profiler_events()) == before
+
+
+def test_stat_registry_threaded():
+    reg = prof.StatRegistry()
+    def add_many():
+        for _ in range(1000):
+            reg.add("n")
+    ts = [threading.Thread(target=add_many) for _ in range(4)]
+    [t.start() for t in ts]
+    [t.join() for t in ts]
+    assert reg.get("n") == 4000
+    reg.set("x", 2.5)
+    assert "n=4000" in reg.report() and "x=2.5" in reg.report()
+    reg.reset()
+    assert reg.get("n") == 0
+
+
+def test_find_nonfinite_and_dump(tmp_path):
+    good = {"a": jnp.ones(3), "b": {"c": np.zeros(2, np.float32)}}
+    assert prof.find_nonfinite(good) == []
+    bad = {"a": jnp.ones(3), "b": {"c": np.array([1.0, np.nan])},
+           "ints": np.arange(3)}  # int leaves are skipped
+    paths = prof.find_nonfinite(bad)
+    assert len(paths) == 1 and "c" in paths[0]
+
+    out = prof.dump_tree(str(tmp_path / "scope"), bad)
+    loaded = np.load(out)
+    assert any("c" in k for k in loaded.files)
+
+
+def test_dump_stream(tmp_path):
+    path = str(tmp_path / "dump" / "fields.txt")
+    with prof.DumpStream(path) as ds:
+        ds.write("hello")
+        ds.write_fields(7, [0.25, 0.75], [0.0, 1.0], extra={"rank": [1, 2]})
+    with open(path) as f:
+        lines = f.read().splitlines()
+    assert lines[0] == "hello"
+    assert lines[1].startswith("7 0 0.250000 0") and "rank:1" in lines[1]
+    assert lines[2].startswith("7 1 0.750000 1") and "rank:2" in lines[2]
+
+
+def test_trainer_dump_and_nan_guard(tmp_path):
+    # integration: dump_fields writes one line per example; nan trip dumps
+    # the scope
+    import jax
+    from paddlebox_tpu.data import DataFeedSchema
+    from paddlebox_tpu.data.dataset import SlotDataset
+    from paddlebox_tpu.embedding import EmbeddingConfig, HostEmbeddingStore
+    from paddlebox_tpu.models import DNNCTRModel
+    from paddlebox_tpu.parallel import make_mesh
+    from paddlebox_tpu.train import Trainer, TrainerConfig
+
+    schema = DataFeedSchema.ctr(num_sparse=3, num_float=1, batch_size=8,
+                                max_len=2)
+    rng = np.random.default_rng(0)
+    ds = SlotDataset(schema)
+    lines = []
+    for i in range(16):
+        # schema order: label, dense_0, slot_0..2
+        parts = [f"1 {int(rng.random() < 0.4)}", f"1 {rng.random():.3f}"]
+        for s in range(3):
+            parts.append(f"2 {rng.integers(1, 1000)} {rng.integers(1, 1000)}")
+        lines.append(" ".join(parts))
+    f = tmp_path / "part-0"
+    f.write_text("\n".join(lines) + "\n")
+    ds.set_filelist([str(f)])
+    ds.load_into_memory(global_shuffle=False)
+
+    store = HostEmbeddingStore(EmbeddingConfig(dim=4))
+    mesh = make_mesh(1)
+    model = DNNCTRModel(num_slots=3, emb_dim=4, dense_dim=1, hidden=(8,))
+    dump_path = str(tmp_path / "fields.txt")
+    tr = Trainer(model, store, schema, mesh,
+                 TrainerConfig(global_batch_size=8,
+                               auc_buckets=1 << 8,
+                               dump_fields_path=dump_path))
+    out = tr.train_pass(ds)
+    assert out["steps"] == 2
+    with open(dump_path) as fh:
+        dumped = fh.read().splitlines()
+    assert len(dumped) == 16  # one line per trained example
