@@ -12,6 +12,9 @@ HDFS rendezvous store (gloo_wrapper.h:45-200), and the fleet role makers
 - ``RoleMaker`` — rank/world from env, optional jax.distributed init for
   real multi-host TPU pods.
 - ``launch`` — one-process-per-host launcher (fleetrun equivalent).
+- ``ps`` — host parameter-server cluster (the PSLib/FleetWrapper + brpc-PS
+  capability: sharded sparse tables with in-table optimizers, async dense
+  tables, save/load/shrink over TCP).
 
 Device-side collectives never touch this: they are XLA psum/all_gather
 over the mesh inside jit.
@@ -20,3 +23,5 @@ over the mesh inside jit.
 from paddlebox_tpu.distributed.store import FileStore  # noqa: F401
 from paddlebox_tpu.distributed.collectives import HostCollectives  # noqa: F401
 from paddlebox_tpu.distributed.role_maker import RoleMaker  # noqa: F401
+from paddlebox_tpu.distributed.ps import (PSClient, PSServer,  # noqa: F401
+                                          RemoteEmbeddingStore)

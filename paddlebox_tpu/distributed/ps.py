@@ -1,0 +1,548 @@
+"""Host parameter-server cluster (sparse + dense tables over TCP).
+
+The reference trains in two PS regimes: the closed-source GPU-resident BoxPS,
+and the CPU parameter-server path — PSLib behind ``FleetWrapper``
+(fleet_wrapper.h:66-360: PullSparseVarsSync h:111, PullDenseVarsAsync h:143,
+PushDenseVarsAsync h:156, PushSparseVarsWithLabelAsync h:200, save/load/
+shrink h:260-340) and its in-repo brpc successor (fluid/distributed/service,
+sharded common_sparse_table / dense tables). This module is the TPU
+framework's CPU-PS regime:
+
+- :class:`PSServer` — one process/thread per server; owns shards of sparse
+  tables (a :class:`~paddlebox_tpu.embedding.store.HostEmbeddingStore` each,
+  with the same in-table optimizers the device path uses) and dense tables
+  (:class:`~paddlebox_tpu.parallel.dense_sync.AsyncDenseTable` — the async
+  merge/update semantics of BoxPSAsynDenseTable).
+- :class:`PSClient` — FleetWrapper-shaped API: sparse pull/push, dense
+  pull/push (sync or fire-and-forget), save/load/shrink, stop. Keys are
+  hash-sharded across servers; dense tables are placed by name hash.
+- :class:`RemoteEmbeddingStore` — adapter with the HostEmbeddingStore pass
+  API (lookup_or_init / write_back / peek_rows), so ``PassWorkingSet`` /
+  ``Trainer`` run unchanged with the table held by a PS cluster instead of
+  the local host (the DownpourWorker arrangement, device_worker.h:268).
+
+Wire format: 8-byte length frame; payload = json header + contiguous array
+buffers (dtype/shape in the header). No pickle anywhere on the wire.
+"""
+
+from __future__ import annotations
+
+import json
+import socket
+import socketserver
+import struct
+import threading
+from typing import Any, Sequence
+
+import numpy as np
+
+from paddlebox_tpu.embedding.config import EmbeddingConfig
+from paddlebox_tpu.embedding.store import HostEmbeddingStore
+from paddlebox_tpu.parallel.dense_sync import AsyncDenseTable
+
+_MIX = np.uint64(0x9E3779B97F4A7C15)  # Fibonacci mix before modulo sharding
+
+
+# ---------------------------------------------------------------------------
+# framing
+# ---------------------------------------------------------------------------
+
+def _pack(header: dict, arrays: Sequence[np.ndarray] = ()) -> bytes:
+    header = dict(header)
+    header["arrays"] = [{"dtype": str(a.dtype), "shape": list(a.shape)}
+                        for a in arrays]
+    hb = json.dumps(header).encode()
+    parts = [struct.pack("<I", len(hb)), hb]
+    parts += [np.ascontiguousarray(a).tobytes() for a in arrays]
+    body = b"".join(parts)
+    return struct.pack("<Q", len(body)) + body
+
+
+def _unpack(body: bytes) -> tuple[dict, list[np.ndarray]]:
+    hlen = struct.unpack_from("<I", body, 0)[0]
+    header = json.loads(body[4:4 + hlen].decode())
+    arrays = []
+    off = 4 + hlen
+    for spec in header.pop("arrays", []):
+        dt = np.dtype(spec["dtype"])
+        n = int(np.prod(spec["shape"])) if spec["shape"] else 1
+        nbytes = dt.itemsize * n
+        arr = np.frombuffer(body[off:off + nbytes], dtype=dt)
+        arrays.append(arr.reshape(spec["shape"]))
+        off += nbytes
+    return header, arrays
+
+
+def _recv_exact(sock: socket.socket, n: int) -> bytes | None:
+    buf = bytearray()
+    while len(buf) < n:
+        chunk = sock.recv(min(1 << 20, n - len(buf)))
+        if not chunk:
+            return None
+        buf += chunk
+    return bytes(buf)
+
+
+def _recv_msg(sock: socket.socket) -> tuple[dict, list[np.ndarray]] | None:
+    head = _recv_exact(sock, 8)
+    if head is None:
+        return None
+    body = _recv_exact(sock, struct.unpack("<Q", head)[0])
+    if body is None:
+        return None
+    return _unpack(body)
+
+
+# ---------------------------------------------------------------------------
+# server
+# ---------------------------------------------------------------------------
+
+class _SparseTable:
+    """One server's shard of a sparse table: store + in-table optimizer."""
+
+    def __init__(self, cfg: EmbeddingConfig):
+        self.cfg = cfg
+        self.store = HostEmbeddingStore(cfg)
+        self._lock = threading.Lock()
+
+    def pull(self, keys: np.ndarray, init_missing: bool) -> np.ndarray:
+        rows = (self.store.lookup_or_init(keys) if init_missing
+                else self.store.peek_rows(keys))
+        return rows[:, :self.cfg.pull_width]
+
+    def pull_rows(self, keys: np.ndarray, init_missing: bool) -> np.ndarray:
+        return (self.store.lookup_or_init(keys) if init_missing
+                else self.store.peek_rows(keys))
+
+    def write_rows(self, keys: np.ndarray, rows: np.ndarray) -> None:
+        self.store.lookup_or_init(keys)  # ensure presence
+        self.store.write_back(keys, rows)
+
+    def push(self, keys: np.ndarray, grads: np.ndarray, shows: np.ndarray,
+             clks: np.ndarray) -> None:
+        """Merge duplicate keys, then apply the in-table optimizer — the
+        PS-side update of PushSparseGPU (box_wrapper_impl.h:229)."""
+        from paddlebox_tpu.embedding.optim import apply_updates
+        with self._lock:  # pushes serialize per table shard
+            uniq, inv = np.unique(keys, return_inverse=True)
+            gw = grads.shape[1]
+            m = np.zeros((len(uniq), gw + 2), np.float32)
+            np.add.at(m, inv, np.concatenate(
+                [grads, shows[:, None], clks[:, None]], axis=1))
+            rows = self.store.lookup_or_init(uniq)
+            new_rows = np.asarray(apply_updates(
+                rows, m[:, :gw], m[:, gw], m[:, gw + 1], self.cfg))
+            self.store.write_back(uniq, new_rows)
+
+
+class PSServer:
+    """One parameter-server endpoint (threaded TCP)."""
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 0):
+        self.sparse: dict[str, _SparseTable] = {}
+        self.dense: dict[str, AsyncDenseTable] = {}
+        self._handlers = {
+            "create_sparse": self._h_create_sparse,
+            "pull_sparse": self._h_pull_sparse,
+            "pull_rows": self._h_pull_rows,
+            "write_rows": self._h_write_rows,
+            "push_sparse": self._h_push_sparse,
+            "create_dense": self._h_create_dense,
+            "pull_dense": self._h_pull_dense,
+            "push_dense": self._h_push_dense,
+            "save": self._h_save,
+            "load": self._h_load,
+            "shrink": self._h_shrink,
+            "stats": self._h_stats,
+            "ping": lambda h, a: ({"ok": True}, []),
+        }
+        outer = self
+
+        class Handler(socketserver.BaseRequestHandler):
+            def handle(self):
+                while True:
+                    msg = _recv_msg(self.request)
+                    if msg is None:
+                        return
+                    header, arrays = msg
+                    cmd = header.get("cmd")
+                    if cmd == "stop":
+                        self.request.sendall(_pack({"ok": True}))
+                        outer._srv.shutdown()
+                        return
+                    try:
+                        rh, ra = outer._handlers[cmd](header, arrays)
+                    except Exception as e:  # error → client-side raise
+                        rh, ra = {"ok": False, "error": f"{type(e).__name__}:"
+                                  f" {e}"}, []
+                    self.request.sendall(_pack(rh, ra))
+
+        class Srv(socketserver.ThreadingTCPServer):
+            allow_reuse_address = True
+            daemon_threads = True
+
+        self._srv = Srv((host, port), Handler)
+        self.host, self.port = self._srv.server_address
+        self._thread: threading.Thread | None = None
+
+    # ---- lifecycle ----
+    def start(self) -> "PSServer":
+        self._thread = threading.Thread(target=self._srv.serve_forever,
+                                        daemon=True)
+        self._thread.start()
+        return self
+
+    def serve_forever(self) -> None:
+        self._srv.serve_forever()
+
+    def stop(self) -> None:
+        self._srv.shutdown()
+        for t in self.dense.values():
+            t.stop()
+        if self._thread:
+            self._thread.join()
+
+    # ---- handlers ----
+    def _h_create_sparse(self, h, a):
+        cfg = EmbeddingConfig(**h["cfg"])
+        self.sparse.setdefault(h["table"], _SparseTable(cfg))
+        return {"ok": True}, []
+
+    def _sparse(self, h) -> _SparseTable:
+        t = self.sparse.get(h["table"])
+        if t is None:
+            raise KeyError(f"sparse table {h['table']!r} not created")
+        return t
+
+    def _h_pull_sparse(self, h, a):
+        vals = self._sparse(h).pull(a[0], h.get("init", True))
+        return {"ok": True}, [vals]
+
+    def _h_pull_rows(self, h, a):
+        return {"ok": True}, [self._sparse(h).pull_rows(a[0],
+                                                        h.get("init", True))]
+
+    def _h_write_rows(self, h, a):
+        self._sparse(h).write_rows(a[0], a[1])
+        return {"ok": True}, []
+
+    def _h_push_sparse(self, h, a):
+        self._sparse(h).push(a[0], a[1], a[2], a[3])
+        return {"ok": True}, []
+
+    def _h_create_dense(self, h, a):
+        name = h["name"]
+        if name not in self.dense:
+            t = AsyncDenseTable(a[0], lr=h.get("lr", 1e-3),
+                                merge_limit=h.get("merge_limit", 4))
+            t.start()
+            self.dense[name] = t
+        return {"ok": True}, []
+
+    def _dense(self, h) -> AsyncDenseTable:
+        t = self.dense.get(h["name"])
+        if t is None:
+            raise KeyError(f"dense table {h['name']!r} not created")
+        return t
+
+    def _h_pull_dense(self, h, a):
+        return {"ok": True}, [self._dense(h).pull()]
+
+    def _h_push_dense(self, h, a):
+        self._dense(h).push(a[0])
+        return {"ok": True}, []
+
+    def _h_save(self, h, a):
+        t = self._sparse(h)
+        path = h["path"]
+        f = (t.store.save_delta(path) if h.get("mode") == "delta"
+             else t.store.save_base(path))
+        return {"ok": True, "file": f}, []
+
+    def _h_load(self, h, a):
+        t = self._sparse(h)
+        t.store = HostEmbeddingStore.load(h["path"], t.cfg)
+        return {"ok": True}, []
+
+    def _h_shrink(self, h, a):
+        n = self._sparse(h).store.shrink(h["min_show"], h.get("decay", 1.0))
+        return {"ok": True, "evicted": n}, []
+
+    def _h_stats(self, h, a):
+        return {"ok": True,
+                "sparse": {k: len(t.store) for k, t in self.sparse.items()},
+                "dense": sorted(self.dense)}, []
+
+
+# ---------------------------------------------------------------------------
+# client
+# ---------------------------------------------------------------------------
+
+class PSClient:
+    """FleetWrapper-shaped client over one or more PSServer endpoints."""
+
+    def __init__(self, endpoints: Sequence[tuple[str, int]]):
+        self.endpoints = list(endpoints)
+        self._socks: list[socket.socket | None] = [None] * len(self.endpoints)
+        self._locks = [threading.Lock() for _ in self.endpoints]
+        self._async_threads: list[threading.Thread] = []
+
+    @property
+    def n_servers(self) -> int:
+        return len(self.endpoints)
+
+    # ---- transport ----
+    def _sock(self, i: int) -> socket.socket:
+        if self._socks[i] is None:
+            s = socket.create_connection(self.endpoints[i], timeout=120)
+            s.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            self._socks[i] = s
+        return self._socks[i]
+
+    def _call(self, i: int, header: dict,
+              arrays: Sequence[np.ndarray] = ()) -> tuple[dict,
+                                                          list[np.ndarray]]:
+        with self._locks[i]:
+            s = self._sock(i)
+            s.sendall(_pack(header, arrays))
+            resp = _recv_msg(s)
+        if resp is None:
+            raise ConnectionError(f"server {self.endpoints[i]} closed")
+        rh, ra = resp
+        if not rh.get("ok", False):
+            raise RuntimeError(f"PS {self.endpoints[i]}: "
+                               f"{rh.get('error', 'unknown error')}")
+        return rh, ra
+
+    @staticmethod
+    def _fanout(fns) -> list[threading.Thread]:
+        """Run thunks on threads; re-raise the first worker exception."""
+        errs: list[BaseException] = []
+
+        def guard(fn):
+            def run():
+                try:
+                    fn()
+                except BaseException as e:
+                    errs.append(e)
+            return run
+        ts = [threading.Thread(target=guard(fn)) for fn in fns]
+        [t.start() for t in ts]
+        [t.join() for t in ts]
+        if errs:
+            raise errs[0]
+        return ts
+
+    def _all(self, header: dict, arrays: Sequence[np.ndarray] = ()):
+        outs = [None] * self.n_servers
+
+        def one(i):
+            outs[i] = self._call(i, header, arrays)
+        self._fanout([lambda i=i: one(i) for i in range(self.n_servers)])
+        return outs
+
+    def _owner_of(self, keys: np.ndarray) -> np.ndarray:
+        with np.errstate(over="ignore"):
+            return ((keys.astype(np.uint64) * _MIX)
+                    % np.uint64(self.n_servers)).astype(np.int64)
+
+    def _dense_owner(self, name: str) -> int:
+        return hash(name) % self.n_servers
+
+    # ---- sparse (PullSparseVarsSync / PushSparseVarsWithLabelAsync) ----
+    def create_sparse_table(self, table: str, cfg: EmbeddingConfig) -> None:
+        import dataclasses
+        self._all({"cmd": "create_sparse", "table": table,
+                   "cfg": dataclasses.asdict(cfg)})
+
+    def _scatter(self, keys: np.ndarray):
+        owner = self._owner_of(keys)
+        parts = [np.nonzero(owner == i)[0] for i in range(self.n_servers)]
+        return parts
+
+    def pull_sparse(self, table: str, keys: np.ndarray,
+                    init_missing: bool = True, rows: bool = False
+                    ) -> np.ndarray:
+        keys = np.asarray(keys, dtype=np.uint64)
+        parts = self._scatter(keys)
+        cmd = "pull_rows" if rows else "pull_sparse"
+        outs: list[np.ndarray | None] = [None] * self.n_servers
+
+        def one(i):
+            if len(parts[i]) == 0:
+                return
+            _, ra = self._call(i, {"cmd": cmd, "table": table,
+                                   "init": init_missing}, [keys[parts[i]]])
+            outs[i] = ra[0]
+        self._fanout([lambda i=i: one(i) for i in range(self.n_servers)])
+        if all(o is None for o in outs):  # only possible when keys is empty
+            return np.zeros((0, 0), np.float32)
+        width = next(o.shape[1] for o in outs if o is not None)
+        res = np.zeros((len(keys), width), np.float32)
+        for i, o in enumerate(outs):
+            if o is not None:
+                res[parts[i]] = o
+        return res
+
+    def push_sparse(self, table: str, keys: np.ndarray, grads: np.ndarray,
+                    shows: np.ndarray, clks: np.ndarray,
+                    wait: bool = True) -> None:
+        keys = np.asarray(keys, dtype=np.uint64)
+        grads = np.asarray(grads, dtype=np.float32)
+        shows = np.asarray(shows, dtype=np.float32)
+        clks = np.asarray(clks, dtype=np.float32)
+        parts = self._scatter(keys)
+
+        def one(i):
+            if len(parts[i]) == 0:
+                return
+            p = parts[i]
+            self._call(i, {"cmd": "push_sparse", "table": table},
+                       [keys[p], grads[p], shows[p], clks[p]])
+        if wait:
+            self._fanout([lambda i=i: one(i)
+                          for i in range(self.n_servers)])
+        else:  # PushSparseVarsWithLabelAsync: fire and track for flush()
+            ts = [threading.Thread(target=one, args=(i,))
+                  for i in range(self.n_servers)]
+            [t.start() for t in ts]
+            self._async_threads += ts
+
+    def write_rows(self, table: str, keys: np.ndarray,
+                   rows: np.ndarray) -> None:
+        keys = np.asarray(keys, dtype=np.uint64)
+        rows = np.asarray(rows, dtype=np.float32)
+        parts = self._scatter(keys)
+
+        def one(i):
+            if len(parts[i]):
+                self._call(i, {"cmd": "write_rows", "table": table},
+                           [keys[parts[i]], rows[parts[i]]])
+        self._fanout([lambda i=i: one(i) for i in range(self.n_servers)])
+
+    def flush(self) -> None:
+        """Barrier for async pushes (the role of FleetWrapper's
+        sparse-push wait groups)."""
+        for t in self._async_threads:
+            t.join()
+        self._async_threads.clear()
+
+    # ---- dense (PullDenseVarsAsync / PushDenseVarsAsync) ----
+    def create_dense_table(self, name: str, init: np.ndarray,
+                           lr: float = 1e-3, merge_limit: int = 4) -> None:
+        i = self._dense_owner(name)
+        self._call(i, {"cmd": "create_dense", "name": name, "lr": lr,
+                       "merge_limit": merge_limit},
+                   [np.asarray(init, np.float32)])
+
+    def pull_dense(self, name: str) -> np.ndarray:
+        _, ra = self._call(self._dense_owner(name),
+                           {"cmd": "pull_dense", "name": name})
+        return ra[0]
+
+    def push_dense(self, name: str, grad: np.ndarray) -> None:
+        self._call(self._dense_owner(name),
+                   {"cmd": "push_dense", "name": name},
+                   [np.asarray(grad, np.float32)])
+
+    # ---- persistence / hygiene ----
+    def save(self, table: str, path: str, mode: str = "base") -> list[str]:
+        outs = self._all_with_shard_path(
+            {"cmd": "save", "table": table, "mode": mode}, path)
+        return [h["file"] for h, _ in outs]
+
+    def load(self, table: str, path: str) -> None:
+        self._all_with_shard_path({"cmd": "load", "table": table}, path)
+
+    def _all_with_shard_path(self, header: dict, path: str):
+        outs = [None] * self.n_servers
+
+        def one(i):
+            h = dict(header)
+            h["path"] = f"{path}/shard-{i:03d}"
+            outs[i] = self._call(i, h)
+        self._fanout([lambda i=i: one(i) for i in range(self.n_servers)])
+        return outs
+
+    def shrink(self, table: str, min_show: float, decay: float = 1.0) -> int:
+        outs = self._all({"cmd": "shrink", "table": table,
+                          "min_show": min_show, "decay": decay})
+        return sum(h["evicted"] for h, _ in outs)
+
+    def stats(self) -> list[dict]:
+        return [h for h, _ in self._all({"cmd": "stats"})]
+
+    def stop_servers(self) -> None:
+        for i in range(self.n_servers):
+            try:
+                with self._locks[i]:
+                    s = self._sock(i)
+                    s.sendall(_pack({"cmd": "stop"}))
+                    _recv_msg(s)
+            except OSError:
+                pass
+        self.close()
+
+    def close(self) -> None:
+        for s in self._socks:
+            if s is not None:
+                try:
+                    s.close()
+                except OSError:
+                    pass
+        self._socks = [None] * self.n_servers
+
+
+# ---------------------------------------------------------------------------
+# store adapter: Trainer/PassWorkingSet on a PS cluster
+# ---------------------------------------------------------------------------
+
+class RemoteEmbeddingStore:
+    """HostEmbeddingStore pass API backed by a PS cluster.
+
+    Lets ``PassWorkingSet.begin_pass(store, ...)`` / ``end_pass`` run with
+    the table sharded across parameter servers — the DownpourWorker regime —
+    while the device-side lookup/push path stays identical.
+    """
+
+    def __init__(self, client: PSClient, table: str, cfg: EmbeddingConfig):
+        self.client = client
+        self.table = table
+        self.cfg = cfg
+        client.create_sparse_table(table, cfg)
+
+    def lookup_or_init(self, keys: np.ndarray) -> np.ndarray:
+        return self.client.pull_sparse(self.table, keys, init_missing=True,
+                                       rows=True)
+
+    def peek_rows(self, keys: np.ndarray) -> np.ndarray:
+        return self.client.pull_sparse(self.table, keys, init_missing=False,
+                                       rows=True)
+
+    def write_back(self, keys: np.ndarray, rows: np.ndarray) -> None:
+        self.client.write_rows(self.table, keys, rows)
+
+    def save_base(self, path: str) -> list[str]:
+        return self.client.save(self.table, path, mode="base")
+
+    def save_delta(self, path: str) -> list[str]:
+        return self.client.save(self.table, path, mode="delta")
+
+    def shrink(self, min_show: float, decay: float = 1.0) -> int:
+        return self.client.shrink(self.table, min_show, decay)
+
+
+def _main() -> None:  # python -m paddlebox_tpu.distributed.ps --port 9000
+    """Standalone server process (the pserver role of fleetrun)."""
+    import argparse
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--host", default="0.0.0.0")
+    ap.add_argument("--port", type=int, default=9000)
+    args = ap.parse_args()
+    srv = PSServer(args.host, args.port)
+    print(f"ps server listening on {srv.host}:{srv.port}", flush=True)
+    srv.serve_forever()
+
+
+if __name__ == "__main__":
+    _main()

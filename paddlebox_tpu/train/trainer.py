@@ -439,8 +439,7 @@ class Trainer:
                 if dump_stream is not None:
                     if dump_pending is not None:
                         s, p, y = dump_pending
-                        dump_stream.write_fields(s, np.asarray(p),
-                                                 np.asarray(y))
+                        dump_stream.write_fields(s, p, y)
                     dump_pending = (self.global_step, preds, labels)
                 if cfg.check_nan_inf:
                     lv = float(loss)
@@ -475,11 +474,16 @@ class Trainer:
                 self.params, self.opt_state = params, opt_state
             if dump_stream is not None:
                 # flush the tail batch even when the pass raised — a nan
-                # trip must keep the debug stream it exists for
-                if dump_pending is not None:
-                    s, p, y = dump_pending
-                    dump_stream.write_fields(s, np.asarray(p), np.asarray(y))
-                dump_stream.close()
+                # trip must keep the debug stream it exists for. A dump IO
+                # failure is reported but never masks the training exception.
+                try:
+                    if dump_pending is not None:
+                        s, p, y = dump_pending
+                        dump_stream.write_fields(s, p, y)
+                    dump_stream.close()
+                except Exception as e:
+                    import warnings
+                    warnings.warn(f"dump stream failed: {e}")
         ws.end_pass(self.store, table)
         losses = [float(l) for l in dev_losses]  # one sync, post-loop
         out = auc_acc.compute()

@@ -79,7 +79,9 @@ class RecordEvent:
         return self
 
     def __exit__(self, *exc):
-        if _enabled and self._start is not None:
+        # the _start >= _t0 guard drops spans that straddle an
+        # enable_profiler() reset — they belong to neither trace
+        if _enabled and self._start is not None and self._start >= _t0:
             end = time.perf_counter()
             ev = {
                 "name": self.name,
@@ -176,12 +178,25 @@ def stat_get(name: str) -> float:
 # nan/inf guard (details/nan_inf_utils)
 # ---------------------------------------------------------------------------
 
+def host_local(a: Any) -> np.ndarray:
+    """np.asarray that survives multi-host sharded jax arrays: falls back to
+    concatenating this host's addressable shards along axis 0 (right for
+    batch-dim sharding; each host dumps its own slice)."""
+    try:
+        return np.asarray(a)
+    except RuntimeError:
+        shards = getattr(a, "addressable_shards", None)
+        if not shards:
+            raise
+        return np.concatenate([np.asarray(s.data) for s in shards], axis=0)
+
+
 def find_nonfinite(tree: Any) -> list[str]:
     """Paths of pytree leaves containing nan/inf (empty list = all finite)."""
     import jax
     bad = []
     for path, leaf in jax.tree_util.tree_flatten_with_path(tree)[0]:
-        arr = np.asarray(leaf)
+        arr = host_local(leaf)
         if np.issubdtype(arr.dtype, np.floating) and not np.isfinite(arr).all():
             bad.append(jax.tree_util.keystr(path))
     return bad
@@ -193,7 +208,7 @@ def dump_tree(path: str, tree: Any) -> str:
     import jax
     flat = {}
     for p, leaf in jax.tree_util.tree_flatten_with_path(tree)[0]:
-        flat[jax.tree_util.keystr(p)] = np.asarray(leaf)
+        flat[jax.tree_util.keystr(p)] = host_local(leaf)
     out = path if path.endswith(".npz") else path + ".npz"
     os.makedirs(os.path.dirname(os.path.abspath(out)), exist_ok=True)
     np.savez(out, **flat)
@@ -216,7 +231,7 @@ class DumpStream:
     def __init__(self, path: str, mode: str = "w"):
         self.path = path
         os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)
-        self._q: queue.Queue[str | None] = queue.Queue(maxsize=4096)
+        self._q: queue.Queue[str | tuple | None] = queue.Queue(maxsize=4096)
         self._error: BaseException | None = None
         self._f = open(path, mode)
         self._thread = threading.Thread(target=self._drain, daemon=True)
@@ -224,14 +239,24 @@ class DumpStream:
 
     def _drain(self):
         while True:
-            line = self._q.get()
-            if line is None:
+            job = self._q.get()
+            if job is None:
                 break
-            if self._error is None:  # after a write error: keep consuming
-                try:                 # so producers never block on a full q
-                    self._f.write(line)
-                except BaseException as e:
-                    self._error = e
+            if self._error is not None:  # after a write error: keep
+                continue                 # consuming so producers never block
+            try:
+                if isinstance(job, str):
+                    self._f.write(job)
+                else:  # deferred field-formatting job (see write_fields)
+                    step, preds, labels, cols = job
+                    out = []
+                    for i in range(len(preds)):
+                        tail = "".join(f" {k}:{cols[k][i]}" for k in cols)
+                        out.append(f"{step} {i} {preds[i]:.6f} "
+                                   f"{labels[i]:g}{tail}\n")
+                    self._f.write("".join(out))
+            except BaseException as e:
+                self._error = e
 
     def write(self, line: str) -> None:
         if not line.endswith("\n"):
@@ -242,13 +267,13 @@ class DumpStream:
                      labels: Iterable[float],
                      extra: dict[str, Iterable[Any]] | None = None) -> None:
         """Per-instance dump: ``step <i> pred label [k:v ...]`` lines —
-        DumpField's instance-major text format."""
-        preds = np.asarray(preds).reshape(-1)
-        labels = np.asarray(labels).reshape(-1)
-        cols = {k: np.asarray(v).reshape(-1) for k, v in (extra or {}).items()}
-        for i in range(len(preds)):
-            tail = "".join(f" {k}:{cols[k][i]}" for k in cols)
-            self.write(f"{step} {i} {preds[i]:.6f} {labels[i]:g}{tail}")
+        DumpField's instance-major text format. Only the (cheap) host
+        conversion happens here; the per-instance string formatting runs on
+        the writer thread so the training loop isn't serialized behind it."""
+        preds = host_local(preds).reshape(-1)
+        labels = host_local(labels).reshape(-1)
+        cols = {k: host_local(v).reshape(-1) for k, v in (extra or {}).items()}
+        self._q.put((int(step), preds, labels, cols))
 
     def close(self) -> None:
         self._q.put(None)

@@ -1,0 +1,175 @@
+"""Host parameter-server cluster: sparse/dense pull-push, sharding,
+save/load/shrink, and the RemoteEmbeddingStore-backed trainer flow.
+
+Tested the reference's way (test_collective_base.py / test_dist_base.py:
+real localhost exchanges, no mocks) — servers run threaded in-proc, the
+client speaks the actual wire protocol through real sockets.
+"""
+
+import numpy as np
+import pytest
+
+from paddlebox_tpu.distributed.ps import (PSClient, PSServer,
+                                          RemoteEmbeddingStore, _pack,
+                                          _unpack)
+from paddlebox_tpu.embedding import EmbeddingConfig, HostEmbeddingStore
+
+
+@pytest.fixture
+def cluster():
+    servers = [PSServer().start() for _ in range(2)]
+    client = PSClient([(s.host, s.port) for s in servers])
+    yield client, servers
+    client.stop_servers()
+
+
+def test_pack_roundtrip():
+    h = {"cmd": "x", "n": 3}
+    arrs = [np.arange(6, dtype=np.uint64).reshape(2, 3),
+            np.ones(4, np.float32)]
+    header, out = _unpack(_pack(h, arrs)[8:])
+    assert header["cmd"] == "x" and header["n"] == 3
+    np.testing.assert_array_equal(out[0], arrs[0])
+    np.testing.assert_array_equal(out[1], arrs[1])
+
+
+def test_sparse_pull_push_matches_local_store(cluster):
+    client, _ = cluster
+    cfg = EmbeddingConfig(dim=4, optimizer="adagrad", learning_rate=0.1)
+    client.create_sparse_table("emb", cfg)
+    keys = np.array([1, 2, 3, 4, 5, 1 << 50], dtype=np.uint64)
+
+    pulled = client.pull_sparse("emb", keys)
+    assert pulled.shape == (6, cfg.pull_width)
+
+    # push some grads (with a duplicated key to exercise the merge path)
+    pkeys = np.array([1, 2, 1], dtype=np.uint64)
+    grads = np.ones((3, cfg.grad_width), np.float32) * 0.5
+    client.push_sparse("emb", pkeys, grads, np.ones(3, np.float32),
+                       np.zeros(3, np.float32))
+
+    # local twin: same config, same ops
+    local = HostEmbeddingStore(cfg)
+    local.lookup_or_init(keys)
+    from paddlebox_tpu.embedding.optim import apply_updates
+    uniq, inv = np.unique(pkeys, return_inverse=True)
+    m = np.zeros((len(uniq), cfg.grad_width + 2), np.float32)
+    np.add.at(m, inv, np.concatenate(
+        [grads, np.ones((3, 1), np.float32), np.zeros((3, 1), np.float32)],
+        axis=1))
+    rows = local.lookup_or_init(uniq)
+    local.write_back(uniq, np.asarray(apply_updates(
+        rows, m[:, :cfg.grad_width], m[:, cfg.grad_width],
+        m[:, cfg.grad_width + 1], cfg)))
+
+    got = client.pull_sparse("emb", keys)
+    want = local.get_rows(keys)[:, :cfg.pull_width]
+    np.testing.assert_allclose(got, want, rtol=1e-6, atol=1e-7)
+    # keys are sharded: both servers should own part of the table
+    stats = client.stats()
+    counts = [s["sparse"]["emb"] for s in stats]
+    assert sum(counts) == 6 and all(c > 0 for c in counts)
+
+
+def test_sparse_async_push_flush(cluster):
+    client, _ = cluster
+    cfg = EmbeddingConfig(dim=2, optimizer="sgd", learning_rate=1.0)
+    client.create_sparse_table("t", cfg)
+    keys = np.arange(1, 9, dtype=np.uint64)
+    client.pull_sparse("t", keys)
+    for _ in range(4):
+        client.push_sparse("t", keys, np.ones((8, cfg.grad_width),
+                                              np.float32),
+                           np.ones(8, np.float32), np.zeros(8, np.float32),
+                           wait=False)
+    client.flush()
+    got = client.pull_sparse("t", keys)
+    np.testing.assert_allclose(got[:, 0], 4.0)      # shows accumulated
+    np.testing.assert_allclose(got[:, 2], -4.0)     # w -= lr * sum(g)
+
+
+def test_dense_table(cluster):
+    client, _ = cluster
+    init = np.zeros(16, np.float32)
+    client.create_dense_table("mlp", init, lr=0.5)
+    client.push_dense("mlp", np.ones(16, np.float32))
+    # async apply: poll until the updater thread lands it
+    import time
+    for _ in range(100):
+        got = client.pull_dense("mlp")
+        if np.any(got != 0):
+            break
+        time.sleep(0.01)
+    assert np.all(got != 0)
+
+
+def test_save_load_shrink(cluster, tmp_path):
+    client, servers = cluster
+    cfg = EmbeddingConfig(dim=2)
+    client.create_sparse_table("emb", cfg)
+    keys = np.arange(1, 33, dtype=np.uint64)
+    client.pull_sparse("emb", keys)
+    # train half the keys so they have shows
+    half = keys[:16]
+    client.push_sparse("emb", half, np.ones((16, cfg.grad_width), np.float32),
+                       np.ones(16, np.float32), np.zeros(16, np.float32))
+    files = client.save("emb", str(tmp_path / "ck"))
+    assert len(files) == 2
+    before = client.pull_sparse("emb", keys)
+
+    # evict cold rows (show < 1): the untrained half disappears
+    evicted = client.shrink("emb", min_show=0.5)
+    assert evicted == 16
+
+    client.load("emb", str(tmp_path / "ck"))
+    after = client.pull_sparse("emb", keys)
+    np.testing.assert_allclose(after, before, rtol=1e-6)
+
+
+def test_error_propagates(cluster):
+    client, _ = cluster
+    with pytest.raises(RuntimeError, match="not created"):
+        client.pull_sparse("nope", np.array([1], dtype=np.uint64))
+
+
+def test_trainer_on_remote_store(cluster):
+    """Full training flow with the table on the PS cluster (DownpourWorker
+    arrangement): PassWorkingSet pulls rows from the servers, trains on the
+    mesh, writes rows back at end_pass."""
+    import jax
+    from paddlebox_tpu.data import DataFeedSchema
+    from paddlebox_tpu.embedding import PassWorkingSet
+    from paddlebox_tpu.models import DNNCTRModel
+    from paddlebox_tpu.parallel import make_mesh
+    from paddlebox_tpu.train import Trainer, TrainerConfig
+
+    client, _ = cluster
+    cfg = EmbeddingConfig(dim=4)
+    store = RemoteEmbeddingStore(client, "emb_t", cfg)
+    schema = DataFeedSchema.ctr(num_sparse=3, num_float=1, batch_size=16,
+                                max_len=1)
+    mesh = make_mesh(4)
+    model = DNNCTRModel(num_slots=3, emb_dim=4, dense_dim=1, hidden=(8,))
+    tr = Trainer(model, store, schema, mesh,
+                 TrainerConfig(global_batch_size=16, auc_buckets=1 << 8))
+
+    rng = np.random.default_rng(0)
+    keys = rng.choice(1 << 40, 50, replace=False).astype(np.uint64)
+    ws = PassWorkingSet.begin_pass(store, keys, mesh)
+    T = tr.layout.total_len
+    from paddlebox_tpu.parallel import mesh as mesh_lib
+    sh = mesh_lib.batch_sharding(mesh)
+    raw = rng.choice(keys, size=(16, T))
+    idx = ws.translate(raw, np.ones((16, T), bool))
+    table, params, opt = ws.table, tr.params, tr.opt_state
+    args = [jax.device_put(np.asarray(a), sh) for a in
+            (idx, np.ones((16, T), bool),
+             rng.normal(size=(16, 1)).astype(np.float32),
+             (rng.random(16) < 0.5).astype(np.float32))]
+    table, params, opt, loss, preds = tr._step_fn(table, params, opt, *args)
+    assert np.isfinite(float(loss))
+    ws.table = table
+    ws.end_pass(store, table)
+    # the trained rows landed back on the servers
+    rows = store.peek_rows(keys)
+    assert np.any(rows[:, 0] > 0)  # shows incremented on trained keys
