@@ -14,6 +14,7 @@ from __future__ import annotations
 import jax.numpy as jnp
 
 from paddlebox_tpu.embedding.config import EmbeddingConfig
+from paddlebox_tpu.ops.ftrl import ftrl_step
 
 
 def apply_updates(rows: jnp.ndarray, grads: jnp.ndarray,
@@ -73,13 +74,8 @@ def apply_updates(rows: jnp.ndarray, grads: jnp.ndarray,
         # FTRL-proximal on the scalar w (the wide/LR component — its natural
         # habitat); adagrad on embedx with the remaining two state columns.
         z, n = rows[:, 3 + d], rows[:, 4 + d]
-        new_n = n + g_w * g_w
-        sigma = (jnp.sqrt(new_n) - jnp.sqrt(n)) / cfg.ftrl_beta
-        new_z = z + g_w - sigma * w
-        l1, l2 = cfg.ftrl_l1, cfg.ftrl_l2
-        shrink = jnp.maximum(jnp.abs(new_z) - l1, 0.0)
-        new_w = -jnp.sign(new_z) * shrink / (
-            (cfg.ftrl_beta + jnp.sqrt(new_n)) / lr + l2)
+        new_w, new_z, new_n = ftrl_step(
+            g_w, z, n, w, lr, cfg.ftrl_l1, cfg.ftrl_l2, cfg.ftrl_beta)
         x_g2 = rows[:, 5 + d]
         mean_gx2 = jnp.mean(g_x * g_x, axis=1) if d else jnp.zeros_like(g_w)
         new_xg2 = x_g2 + mean_gx2

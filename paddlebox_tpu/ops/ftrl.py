@@ -1,0 +1,26 @@
+"""Shared FTRL-proximal update step.
+
+One elementwise kernel used by both the dense optimizer registry
+(train/optimizers.py) and the in-table sparse optimizer
+(embedding/optim.py), so the two paths cannot drift. Same rule as the
+reference's ``ftrl_op`` (operators/optimizers/ftrl_op.h, lr_power=-0.5):
+
+    new_n = n + g^2
+    sigma = (sqrt(new_n) - sqrt(n)) / alpha
+    new_z = z + g - sigma * w
+    new_w = -shrink(new_z, l1) / ((beta + sqrt(new_n)) / alpha + l2)
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+
+def ftrl_step(g, z, n, w, lr: float, l1: float, l2: float, beta: float):
+    """Return (new_w, new_z, new_n); all args broadcast elementwise."""
+    new_n = n + g * g
+    sigma = (jnp.sqrt(new_n) - jnp.sqrt(n)) / lr
+    new_z = z + g - sigma * w
+    shrink = jnp.maximum(jnp.abs(new_z) - l1, 0.0)
+    new_w = -jnp.sign(new_z) * shrink / ((beta + jnp.sqrt(new_n)) / lr + l2)
+    return new_w, new_z, new_n

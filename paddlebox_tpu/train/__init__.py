@@ -1,1 +1,2 @@
 from paddlebox_tpu.train.trainer import Trainer, TrainerConfig  # noqa: F401
+from paddlebox_tpu.train import optimizers  # noqa: F401

@@ -1,0 +1,81 @@
+"""Dense optimizer registry.
+
+The reference ships dense optimizers as graph ops (operators/optimizers/:
+sgd_op, momentum_op, adam_op, adagrad_op, ftrl_op, rmsprop_op) selected by
+the Python ``fluid.optimizer.*`` classes. Here each is an optax
+``GradientTransformation`` picked by name; FTRL-proximal is not in optax so
+it is implemented below with the same update rule as the reference's
+``ftrl_op`` (operators/optimizers/ftrl_op.h):
+
+    new_accum = accum + g^2
+    sigma     = (sqrt(new_accum) - sqrt(accum)) / lr_power'd lr
+    z        += g - sigma * w
+    w         = -shrink(z, l1) / ((beta + sqrt(new_accum)) / lr + l2)
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+import optax
+
+from paddlebox_tpu.ops.ftrl import ftrl_step
+
+
+class FtrlState(NamedTuple):
+    z: optax.Updates
+    n: optax.Updates
+
+
+def ftrl(learning_rate: float = 0.1, l1: float = 0.0, l2: float = 0.0,
+         beta: float = 1.0) -> optax.GradientTransformation:
+    """FTRL-proximal as an optax transform.
+
+    Unlike the additive-update optimizers, FTRL computes the new weight
+    directly from (z, n); the returned update is ``new_w - w`` so it
+    composes with ``optax.apply_updates``.
+    """
+
+    def init_fn(params):
+        zeros = lambda p: jnp.zeros_like(p)
+        return FtrlState(z=jax.tree.map(zeros, params),
+                         n=jax.tree.map(zeros, params))
+
+    def update_fn(grads, state, params):
+        if params is None:
+            raise ValueError("ftrl requires params")
+
+        def pick(i):
+            # One tree.map per output component; under jit XLA CSEs the
+            # repeated ftrl_step, and leaf-wise maps stay correct for any
+            # container structure (tuples included).
+            return jax.tree.map(
+                lambda g, z, n, w: ftrl_step(g, z, n, w, learning_rate,
+                                             l1, l2, beta)[i],
+                grads, state.z, state.n, params)
+
+        new_w, new_z, new_n = pick(0), pick(1), pick(2)
+        updates = jax.tree.map(lambda nw, w: nw - w, new_w, params)
+        return updates, FtrlState(z=new_z, n=new_n)
+
+    return optax.GradientTransformation(init_fn, update_fn)
+
+
+def make(name: str, lr: float, **kw) -> optax.GradientTransformation:
+    """Build a dense optimizer by name (fluid.optimizer.* equivalents)."""
+    if name == "adam":
+        return optax.adam(lr, **kw)
+    if name == "sgd":
+        return optax.sgd(lr, **kw)
+    if name == "momentum":
+        return optax.sgd(lr, momentum=kw.pop("momentum", 0.9), **kw)
+    if name == "adagrad":
+        return optax.adagrad(lr, **kw)
+    if name == "rmsprop":
+        return optax.rmsprop(lr, **kw)
+    if name == "ftrl":
+        return ftrl(lr, **kw)
+    raise ValueError(f"unknown dense optimizer {name!r}; expected one of "
+                     "adam|sgd|momentum|adagrad|rmsprop|ftrl")

@@ -32,6 +32,7 @@ from paddlebox_tpu.embedding import (EmbeddingConfig, HostEmbeddingStore,
                                      PassWorkingSet, sharded)
 from paddlebox_tpu.metrics import auc as auc_lib
 from paddlebox_tpu.parallel import dense_sync
+from paddlebox_tpu.train import optimizers
 from paddlebox_tpu.parallel import mesh as mesh_lib
 from paddlebox_tpu.utils.profiler import RecordEvent, DumpStream, dump_tree
 from paddlebox_tpu.utils.timer import StageTimers
@@ -40,7 +41,8 @@ from paddlebox_tpu.utils.timer import StageTimers
 @dataclasses.dataclass
 class TrainerConfig:
     dense_lr: float = 1e-3
-    dense_optimizer: str = "adam"          # adam | sgd | adagrad
+    dense_optimizer: str = "adam"  # adam|sgd|momentum|adagrad|rmsprop|ftrl
+    dense_optimizer_kwargs: dict = dataclasses.field(default_factory=dict)
     global_batch_size: int = 256
     capacity_factor: float = 2.0           # all_to_all routing slack
     auc_buckets: int = 1 << 16
@@ -75,13 +77,8 @@ def _mean_replicated_grad(gp, axes):
 
 
 def _dense_tx(cfg: TrainerConfig) -> optax.GradientTransformation:
-    if cfg.dense_optimizer == "adam":
-        return optax.adam(cfg.dense_lr)
-    if cfg.dense_optimizer == "sgd":
-        return optax.sgd(cfg.dense_lr)
-    if cfg.dense_optimizer == "adagrad":
-        return optax.adagrad(cfg.dense_lr)
-    raise ValueError(cfg.dense_optimizer)
+    return optimizers.make(cfg.dense_optimizer, cfg.dense_lr,
+                           **cfg.dense_optimizer_kwargs)
 
 
 class Trainer:

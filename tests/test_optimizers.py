@@ -1,0 +1,69 @@
+"""Dense optimizer registry tests (reference operators/optimizers/*)."""
+
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+from paddlebox_tpu.train import optimizers
+
+
+def _run(tx, steps=50, lr_target=None):
+    # minimize ||w - t||^2 on a small vector
+    w = {"w": jnp.ones((4,), jnp.float32) * 2.0}
+    t = jnp.asarray([1.0, -0.5, 0.0, 3.0], jnp.float32)
+    state = tx.init(w)
+    for _ in range(steps):
+        g = {"w": 2.0 * (w["w"] - t)}
+        upd, state = tx.update(g, state, w)
+        w = optax.apply_updates(w, upd)
+    return np.asarray(w["w"]), np.asarray(t)
+
+
+@pytest.mark.parametrize("name", ["adam", "sgd", "momentum", "adagrad",
+                                  "rmsprop", "ftrl"])
+def test_all_optimizers_descend(name):
+    lr = {"sgd": 0.1, "momentum": 0.05, "adam": 0.1, "adagrad": 0.5,
+          "rmsprop": 0.05, "ftrl": 0.5}[name]
+    w, t = _run(optimizers.make(name, lr), steps=200)
+    assert np.abs(w - t).max() < 0.15, (name, w, t)
+
+
+def test_ftrl_l1_sparsifies():
+    # strong l1 drives small-gradient coordinates exactly to zero
+    tx = optimizers.ftrl(learning_rate=0.5, l1=5.0)
+    w = {"w": jnp.zeros((2,), jnp.float32)}
+    state = tx.init(w)
+    for _ in range(20):
+        g = {"w": jnp.asarray([0.01, -4.0], jnp.float32)}
+        upd, state = tx.update(g, state, w)
+        w = optax.apply_updates(w, upd)
+    arr = np.asarray(w["w"])
+    assert arr[0] == 0.0          # tiny gradient → clipped by l1
+    assert arr[1] > 0.0           # large gradient survives shrinkage
+
+
+def test_ftrl_tuple_container_pytree():
+    # param trees with tuple containers must round-trip leaf-wise
+    tx = optimizers.ftrl(learning_rate=0.5)
+    w = {"layer": (jnp.ones((2,), jnp.float32), jnp.zeros((3,), jnp.float32))}
+    state = tx.init(w)
+    g = {"layer": (jnp.ones((2,), jnp.float32) * 0.1,
+                   jnp.ones((3,), jnp.float32) * 0.1)}
+    upd, state = tx.update(g, state, w)
+    assert upd["layer"][0].shape == (2,)
+    assert upd["layer"][1].shape == (3,)
+
+
+def test_unknown_name_raises():
+    with pytest.raises(ValueError):
+        optimizers.make("lamb", 0.1)
+
+
+def test_trainer_accepts_ftrl():
+    from paddlebox_tpu.train.trainer import TrainerConfig, _dense_tx
+    tx = _dense_tx(TrainerConfig(dense_optimizer="ftrl", dense_lr=0.1))
+    w = {"w": jnp.ones((3,), jnp.float32)}
+    st = tx.init(w)
+    upd, _ = tx.update({"w": jnp.ones((3,), jnp.float32)}, st, w)
+    assert upd["w"].shape == (3,)
