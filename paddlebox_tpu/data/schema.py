@@ -65,6 +65,17 @@ class DataFeedSchema:
     def use_slots(self) -> tuple[Slot, ...]:
         return tuple(s for s in self.slots if s.is_used)
 
+    def float_split_cols(self, label_slot: str) -> tuple[int, int, int]:
+        """(label_col, label_width, total_float_cols) over the packed float
+        columns; label_col is -1 when `label_slot` is absent (legal at
+        serving time — training callers should treat that as an error)."""
+        col, lc, lw = 0, -1, 0
+        for slot in self.float_slots:
+            if slot.name == label_slot:
+                lc, lw = col, slot.max_len
+            col += slot.max_len
+        return lc, lw, col
+
     def slot_index(self, name: str) -> int:
         for i, s in enumerate(self.slots):
             if s.name == name:

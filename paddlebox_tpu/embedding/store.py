@@ -177,6 +177,19 @@ class HostEmbeddingStore:
 
     # ---- checkpoint (SaveBase/SaveDelta/Load, box_wrapper.cc:1387-1420) ----
 
+    def export_serving(self) -> tuple[np.ndarray, np.ndarray]:
+        """Snapshot (keys, pull-values) for a serving table.
+
+        Only the inference-visible columns (show, clk, w, embedx — the pull
+        layout) are exported; optimizer state stays train-side. This is the
+        content of the reference's "xbox" serving model (SaveBase's xbox
+        plane, box_wrapper.cc:1387-1420), minus its binary container.
+        """
+        with self._lock:
+            keys = self._keys[:self._n].copy()
+            vals = self._rows[:self._n, :self.cfg.pull_width].copy()
+        return keys, vals
+
     def save_base(self, path: str) -> str:
         os.makedirs(path, exist_ok=True)
         with self._lock:

@@ -1,0 +1,6 @@
+from paddlebox_tpu.inference.serving_table import ServingTable  # noqa: F401
+from paddlebox_tpu.inference.export import (  # noqa: F401
+    save_inference_model, load_inference_model, model_config)
+from paddlebox_tpu.inference.predictor import Predictor  # noqa: F401
+from paddlebox_tpu.inference.stablehlo import (  # noqa: F401
+    export_stablehlo, load_stablehlo)

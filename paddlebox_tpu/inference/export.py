@@ -1,0 +1,124 @@
+"""Inference-model export/load.
+
+The reference's ``save_inference_model`` (python/paddle/fluid/io.py) writes a
+pruned static-graph ProgramDesc + persistables that its inference engine
+(paddle/fluid/inference) reloads in C++/Go/R clients. There is no graph
+program to prune here — the jitted apply IS the graph — so an exported model
+is a directory of plain artifacts:
+
+    model.json    model name + constructor config + schema + format version
+    dense.npz     trained dense parameters (flat pytree)
+    serving.npz   frozen embedding pull plane (ServingTable)
+
+``load_inference_model`` reconstructs the model from MODEL_REGISTRY and
+returns everything a Predictor needs. For native/out-of-Python serving, see
+stablehlo.py (the portable compiled artifact).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import inspect
+import json
+import os
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+
+from paddlebox_tpu.data.schema import DataFeedSchema, Slot, SlotType
+from paddlebox_tpu.inference.serving_table import ServingTable
+from paddlebox_tpu.models import MODEL_REGISTRY
+from paddlebox_tpu.utils import checkpoint
+
+FORMAT_VERSION = 1
+
+
+def model_config(model: Any) -> dict[str, Any]:
+    """Recover a model's constructor kwargs by introspection.
+
+    Every zoo model stores each __init__ arg under the same attribute name
+    (models/*.py); custom models must follow the same convention (or ship
+    their own export path).
+    """
+    sig = inspect.signature(type(model).__init__)
+    cfg = {}
+    for name in sig.parameters:
+        if name == "self":
+            continue
+        if not hasattr(model, name):
+            raise ValueError(
+                f"{type(model).__name__} does not store __init__ arg "
+                f"{name!r} as an attribute; cannot export its config")
+        v = getattr(model, name)
+        if name == "compute_dtype":
+            v = jnp.dtype(v).name
+        elif isinstance(v, tuple):
+            v = list(v)
+        cfg[name] = v
+    return cfg
+
+
+def _schema_json(schema: DataFeedSchema) -> dict[str, Any]:
+    return {
+        "batch_size": schema.batch_size,
+        "slots": [{"name": s.name, "type": s.type.value,
+                   "is_dense": s.is_dense, "is_used": s.is_used,
+                   "max_len": s.max_len} for s in schema.slots],
+    }
+
+
+def _schema_from_json(d: dict[str, Any]) -> DataFeedSchema:
+    slots = [Slot(s["name"], SlotType(s["type"]), s["is_dense"],
+                  s["is_used"], s["max_len"]) for s in d["slots"]]
+    return DataFeedSchema(slots, batch_size=d["batch_size"])
+
+
+def save_inference_model(path: str, model: Any, params: Any,
+                         store_or_table: Any, schema: DataFeedSchema,
+                         label_slot: str = "label") -> str:
+    """Write a self-contained serving directory; returns `path`.
+
+    `store_or_table` is a HostEmbeddingStore (frozen via export_serving) or
+    an already-built ServingTable.
+    """
+    if model.name not in MODEL_REGISTRY:
+        raise ValueError(f"model {model.name!r} not in MODEL_REGISTRY")
+    os.makedirs(path, exist_ok=True)
+    table = (store_or_table if isinstance(store_or_table, ServingTable)
+             else ServingTable.from_store(store_or_table))
+    table.save(path)
+    checkpoint.save_pytree(params, os.path.join(path, "dense.npz"))
+    meta = {
+        "format_version": FORMAT_VERSION,
+        "model": model.name,
+        "config": model_config(model),
+        "schema": _schema_json(schema),
+        "label_slot": label_slot,
+        "pull_width": table.pull_width,
+    }
+    with open(os.path.join(path, "model.json"), "w") as f:
+        json.dump(meta, f, indent=1)
+    return path
+
+
+def load_inference_model(path: str):
+    """→ (model, params, ServingTable, schema, meta)."""
+    with open(os.path.join(path, "model.json")) as f:
+        meta = json.load(f)
+    if meta["format_version"] > FORMAT_VERSION:
+        raise ValueError(f"export format {meta['format_version']} is newer "
+                         f"than this framework understands")
+    cls = MODEL_REGISTRY[meta["model"]]
+    cfg = dict(meta["config"])
+    if "compute_dtype" in cfg:
+        cfg["compute_dtype"] = jnp.dtype(cfg["compute_dtype"])
+    for k, v in cfg.items():
+        if isinstance(v, list):
+            cfg[k] = tuple(v)
+    model = cls(**cfg)
+    template = model.init(jax.random.PRNGKey(0))
+    params = checkpoint.load_pytree(template, os.path.join(path, "dense.npz"))
+    table = ServingTable.load(path)
+    schema = _schema_from_json(meta["schema"])
+    return model, params, table, schema, meta

@@ -1,0 +1,83 @@
+"""Predictor — the serving-side runner.
+
+The reference's analysis+executor inference engine (paddle/fluid/inference,
+api_impl.cc PaddlePredictor) loads a saved program and runs it per request;
+graph optimization passes do the fusing. Here loading gives back a pure
+apply function which jit compiles once per batch shape — XLA is the analysis
+pass — and the embedding half of the model is a host-side ServingTable
+lookup feeding the device step, exactly mirroring how training splits
+pull (host/PS) from the dense net (device).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from paddlebox_tpu.data.schema import DataFeedSchema
+from paddlebox_tpu.data.slot_record import PackedBatch, SparseLayout
+from paddlebox_tpu.inference.export import load_inference_model
+from paddlebox_tpu.inference.serving_table import ServingTable
+
+
+class Predictor:
+    """Batch scorer over an exported model directory."""
+
+    def __init__(self, model: Any, params: Any, table: ServingTable,
+                 schema: DataFeedSchema, label_slot: str = "label"):
+        self.model = model
+        self.params = params
+        self.table = table
+        self.schema = schema
+        self.label_slot = label_slot
+        self.layout = SparseLayout.from_schema(schema)
+        self._device_params = jax.device_put(params)
+        seg = self.layout.segment_ids
+        num_slots = self.layout.num_slots
+        multi_task = hasattr(model, "apply_tasks")
+        apply = model.apply_tasks if multi_task else model.apply
+
+        @functools.partial(jax.jit)
+        def _fwd(params, pulled, mask, dense):
+            logits = apply(params, pulled, mask, dense, seg, num_slots)
+            return jax.nn.sigmoid(logits)
+
+        self._fwd = _fwd
+
+    @classmethod
+    def load(cls, path: str) -> "Predictor":
+        model, params, table, schema, meta = load_inference_model(path)
+        return cls(model, params, table, schema,
+                   label_slot=meta.get("label_slot", "label"))
+
+    # ------------------------------------------------------------------
+    def predict(self, ids: np.ndarray, mask: np.ndarray,
+                dense: np.ndarray | None = None) -> np.ndarray:
+        """ids uint64 (B, T) raw feature signs, mask bool (B, T),
+        dense float32 (B, F) — returns probabilities (B,) (or (B, tasks)
+        for multi-task models)."""
+        ids = np.asarray(ids)
+        mask = np.asarray(mask, bool)
+        if ids.shape[1] != self.layout.total_len:
+            raise ValueError(f"ids token axis {ids.shape[1]} != schema "
+                             f"T={self.layout.total_len}")
+        pulled = self.table.lookup(ids, mask)
+        if dense is None:
+            dense = np.zeros((ids.shape[0], 0), np.float32)
+        out = self._fwd(self._device_params, jnp.asarray(pulled),
+                        jnp.asarray(mask), jnp.asarray(dense, jnp.float32))
+        return np.asarray(out)
+
+    def predict_batch(self, pb: PackedBatch) -> np.ndarray:
+        """Score a PackedBatch from the data pipeline; the label column
+        (if present in the schema) is dropped from the float features."""
+        lc, lw, _ = pb.schema.float_split_cols(self.label_slot)
+        floats = pb.floats
+        if lc >= 0:
+            floats = np.concatenate([floats[:, :lc], floats[:, lc + lw:]],
+                                    axis=1)
+        return self.predict(pb.ids.astype(np.uint64), pb.mask, floats)

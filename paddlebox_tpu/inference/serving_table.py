@@ -1,0 +1,127 @@
+"""Read-optimized serving embedding table.
+
+The reference serves sparse models from the "xbox" plane of a BoxPS
+checkpoint: a key→pull-value map shipped to serving hosts, updated online by
+delta models (SaveBase/SaveDelta, box_wrapper.cc:1387-1420; day/pass delta
+layout fleet_util.py:722-745). Here that plane is an explicit host-side
+structure: a sorted uint64 key array plus a dense (N, pull_width) float32
+value matrix, so batched lookups are one ``np.searchsorted`` + gather —
+no Python dict in the hot path. Unknown keys resolve to zeros
+(FLAGS_enable_pull_box_padding_zero semantics, flags.cc:607).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+
+import numpy as np
+
+
+class ServingTable:
+    def __init__(self, keys: np.ndarray, vals: np.ndarray):
+        keys = np.asarray(keys, dtype=np.uint64)
+        vals = np.asarray(vals, dtype=np.float32)
+        if keys.ndim != 1 or vals.ndim != 2 or len(keys) != len(vals):
+            raise ValueError("keys (N,) and vals (N, P) must align")
+        order = np.argsort(keys, kind="stable")
+        self.keys = keys[order]
+        self.vals = vals[order]
+        if len(self.keys) and (self.keys[1:] == self.keys[:-1]).any():
+            raise ValueError("duplicate keys in serving table")
+
+    # ------------------------------------------------------------------
+    @property
+    def pull_width(self) -> int:
+        return self.vals.shape[1]
+
+    def __len__(self) -> int:
+        return len(self.keys)
+
+    @classmethod
+    def from_store(cls, store) -> "ServingTable":
+        """Freeze a HostEmbeddingStore's pull plane for serving."""
+        keys, vals = store.export_serving()
+        return cls(keys, vals)
+
+    # ------------------------------------------------------------------
+    def lookup(self, ids: np.ndarray, mask: np.ndarray | None = None
+               ) -> np.ndarray:
+        """ids uint64 (...,) → pull values (..., P); misses/masked → 0."""
+        ids = np.asarray(ids, dtype=np.uint64)
+        flat = ids.reshape(-1)
+        pos = np.searchsorted(self.keys, flat)
+        pos_c = np.minimum(pos, max(len(self.keys) - 1, 0))
+        if len(self.keys):
+            hit = self.keys[pos_c] == flat
+            out = np.where(hit[:, None], self.vals[pos_c], 0.0)
+        else:
+            out = np.zeros((len(flat), self.pull_width), np.float32)
+        out = out.reshape(*ids.shape, self.pull_width)
+        if mask is not None:
+            out = out * np.asarray(mask, np.float32)[..., None]
+        return out.astype(np.float32)
+
+    # ------------------------------------------------------------------
+    def _merge(self, keys: np.ndarray, vals: np.ndarray) -> None:
+        """Upsert rows (delta-model application, newest wins)."""
+        keys = np.asarray(keys, dtype=np.uint64)
+        vals = np.asarray(vals, dtype=np.float32)[:, :self.pull_width]
+        # de-dup within the delta itself, keeping the last occurrence
+        _, last = np.unique(keys[::-1], return_index=True)
+        keep = len(keys) - 1 - last
+        keys, vals = keys[keep], vals[keep]
+        pos = np.searchsorted(self.keys, keys)
+        pos_c = np.minimum(pos, max(len(self.keys) - 1, 0))
+        exists = (self.keys[pos_c] == keys) if len(self.keys) else \
+            np.zeros(len(keys), bool)
+        if exists.any():
+            self.vals[pos_c[exists]] = vals[exists]
+        if (~exists).any():
+            all_keys = np.concatenate([self.keys, keys[~exists]])
+            all_vals = np.concatenate([self.vals, vals[~exists]])
+            order = np.argsort(all_keys, kind="stable")
+            self.keys, self.vals = all_keys[order], all_vals[order]
+
+    def _drop(self, keys: np.ndarray) -> None:
+        keys = np.asarray(keys, dtype=np.uint64)
+        if not len(keys) or not len(self.keys):
+            return
+        pos = np.searchsorted(self.keys, keys)
+        pos_c = np.minimum(pos, len(self.keys) - 1)
+        hits = pos_c[self.keys[pos_c] == keys]
+        if len(hits):
+            keep = np.ones(len(self.keys), bool)
+            keep[hits] = False
+            self.keys, self.vals = self.keys[keep], self.vals[keep]
+
+    def apply_delta_file(self, fname: str) -> None:
+        """Apply one delta-*.npz written by HostEmbeddingStore.save_delta
+        (rows arrive at full row_width; the serving table keeps only the
+        pull columns) or by ServingTable.save."""
+        z = np.load(fname)
+        self._merge(z["keys"], z["rows"])
+        if "removed" in z and len(z["removed"]):
+            self._drop(z["removed"])
+
+    def apply_delta_dir(self, path: str) -> int:
+        """Apply every delta-*.npz under `path` in sequence order."""
+        names = sorted(f for f in os.listdir(path) if f.startswith("delta-"))
+        for f in names:
+            self.apply_delta_file(os.path.join(path, f))
+        return len(names)
+
+    # ------------------------------------------------------------------
+    def save(self, path: str) -> str:
+        os.makedirs(path, exist_ok=True)
+        fname = os.path.join(path, "serving.npz")
+        np.savez_compressed(fname, keys=self.keys, rows=self.vals)
+        with open(os.path.join(path, "serving_meta.json"), "w") as f:
+            json.dump({"num_keys": int(len(self.keys)),
+                       "pull_width": int(self.pull_width)}, f)
+        return fname
+
+    @classmethod
+    def load(cls, path: str) -> "ServingTable":
+        z = np.load(os.path.join(path, "serving.npz"))
+        return cls(z["keys"], z["rows"])

@@ -1,0 +1,79 @@
+"""Portable compiled-model artifact via StableHLO.
+
+The reference reaches non-Python serving through language clients around its
+C++ inference engine (go/paddle/predictor.go, r/example). The TPU-native
+equivalent is ``jax.export``: the dense half of the model (everything after
+the embedding pull) is serialized as versioned StableHLO that any XLA
+runtime — C++, TF serving, IFRT — can load and execute without Python.
+The host half (ServingTable lookup) stays a trivial sorted-array gather that
+any language can implement against serving.npz.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import export as jax_export
+
+from paddlebox_tpu.data.schema import DataFeedSchema
+from paddlebox_tpu.data.slot_record import SparseLayout
+
+
+def export_stablehlo(path: str, model: Any, params: Any,
+                     schema: DataFeedSchema, batch_size: int,
+                     pull_width: int, num_dense: int | None = None,
+                     label_slot: str = "label") -> str:
+    """Serialize sigmoid(model.apply(params, …)) at a fixed batch size.
+
+    Params are baked into the artifact as constants (a serving snapshot,
+    like the reference's frozen inference program). Inputs:
+        pulled (B, T, P) f32, mask (B, T) bool, dense (B, F) f32
+    Returns the artifact file path.
+    """
+    layout = SparseLayout.from_schema(schema)
+    seg, num_slots = layout.segment_ids, layout.num_slots
+    if num_dense is None:
+        _, lw, total = schema.float_split_cols(label_slot)
+        num_dense = total - lw
+    multi_task = hasattr(model, "apply_tasks")
+    apply = model.apply_tasks if multi_task else model.apply
+    frozen = jax.device_put(params)
+
+    def fwd(pulled, mask, dense):
+        return jax.nn.sigmoid(apply(frozen, pulled, mask, dense,
+                                    seg, num_slots))
+
+    B, T = batch_size, layout.total_len
+    args = (
+        jax.ShapeDtypeStruct((B, T, pull_width), jnp.float32),
+        jax.ShapeDtypeStruct((B, T), jnp.bool_),
+        jax.ShapeDtypeStruct((B, num_dense), jnp.float32),
+    )
+    exported = jax_export.export(jax.jit(fwd))(*args)
+    os.makedirs(path, exist_ok=True)
+    fname = os.path.join(path, "model.stablehlo")
+    with open(fname, "wb") as f:
+        f.write(exported.serialize())
+    with open(os.path.join(path, "stablehlo_meta.json"), "w") as f:
+        json.dump({"batch_size": B, "total_len": T,
+                   "pull_width": pull_width, "num_dense": num_dense,
+                   "multi_task": multi_task}, f)
+    return fname
+
+
+def load_stablehlo(path: str):
+    """Reload the artifact → callable(pulled, mask, dense) -> probs."""
+    with open(os.path.join(path, "model.stablehlo"), "rb") as f:
+        exported = jax_export.deserialize(f.read())
+
+    def call(pulled, mask, dense):
+        return np.asarray(exported.call(
+            jnp.asarray(pulled, jnp.float32), jnp.asarray(mask, bool),
+            jnp.asarray(dense, jnp.float32)))
+
+    return call

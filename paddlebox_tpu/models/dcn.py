@@ -26,7 +26,8 @@ class DCNv2Model:
         self.emb_dim = emb_dim
         self.dense_dim = dense_dim
         self.use_cvm = use_cvm
-        self.num_cross = num_cross_layers
+        self.hidden = tuple(hidden)
+        self.num_cross_layers = num_cross_layers
         self.compute_dtype = compute_dtype
         slot_feat = (3 + emb_dim) if use_cvm else (1 + emb_dim)
         self.in_dim = num_slots * slot_feat + dense_dim
@@ -36,7 +37,7 @@ class DCNv2Model:
     def init(self, key):
         kc, kd, kh = jax.random.split(key, 3)
         cross = [dense_init(k, self.in_dim, self.in_dim)
-                 for k in jax.random.split(kc, self.num_cross)]
+                 for k in jax.random.split(kc, self.num_cross_layers)]
         return {
             "cross": cross,
             "deep": mlp_init(kd, self.deep_dims),

@@ -27,6 +27,8 @@ class DLRMModel:
         self.emb_dim = emb_dim
         self.dense_dim = dense_dim
         self.use_cvm = use_cvm
+        self.bottom_hidden = tuple(bottom_hidden)
+        self.top_hidden = tuple(top_hidden)
         self.compute_dtype = compute_dtype
         # bottom MLP maps dense floats → emb_dim so it joins the interaction
         self.bottom_dims = (max(dense_dim, 1), *bottom_hidden, emb_dim)

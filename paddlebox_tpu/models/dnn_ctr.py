@@ -26,6 +26,7 @@ class DNNCTRModel:
         self.emb_dim = emb_dim
         self.dense_dim = dense_dim
         self.use_cvm = use_cvm
+        self.hidden = tuple(hidden)
         self.compute_dtype = compute_dtype
         slot_feat = (3 + emb_dim) if use_cvm else (1 + emb_dim)
         self.in_dim = num_slots * slot_feat + dense_dim

@@ -34,6 +34,9 @@ class MMoEModel:
         self.dense_dim = dense_dim
         self.num_experts = num_experts
         self.num_tasks = num_tasks
+        self.expert_hidden = tuple(expert_hidden)
+        self.expert_out = expert_out
+        self.tower_hidden = tuple(tower_hidden)
         self.use_cvm = use_cvm
         self.compute_dtype = compute_dtype
         slot_feat = (3 + emb_dim) if use_cvm else (1 + emb_dim)

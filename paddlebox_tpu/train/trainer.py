@@ -160,12 +160,8 @@ class Trainer:
     # ------------------------------------------------------------------
     def _float_split(self) -> tuple[int, int, int]:
         """(label_col_start, label_width, total_float_width)."""
-        col = 0
-        label_col, label_w = -1, 0
-        for slot in self.schema.float_slots:
-            if slot.name == self.cfg.label_slot:
-                label_col, label_w = col, slot.max_len
-            col += slot.max_len
+        label_col, label_w, col = self.schema.float_split_cols(
+            self.cfg.label_slot)
         if label_col < 0:
             raise ValueError(f"label slot {self.cfg.label_slot!r} not found")
         return label_col, label_w, col

@@ -43,6 +43,25 @@ def test_ftrl_l1_sparsifies():
     assert arr[1] > 0.0           # large gradient survives shrinkage
 
 
+def test_ftrl_step_pinned_numerics():
+    # Pin the shared FTRL-proximal rule (ops/ftrl.py) to hand-computed
+    # values: sigma divides by lr (the standard alpha denominator, as in the
+    # reference's ftrl_op.h) — NOT by beta.
+    from paddlebox_tpu.ops.ftrl import ftrl_step
+    g, z, n, w = 2.0, 0.5, 4.0, 1.0
+    lr, l1, l2, beta = 0.5, 0.1, 0.2, 1.0
+    new_n = n + g * g                                   # 8
+    sigma = (np.sqrt(new_n) - np.sqrt(n)) / lr          # (2.828..-2)/0.5
+    new_z = z + g - sigma * w
+    shrink = max(abs(new_z) - l1, 0.0)
+    new_w = -np.sign(new_z) * shrink / ((beta + np.sqrt(new_n)) / lr + l2)
+    got = ftrl_step(jnp.float32(g), jnp.float32(z), jnp.float32(n),
+                    jnp.float32(w), lr, l1, l2, beta)
+    np.testing.assert_allclose(np.asarray(got[0]), new_w, rtol=1e-6)
+    np.testing.assert_allclose(np.asarray(got[1]), new_z, rtol=1e-6)
+    np.testing.assert_allclose(np.asarray(got[2]), new_n, rtol=1e-6)
+
+
 def test_ftrl_tuple_container_pytree():
     # param trees with tuple containers must round-trip leaf-wise
     tx = optimizers.ftrl(learning_rate=0.5)
