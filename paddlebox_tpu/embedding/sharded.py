@@ -35,6 +35,7 @@ from jax import lax
 
 from paddlebox_tpu.embedding.config import EmbeddingConfig
 from paddlebox_tpu.embedding.optim import apply_updates
+from paddlebox_tpu.ops import pallas_kernels
 
 NULL_INDEX = 0  # reserved all-zero row; padding tokens point here
 
@@ -85,13 +86,16 @@ def push(table: jnp.ndarray, idx: jnp.ndarray, grads: jnp.ndarray,
     gw = cfg.grad_width
     acc = jnp.zeros((table.shape[0], gw + 3), payload.dtype)
     acc = acc.at[idx].add(payload, mode="drop")
-    new_rows = apply_updates(table, acc[:, :gw], acc[:, gw], acc[:, gw + 1],
-                             cfg)
-    touched = acc[:, gw + 2] > 0
     # Untouched rows keep their exact bits (stateful optimizers like adam
     # would otherwise decay momentum on every row). The null row only ever
     # receives zero grads/increments (callers mask padding), and a fresh
     # zero row is a fixed point of every optimizer — it stays exactly zero.
+    if pallas_kernels.use_pallas():
+        # single fused read-modify-write pass over the table
+        return pallas_kernels.merge_update(table, acc, cfg)
+    new_rows = apply_updates(table, acc[:, :gw], acc[:, gw], acc[:, gw + 1],
+                             cfg)
+    touched = acc[:, gw + 2] > 0
     return jnp.where(touched[:, None], new_rows, table)
 
 

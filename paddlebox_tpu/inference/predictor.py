@@ -11,7 +11,6 @@ pull (host/PS) from the dense net (device).
 
 from __future__ import annotations
 
-import functools
 from typing import Any
 
 import jax
@@ -22,6 +21,20 @@ from paddlebox_tpu.data.schema import DataFeedSchema
 from paddlebox_tpu.data.slot_record import PackedBatch, SparseLayout
 from paddlebox_tpu.inference.export import load_inference_model
 from paddlebox_tpu.inference.serving_table import ServingTable
+
+
+def make_serving_fn(model: Any, segment_ids, num_slots: int):
+    """The one serving forward: sigmoid(apply(...)), multi-task aware.
+
+    Shared by Predictor and the StableHLO exporter so the Python path and
+    the portable artifact cannot diverge."""
+    apply = getattr(model, "apply_tasks", None) or model.apply
+
+    def fwd(params, pulled, mask, dense):
+        return jax.nn.sigmoid(
+            apply(params, pulled, mask, dense, segment_ids, num_slots))
+
+    return fwd
 
 
 class Predictor:
@@ -36,17 +49,8 @@ class Predictor:
         self.label_slot = label_slot
         self.layout = SparseLayout.from_schema(schema)
         self._device_params = jax.device_put(params)
-        seg = self.layout.segment_ids
-        num_slots = self.layout.num_slots
-        multi_task = hasattr(model, "apply_tasks")
-        apply = model.apply_tasks if multi_task else model.apply
-
-        @functools.partial(jax.jit)
-        def _fwd(params, pulled, mask, dense):
-            logits = apply(params, pulled, mask, dense, seg, num_slots)
-            return jax.nn.sigmoid(logits)
-
-        self._fwd = _fwd
+        self._fwd = jax.jit(make_serving_fn(
+            model, self.layout.segment_ids, self.layout.num_slots))
 
     @classmethod
     def load(cls, path: str) -> "Predictor":

@@ -45,15 +45,21 @@ class ServingTable:
         return cls(keys, vals)
 
     # ------------------------------------------------------------------
+    def _probe(self, keys: np.ndarray) -> tuple[np.ndarray, np.ndarray]:
+        """Sorted-array probe: → (clamped positions, hit mask)."""
+        pos = np.searchsorted(self.keys, keys)
+        pos_c = np.minimum(pos, max(len(self.keys) - 1, 0))
+        hit = (self.keys[pos_c] == keys) if len(self.keys) else \
+            np.zeros(len(keys), bool)
+        return pos_c, hit
+
     def lookup(self, ids: np.ndarray, mask: np.ndarray | None = None
                ) -> np.ndarray:
         """ids uint64 (...,) → pull values (..., P); misses/masked → 0."""
         ids = np.asarray(ids, dtype=np.uint64)
         flat = ids.reshape(-1)
-        pos = np.searchsorted(self.keys, flat)
-        pos_c = np.minimum(pos, max(len(self.keys) - 1, 0))
+        pos_c, hit = self._probe(flat)
         if len(self.keys):
-            hit = self.keys[pos_c] == flat
             out = np.where(hit[:, None], self.vals[pos_c], 0.0)
         else:
             out = np.zeros((len(flat), self.pull_width), np.float32)
@@ -71,10 +77,7 @@ class ServingTable:
         _, last = np.unique(keys[::-1], return_index=True)
         keep = len(keys) - 1 - last
         keys, vals = keys[keep], vals[keep]
-        pos = np.searchsorted(self.keys, keys)
-        pos_c = np.minimum(pos, max(len(self.keys) - 1, 0))
-        exists = (self.keys[pos_c] == keys) if len(self.keys) else \
-            np.zeros(len(keys), bool)
+        pos_c, exists = self._probe(keys)
         if exists.any():
             self.vals[pos_c[exists]] = vals[exists]
         if (~exists).any():
@@ -87,9 +90,8 @@ class ServingTable:
         keys = np.asarray(keys, dtype=np.uint64)
         if not len(keys) or not len(self.keys):
             return
-        pos = np.searchsorted(self.keys, keys)
-        pos_c = np.minimum(pos, len(self.keys) - 1)
-        hits = pos_c[self.keys[pos_c] == keys]
+        pos_c, hit = self._probe(keys)
+        hits = pos_c[hit]
         if len(hits):
             keep = np.ones(len(self.keys), bool)
             keep[hits] = False

@@ -22,6 +22,7 @@ from jax import export as jax_export
 
 from paddlebox_tpu.data.schema import DataFeedSchema
 from paddlebox_tpu.data.slot_record import SparseLayout
+from paddlebox_tpu.inference.predictor import make_serving_fn
 
 
 def export_stablehlo(path: str, model: Any, params: Any,
@@ -41,12 +42,11 @@ def export_stablehlo(path: str, model: Any, params: Any,
         _, lw, total = schema.float_split_cols(label_slot)
         num_dense = total - lw
     multi_task = hasattr(model, "apply_tasks")
-    apply = model.apply_tasks if multi_task else model.apply
     frozen = jax.device_put(params)
+    serve = make_serving_fn(model, seg, num_slots)
 
     def fwd(pulled, mask, dense):
-        return jax.nn.sigmoid(apply(frozen, pulled, mask, dense,
-                                    seg, num_slots))
+        return serve(frozen, pulled, mask, dense)
 
     B, T = batch_size, layout.total_len
     args = (
@@ -70,9 +70,10 @@ def load_stablehlo(path: str):
     """Reload the artifact → callable(pulled, mask, dense) -> probs."""
     with open(os.path.join(path, "model.stablehlo"), "rb") as f:
         exported = jax_export.deserialize(f.read())
+    fn = jax.jit(exported.call)  # compile once; serving calls hit the cache
 
     def call(pulled, mask, dense):
-        return np.asarray(exported.call(
+        return np.asarray(fn(
             jnp.asarray(pulled, jnp.float32), jnp.asarray(mask, bool),
             jnp.asarray(dense, jnp.float32)))
 

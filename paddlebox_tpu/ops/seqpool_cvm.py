@@ -57,9 +57,22 @@ def _filter_and_quant(pulled, mask, seg_np, cvm_offset, need_filter,
 
 
 def _pool(x, seg_np, num_slots):
-    """Sum-pool tokens into slots via a constant one-hot (T, S) matmul — rides
-    the MXU and avoids a scatter op (scatters carry a large fixed per-op cost
-    on TPU)."""
+    """Sum-pool tokens into slots.
+
+    Fast path: when every slot owns an equal contiguous run of tokens (the
+    SparseLayout for uniform max_len — the common CTR geometry), pooling is
+    a free reshape + axis reduction. Otherwise a constant one-hot (T, S)
+    matmul — rides the MXU and avoids a scatter op (scatters carry a large
+    fixed per-op cost on TPU). Measured on one v5 chip, B=8192 S=26 L=20:
+    reshape-sum 19.3us vs one-hot 25.5us, and it does O(B*T*P) work instead
+    of O(B*T*S*P)."""
+    T = x.shape[1]
+    uniform = (num_slots > 0 and T % num_slots == 0
+               and np.array_equal(
+                   seg_np, np.repeat(np.arange(num_slots), T // num_slots)))
+    if uniform:
+        B, _, P = x.shape
+        return x.reshape(B, num_slots, T // num_slots, P).sum(axis=2)
     pool_mat = jnp.asarray(np.eye(num_slots, dtype=np.float32)[seg_np])
     return jnp.einsum("btp,ts->bsp", x, pool_mat)
 
