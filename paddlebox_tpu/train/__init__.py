@@ -1,2 +1,3 @@
 from paddlebox_tpu.train.trainer import Trainer, TrainerConfig  # noqa: F401
+from paddlebox_tpu.train.heter import HeterTrainer, HeterConfig  # noqa: F401
 from paddlebox_tpu.train import optimizers  # noqa: F401

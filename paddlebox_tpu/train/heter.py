@@ -1,0 +1,197 @@
+"""Heterogeneous trainer — host-resident embedding + device dense stage.
+
+The reference's heterogeneous mode (HeterXpuTrainer trainer.h:163,
+HeterBoxWorker device_worker.h:442, heter_wrapper.h:111-112) splits the
+graph: the CPU side owns the sparse tables and the first stage of the graph,
+the accelerator runs the dense stage, and tensors travel between them over
+brpc. Its purpose: train tables far bigger than accelerator memory while
+the accelerator does the matmul-heavy dense net.
+
+TPU-native shape of the same idea, with the process boundary collapsed to a
+host↔device transfer:
+
+    host stage   : pull rows for the batch straight from the
+                   HostEmbeddingStore (no pass working set, no HBM table) —
+                   the store IS the CPU parameter server
+    device stage : ONE jitted step — model fwd/bwd + dense optimizer, which
+                   returns the sparse grads for the batch
+    host stage   : merge per-key grads (np) and apply the in-table
+                   optimizer on CPU, write rows back
+
+The host pull of batch N+1 overlaps the device step of batch N (a one-deep
+pipeline via a prefetch thread — the reference overlaps the same two stages
+with its xpu channels). A prefetched pull can read rows up to
+``prefetch_depth`` batches stale — the same bounded-staleness contract as
+the reference's async dense table (BoxPSAsynDenseTable merges up to 4
+pending grads, boxps_worker.cc:173-225); set prefetch_depth=1 for fully
+serial reads. Dense params/optimizer state stay on device the
+whole pass; sparse state never leaves the host.
+
+Use `Trainer` (train/trainer.py) when the pass working set fits in HBM —
+it is the fast path. HeterTrainer trades per-batch H2D/D2H traffic for an
+unbounded table.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import queue
+import threading
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from paddlebox_tpu.data.schema import DataFeedSchema
+from paddlebox_tpu.data.slot_record import SparseLayout
+from paddlebox_tpu.embedding import HostEmbeddingStore
+from paddlebox_tpu.embedding.optim import apply_updates
+from paddlebox_tpu.metrics import auc as auc_lib
+from paddlebox_tpu.train import optimizers
+
+
+@dataclasses.dataclass
+class HeterConfig:
+    dense_lr: float = 1e-3
+    dense_optimizer: str = "adam"
+    global_batch_size: int = 256
+    auc_buckets: int = 1 << 16
+    label_slot: str = "label"
+    prefetch_depth: int = 2          # host-pull batches in flight
+
+
+class HeterTrainer:
+    """Host-table CPU↔TPU split trainer (HeterXpuTrainer equivalent)."""
+
+    def __init__(self, model: Any, store: HostEmbeddingStore,
+                 schema: DataFeedSchema, config: HeterConfig | None = None,
+                 seed: int = 0):
+        self.model = model
+        self.store = store
+        self.schema = schema
+        self.cfg = config or HeterConfig()
+        self.layout = SparseLayout.from_schema(schema)
+        self.params = model.init(jax.random.PRNGKey(seed))
+        self.tx = optimizers.make(self.cfg.dense_optimizer, self.cfg.dense_lr)
+        self.opt_state = self.tx.init(self.params)
+        lc, _, _ = schema.float_split_cols(self.cfg.label_slot)
+        if lc < 0:
+            raise ValueError(f"label slot {self.cfg.label_slot!r} not found")
+        self._cpu = jax.devices("cpu")[0]
+        self._step = self._build_device_step()
+        # host-side sparse optimizer, pinned to CPU (the "PS side" compute)
+        emb_cfg = store.cfg
+
+        def host_apply(rows, grads, shows, clks):
+            return apply_updates(rows, grads, shows, clks, emb_cfg)
+
+        with jax.default_device(self._cpu):
+            self._host_apply = jax.jit(host_apply)
+        self.global_step = 0
+
+    # ------------------------------------------------------------------
+    def _build_device_step(self):
+        model = self.model
+        seg = self.layout.segment_ids
+        num_slots = self.layout.num_slots
+        tx = self.tx
+
+        def step(params, opt_state, pulled, mask, dense, labels):
+            def loss_fn(p, pulled_in):
+                logits = model.apply(p, pulled_in, mask, dense, seg,
+                                     num_slots)
+                loss = jnp.mean(
+                    optax.sigmoid_binary_cross_entropy(logits, labels))
+                return loss, jax.nn.sigmoid(logits)
+
+            (loss, preds), (gp, gpull) = jax.value_and_grad(
+                loss_fn, argnums=(0, 1), has_aux=True)(params, pulled)
+            updates, new_opt = tx.update(gp, opt_state, params)
+            new_params = optax.apply_updates(params, updates)
+            # only (w, embedx) columns train; show/clk are counters
+            sgrad = gpull[..., 2:]
+            return new_params, new_opt, loss, preds, sgrad
+
+        return jax.jit(step, donate_argnums=(0, 1))
+
+    # ------------------------------------------------------------------
+    def _host_pull(self, pb):
+        """CPU stage 1: raw ids → pull values from the host store."""
+        ids = pb.ids.reshape(-1).astype(np.uint64)
+        mask = pb.mask.reshape(-1)
+        # one store round-trip for the batch's masked tokens
+        uniq, inverse = np.unique(ids[mask], return_inverse=True)
+        rows = self.store.lookup_or_init(uniq)
+        P = self.store.cfg.pull_width
+        B, T = pb.mask.shape
+        pulled = np.zeros((B * T, P), np.float32)
+        pulled[mask] = rows[inverse, :P]
+        labels, dense = _split(pb, self.cfg.label_slot)
+        return (uniq, inverse, pulled.reshape(B, T, P), pb.mask, dense,
+                labels)
+
+    def _host_push(self, uniq, inverse, mask, labels, sgrad):
+        """CPU stage 3: merge per-key grads, run the in-table optimizer."""
+        gw = self.store.cfg.grad_width
+        sg = np.asarray(sgrad).reshape(-1, gw)[mask.reshape(-1)]
+        merged = np.zeros((len(uniq), gw), np.float32)
+        np.add.at(merged, inverse, sg)
+        shows = np.bincount(inverse, minlength=len(uniq)).astype(np.float32)
+        clk_tok = np.repeat(labels, mask.shape[1])[mask.reshape(-1)]
+        clks = np.bincount(inverse, weights=clk_tok,
+                           minlength=len(uniq)).astype(np.float32)
+        rows = self.store.get_rows(uniq)
+        with jax.default_device(self._cpu):
+            new_rows = np.asarray(self._host_apply(rows, merged, shows, clks))
+        self.store.write_back(uniq, new_rows)
+
+    # ------------------------------------------------------------------
+    def train_pass(self, dataset) -> dict[str, float]:
+        cfg = self.cfg
+        auc_acc = auc_lib.AucAccumulator(cfg.auc_buckets)
+        with jax.default_device(self._cpu):
+            auc_fn = jax.jit(auc_lib.auc_update)
+        losses: list[float] = []
+
+        q: queue.Queue = queue.Queue(maxsize=cfg.prefetch_depth)
+        stop = object()
+
+        def producer():
+            try:
+                for pb in dataset.batches(cfg.global_batch_size,
+                                          drop_last=True):
+                    q.put(self._host_pull(pb))
+            finally:
+                q.put(stop)
+
+        t = threading.Thread(target=producer, daemon=True)
+        t.start()
+        while True:
+            item = q.get()
+            if item is stop:
+                break
+            uniq, inverse, pulled, mask, dense, labels = item
+            self.params, self.opt_state, loss, preds, sgrad = self._step(
+                self.params, self.opt_state, jnp.asarray(pulled),
+                jnp.asarray(mask), jnp.asarray(dense), jnp.asarray(labels))
+            self._host_push(uniq, inverse, mask, labels, np.asarray(sgrad))
+            with jax.default_device(self._cpu):
+                auc_acc.update(auc_fn, np.asarray(preds), labels)
+            losses.append(float(loss))
+            self.global_step += 1
+        t.join()
+        out = auc_acc.compute()
+        out["loss_mean"] = float(np.mean(losses)) if losses else 0.0
+        out["loss_first"] = losses[0] if losses else 0.0
+        out["steps"] = len(losses)
+        return out
+
+
+def _split(pb, label_slot: str):
+    lc, lw, _ = pb.schema.float_split_cols(label_slot)
+    labels = pb.floats[:, lc:lc + lw].reshape(-1)
+    dense = np.concatenate([pb.floats[:, :lc], pb.floats[:, lc + lw:]],
+                           axis=1)
+    return labels, dense
