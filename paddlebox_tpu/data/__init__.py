@@ -3,5 +3,6 @@ from paddlebox_tpu.data.slot_record import (SlotRecordBatch, PackedBatch,  # noq
                                             SparseLayout)
 from paddlebox_tpu.data.parser import parse_multislot_lines  # noqa: F401
 from paddlebox_tpu.data.dataset import SlotDataset  # noqa: F401
+from paddlebox_tpu.data.queue_dataset import QueueDataset  # noqa: F401
 from paddlebox_tpu.data.archive import (write_archive, read_archive,  # noqa: F401
                                         archive_filelist)

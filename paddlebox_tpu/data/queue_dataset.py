@@ -1,0 +1,129 @@
+"""Streaming dataset — the QueueDataset equivalent.
+
+The reference has two dataset modes (data_set.h:175-346,
+python/paddle/fluid/dataset.py): ``InMemoryDataset`` (load the pass, shuffle,
+train — our SlotDataset) and ``QueueDataset``, which streams files through
+bounded channels straight to the trainers: single epoch, no global shuffle,
+memory bounded by channel capacity rather than pass size.
+
+Here reader threads parse files into columnar chunks feeding a bounded
+queue; the consumer restitches chunks into fixed-size ``PackedBatch``es.
+Memory high-water = ``queue_capacity`` chunks + one batch remainder,
+independent of pass size.
+
+For training with the HBM working-set path a pass's unique keys must be
+known up front, which streaming cannot provide — so QueueDataset pairs with
+``HeterTrainer`` (host-resident table, no pass working set) or with a
+replicated/cached table. This mirrors the reference, where QueueDataset is
+the PSLib/CPU-trainer mode while BoxPS uses the in-memory pass dataset
+(SURVEY.md §2.2).
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+from typing import Iterator, Sequence
+
+import numpy as np
+
+from paddlebox_tpu.data.reader import ParserPlugin, read_file
+from paddlebox_tpu.data.schema import DataFeedSchema
+from paddlebox_tpu.data.slot_record import PackedBatch, SlotRecordBatch
+from paddlebox_tpu.utils.profiler import stat_add
+
+_STOP = object()
+
+
+class QueueDataset:
+    """Bounded-memory streaming over a filelist."""
+
+    def __init__(self, schema: DataFeedSchema, num_threads: int = 2,
+                 queue_capacity: int = 8):
+        self.schema = schema
+        self.filelist: list[str] = []
+        self.pipe_command: str | None = None
+        self.parser_plugin: ParserPlugin | None = None
+        self.num_threads = max(1, num_threads)
+        self.queue_capacity = queue_capacity
+
+    # ---- configuration (dataset.py QueueDataset API) ----
+    def set_filelist(self, files: Sequence[str]) -> None:
+        self.filelist = list(files)
+
+    def set_pipe_command(self, cmd: str | None) -> None:
+        self.pipe_command = cmd
+
+    def set_parser_plugin(self, plugin: ParserPlugin | None) -> None:
+        self.parser_plugin = plugin
+
+    # ---- streaming ----
+    def _chunks(self, files: Sequence[str]) -> Iterator[SlotRecordBatch]:
+        """Parse `files` with a reader-thread pool; yield columnar chunks in
+        completion order (the reference's channel semantics — order across
+        files is not guaranteed)."""
+        q: queue.Queue = queue.Queue(maxsize=self.queue_capacity)
+        it = iter(files)
+        it_lock = threading.Lock()
+        errors: list[BaseException] = []
+
+        def worker():
+            try:
+                while True:
+                    with it_lock:
+                        path = next(it, None)
+                    if path is None:
+                        break
+                    chunk = read_file(path, self.schema,
+                                      pipe_command=self.pipe_command,
+                                      parser_plugin=self.parser_plugin)
+                    stat_add("queue_dataset_examples", chunk.num)
+                    q.put(chunk)
+            except BaseException as e:  # surfaced to the consumer
+                errors.append(e)
+            finally:
+                q.put(_STOP)
+
+        n = min(self.num_threads, max(1, len(files)))
+        for _ in range(n):
+            threading.Thread(target=worker, daemon=True).start()
+        done = 0
+        while done < n:
+            item = q.get()
+            if item is _STOP:
+                done += 1
+                continue
+            yield item
+        if errors:
+            raise errors[0]
+
+    def batches(self, batch_size: int | None = None,
+                drop_last: bool = True,
+                files: Sequence[str] | None = None
+                ) -> Iterator[PackedBatch]:
+        """Stream fixed-size PackedBatches; chunk remainders are stitched
+        across file boundaries."""
+        bs = batch_size or self.schema.batch_size
+        pending: list[SlotRecordBatch] = []
+        have = 0
+        for chunk in self._chunks(self.filelist if files is None else files):
+            pending.append(chunk)
+            have += chunk.num
+            while have >= bs:
+                merged = SlotRecordBatch.concat(pending)
+                yield merged.pack(0, bs)
+                rest = merged.select(np.arange(bs, merged.num))
+                pending = [rest] if rest.num else []
+                have = rest.num
+        if have and not drop_last:
+            merged = SlotRecordBatch.concat(pending)
+            yield merged.pack(0, merged.num)
+
+    def shard_batches(self, shard: int, num_shards: int,
+                      batch_size: int | None = None,
+                      drop_last: bool = True) -> Iterator[PackedBatch]:
+        """File-level sharding for multi-worker streaming (the reference
+        assigns whole files round-robin to its readers)."""
+        files = [f for i, f in enumerate(self.filelist)
+                 if i % num_shards == shard]
+        return self.batches(batch_size, drop_last, files=files)

@@ -40,7 +40,11 @@ def use_pallas() -> bool:
     """Default ON for TPU (measured end-to-end win, see module docstring;
     bench: 67.2M vs 58.0M examples/s/chip on DeepFM), OFF elsewhere (the
     CPU interpreter exists for tests, not speed). PBTPU_PALLAS=0/1
-    overrides."""
+    overrides.
+
+    Read at TRACE time: set it before the first train step compiles.
+    Flipping it later does nothing — jitted steps (donated, fed back) never
+    retrace, so the already-compiled path keeps running."""
     v = os.environ.get("PBTPU_PALLAS")
     if v is not None:
         return v == "1"
@@ -76,6 +80,18 @@ def merge_update(table: jnp.ndarray, acc: jnp.ndarray, cfg: EmbeddingConfig,
     # inside shard_map the output varies over the same mesh axes as the
     # table shard (new-style shard_map vma checking)
     vma = getattr(jax.typeof(table), "vma", frozenset())
+    if interpret and vma:
+        # The Pallas interpreter evaluates the kernel jaxpr with
+        # vma-carrying block values, and EVERY op mixing a literal
+        # (x * 2.0, x > 0, ...) trips shard_map's vma check — interpret
+        # mode fundamentally cannot run nontrivial kernels inside a
+        # check_vma shard_map (JAX 0.9.0). Use the identical jnp math on
+        # CPU test meshes; Mosaic lowering on real TPU is a custom call
+        # and does not hit this.
+        gw = cfg.grad_width
+        new_rows = apply_updates(table, acc[:, :gw], acc[:, gw],
+                                 acc[:, gw + 1], cfg)
+        return jnp.where((acc[:, gw + 2] > 0)[:, None], new_rows, table)
     return pl.pallas_call(
         functools.partial(_merge_update_kernel, cfg=cfg),
         out_shape=jax.ShapeDtypeStruct((n, w), table.dtype, vma=vma),

@@ -41,6 +41,44 @@ def test_merge_update_matches_xla_path(opt, n):
                                   np.asarray(table)[untouched])
 
 
+def test_merge_update_inside_shard_map(monkeypatch):
+    """routed_push's production context: push under shard_map on a sharded
+    table (interpret mode on the CPU mesh) — exercises the vma plumbing on
+    the kernel's out_shape."""
+    import jax
+    from jax.sharding import PartitionSpec as P
+    from paddlebox_tpu.parallel import make_mesh
+
+    monkeypatch.setenv("PBTPU_PALLAS", "1")
+    cfg = EmbeddingConfig(dim=4, optimizer="adagrad", learning_rate=0.1)
+    rng = np.random.default_rng(2)
+    mesh = make_mesh(8)
+    axes = tuple(mesh.axis_names)
+    n, tokens = 64 * 8, 128           # 64 rows per shard
+    table = jnp.asarray(rng.normal(size=(n, cfg.row_width))
+                        .astype(np.float32))
+    idx = jnp.asarray(rng.integers(1, n, size=tokens * 8)
+                      .astype(np.int32))
+    grads = jnp.asarray(rng.normal(size=(tokens * 8, cfg.grad_width))
+                        .astype(np.float32))
+    ones = jnp.ones((tokens * 8,), jnp.float32)
+
+    def body(tshard, idx_l, g_l, s_l, c_l):
+        return sharded.routed_push(tshard, idx_l, g_l, s_l, c_l, cfg, axes)
+
+    fused = jax.jit(jax.shard_map(
+        body, mesh=mesh,
+        in_specs=(P(axes), P(axes), P(axes), P(axes), P(axes)),
+        out_specs=P(axes)))(table, idx, grads, ones, ones)
+    monkeypatch.setenv("PBTPU_PALLAS", "0")
+    base = jax.jit(jax.shard_map(
+        body, mesh=mesh,
+        in_specs=(P(axes), P(axes), P(axes), P(axes), P(axes)),
+        out_specs=P(axes)))(table, idx, grads, ones, ones)
+    np.testing.assert_allclose(np.asarray(fused), np.asarray(base),
+                               rtol=1e-5, atol=1e-5)
+
+
 def test_push_flag_gated(monkeypatch):
     """PBTPU_PALLAS=1 routes push through the kernel with equal results."""
     cfg = EmbeddingConfig(dim=4, optimizer="adagrad", learning_rate=0.1)
