@@ -61,15 +61,29 @@ class QueueDataset:
     def _chunks(self, files: Sequence[str]) -> Iterator[SlotRecordBatch]:
         """Parse `files` with a reader-thread pool; yield columnar chunks in
         completion order (the reference's channel semantics — order across
-        files is not guaranteed)."""
+        files is not guaranteed).
+
+        Abandoning the iterator early (break / next-once) shuts the workers
+        down via `cancel`: puts are bounded-wait so a blocked worker notices
+        cancellation instead of leaking forever against the full queue."""
         q: queue.Queue = queue.Queue(maxsize=self.queue_capacity)
         it = iter(files)
         it_lock = threading.Lock()
+        cancel = threading.Event()
         errors: list[BaseException] = []
+
+        def _put(item) -> bool:
+            while not cancel.is_set():
+                try:
+                    q.put(item, timeout=0.1)
+                    return True
+                except queue.Full:
+                    continue
+            return False
 
         def worker():
             try:
-                while True:
+                while not cancel.is_set():
                     with it_lock:
                         path = next(it, None)
                     if path is None:
@@ -78,22 +92,35 @@ class QueueDataset:
                                       pipe_command=self.pipe_command,
                                       parser_plugin=self.parser_plugin)
                     stat_add("queue_dataset_examples", chunk.num)
-                    q.put(chunk)
+                    if not _put(chunk):
+                        return
             except BaseException as e:  # surfaced to the consumer
                 errors.append(e)
             finally:
-                q.put(_STOP)
+                _put(_STOP) or q.put(_STOP)  # sentinel must always land
 
         n = min(self.num_threads, max(1, len(files)))
-        for _ in range(n):
-            threading.Thread(target=worker, daemon=True).start()
+        threads = [threading.Thread(target=worker, daemon=True)
+                   for _ in range(n)]
+        for t in threads:
+            t.start()
         done = 0
-        while done < n:
-            item = q.get()
-            if item is _STOP:
-                done += 1
-                continue
-            yield item
+        try:
+            while done < n:
+                item = q.get()
+                if item is _STOP:
+                    done += 1
+                    continue
+                yield item
+        finally:
+            cancel.set()
+            # unblock any worker stuck on a full queue, then reap
+            while done < n:
+                item = q.get()
+                if item is _STOP:
+                    done += 1
+            for t in threads:
+                t.join()
         if errors:
             raise errors[0]
 
@@ -109,12 +136,18 @@ class QueueDataset:
         for chunk in self._chunks(self.filelist if files is None else files):
             pending.append(chunk)
             have += chunk.num
-            while have >= bs:
-                merged = SlotRecordBatch.concat(pending)
-                yield merged.pack(0, bs)
-                rest = merged.select(np.arange(bs, merged.num))
-                pending = [rest] if rest.num else []
-                have = rest.num
+            if have < bs:
+                continue
+            # one concat per stitch group, then a sliding pack cursor —
+            # only the < bs tail is re-materialized via select
+            merged = SlotRecordBatch.concat(pending)
+            off = 0
+            while off + bs <= merged.num:
+                yield merged.pack(off, off + bs)
+                off += bs
+            have = merged.num - off
+            pending = ([merged.select(np.arange(off, merged.num))]
+                       if have else [])
         if have and not drop_last:
             merged = SlotRecordBatch.concat(pending)
             yield merged.pack(0, merged.num)

@@ -6,8 +6,9 @@ and of ``SaveBase``/``SaveDelta``/``LoadSSD2Mem``/``ShrinkTable``
 (box_wrapper.h:487-494, box_wrapper.cc:1387-1420). HBM only ever holds a
 pass's *working set* (see working_set.py); between passes rows live here.
 
-Implementation: open-addressed via a python dict key→row index over one
-growing float32 rows array. Checkpointing is numpy-native:
+Implementation: a batch KeyIndex (native C++ open-addressing map,
+native/key_index.cc, with a dict fallback) over one growing float32 rows
+array. Checkpointing is numpy-native:
 
 - ``save_base``  — full snapshot (keys + rows + config meta), the "batch
   model"; also the serving "xbox" format in the reference — here one format
@@ -29,6 +30,7 @@ import threading
 import numpy as np
 
 from paddlebox_tpu.embedding.config import EmbeddingConfig
+from paddlebox_tpu.native.key_index import KeyIndex
 
 
 class HostEmbeddingStore:
@@ -36,7 +38,7 @@ class HostEmbeddingStore:
 
     def __init__(self, cfg: EmbeddingConfig, initial_capacity: int = 1024):
         self.cfg = cfg
-        self._index: dict[int, int] = {}
+        self._index = KeyIndex(initial_capacity)
         self._keys = np.zeros(initial_capacity, dtype=np.uint64)
         self._rows = np.zeros((initial_capacity, cfg.row_width), dtype=np.float32)
         self._n = 0
@@ -79,40 +81,33 @@ class HostEmbeddingStore:
         """
         keys = np.asarray(keys).astype(np.uint64)
         with self._lock:
-            idx = np.empty(len(keys), dtype=np.int64)
-            missing: list[int] = []          # first occurrence of each new key
-            pending: dict[int, int] = {}     # new key -> provisional row index
-            for i, k in enumerate(keys.tolist()):
-                j = self._index.get(k, -1)
-                if j < 0:
-                    j = pending.get(k, -1)
-                    if j < 0:
-                        j = self._n + len(missing)
-                        pending[k] = j
-                        missing.append(i)
-                idx[i] = j
-            if missing:
-                new_keys = keys[missing]
-                self._reserve(self._n + len(missing))
-                init = self._init_rows(new_keys)
-                for off, i in enumerate(missing):
-                    j = self._n + off
-                    k_int = int(new_keys[off])
-                    self._index[k_int] = j
+            idx, added = self._index.lookup_or_insert(keys)
+            if added:
+                # new ids are sequential from the old size in
+                # first-occurrence order — append their rows in that order
+                new_mask = idx >= self._n
+                seen_order = np.argsort(idx[new_mask], kind="stable")
+                new_pos = np.flatnonzero(new_mask)[seen_order]
+                # one position per new id (duplicates share the id)
+                _, take = np.unique(idx[new_pos], return_index=True)
+                first_pos = new_pos[take]
+                new_keys = keys[first_pos]
+                self._reserve(self._n + added)
+                self._rows[self._n:self._n + added] = \
+                    self._init_rows(new_keys)
+                self._keys[self._n:self._n + added] = new_keys
+                self._n += added
+                for k_int in new_keys.tolist():
                     # a re-created key is live again — its pending tombstone
                     # must not delete it at delta-replay time
-                    self._tombstones.discard(k_int)
-                    self._keys[j] = new_keys[off]
-                self._rows[self._n:self._n + len(missing)] = init
-                self._n += len(missing)
+                    self._tombstones.discard(int(k_int))
             return self._rows[idx].copy()
 
     def write_back(self, keys: np.ndarray, rows: np.ndarray) -> None:
         """Persist updated rows after a pass (EndPass equivalent)."""
         keys = np.asarray(keys).astype(np.uint64)
         with self._lock:
-            idx = np.fromiter((self._index[int(k)] for k in keys),
-                              dtype=np.int64, count=len(keys))
+            idx = self._lookup_strict(keys)
             self._rows[idx] = rows
             self._dirty.update(int(k) for k in keys)
 
@@ -123,18 +118,26 @@ class HostEmbeddingStore:
         keys = np.asarray(keys).astype(np.uint64)
         rows = self._init_rows(keys)
         with self._lock:
-            for i, k in enumerate(keys.tolist()):
-                j = self._index.get(k, -1)
-                if j >= 0:
-                    rows[i] = self._rows[j]
+            idx = self._index.lookup(keys)
+            hit = idx >= 0
+            rows[hit] = self._rows[idx[hit]]
         return rows
 
     def get_rows(self, keys: np.ndarray) -> np.ndarray:
         keys = np.asarray(keys).astype(np.uint64)
         with self._lock:
-            idx = np.fromiter((self._index[int(k)] for k in keys),
-                              dtype=np.int64, count=len(keys))
+            idx = self._lookup_strict(keys)
             return self._rows[idx].copy()
+
+    def _lookup_strict(self, keys: np.ndarray) -> np.ndarray:
+        """Batch index lookup; every key must be present (KeyError parity
+        with the old dict path)."""
+        keys = np.asarray(keys).astype(np.uint64)
+        idx = self._index.lookup(keys)
+        if len(idx) and idx.min() < 0:
+            bad = keys[idx < 0][0]
+            raise KeyError(int(bad))
+        return idx
 
     def _reserve(self, need: int) -> None:
         cap = len(self._keys)
@@ -165,11 +168,12 @@ class HostEmbeddingStore:
                 gone = self._keys[:self._n][~keep]
                 kept_keys = self._keys[:self._n][keep]
                 kept_rows = self._rows[:self._n][keep]
-                self._index = {int(k): i for i, k in enumerate(kept_keys.tolist())}
+                self._index.rebuild(kept_keys)
                 self._n = len(kept_keys)
                 self._keys[:self._n] = kept_keys
                 self._rows[:self._n] = kept_rows
-                self._dirty.intersection_update(self._index.keys())
+                self._dirty.intersection_update(
+                    int(k) for k in kept_keys.tolist())
                 # tombstone evictions so load(base + deltas) does not
                 # resurrect them
                 self._tombstones.update(int(k) for k in gone.tolist())
@@ -208,8 +212,7 @@ class HostEmbeddingStore:
             self._save_seq += 1
             keys = np.fromiter(self._dirty, dtype=np.uint64,
                                count=len(self._dirty))
-            idx = np.fromiter((self._index[int(k)] for k in keys),
-                              dtype=np.int64, count=len(keys))
+            idx = self._lookup_strict(keys)
             fname = os.path.join(path, f"delta-{self._save_seq:05d}.npz")
             removed = np.fromiter(self._tombstones, dtype=np.uint64,
                                   count=len(self._tombstones))
@@ -257,26 +260,36 @@ class HostEmbeddingStore:
 
     def _remove(self, keys: np.ndarray) -> None:
         with self._lock:
-            gone = {int(k) for k in keys.tolist() if int(k) in self._index}
-            if not gone:
+            present = self._index.lookup(keys) >= 0
+            if not present.any():
                 return
+            gone = set(keys[present].tolist())
             keep = np.array([int(k) not in gone
                              for k in self._keys[:self._n].tolist()])
             kept_keys = self._keys[:self._n][keep]
             kept_rows = self._rows[:self._n][keep]
-            self._index = {int(k): i for i, k in enumerate(kept_keys.tolist())}
+            self._index.rebuild(kept_keys)
             self._n = len(kept_keys)
             self._keys[:self._n] = kept_keys
             self._rows[:self._n] = kept_rows
 
     def _ingest(self, keys: np.ndarray, rows: np.ndarray) -> None:
         with self._lock:
-            for k, r in zip(keys.tolist(), rows):
-                j = self._index.get(k, -1)
-                if j < 0:
-                    self._reserve(self._n + 1)
-                    j = self._n
-                    self._index[k] = j
-                    self._keys[j] = k
-                    self._n += 1
-                self._rows[j] = r
+            keys = np.asarray(keys).astype(np.uint64)
+            idx, added = self._index.lookup_or_insert(keys)
+            if added:
+                new_mask = idx >= self._n
+                new_pos = np.flatnonzero(new_mask)
+                order = np.argsort(idx[new_pos], kind="stable")
+                _, take = np.unique(idx[new_pos][order], return_index=True)
+                first_pos = new_pos[order][take]
+                self._reserve(self._n + added)
+                self._keys[self._n:self._n + added] = keys[first_pos]
+                self._n += added
+            # every ingested key is live again — clear pending tombstones
+            # so a later save_delta cannot list it as removed
+            # (mirrors lookup_or_init's discard)
+            self._tombstones.difference_update(
+                int(k) for k in keys.tolist())
+            # last occurrence wins for duplicate keys (replay order)
+            self._rows[idx] = rows

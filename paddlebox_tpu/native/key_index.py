@@ -1,0 +1,132 @@
+"""KeyIndex — batch uint64→int64 index with native backend + dict fallback.
+
+The embedding store's key→row index (the BoxPS key-agent role). The native
+backend (key_index.cc) does linear-probing batch ops; the fallback keeps
+the exact dict semantics the store always had. Both assign ids to new keys
+in first-occurrence order, so row-append order is identical whichever
+backend loads.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+
+import numpy as np
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+_LIB_PATH = os.path.join(_HERE, "libkeyindex.so")
+_lock = threading.Lock()
+_lib_cache: list = []
+
+
+def _build() -> bool:
+    if os.environ.get("PBTPU_NO_NATIVE_BUILD"):
+        return False
+    try:
+        subprocess.run(["make", "-C", _HERE, "-s", "libkeyindex.so"],
+                       check=True, capture_output=True, timeout=120)
+        return os.path.exists(_LIB_PATH)
+    except Exception:
+        return False
+
+
+def _load() -> ctypes.CDLL | None:
+    if not os.path.exists(_LIB_PATH) and not _build():
+        return None
+    lib = ctypes.CDLL(_LIB_PATH)
+    c = ctypes
+    u64p = np.ctypeslib.ndpointer(np.uint64, flags="C_CONTIGUOUS")
+    i64p = np.ctypeslib.ndpointer(np.int64, flags="C_CONTIGUOUS")
+    lib.ki_create.restype = c.c_void_p
+    lib.ki_create.argtypes = [c.c_int64]
+    lib.ki_free.restype = None
+    lib.ki_free.argtypes = [c.c_void_p]
+    lib.ki_size.restype = c.c_int64
+    lib.ki_size.argtypes = [c.c_void_p]
+    lib.ki_lookup.restype = None
+    lib.ki_lookup.argtypes = [c.c_void_p, u64p, c.c_int64, i64p]
+    lib.ki_lookup_or_insert.restype = c.c_int64
+    lib.ki_lookup_or_insert.argtypes = [c.c_void_p, u64p, c.c_int64, i64p]
+    lib.ki_rebuild.restype = None
+    lib.ki_rebuild.argtypes = [c.c_void_p, u64p, c.c_int64]
+    return lib
+
+
+def get_lib() -> ctypes.CDLL | None:
+    with _lock:
+        if not _lib_cache:
+            _lib_cache.append(_load())
+        return _lib_cache[0]
+
+
+def native_available() -> bool:
+    return get_lib() is not None
+
+
+class KeyIndex:
+    """Batch key index; picks the native backend when available.
+
+    force_python=True pins the dict fallback (used by the parity tests)."""
+
+    def __init__(self, capacity_hint: int = 1024,
+                 force_python: bool = False):
+        self._lib = None if force_python else get_lib()
+        if self._lib is not None:
+            self._h = self._lib.ki_create(int(capacity_hint))
+        else:
+            self._d: dict[int, int] = {}
+
+    def __del__(self):
+        lib = getattr(self, "_lib", None)
+        if lib is not None and getattr(self, "_h", None):
+            lib.ki_free(self._h)
+            self._h = None
+
+    def __len__(self) -> int:
+        if self._lib is not None:
+            return int(self._lib.ki_size(self._h))
+        return len(self._d)
+
+    # ------------------------------------------------------------------
+    def lookup(self, keys: np.ndarray) -> np.ndarray:
+        """→ int64 ids, -1 for absent keys."""
+        keys = np.ascontiguousarray(keys, dtype=np.uint64)
+        out = np.empty(len(keys), dtype=np.int64)
+        if self._lib is not None:
+            self._lib.ki_lookup(self._h, keys, len(keys), out)
+        else:
+            d = self._d
+            for i, k in enumerate(keys.tolist()):
+                out[i] = d.get(k, -1)
+        return out
+
+    def lookup_or_insert(self, keys: np.ndarray) -> tuple[np.ndarray, int]:
+        """→ (int64 ids, n_new); new keys get sequential ids from len(self)
+        in first-occurrence order."""
+        keys = np.ascontiguousarray(keys, dtype=np.uint64)
+        out = np.empty(len(keys), dtype=np.int64)
+        if self._lib is not None:
+            added = int(self._lib.ki_lookup_or_insert(
+                self._h, keys, len(keys), out))
+            return out, added
+        d = self._d
+        added = 0
+        for i, k in enumerate(keys.tolist()):
+            j = d.get(k, -1)
+            if j < 0:
+                j = len(d)
+                d[k] = j
+                added += 1
+            out[i] = j
+        return out, added
+
+    def rebuild(self, keys: np.ndarray) -> None:
+        """Reset to exactly `keys` with ids 0..n-1."""
+        keys = np.ascontiguousarray(keys, dtype=np.uint64)
+        if self._lib is not None:
+            self._lib.ki_rebuild(self._h, keys, len(keys))
+        else:
+            self._d = {int(k): i for i, k in enumerate(keys.tolist())}

@@ -157,12 +157,18 @@ class HeterTrainer:
 
         q: queue.Queue = queue.Queue(maxsize=cfg.prefetch_depth)
         stop = object()
+        producer_errors: list[BaseException] = []
 
         def producer():
             try:
                 for pb in dataset.batches(cfg.global_batch_size,
                                           drop_last=True):
                     q.put(self._host_pull(pb))
+            except BaseException as e:
+                # surfaced after the loop — a pass must not silently
+                # complete on truncated data (reader failures are
+                # fail-stop, like the reference's PADDLE_ENFORCE path)
+                producer_errors.append(e)
             finally:
                 q.put(stop)
 
@@ -182,6 +188,8 @@ class HeterTrainer:
             losses.append(float(loss))
             self.global_step += 1
         t.join()
+        if producer_errors:
+            raise producer_errors[0]
         out = auc_acc.compute()
         out["loss_mean"] = float(np.mean(losses)) if losses else 0.0
         out["loss_first"] = losses[0] if losses else 0.0

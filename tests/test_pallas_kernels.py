@@ -41,10 +41,39 @@ def test_merge_update_matches_xla_path(opt, n):
                                   np.asarray(table)[untouched])
 
 
-def test_merge_update_inside_shard_map(monkeypatch):
-    """routed_push's production context: push under shard_map on a sharded
-    table (interpret mode on the CPU mesh) — exercises the vma plumbing on
-    the kernel's out_shape."""
+def test_vma_plumbing_api_canary():
+    """merge_update's shard_map handshake is jax.typeof(x).vma →
+    ShapeDtypeStruct(vma=...). It can only EXECUTE on real TPU (the Pallas
+    interpreter rejects any kernel under a check_vma shard_map — even a
+    pure copy trips its while_loop carry typing in JAX 0.9.0), so pin the
+    two API halves here: a JAX upgrade that drops either breaks this test
+    in CI instead of erroring first on a TPU pod."""
+    import jax
+    from jax.sharding import PartitionSpec as P
+    from paddlebox_tpu.parallel import make_mesh
+
+    mesh = make_mesh(8)
+    axes = tuple(mesh.axis_names)
+    seen = []
+
+    def body(x):
+        vma = getattr(jax.typeof(x), "vma", None)
+        seen.append(vma)
+        return x
+
+    x = jnp.zeros((64, 4), jnp.float32)
+    jax.jit(jax.shard_map(body, mesh=mesh, in_specs=(P(axes),),
+                          out_specs=P(axes)))(x)
+    assert seen and seen[0], "jax.typeof(...).vma no longer set in shard_map"
+    s = jax.ShapeDtypeStruct((4, 4), jnp.float32, vma=seen[0])
+    assert s.shape == (4, 4)
+
+
+def test_routed_push_with_flag_on_cpu_mesh(monkeypatch):
+    """routed_push under shard_map with PBTPU_PALLAS=1 on the CPU mesh:
+    exercises the interpret+vma fallback inside merge_update (the kernel
+    itself runs only on real TPU; its math is identical by construction
+    and covered on-chip)."""
     import jax
     from jax.sharding import PartitionSpec as P
     from paddlebox_tpu.parallel import make_mesh

@@ -74,6 +74,35 @@ def test_reader_error_propagates(tmp_path):
         list(q.batches(batch_size=8))
 
 
+def test_abandoned_iterator_reaps_reader_threads(tmp_path):
+    import threading, gc
+    paths, schema, _ = _write_files(tmp_path)
+    q = QueueDataset(schema, num_threads=3, queue_capacity=1)
+    q.set_filelist(paths * 4)
+    before = threading.active_count()
+    it = q.batches(batch_size=32)
+    next(it)              # start workers, then abandon
+    it.close()            # GeneratorExit → cancel + join
+    gc.collect()
+    assert threading.active_count() <= before
+
+
+def test_heter_surfaces_reader_errors(tmp_path):
+    from paddlebox_tpu.embedding import EmbeddingConfig, HostEmbeddingStore
+    from paddlebox_tpu.models import DNNCTRModel
+    from paddlebox_tpu.train import HeterTrainer, HeterConfig
+
+    paths, schema, _ = _write_files(tmp_path, n_files=2, lines_per=64)
+    q = QueueDataset(schema, num_threads=1)
+    q.set_filelist(paths + [str(tmp_path / "missing.txt")])
+    store = HostEmbeddingStore(EmbeddingConfig(dim=4))
+    tr = HeterTrainer(DNNCTRModel(num_slots=NUM_SLOTS, emb_dim=4,
+                                  dense_dim=1, hidden=(8,)),
+                      store, schema, HeterConfig(global_batch_size=32))
+    with pytest.raises(OSError):
+        tr.train_pass(q)
+
+
 def test_queue_dataset_feeds_heter_trainer(tmp_path):
     from paddlebox_tpu.embedding import EmbeddingConfig, HostEmbeddingStore
     from paddlebox_tpu.models import DNNCTRModel
