@@ -83,20 +83,9 @@ class HostEmbeddingStore:
         with self._lock:
             idx, added = self._index.lookup_or_insert(keys)
             if added:
-                # new ids are sequential from the old size in
-                # first-occurrence order — append their rows in that order
-                new_mask = idx >= self._n
-                seen_order = np.argsort(idx[new_mask], kind="stable")
-                new_pos = np.flatnonzero(new_mask)[seen_order]
-                # one position per new id (duplicates share the id)
-                _, take = np.unique(idx[new_pos], return_index=True)
-                first_pos = new_pos[take]
-                new_keys = keys[first_pos]
-                self._reserve(self._n + added)
-                self._rows[self._n:self._n + added] = \
+                new_keys = self._append_new_keys(idx, keys, added)
+                self._rows[self._n - added:self._n] = \
                     self._init_rows(new_keys)
-                self._keys[self._n:self._n + added] = new_keys
-                self._n += added
                 for k_int in new_keys.tolist():
                     # a re-created key is live again — its pending tombstone
                     # must not delete it at delta-replay time
@@ -128,6 +117,20 @@ class HostEmbeddingStore:
         with self._lock:
             idx = self._lookup_strict(keys)
             return self._rows[idx].copy()
+
+    def _append_new_keys(self, idx: np.ndarray, keys: np.ndarray,
+                         added: int) -> np.ndarray:
+        """Append the `added` new keys the index just assigned (ids are
+        sequential from the old size, first-occurrence order). Returns the
+        new keys in id order; rows for them are the caller's job."""
+        new_pos = np.flatnonzero(idx >= self._n)
+        # np.unique returns first-occurrence positions ordered by id
+        _, take = np.unique(idx[new_pos], return_index=True)
+        new_keys = keys[new_pos[take]]
+        self._reserve(self._n + added)
+        self._keys[self._n:self._n + added] = new_keys
+        self._n += added
+        return new_keys
 
     def _lookup_strict(self, keys: np.ndarray) -> np.ndarray:
         """Batch index lookup; every key must be present (KeyError parity
@@ -263,9 +266,7 @@ class HostEmbeddingStore:
             present = self._index.lookup(keys) >= 0
             if not present.any():
                 return
-            gone = set(keys[present].tolist())
-            keep = np.array([int(k) not in gone
-                             for k in self._keys[:self._n].tolist()])
+            keep = ~np.isin(self._keys[:self._n], keys[present])
             kept_keys = self._keys[:self._n][keep]
             kept_rows = self._rows[:self._n][keep]
             self._index.rebuild(kept_keys)
@@ -278,14 +279,7 @@ class HostEmbeddingStore:
             keys = np.asarray(keys).astype(np.uint64)
             idx, added = self._index.lookup_or_insert(keys)
             if added:
-                new_mask = idx >= self._n
-                new_pos = np.flatnonzero(new_mask)
-                order = np.argsort(idx[new_pos], kind="stable")
-                _, take = np.unique(idx[new_pos][order], return_index=True)
-                first_pos = new_pos[order][take]
-                self._reserve(self._n + added)
-                self._keys[self._n:self._n + added] = keys[first_pos]
-                self._n += added
+                self._append_new_keys(idx, keys, added)
             # every ingested key is live again — clear pending tombstones
             # so a later save_delta cannot list it as removed
             # (mirrors lookup_or_init's discard)

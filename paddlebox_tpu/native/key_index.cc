@@ -147,18 +147,16 @@ void ki_rebuild(void* h, const uint64_t* ks, int64_t n) {
   ki->sentinel_val = -1;
   for (int64_t i = 0; i < n; ++i) {
     if (ks[i] == kEmpty) {
-      if (ki->sentinel_val < 0) {
-        ki->sentinel_val = i;
-        ++ki->size;
-      }
+      if (ki->sentinel_val < 0) ++ki->size;
+      ki->sentinel_val = i;  // last occurrence wins (dict-fallback parity)
       continue;
     }
     uint64_t s = ki->probe(ks[i]);
     if (ki->keys[s] != ks[i]) {
       ki->keys[s] = ks[i];
-      ki->vals[s] = i;
       ++ki->size;
     }
+    ki->vals[s] = i;  // last occurrence wins (dict-fallback parity)
   }
 }
 

@@ -10,33 +10,13 @@ backend loads.
 from __future__ import annotations
 
 import ctypes
-import os
-import subprocess
-import threading
 
 import numpy as np
 
-_HERE = os.path.dirname(os.path.abspath(__file__))
-_LIB_PATH = os.path.join(_HERE, "libkeyindex.so")
-_lock = threading.Lock()
-_lib_cache: list = []
+from paddlebox_tpu.native.loader import load_native
 
 
-def _build() -> bool:
-    if os.environ.get("PBTPU_NO_NATIVE_BUILD"):
-        return False
-    try:
-        subprocess.run(["make", "-C", _HERE, "-s", "libkeyindex.so"],
-                       check=True, capture_output=True, timeout=120)
-        return os.path.exists(_LIB_PATH)
-    except Exception:
-        return False
-
-
-def _load() -> ctypes.CDLL | None:
-    if not os.path.exists(_LIB_PATH) and not _build():
-        return None
-    lib = ctypes.CDLL(_LIB_PATH)
+def _configure(lib: ctypes.CDLL) -> None:
     c = ctypes
     u64p = np.ctypeslib.ndpointer(np.uint64, flags="C_CONTIGUOUS")
     i64p = np.ctypeslib.ndpointer(np.int64, flags="C_CONTIGUOUS")
@@ -52,14 +32,10 @@ def _load() -> ctypes.CDLL | None:
     lib.ki_lookup_or_insert.argtypes = [c.c_void_p, u64p, c.c_int64, i64p]
     lib.ki_rebuild.restype = None
     lib.ki_rebuild.argtypes = [c.c_void_p, u64p, c.c_int64]
-    return lib
 
 
 def get_lib() -> ctypes.CDLL | None:
-    with _lock:
-        if not _lib_cache:
-            _lib_cache.append(_load())
-        return _lib_cache[0]
+    return load_native("libkeyindex.so", _configure)
 
 
 def native_available() -> bool:

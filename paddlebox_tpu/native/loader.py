@@ -1,0 +1,50 @@
+"""Shared loader for the native C++ helpers.
+
+One place for the build-on-first-use / cache / PBTPU_NO_NATIVE_BUILD logic
+used by every binding (slot parser, key index). Each binding supplies the
+library filename, the make target, and a `configure(lib)` that declares
+ctypes signatures.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+from typing import Callable
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+_lock = threading.Lock()
+_cache: dict[str, ctypes.CDLL | None] = {}
+
+
+def _build(target: str) -> bool:
+    if os.environ.get("PBTPU_NO_NATIVE_BUILD"):
+        return False
+    try:
+        subprocess.run(["make", "-C", _HERE, "-s", target], check=True,
+                       capture_output=True, timeout=120)
+        return os.path.exists(os.path.join(_HERE, target))
+    except Exception:
+        return False
+
+
+def load_native(lib_filename: str,
+                configure: Callable[[ctypes.CDLL], None]
+                ) -> ctypes.CDLL | None:
+    """Load (building if needed) a native lib; returns None when
+    unavailable — callers fall back to their Python paths."""
+    with _lock:
+        if lib_filename in _cache:
+            return _cache[lib_filename]
+        path = os.path.join(_HERE, lib_filename)
+        lib = None
+        if os.path.exists(path) or _build(lib_filename):
+            try:
+                lib = ctypes.CDLL(path)
+                configure(lib)
+            except Exception:
+                lib = None
+        _cache[lib_filename] = lib
+        return lib

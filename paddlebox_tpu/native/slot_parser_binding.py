@@ -11,34 +11,14 @@ other (tests/test_native_parser.py).
 from __future__ import annotations
 
 import ctypes
-import os
-import subprocess
-import threading
 from typing import Iterable
 
 import numpy as np
 
-_HERE = os.path.dirname(os.path.abspath(__file__))
-_LIB_PATH = os.path.join(_HERE, "libslotparser.so")
-_lock = threading.Lock()
-_lib_cache: list = []
+from paddlebox_tpu.native.loader import load_native
 
 
-def _build() -> bool:
-    if os.environ.get("PBTPU_NO_NATIVE_BUILD"):
-        return False
-    try:
-        subprocess.run(["make", "-C", _HERE, "-s"], check=True,
-                       capture_output=True, timeout=120)
-        return os.path.exists(_LIB_PATH)
-    except Exception:
-        return False
-
-
-def _load() -> ctypes.CDLL | None:
-    if not os.path.exists(_LIB_PATH) and not _build():
-        return None
-    lib = ctypes.CDLL(_LIB_PATH)
+def _configure(lib: ctypes.CDLL) -> None:
     c = ctypes
     lib.sp_parse.restype = c.c_void_p
     lib.sp_parse.argtypes = [
@@ -61,14 +41,11 @@ def _load() -> ctypes.CDLL | None:
     lib.sp_free.argtypes = [c.c_void_p]
     lib.sp_hash64.restype = c.c_uint64
     lib.sp_hash64.argtypes = [c.c_char_p, c.c_int64]
-    return lib
 
 
 def get_lib() -> ctypes.CDLL | None:
-    with _lock:
-        if not _lib_cache:
-            _lib_cache.append(_load())
-    return _lib_cache[0]
+    return load_native("libslotparser.so", _configure)
+
 
 
 def available() -> bool:

@@ -69,14 +69,21 @@ def test_sentinel_key_max_uint64():
         assert ki.lookup(sent).tolist() == [0] and len(ki) == 2
 
 
+def test_rebuild_duplicate_keys_last_occurrence_wins():
+    dup = np.array([5, 5, 7], np.uint64)
+    for fp in ([True, False] if native_available() else [True]):
+        ki = KeyIndex(8, force_python=fp)
+        ki.rebuild(dup)
+        assert len(ki) == 2, fp
+        assert ki.lookup(np.array([5, 7], np.uint64)).tolist() == [1, 2], fp
+
+
 def test_store_works_on_python_fallback(monkeypatch):
     """The store must behave identically when the native lib is absent."""
     import paddlebox_tpu.native.key_index as kim
     from paddlebox_tpu.embedding import EmbeddingConfig, HostEmbeddingStore
 
-    monkeypatch.setattr(kim, "_lib_cache", [None])
     monkeypatch.setenv("PBTPU_NO_NATIVE_BUILD", "1")
-    # _load would rebuild; short-circuit get_lib entirely
     monkeypatch.setattr(kim, "get_lib", lambda: None)
     store = HostEmbeddingStore(EmbeddingConfig(dim=4))
     keys = np.array([3, 9, 3, 27], np.uint64)
