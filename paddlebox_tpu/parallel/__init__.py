@@ -5,3 +5,5 @@ from paddlebox_tpu.parallel.dense_sync import (AsyncDenseTable,  # noqa: F401
 from paddlebox_tpu.parallel.pipeline import (gpipe_spmd,  # noqa: F401
                                              make_pipeline, split_stages,
                                              stack_stage_params)
+from paddlebox_tpu.parallel import tensor  # noqa: F401
+from paddlebox_tpu.parallel import expert  # noqa: F401

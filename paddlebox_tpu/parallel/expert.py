@@ -1,0 +1,179 @@
+"""Expert parallelism — MoE with all_to_all token dispatch over an ep axis.
+
+Absent in the reference (SURVEY.md §2.3: "Expert parallelism — NO"; its
+MMoE runs every expert densely on every device). Here experts shard across
+an ``ep`` mesh axis and tokens travel to their experts through the same
+fixed-capacity ``all_to_all`` pattern the embedding engine uses for keys
+(embedding/sharded.py) — the TPU-native shape of MoE dispatch:
+
+    gate (top-k softmax) → route token features into per-(device, expert)
+    capacity lanes → all_to_all over ep → batched expert MLPs
+    (one einsum over stacked local experts) → all_to_all back →
+    weighted combine.
+
+Tokens beyond a lane's capacity are dropped (standard MoE capacity-factor
+semantics; monitor with `dropped_tokens`). Numerics match `moe_reference`
+for all surviving tokens.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+EP_AXIS = "ep"
+
+
+def make_ep_mesh(n_ep: int,
+                 devices: Sequence[jax.Device] | None = None) -> Mesh:
+    devs = list(devices) if devices is not None else list(jax.devices())
+    return Mesh(np.array(devs[:n_ep]), (EP_AXIS,))
+
+
+def init_moe(key, num_experts: int, d_model: int, d_hidden: int) -> dict:
+    """Gate + stacked expert FFNs (unsharded; shard with shard_moe_params)."""
+    kg, k1, k2 = jax.random.split(key, 3)
+    s1 = 1.0 / jnp.sqrt(d_model)
+    s2 = 1.0 / jnp.sqrt(d_hidden)
+    return {
+        "gate": jax.random.normal(kg, (d_model, num_experts),
+                                  jnp.float32) * s1,
+        "w1": jax.random.normal(k1, (num_experts, d_model, d_hidden),
+                                jnp.float32) * s1,
+        "b1": jnp.zeros((num_experts, d_hidden), jnp.float32),
+        "w2": jax.random.normal(k2, (num_experts, d_hidden, d_model),
+                                jnp.float32) * s2,
+        "b2": jnp.zeros((num_experts, d_model), jnp.float32),
+    }
+
+
+def _expert_ffn(w1, b1, w2, b2, x):
+    """x (E, n, D) through per-expert FFNs — one batched einsum pair."""
+    h = jax.nn.relu(jnp.einsum("end,edh->enh", x, w1) + b1[:, None, :])
+    return jnp.einsum("enh,ehd->end", h, w2) + b2[:, None, :]
+
+
+def moe_reference(params: dict, x: jnp.ndarray, top_k: int = 2
+                  ) -> jnp.ndarray:
+    """Dense ground truth: every expert computes every token."""
+    logits = x @ params["gate"]
+    weights, experts = lax.top_k(jax.nn.softmax(logits, axis=-1), top_k)
+    all_out = _expert_ffn(params["w1"], params["b1"], params["w2"],
+                          params["b2"],
+                          jnp.broadcast_to(x, (params["w1"].shape[0],
+                                               *x.shape)))
+    out = jnp.zeros_like(x)
+    for k in range(top_k):
+        out = out + weights[:, k:k + 1] * all_out[experts[:, k],
+                                                  jnp.arange(x.shape[0])]
+    return out
+
+
+def shard_moe_params(mesh: Mesh, params: dict) -> dict:
+    """Experts shard over ep (leading axis); the gate is replicated."""
+    ex = NamedSharding(mesh, P(EP_AXIS))
+    rep = NamedSharding(mesh, P())
+    return {
+        "gate": jax.device_put(params["gate"], rep),
+        "w1": jax.device_put(params["w1"], ex),
+        "b1": jax.device_put(params["b1"], ex),
+        "w2": jax.device_put(params["w2"], ex),
+        "b2": jax.device_put(params["b2"], ex),
+    }
+
+
+def dropped_tokens(params: dict, x: jnp.ndarray, n_ep: int,
+                   top_k: int = 2, capacity_factor: float = 2.0) -> int:
+    """How many (token, choice) assignments the dispatch will drop.
+
+    Mirrors make_moe exactly: each top-k round has its OWN capacity lanes
+    (a separate all_to_all per k), so counts are per (source device,
+    expert, k)."""
+    logits = x @ params["gate"]
+    _, experts = lax.top_k(jax.nn.softmax(logits, axis=-1), top_k)
+    E = params["w1"].shape[0]
+    n_local = x.shape[0] // n_ep
+    cap = _capacity(n_local, E, capacity_factor)
+    dropped = 0
+    for k in range(top_k):
+        for dev in range(n_ep):
+            loc = np.asarray(experts[dev * n_local:(dev + 1) * n_local, k])
+            for e in range(E):
+                dropped += max(0, int((loc == e).sum()) - cap)
+    return dropped
+
+
+def _capacity(n_local: int, n_experts: int, factor: float) -> int:
+    avg = n_local * 1.0 / n_experts  # per (local batch, expert) average
+    return max(1, int(np.ceil(avg * factor)))
+
+
+def make_moe(mesh: Mesh, num_experts: int, top_k: int = 2,
+             capacity_factor: float = 2.0) -> Callable:
+    """→ fn(sharded_params, x) with x batch-sharded over ep.
+
+    Requires num_experts % n_ep == 0."""
+    n_ep = mesh.shape[EP_AXIS]
+    if num_experts % n_ep:
+        raise ValueError(f"{num_experts} experts not divisible by "
+                         f"ep={n_ep}")
+    e_local = num_experts // n_ep
+
+    def body(params: dict, x: jnp.ndarray) -> jnp.ndarray:
+        n, d = x.shape  # local batch
+        cap = _capacity(n, num_experts, capacity_factor)
+        logits = x @ params["gate"]
+        weights, experts = lax.top_k(jax.nn.softmax(logits, axis=-1), top_k)
+        out = jnp.zeros_like(x)
+        for k in range(top_k):
+            # destination = global expert id; device dev = id // e_local
+            # owns local expert id % e_local
+            dest = experts[:, k]
+            # lane position within each destination: stable rank
+            order = jnp.argsort(dest)
+            sdest = dest[order]
+            counts = jnp.bincount(dest, length=num_experts)
+            starts = jnp.cumsum(counts) - counts
+            pos = jnp.arange(n, dtype=jnp.int32) - starts[sdest]
+            valid = pos < cap
+            # send buffers: features + originating row (for the return trip)
+            send_x = jnp.zeros((n_ep, e_local, cap, d), x.dtype)
+            send_row = jnp.full((n_ep, e_local, cap), -1, jnp.int32)
+            sdev, sloc = sdest // e_local, sdest % e_local
+            rows = order.astype(jnp.int32)
+            send_x = send_x.at[sdev, sloc, pos].set(
+                jnp.where(valid[:, None], x[order], 0.0), mode="drop")
+            send_row = send_row.at[sdev, sloc, pos].set(
+                jnp.where(valid, rows, -1), mode="drop")
+            # dispatch / compute / return. After the tiled all_to_all,
+            # axis 0 indexes the SOURCE device, so fold (src, cap) into the
+            # expert token axis with an explicit transpose — and undo it
+            # symmetrically on the way back.
+            recv_x = lax.all_to_all(send_x, EP_AXIS, 0, 0, tiled=True)
+            recv_x = recv_x.transpose(1, 0, 2, 3).reshape(
+                e_local, n_ep * cap, d)
+            y = _expert_ffn(params["w1"], params["b1"], params["w2"],
+                            params["b2"], recv_x)
+            y = y.reshape(e_local, n_ep, cap, d).transpose(1, 0, 2, 3)
+            back = lax.all_to_all(y, EP_AXIS, 0, 0, tiled=True)
+            # scatter outputs to their originating rows
+            flat_row = send_row.reshape(-1)
+            flat_y = back.reshape(-1, d)
+            safe = jnp.where(flat_row >= 0, flat_row, n)
+            gathered = jnp.zeros((n + 1, d), x.dtype).at[safe].add(
+                flat_y, mode="drop")[:n]
+            out = out + weights[:, k:k + 1] * gathered
+        return out
+
+    spec_p = {"gate": P(), "w1": P(EP_AXIS), "b1": P(EP_AXIS),
+              "w2": P(EP_AXIS), "b2": P(EP_AXIS)}
+
+    # jitted once — rebuilding per call would retrace every step
+    return jax.jit(jax.shard_map(
+        body, mesh=mesh, in_specs=(spec_p, P(EP_AXIS)),
+        out_specs=P(EP_AXIS)))

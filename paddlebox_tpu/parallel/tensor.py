@@ -1,0 +1,139 @@
+"""Tensor (model) parallelism — Megatron-style sharded linears.
+
+Absent in the reference (SURVEY.md §2.3: "Tensor parallelism — NO"); added
+here because on TPU it is a mesh axis away, and CTR towers are starting to
+grow past single-chip widths. The classic pairing over a ``tp`` axis:
+
+- column-parallel linear: W1 split along OUT features; each shard computes
+  its slice of the hidden layer, no communication (inputs replicated).
+- row-parallel linear: W2 split along IN features; each shard computes a
+  partial product and one ``psum`` over tp restores the full output.
+
+One all-reduce per column→row block — the standard Megatron fwd cost. The
+pattern composes with dp: use a 2D (dp, tp) mesh, batch sharded over dp,
+weights sharded over tp.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+TP_AXIS = "tp"
+
+
+def make_tp_mesh(n_tp: int, n_dp: int = 1,
+                 devices: Sequence[jax.Device] | None = None) -> Mesh:
+    devs = list(devices) if devices is not None else list(jax.devices())
+    if n_dp > 1:
+        arr = np.array(devs[:n_dp * n_tp]).reshape(n_dp, n_tp)
+        return Mesh(arr, ("dp", TP_AXIS))
+    return Mesh(np.array(devs[:n_tp]), (TP_AXIS,))
+
+
+def init_tp_mlp(key, dims: Sequence[int]) -> list[dict[str, jnp.ndarray]]:
+    """Unsharded parameters for a [d0, d1, ..., dn] MLP (relu between,
+    linear head). Shard with `shard_tp_params` or feed to the reference
+    apply for parity tests."""
+    params = []
+    keys = jax.random.split(key, len(dims) - 1)
+    for k, din, dout in zip(keys, dims[:-1], dims[1:]):
+        params.append({
+            "w": jax.random.normal(k, (din, dout), jnp.float32)
+            / jnp.sqrt(din),
+            "b": jnp.zeros((dout,), jnp.float32),
+        })
+    return params
+
+
+def mlp_reference(params: list[dict], x: jnp.ndarray) -> jnp.ndarray:
+    h = x
+    for i, p in enumerate(params):
+        h = h @ p["w"] + p["b"]
+        if i < len(params) - 1:
+            h = jax.nn.relu(h)
+    return h
+
+
+def plan_modes(dims: Sequence[int], n_tp: int) -> list[str]:
+    """Per-layer parallel mode: "col" (OUT sharded), "row" (IN sharded,
+    psum), or "rep" (replicated — e.g. a width-1 head).
+
+    Greedy: col whenever features are complete and OUT divides by tp; row
+    whenever features arrive sharded (its IN is the previous col's OUT,
+    divisible by construction); rep otherwise. A col layer is therefore
+    always followed by a row layer — no gathers are ever needed."""
+    modes, sharded = [], False
+    for din, dout in zip(dims[:-1], dims[1:]):
+        if sharded:
+            modes.append("row")
+            sharded = False
+        elif dout % n_tp == 0:
+            modes.append("col")
+            sharded = True
+        else:
+            modes.append("rep")
+    return modes
+
+
+_SPECS = {
+    "col": {"w": P(None, TP_AXIS), "b": P(TP_AXIS)},
+    "row": {"w": P(TP_AXIS, None), "b": P()},
+    "rep": {"w": P(), "b": P()},
+}
+
+
+def shard_tp_params(mesh: Mesh, params: list[dict]) -> list[dict]:
+    """Place weights per the mode plan (col: OUT split + sharded bias;
+    row: IN split + replicated bias; rep: replicated)."""
+    n_tp = mesh.shape[TP_AXIS]
+    dims = [params[0]["w"].shape[0]] + [p["w"].shape[1] for p in params]
+    out = []
+    for p, mode in zip(params, plan_modes(dims, n_tp)):
+        spec = _SPECS[mode]
+        out.append({"w": jax.device_put(p["w"], NamedSharding(mesh,
+                                                              spec["w"])),
+                    "b": jax.device_put(p["b"], NamedSharding(mesh,
+                                                              spec["b"]))})
+    return out
+
+
+def make_tp_mlp(mesh: Mesh, dims: Sequence[int],
+                dp_axis: str | None = None) -> Callable:
+    """→ fn(sharded_params, x) running the planned col/row/rep MLP under
+    shard_map with one psum per row layer; numerically equal to
+    `mlp_reference`.
+
+    x is replicated over tp (and, if `dp_axis` given, sharded over dp)."""
+    batch_spec = P(dp_axis) if dp_axis else P()
+    n_tp = mesh.shape[TP_AXIS]
+    modes = plan_modes(dims, n_tp)
+    n_layers = len(modes)
+
+    def body(params: list[dict], x: jnp.ndarray) -> jnp.ndarray:
+        h = x
+        for i, (p, mode) in enumerate(zip(params, modes)):
+            if mode == "row":
+                # partial product + one tp all-reduce
+                h = lax.psum(h @ p["w"], TP_AXIS) + p["b"]
+            else:  # col (local OUT slice) or rep (replicated)
+                h = h @ p["w"] + p["b"]
+            if i < n_layers - 1:
+                # relu is elementwise — valid on column-sharded features
+                # (each shard holds complete individual features)
+                h = jax.nn.relu(h)
+        return h
+
+    in_specs = ([_SPECS[m] for m in modes], batch_spec)
+    dp = dp_axis if dp_axis else None
+    # a trailing col layer leaves the feature axis sharded over tp
+    out_spec = P(dp, TP_AXIS) if modes[-1] == "col" else batch_spec
+
+    # jitted once — rebuilding per call would retrace every step
+    return jax.jit(jax.shard_map(
+        body, mesh=mesh, in_specs=tuple(in_specs), out_specs=out_spec))
