@@ -42,7 +42,7 @@ class HostEmbeddingStore:
         self._keys = np.zeros(initial_capacity, dtype=np.uint64)
         self._rows = np.zeros((initial_capacity, cfg.row_width), dtype=np.float32)
         self._n = 0
-        self._dirty: set[int] = set()
+        self._dirty = np.zeros(initial_capacity, dtype=bool)
         self._tombstones: set[int] = set()  # evicted since last save
         self._lock = threading.Lock()
         self._save_seq = 0
@@ -86,10 +86,19 @@ class HostEmbeddingStore:
                 new_keys = self._append_new_keys(idx, keys, added)
                 self._rows[self._n - added:self._n] = \
                     self._init_rows(new_keys)
-                for k_int in new_keys.tolist():
-                    # a re-created key is live again — its pending tombstone
-                    # must not delete it at delta-replay time
-                    self._tombstones.discard(int(k_int))
+                if self._tombstones:
+                    tomb = np.fromiter(self._tombstones, dtype=np.uint64,
+                                       count=len(self._tombstones))
+                    res = np.isin(new_keys, tomb)
+                    if res.any():
+                        # a re-created key is live again: drop its pending
+                        # tombstone AND mark its fresh init row dirty — the
+                        # next delta must carry the new row, or load(base +
+                        # deltas) would resurrect the stale pre-eviction row
+                        self._dirty[self._n - added
+                                    + np.flatnonzero(res)] = True
+                        self._tombstones.difference_update(
+                            int(k) for k in new_keys[res].tolist())
             return self._rows[idx].copy()
 
     def write_back(self, keys: np.ndarray, rows: np.ndarray) -> None:
@@ -98,7 +107,7 @@ class HostEmbeddingStore:
         with self._lock:
             idx = self._lookup_strict(keys)
             self._rows[idx] = rows
-            self._dirty.update(int(k) for k in keys)
+            self._dirty[idx] = True
 
     def peek_rows(self, keys: np.ndarray) -> np.ndarray:
         """Fetch rows without creating missing ones (test/eval mode —
@@ -148,6 +157,9 @@ class HostEmbeddingStore:
             return
         new_cap = max(need, int(cap * self._GROW))
         self._keys = np.resize(self._keys, new_cap)
+        dirty = np.zeros(new_cap, dtype=bool)
+        dirty[:self._n] = self._dirty[:self._n]
+        self._dirty = dirty
         rows = np.zeros((new_cap, self.cfg.row_width), dtype=np.float32)
         rows[:self._n] = self._rows[:self._n]
         self._rows = rows
@@ -163,20 +175,20 @@ class HostEmbeddingStore:
             if decay != 1.0:
                 self._rows[:self._n, 0] *= decay
                 # decayed counters must reach the next delta checkpoint
-                self._dirty.update(int(k) for k in
-                                   self._keys[:self._n].tolist())
+                self._dirty[:self._n] = True
             keep = self._rows[:self._n, 0] >= min_show
             evicted = int((~keep).sum())
             if evicted:
                 gone = self._keys[:self._n][~keep]
                 kept_keys = self._keys[:self._n][keep]
                 kept_rows = self._rows[:self._n][keep]
+                kept_dirty = self._dirty[:len(keep)][keep]
                 self._index.rebuild(kept_keys)
                 self._n = len(kept_keys)
                 self._keys[:self._n] = kept_keys
                 self._rows[:self._n] = kept_rows
-                self._dirty.intersection_update(
-                    int(k) for k in kept_keys.tolist())
+                self._dirty[:] = False
+                self._dirty[:self._n] = kept_dirty
                 # tombstone evictions so load(base + deltas) does not
                 # resurrect them
                 self._tombstones.update(int(k) for k in gone.tolist())
@@ -204,7 +216,7 @@ class HostEmbeddingStore:
             np.savez_compressed(fname, keys=self._keys[:self._n],
                                 rows=self._rows[:self._n])
             self._write_meta(path)
-            self._dirty.clear()
+            self._dirty[:] = False
             self._tombstones.clear()
             self._save_seq = 0
         return fname
@@ -213,16 +225,15 @@ class HostEmbeddingStore:
         os.makedirs(path, exist_ok=True)
         with self._lock:
             self._save_seq += 1
-            keys = np.fromiter(self._dirty, dtype=np.uint64,
-                               count=len(self._dirty))
-            idx = self._lookup_strict(keys)
+            idx = np.flatnonzero(self._dirty[:self._n])
+            keys = self._keys[idx]
             fname = os.path.join(path, f"delta-{self._save_seq:05d}.npz")
             removed = np.fromiter(self._tombstones, dtype=np.uint64,
                                   count=len(self._tombstones))
             np.savez_compressed(fname, keys=keys, rows=self._rows[idx],
                                 removed=removed)
             self._write_meta(path)
-            self._dirty.clear()
+            self._dirty[:] = False
             self._tombstones.clear()
         return fname
 
@@ -269,10 +280,13 @@ class HostEmbeddingStore:
             keep = ~np.isin(self._keys[:self._n], keys[present])
             kept_keys = self._keys[:self._n][keep]
             kept_rows = self._rows[:self._n][keep]
+            kept_dirty = self._dirty[:self._n][keep]
             self._index.rebuild(kept_keys)
             self._n = len(kept_keys)
             self._keys[:self._n] = kept_keys
             self._rows[:self._n] = kept_rows
+            self._dirty[:] = False
+            self._dirty[:self._n] = kept_dirty
 
     def _ingest(self, keys: np.ndarray, rows: np.ndarray) -> None:
         with self._lock:
@@ -280,10 +294,11 @@ class HostEmbeddingStore:
             idx, added = self._index.lookup_or_insert(keys)
             if added:
                 self._append_new_keys(idx, keys, added)
-            # every ingested key is live again — clear pending tombstones
-            # so a later save_delta cannot list it as removed
-            # (mirrors lookup_or_init's discard)
-            self._tombstones.difference_update(
-                int(k) for k in keys.tolist())
+            if self._tombstones:
+                # every ingested key is live again — clear pending
+                # tombstones so a later save_delta cannot list it as
+                # removed (mirrors lookup_or_init's discard)
+                self._tombstones.difference_update(
+                    int(k) for k in keys.tolist())
             # last occurrence wins for duplicate keys (replay order)
             self._rows[idx] = rows

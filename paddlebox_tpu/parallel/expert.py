@@ -103,8 +103,8 @@ def dropped_tokens(params: dict, x: jnp.ndarray, n_ep: int,
     for k in range(top_k):
         for dev in range(n_ep):
             loc = np.asarray(experts[dev * n_local:(dev + 1) * n_local, k])
-            for e in range(E):
-                dropped += max(0, int((loc == e).sum()) - cap)
+            counts = np.bincount(loc, minlength=E)
+            dropped += int(np.maximum(counts - cap, 0).sum())
     return dropped
 
 

@@ -358,6 +358,31 @@ def test_store_shrink_survives_delta_checkpoint(tmp_path):
     np.testing.assert_allclose(s2.get_rows(keys[:5])[:, 0], 5.0)
 
 
+def test_recreated_tombstoned_key_reaches_delta(tmp_path):
+    """shrink-evicted key re-created by lookup_or_init: the next delta must
+    carry its fresh row, or load(base+deltas) resurrects the stale one."""
+    cfg = EmbeddingConfig(dim=2, optimizer="sgd")
+    s = HostEmbeddingStore(cfg)
+    keys = np.array([11, 22], np.uint64)
+    rows = s.lookup_or_init(keys)
+    rows[:, 0] = 5.0           # show counters keep both alive
+    rows[:, 2] = 7.0           # distinctive trained w
+    s.write_back(keys, rows)
+    s.save_base(str(tmp_path))
+    s.get_rows(keys)
+    # evict key 11 (low show), then re-create it fresh
+    r = s.get_rows(keys); r[0, 0] = 0.0; s.write_back(keys, r)
+    s.save_delta(str(tmp_path))
+    assert s.shrink(min_show=1.0) == 1
+    s.lookup_or_init(np.array([11], np.uint64))     # re-created, fresh row
+    s.save_delta(str(tmp_path))
+    s2 = HostEmbeddingStore.load(str(tmp_path), cfg)
+    live = s.get_rows(np.array([11], np.uint64))
+    restored = s2.get_rows(np.array([11], np.uint64))
+    np.testing.assert_array_equal(live, restored)
+    assert restored[0, 2] != 7.0    # NOT the stale pre-eviction row
+
+
 def test_translate_empty_working_set():
     c = cfg_small()
     store = HostEmbeddingStore(c)
