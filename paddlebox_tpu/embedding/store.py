@@ -295,10 +295,16 @@ class HostEmbeddingStore:
             if added:
                 self._append_new_keys(idx, keys, added)
             if self._tombstones:
-                # every ingested key is live again — clear pending
-                # tombstones so a later save_delta cannot list it as
-                # removed (mirrors lookup_or_init's discard)
-                self._tombstones.difference_update(
-                    int(k) for k in keys.tolist())
+                tomb = np.fromiter(self._tombstones, dtype=np.uint64,
+                                   count=len(self._tombstones))
+                res = np.isin(keys, tomb)
+                if res.any():
+                    # a re-added key is live again: drop its pending
+                    # tombstone AND dirty its row, so the next delta
+                    # carries the new value instead of load() resurrecting
+                    # the stale pre-eviction row (mirrors lookup_or_init)
+                    self._dirty[idx[res]] = True
+                    self._tombstones.difference_update(
+                        int(k) for k in keys[res].tolist())
             # last occurrence wins for duplicate keys (replay order)
             self._rows[idx] = rows

@@ -383,6 +383,36 @@ def test_recreated_tombstoned_key_reaches_delta(tmp_path):
     assert restored[0, 2] != 7.0    # NOT the stale pre-eviction row
 
 
+def test_reingested_tombstoned_key_reaches_delta(tmp_path):
+    """Same resurrection hole on the apply_delta_file path: a shrink-evicted
+    key re-added by delta replay must be dirtied so the NEXT delta carries
+    its new row."""
+    cfg = EmbeddingConfig(dim=2, optimizer="sgd")
+    s = HostEmbeddingStore(cfg)
+    keys = np.array([11, 22], np.uint64)
+    rows = s.lookup_or_init(keys)
+    rows[:, 0] = 5.0
+    rows[:, 2] = 7.0
+    s.write_back(keys, rows)
+    s.save_base(str(tmp_path))
+    # an external delta carrying a new value for key 11
+    ext = tmp_path / "ext-delta.npz"
+    new_row = rows[0:1].copy(); new_row[0, 2] = 42.0
+    np.savez(ext, keys=np.array([11], np.uint64), rows=new_row,
+             removed=np.zeros(0, np.uint64))
+    # evict 11, then replay the external delta (re-adds it, w=42)
+    r = s.get_rows(keys); r[0, 0] = 0.0; s.write_back(keys, r)
+    s.save_delta(str(tmp_path))
+    assert s.shrink(min_show=1.0) == 1
+    s.apply_delta_file(str(ext))
+    s.save_delta(str(tmp_path))
+    s2 = HostEmbeddingStore.load(str(tmp_path), cfg)
+    np.testing.assert_array_equal(
+        s.get_rows(np.array([11], np.uint64)),
+        s2.get_rows(np.array([11], np.uint64)))
+    assert s2.get_rows(np.array([11], np.uint64))[0, 2] == 42.0
+
+
 def test_translate_empty_working_set():
     c = cfg_small()
     store = HostEmbeddingStore(c)

@@ -1,0 +1,20 @@
+"""The examples/train_ctr.py workflow must stay runnable end to end."""
+
+import os
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def test_train_ctr_example_runs():
+    env = dict(os.environ,
+               PYTHONPATH=REPO,
+               JAX_PLATFORMS="cpu",
+               XLA_FLAGS="--xla_force_host_platform_device_count=8")
+    out = subprocess.run(
+        [sys.executable, os.path.join(REPO, "examples", "train_ctr.py")],
+        env=env, capture_output=True, text=True, timeout=420)
+    assert out.returncode == 0, out.stdout + out.stderr
+    assert "example complete" in out.stdout
+    assert "serving: scored" in out.stdout
