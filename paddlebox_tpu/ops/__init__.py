@@ -1,5 +1,6 @@
 from paddlebox_tpu.ops.seqpool_cvm import (fused_seqpool_cvm,  # noqa: F401
-                                           fused_seqpool_cvm_with_conv)
+                                           fused_seqpool_cvm_with_conv,
+                                           fused_seqpool_cvm_with_pcoc)
 from paddlebox_tpu.ops.cvm import cvm, cvm_inverse  # noqa: F401
 from paddlebox_tpu.ops.rank_attention import rank_attention, build_rank_offset  # noqa: F401
 from paddlebox_tpu.ops.batch_fc import batch_fc  # noqa: F401

@@ -116,6 +116,69 @@ def fused_seqpool_cvm(
     return out
 
 
+def fused_seqpool_cvm_with_pcoc(
+    pulled: jnp.ndarray,
+    mask: jnp.ndarray,
+    segment_ids: np.ndarray | jnp.ndarray,
+    num_slots: int,
+    use_cvm: bool = True,
+    cvm_offset: int = 7,
+    max_cvm_offset: int = 7,
+    need_filter: bool = False,
+    show_coeff: float = 0.2,
+    clk_coeff: float = 1.0,
+    threshold: float = 0.96,
+    quant_ratio: int = 0,
+    flatten: bool = True,
+) -> jnp.ndarray:
+    """PCOC (predicted-click calibration) variant
+    (fused_seqpool_cvm_with_pcoc_op.cu:118-258).
+
+    Pull layout per token: [show, clk, show2, clk2, pclk_1..pclk_P, embedx]
+    where P = cvm_offset - 4 (the reference's used_cvm_offset counts the
+    leading show/clk/show2/clk2 plus P pclk columns; max_cvm_offset is the
+    total leading width before embedx). Join-phase output per slot:
+
+        out[0]            = log(show+1)
+        out[1]            = log(clk+1)  - log(show+1)
+        out[2..2+P)       = log(pclk_i+1) - log(show2+1)
+        out[2+P..2+2P)    = log(pclk_i+1) - log(clk2+1)
+        out[2+2P..]       = pooled embedx (passthrough)
+
+    Update phase drops all max_cvm_offset leading columns.
+    """
+    B, T, E = pulled.shape
+    pclk_num = cvm_offset - 4
+    if pclk_num < 0:
+        raise ValueError("cvm_offset must be >= 4 (show/clk/show2/clk2)")
+    seg_np = np.asarray(segment_ids, dtype=np.int64)
+    keep = mask
+    if need_filter:
+        show, clk = pulled[..., 0], pulled[..., 1]
+        keep = keep & ((show - clk) * show_coeff + clk * clk_coeff
+                       >= threshold)
+    x = pulled
+    if quant_ratio > 0:
+        q = jnp.round(x[..., max_cvm_offset:] * quant_ratio) / quant_ratio
+        x = jnp.concatenate([x[..., :max_cvm_offset], q], axis=-1)
+    x = x * keep[..., None]
+    pooled = _pool(x, seg_np, num_slots)       # (B, S, E)
+    if not use_cvm:
+        out = pooled[..., max_cvm_offset:]
+    else:
+        lg = lambda c: jnp.log(pooled[..., c:c + 1] + 1.0)
+        cols = [lg(0), lg(1) - lg(0)]
+        for i in range(pclk_num):
+            cols.append(lg(4 + i) - lg(2))     # pclk_i vs show2
+        for i in range(pclk_num):
+            cols.append(lg(4 + i) - lg(3))     # pclk_i vs clk2
+        cols.append(pooled[..., max_cvm_offset:])
+        out = jnp.concatenate(cols, axis=-1)
+    if flatten:
+        out = out.reshape(B, -1)
+    return out
+
+
 def fused_seqpool_cvm_with_conv(
     pulled: jnp.ndarray,
     mask: jnp.ndarray,

@@ -138,3 +138,33 @@ def test_fused_concat():
     b = jnp.zeros((2, 4))
     out = fused_concat([a, b], offset=1, length=2)
     assert out.shape == (2, 4)
+
+
+def test_seqpool_cvm_with_pcoc_manual():
+    """Hand-computed PCOC transform (fused_seqpool_cvm_with_pcoc_op.cu):
+    layout [show, clk, show2, clk2, pclk, embedx...], P=1 pclk."""
+    from paddlebox_tpu.ops import fused_seqpool_cvm_with_pcoc
+    B, S, L, E = 1, 1, 2, 9      # 7 leading + 2 embedx
+    seg = np.zeros(S * L, np.int32)
+    tok = np.array([
+        [2.0, 1.0, 4.0, 2.0, 3.0, 0.0, 0.0, 0.5, 0.25],
+        [1.0, 0.0, 2.0, 1.0, 1.0, 0.0, 0.0, 0.5, 0.75],
+    ], np.float32)[None]         # (1, 2, 9)
+    mask = np.ones((B, S * L), bool)
+    # cvm_offset=5 (show,clk,show2,clk2 + 1 pclk); max_cvm_offset=7
+    out = fused_seqpool_cvm_with_pcoc(
+        jnp.asarray(tok), jnp.asarray(mask), seg, S,
+        cvm_offset=5, max_cvm_offset=7, flatten=False)
+    pooled = tok[0].sum(0)       # [3, 1, 6, 3, 4, 0, 0, 1.0, 1.0]
+    lg = lambda v: np.log(v + 1.0)
+    want = [lg(3), lg(1) - lg(3),
+            lg(4) - lg(6),       # pclk vs show2
+            lg(4) - lg(3),       # pclk vs clk2
+            1.0, 1.0]            # embedx passthrough
+    np.testing.assert_allclose(np.asarray(out)[0, 0], want, rtol=1e-6)
+    # update phase: embedx only
+    out_u = fused_seqpool_cvm_with_pcoc(
+        jnp.asarray(tok), jnp.asarray(mask), seg, S, use_cvm=False,
+        cvm_offset=5, max_cvm_offset=7, flatten=False)
+    np.testing.assert_allclose(np.asarray(out_u)[0, 0], [1.0, 1.0],
+                               rtol=1e-6)
