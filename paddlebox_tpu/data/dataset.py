@@ -77,6 +77,14 @@ class SlotDataset:
                  else SlotRecordBatch.empty(self.schema))
         if global_shuffle and batch.num > 0:
             batch = self._global_shuffle(batch, routing)
+        # UnrollInstance hook (data_set.cc:2356, data_feed.cc:3304): like
+        # the reference, unrolling is plugin-defined — a parser plugin may
+        # carry an `unroll(SlotRecordBatch) -> SlotRecordBatch` attribute
+        # (e.g. expanding PV-merged page views back into instances) applied
+        # once after load/shuffle.
+        unroll = getattr(self.parser_plugin, "unroll", None)
+        if unroll is not None and batch.num > 0:
+            batch = unroll(batch)
         # STAT_ADD counters, like data_feed's feasign stats (monitor.h:129)
         stat_add("dataset.records_loaded", batch.num)
         stat_add("dataset.feasigns_loaded",

@@ -199,3 +199,31 @@ def test_slots_shuffle_preserves_counts():
     ds.slots_shuffle(["slot_1"], seed=1)
     after = np.sort(ds.records.sparse_values[1])
     np.testing.assert_array_equal(before, after)
+
+
+def test_parser_plugin_unroll_hook(tmp_path):
+    """UnrollInstance equivalent: a parser plugin's `unroll` attribute runs
+    once after load (data_set.cc:2356 delegates to the plugin the same way)."""
+    schema = make_schema()
+    lines = make_lines(schema, 6)
+    p = tmp_path / "f.txt"
+    p.write_text("\n".join(lines) + "\n")
+
+    def plugin(lns, sch):
+        return parse_multislot_lines(list(lns), sch)
+
+    calls = []
+
+    def unroll(batch):
+        calls.append(batch.num)
+        # duplicate every instance (a PV unroll shape)
+        idx = np.repeat(np.arange(batch.num), 2)
+        return batch.select(idx)
+
+    plugin.unroll = unroll
+    ds = SlotDataset(schema)
+    ds.set_filelist([str(p)])
+    ds.set_parser_plugin(plugin)
+    ds.load_into_memory(global_shuffle=False)
+    assert calls == [6]
+    assert ds.num_examples == 12
