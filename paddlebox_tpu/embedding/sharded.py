@@ -119,35 +119,51 @@ def _route(idx: jnp.ndarray, rows_per_shard: int, n_shards: int, cap: int):
     is the (n_shards, cap) per-destination index buffer (−1 = empty lane).
     Tokens beyond a destination's capacity are dropped (monitor with
     `routed_dropped`).
+
+    NULL_INDEX (masked/padding) tokens are never routed: they want the
+    zero row, which every consumer synthesizes locally — and on row-0's
+    shard they would otherwise flood the capacity lanes and crowd out
+    real tokens (a batch is often 20-40% padding).
     """
     n = idx.shape[0]
-    owner = idx // rows_per_shard
+    owner = jnp.where(idx == NULL_INDEX, n_shards, idx // rows_per_shard)
     order = jnp.argsort(owner)
     sidx = idx[order]
     sowner = owner[order]
-    counts = jnp.bincount(owner, length=n_shards)
+    counts = jnp.bincount(owner, length=n_shards + 1)
     starts = jnp.cumsum(counts) - counts
     pos = jnp.arange(n, dtype=jnp.int32) - starts[sowner]
-    valid = pos < cap
+    valid = (pos < cap) & (sowner < n_shards)
     send_idx = jnp.full((n_shards, cap), -1, dtype=idx.dtype)
+    # sowner == n_shards (null group) lands out of bounds → dropped
     send_idx = send_idx.at[sowner, pos].set(sidx, mode="drop")
     return order, sowner, pos, valid, send_idx
 
 
 def routed_lookup(table_shard: jnp.ndarray, idx: jnp.ndarray,
                   cfg: EmbeddingConfig, axis_name,
-                  capacity_factor: float = 2.0) -> jnp.ndarray:
+                  capacity_factor: float = 2.0,
+                  dedup: bool = False) -> jnp.ndarray:
     """Distributed gather inside shard_map.
 
     table_shard : (rows_per_shard, row_width) this device's contiguous shard
     idx         : (n,) int32 *global* working-set indices for this device's
                   local batch tokens
+    dedup       : route each unique token once and re-expand after the
+                  gather (FLAGS_enable_pullpush_dedup_keys). The dedup sort
+                  costs more than a whole single-chip step (~6ms at 213k
+                  tokens on one v5e), so enable it only where all_to_all
+                  volume is the binding cost.
     Returns (n, pull_width).
     """
     n = idx.shape[0]
     D = _axis_size(axis_name)
     if D == 1:  # single shard: no routing, one direct gather
         return lookup(table_shard, idx, cfg)
+    if dedup:
+        uniq, inverse = dedup_tokens(idx)
+        return routed_lookup(table_shard, uniq, cfg, axis_name,
+                             capacity_factor)[inverse]
     rps = table_shard.shape[0]
     cap = _capacity(n, D, capacity_factor)
     order, sowner, pos, valid, send_idx = _route(idx, rps, D, cap)
@@ -157,7 +173,8 @@ def routed_lookup(table_shard: jnp.ndarray, idx: jnp.ndarray,
     vals = vals.reshape(D, cap, cfg.pull_width)
     vals = jnp.where((recv_idx >= 0)[:, :, None], vals, 0.0)
     back = lax.all_to_all(vals, axis_name, 0, 0, tiled=True)
-    gathered = back[sowner, jnp.minimum(pos, cap - 1)]
+    # null-group rows (sowner == D) are clamped then zeroed by `valid`
+    gathered = back[jnp.minimum(sowner, D - 1), jnp.minimum(pos, cap - 1)]
     gathered = jnp.where(valid[:, None], gathered, 0.0)
     out = jnp.zeros((n, cfg.pull_width), gathered.dtype).at[order].set(gathered)
     return out
@@ -166,12 +183,28 @@ def routed_lookup(table_shard: jnp.ndarray, idx: jnp.ndarray,
 def routed_push(table_shard: jnp.ndarray, idx: jnp.ndarray,
                 grads: jnp.ndarray, shows: jnp.ndarray, clks: jnp.ndarray,
                 cfg: EmbeddingConfig, axis_name,
-                capacity_factor: float = 2.0) -> jnp.ndarray:
-    """Distributed merge-update inside shard_map (reverse of routed_lookup)."""
+                capacity_factor: float = 2.0,
+                dedup: bool = False) -> jnp.ndarray:
+    """Distributed merge-update inside shard_map (reverse of routed_lookup).
+
+    dedup merges per-token payloads onto unique tokens with ONE
+    concatenated scatter-add before routing (see routed_lookup on when it
+    pays; masked tokens carry zero payloads so their merge onto the null
+    slot is a no-op)."""
     n = idx.shape[0]
     D = _axis_size(axis_name)
     if D == 1:
         return push(table_shard, idx, grads, shows, clks, cfg)
+    if dedup:
+        uniq, inverse = dedup_tokens(idx)
+        payload = jnp.concatenate(
+            [grads, shows[:, None], clks[:, None]], axis=1)
+        merged = jnp.zeros((uniq.shape[0], payload.shape[1]),
+                           payload.dtype).at[inverse].add(payload)
+        gw = cfg.grad_width
+        return routed_push(table_shard, uniq, merged[:, :gw],
+                           merged[:, gw], merged[:, gw + 1], cfg,
+                           axis_name, capacity_factor)
     rps = table_shard.shape[0]
     cap = _capacity(n, D, capacity_factor)
     order, sowner, pos, valid, send_idx = _route(idx, rps, D, cap)
@@ -197,11 +230,13 @@ def routed_push(table_shard: jnp.ndarray, idx: jnp.ndarray,
 
 def routed_dropped(idx: jnp.ndarray, rows_per_shard: int, n_shards: int,
                    capacity_factor: float = 2.0) -> jnp.ndarray:
-    """Number of tokens that exceed per-destination capacity (monitoring)."""
+    """Number of tokens that exceed per-destination capacity (monitoring).
+
+    Null/padding tokens are not routed (see _route) and do not count."""
     n = idx.shape[0]
     cap = _capacity(n, n_shards, capacity_factor)
-    owner = idx // rows_per_shard
-    counts = jnp.bincount(owner, length=n_shards)
+    owner = jnp.where(idx == NULL_INDEX, n_shards, idx // rows_per_shard)
+    counts = jnp.bincount(owner, length=n_shards)  # null group falls off
     return jnp.maximum(counts - cap, 0).sum()
 
 

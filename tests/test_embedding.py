@@ -422,9 +422,14 @@ def test_translate_empty_working_set():
 
 
 def test_routed_dropped_counts():
-    idx = jnp.asarray([0, 0, 0, 0, 8, 9], dtype=jnp.int32)
-    # 2 shards of 8 rows, capacity factor 1.0 -> cap=3 per dest; 4 tokens to
-    # shard 0 -> 1 dropped
+    idx = jnp.asarray([1, 2, 3, 4, 8, 9], dtype=jnp.int32)
+    # 2 shards of 8 rows, capacity factor 1.0 -> cap=3 per dest; 4 real
+    # tokens to shard 0 -> 1 dropped
     n = sharded.routed_dropped(idx, rows_per_shard=8, n_shards=2,
                                capacity_factor=1.0)
     assert int(n) == 1
+    # null/padding tokens are not routed and never count against capacity
+    idx2 = jnp.asarray([0, 0, 0, 0, 8, 9], dtype=jnp.int32)
+    n2 = sharded.routed_dropped(idx2, rows_per_shard=8, n_shards=2,
+                                capacity_factor=1.0)
+    assert int(n2) == 0

@@ -125,3 +125,30 @@ def test_check_nan_inf_raises(mesh8):
                                auc_buckets=1 << 10))
     with pytest.raises(FloatingPointError):
         tr.train_pass(ds)
+
+
+def test_dedup_flag_equivalence(mesh8):
+    """pullpush_dedup_keys merges duplicate tokens before the all_to_all;
+    results must match the non-dedup path exactly."""
+    from paddlebox_tpu.config import flags
+    from paddlebox_tpu.embedding import EmbeddingConfig, HostEmbeddingStore
+
+    ds, schema = synth_dataset(512, seed=11)
+    results = {}
+    old = flags.pullpush_dedup_keys
+    try:
+        for on in (True, False):
+            flags.pullpush_dedup_keys = on
+            store = HostEmbeddingStore(
+                EmbeddingConfig(dim=8, learning_rate=0.15))
+            tr = Trainer(DeepFMModel(num_slots=NUM_SLOTS, emb_dim=8,
+                                     dense_dim=1, hidden=(16, 8)),
+                         store, schema, mesh8,
+                         TrainerConfig(global_batch_size=128,
+                                       dense_lr=3e-3))
+            results[on] = tr.train_pass(ds)
+    finally:
+        flags.pullpush_dedup_keys = old
+    assert abs(results[True]["loss_mean"]
+               - results[False]["loss_mean"]) < 1e-5
+    assert abs(results[True]["auc"] - results[False]["auc"]) < 1e-6
