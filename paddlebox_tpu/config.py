@@ -36,6 +36,11 @@ class Flags:
     pullpush_dedup_keys: bool = True        # FLAGS_enable_pullpush_dedup_keys
     pull_padding_zero: bool = True          # FLAGS_enable_pull_box_padding_zero
     use_replica_cache: bool = False         # FLAGS_use_gpu_replica_cache (flags.cc:486)
+    # Pass-boundary transfer compression: embedx crosses host<->device as
+    # bf16 (counters/opt state stay f32). TPU-native analogue of the
+    # reference's Quant/ShowClk quantized feature types; rounds embedx to
+    # 8 mantissa bits once per pass boundary. Opt-in.
+    transfer_compress_embedx: bool = False  # (new)
     embedding_max_keys_per_pass: int = 1 << 26  # (new) working-set capacity guard
 
     # --- trainer (trainer_desc.proto:100-108, flags.cc:591-597) ---

@@ -20,15 +20,80 @@ TPU equivalent:
 
 from __future__ import annotations
 
+import functools
+
 import numpy as np
 
 import jax
 import jax.numpy as jnp
 
+from paddlebox_tpu.config import flags
 from paddlebox_tpu.embedding.config import EmbeddingConfig
 from paddlebox_tpu.embedding.store import HostEmbeddingStore
 from paddlebox_tpu.native.key_index import KeyIndex
 from paddlebox_tpu.parallel import mesh as mesh_lib
+
+
+# ---------------------------------------------------------------------------
+# Pass-boundary transfer compression (Flags.transfer_compress_embedx).
+#
+# The reference's Quant/ShowClk feature types store embedx quantized inside
+# the PS to cut memory and transfer (box_wrapper.cu pull variants). The
+# TPU-native analogue compresses the TRANSFER, not the compute: embedx
+# columns cross host<->device as bfloat16 (counters/w/optimizer state stay
+# f32 — counters above 2^8 would round), and the device table is f32
+# everywhere the step touches it. Each pass boundary rounds embedx to 8
+# mantissa bits — the same concession the reference's int16 quant makes,
+# gentler. Opt-in.
+# ---------------------------------------------------------------------------
+
+def _split_cols(cfg: EmbeddingConfig):
+    e = cfg.embedx_cols
+    return e.start, e.stop
+
+
+@functools.lru_cache(maxsize=None)
+def _combine_jit(lo: int, hi: int, sharding):
+    def combine(rest, emb):
+        return jnp.concatenate(
+            [rest[:, :lo], emb.astype(jnp.float32), rest[:, lo:]], axis=1)
+    # cached per (cols, sharding) so pass boundaries reuse one executable
+    # per table shape instead of recompiling every pass
+    if sharding is not None:
+        return jax.jit(combine, out_shardings=sharding)
+    return jax.jit(combine)
+
+
+@functools.lru_cache(maxsize=None)
+def _split_jit(lo: int, hi: int):
+    def split(t):
+        rest = jnp.concatenate([t[:, :lo], t[:, hi:]], axis=1)
+        return rest, t[:, lo:hi].astype(jnp.bfloat16)
+    return jax.jit(split)
+
+
+def _put_compressed(host_table: np.ndarray, cfg: EmbeddingConfig, sharding):
+    lo, hi = _split_cols(cfg)
+    rest = np.concatenate([host_table[:, :lo], host_table[:, hi:]], axis=1)
+    emb = host_table[:, lo:hi].astype(jnp.bfloat16.dtype)  # ml_dtypes
+    if sharding is not None:
+        rest_d = jax.device_put(rest, sharding)
+        emb_d = jax.device_put(emb, sharding)
+    else:
+        rest_d, emb_d = jnp.asarray(rest), jnp.asarray(emb)
+    return _combine_jit(lo, hi, sharding)(rest_d, emb_d)
+
+
+def _get_compressed(table, cfg: EmbeddingConfig) -> np.ndarray:
+    lo, hi = _split_cols(cfg)
+    rest_d, emb_d = _split_jit(lo, hi)(table)
+    rest = np.asarray(jax.device_get(rest_d))
+    emb = np.asarray(jax.device_get(emb_d)).astype(np.float32)
+    out = np.empty((table.shape[0], hi - lo + rest.shape[1]), np.float32)
+    out[:, :lo] = rest[:, :lo]
+    out[:, lo:hi] = emb
+    out[:, hi:] = rest[:, lo:]
+    return out
 
 
 class PassWorkingSet:
@@ -75,8 +140,11 @@ class PassWorkingSet:
         n_pad = rps * n_shards
         host_table = np.zeros((n_pad, cfg.row_width), dtype=np.float32)
         host_table[1:1 + len(keys)] = rows
-        if mesh is not None:
-            sharding = mesh_lib.table_sharding(mesh)
+        sharding = (mesh_lib.table_sharding(mesh) if mesh is not None
+                    else None)
+        if flags.transfer_compress_embedx and cfg.total_dim:
+            table = _put_compressed(host_table, cfg, sharding)
+        elif sharding is not None:
             table = jax.device_put(host_table, sharding)
         else:
             table = jnp.asarray(host_table)
@@ -105,7 +173,10 @@ class PassWorkingSet:
                  table: jax.Array | None = None) -> None:
         """Persist the (possibly updated) device table back to the host store."""
         t = table if table is not None else self.table
-        host = np.asarray(jax.device_get(t))
+        if flags.transfer_compress_embedx and self.cfg.total_dim:
+            host = _get_compressed(t, self.cfg)
+        else:
+            host = np.asarray(jax.device_get(t))
         store.write_back(self.sorted_keys, host[1:1 + self.num_keys])
 
     # convenience for single-host training loops

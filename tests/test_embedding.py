@@ -433,3 +433,62 @@ def test_routed_dropped_counts():
     n2 = sharded.routed_dropped(idx2, rows_per_shard=8, n_shards=2,
                                 capacity_factor=1.0)
     assert int(n2) == 0
+
+
+def test_transfer_compress_embedx_roundtrip(mesh8):
+    """Flags.transfer_compress_embedx: pass boundaries ship embedx as bf16;
+    counters/w/opt state stay exact, embedx within bf16 tolerance, and a
+    training pass still works."""
+    from paddlebox_tpu.config import flags as cfg_flags
+    from paddlebox_tpu.embedding.working_set import PassWorkingSet
+
+    old = cfg_flags.transfer_compress_embedx
+    cfg_flags.transfer_compress_embedx = True
+    try:
+        cfg = EmbeddingConfig(dim=8, optimizer="adagrad")
+        s = HostEmbeddingStore(cfg)
+        rng = np.random.default_rng(0)
+        keys = rng.choice(1 << 40, 200, replace=False).astype(np.uint64)
+        rows = s.lookup_or_init(keys)
+        rows[:, 0] = rng.integers(0, 100_000, 200)   # large counters
+        rows[:, 1] = rng.integers(0, 50_000, 200)
+        rows[:, 2] = rng.normal(size=200)
+        rows[:, cfg.embedx_cols] = rng.normal(size=(200, cfg.total_dim))
+        s.write_back(keys, rows)
+        before = s.get_rows(keys)
+
+        ws = PassWorkingSet.begin_pass(s, keys, mesh8)
+        ws.end_pass(s)
+        after = s.get_rows(keys)
+        # counters/w/opt exact — including counters far beyond bf16's 2^8
+        np.testing.assert_array_equal(after[:, :3], before[:, :3])
+        # embedx within bf16 rounding
+        np.testing.assert_allclose(after[:, cfg.embedx_cols],
+                                   before[:, cfg.embedx_cols],
+                                   rtol=1 / 128)
+        assert np.abs(after[:, cfg.embedx_cols]
+                      - before[:, cfg.embedx_cols]).max() > 0  # really bf16
+
+        # training under the flag matches the uncompressed baseline
+        from test_train_e2e import synth_dataset, NUM_SLOTS
+        from paddlebox_tpu.models import DNNCTRModel
+        from paddlebox_tpu.train import Trainer, TrainerConfig
+
+        def run():
+            ds, schema = synth_dataset(512, seed=5)
+            store2 = HostEmbeddingStore(EmbeddingConfig(dim=8,
+                                                        learning_rate=0.15))
+            tr = Trainer(DNNCTRModel(num_slots=NUM_SLOTS, emb_dim=8,
+                                     dense_dim=1, hidden=(16,)),
+                         store2, schema, mesh8,
+                         TrainerConfig(global_batch_size=128,
+                                       dense_lr=3e-3))
+            return [tr.train_pass(ds) for _ in range(2)][-1]
+
+        r_on = run()
+        cfg_flags.transfer_compress_embedx = False
+        r_off = run()
+        assert abs(r_on["auc"] - r_off["auc"]) < 0.02, (r_on, r_off)
+        assert abs(r_on["loss_mean"] - r_off["loss_mean"]) < 0.01
+    finally:
+        cfg_flags.transfer_compress_embedx = old
