@@ -144,6 +144,54 @@ class SlotDataset:
             rec.sparse_values[s] = rng.permutation(rec.sparse_values[s])
             del lens
 
+    def merge_by_ins_id(self, merge_size: int = 0) -> int:
+        """Merge examples sharing an ins_id into one (MergeByInsId,
+        reference data_set.cc:1012): sort by ins_id, group, and concatenate
+        each group's sparse slot values member-by-member. With
+        ``merge_size > 0``, groups whose size differs are DROPPED (the
+        reference's strict mode — e.g. exactly one click log + one show
+        log per instance). Float slots and metadata come from the group's
+        first member. Returns the number of dropped examples."""
+        assert self.records is not None
+        r = self.records
+        if r.num == 0:
+            return 0
+        if not r.ins_id.any():
+            raise ValueError(
+                "merge_by_ins_id needs real instance ids; load with "
+                "with_ins_id=True (all ins_id are 0 — merging would "
+                "collapse the whole dataset into one group)")
+        order = np.argsort(r.ins_id, kind="stable")
+        ids = r.ins_id[order]
+        starts = np.flatnonzero(
+            np.concatenate([[True], ids[1:] != ids[:-1]]))
+        sizes = np.diff(np.append(starts, len(ids)))
+        keep = (sizes == merge_size) if merge_size > 0 \
+            else np.ones(len(starts), bool)
+        dropped = int(sizes[~keep].sum())
+        kept_groups = [(starts[g], sizes[g]) for g in np.flatnonzero(keep)]
+        if not kept_groups:
+            self.records = SlotRecordBatch.empty(self.schema)
+            stat_add("dataset.merge_by_ins_id_dropped", dropped)
+            return dropped
+        # one ragged gather via select(), then collapse offsets at group
+        # boundaries (offsets are cumulative, so the group's span is just
+        # the offsets sampled at member boundaries)
+        member_rows = np.concatenate(
+            [order[st:st + sz] for st, sz in kept_groups])
+        picked = r.select(member_rows)
+        bounds = np.cumsum([0] + [sz for _, sz in kept_groups])
+        firsts = r.select(np.asarray([order[st] for st, _ in kept_groups]))
+        self.records = SlotRecordBatch(
+            schema=r.schema, num=len(kept_groups),
+            sparse_values=picked.sparse_values,
+            sparse_offsets=[off[bounds] for off in picked.sparse_offsets],
+            float_values=firsts.float_values,
+            ins_id=firsts.ins_id, search_id=firsts.search_id,
+            rank=firsts.rank, cmatch=firsts.cmatch)
+        stat_add("dataset.merge_by_ins_id_dropped", dropped)
+        return dropped
+
     def merge_by_search_id(self) -> np.ndarray:
         """Group examples into page views (PV merge, reference MergePvInstance):
         returns group ids per example ordered so same-search_id examples are

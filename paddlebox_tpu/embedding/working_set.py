@@ -52,7 +52,7 @@ def _split_cols(cfg: EmbeddingConfig):
     return e.start, e.stop
 
 
-@functools.lru_cache(maxsize=None)
+@functools.lru_cache(maxsize=8)  # bounded: each entry retains its Mesh
 def _combine_jit(lo: int, hi: int, sharding):
     def combine(rest, emb):
         return jnp.concatenate(

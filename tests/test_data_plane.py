@@ -227,3 +227,36 @@ def test_parser_plugin_unroll_hook(tmp_path):
     ds.load_into_memory(global_shuffle=False)
     assert calls == [6]
     assert ds.num_examples == 12
+
+
+def test_merge_by_ins_id():
+    """MergeByInsId semantics (data_set.cc:1012): groups concatenated,
+    wrong-size groups dropped under merge_size."""
+    schema = DataFeedSchema([
+        Slot("label", SlotType.FLOAT, max_len=1),
+        Slot("s0", SlotType.UINT64, max_len=8),
+    ])
+    lines = ["1 1.0 2 10 11", "1 0.0 1 20", "1 1.0 2 12 13",
+             "1 0.0 1 21", "1 1.0 1 30"]
+    ds = SlotDataset(schema)
+    ds.records = parse_multislot_lines(lines, schema)
+    # assign ins_ids: rows 0,2 share A; rows 1,3 share B; row 4 alone
+    ds.records.ins_id[:] = [7, 8, 7, 8, 9]
+
+    dropped = ds.merge_by_ins_id(merge_size=2)
+    assert dropped == 1          # the singleton group (ins 9)
+    assert ds.num_examples == 2
+    r = ds.records
+    merged = {int(r.ins_id[i]):
+              sorted(r.sparse_values[0][r.sparse_offsets[0][i]:
+                                        r.sparse_offsets[0][i + 1]].tolist())
+              for i in range(r.num)}
+    assert merged[7] == [10, 11, 12, 13]
+    assert merged[8] == [20, 21]
+
+    # merge_size=0: merge everything, drop nothing
+    ds2 = SlotDataset(schema)
+    ds2.records = parse_multislot_lines(lines, schema)
+    ds2.records.ins_id[:] = [7, 8, 7, 8, 9]
+    assert ds2.merge_by_ins_id() == 0
+    assert ds2.num_examples == 3
