@@ -93,10 +93,9 @@ def main() -> int:
             ds.load_into_memory(global_shuffle=False)
             box.begin_pass()
             stats = tr.train_pass(ds, metrics=box.metrics)
-            info = box.end_pass(
-                need_save_delta=True,
-                delta_path=os.path.join(
-                    fleet.delta_dir(day, box.pass_id), "sparse"))
+            info = box.end_pass()
+            # single delta writer: save_delta clears the dirty mask, so a
+            # second save of the same pass would always be empty
             fleet.save_delta_model(store, tr.eval_params(), day,
                                    box.pass_id)
             msg = box.get_metric_msg("auc")

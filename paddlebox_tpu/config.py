@@ -42,6 +42,13 @@ class Flags:
     # 8 mantissa bits once per pass boundary. Opt-in.
     transfer_compress_embedx: bool = False  # (new)
     embedding_max_keys_per_pass: int = 1 << 26  # (new) working-set capacity guard
+    # Routed all_to_all capacity overflow policy (new — the reference sizes
+    # buffers dynamically, box_wrapper_impl.h:44-81; fixed lanes are the
+    # static-shape trade). Drops are counted per pass and NEVER silent:
+    # fatal raises at pass end; adapt doubles Trainer capacity_factor for
+    # the next pass (bounded by the shard count, which cannot drop).
+    routed_drop_fatal: bool = False         # (new)
+    routed_drop_adapt: bool = True          # (new)
 
     # --- trainer (trainer_desc.proto:100-108, flags.cc:591-597) ---
     param_sync_step: int = 1                # BoxPSWorkerParameter.sync_dense_step

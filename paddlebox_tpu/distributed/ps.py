@@ -505,11 +505,32 @@ class RemoteEmbeddingStore:
     while the device-side lookup/push path stays identical.
     """
 
+    # the PS table is SHARED between trainers: device-resident reuse and
+    # lazy write-back would hide other trainers' pushes, so FeedPassManager
+    # must rebuild from the PS each pass and write back eagerly
+    supports_resident_reuse = False
+
     def __init__(self, client: PSClient, table: str, cfg: EmbeddingConfig):
         self.client = client
         self.table = table
         self.cfg = cfg
         client.create_sparse_table(table, cfg)
+        self._flush_hooks: list = []
+        self._mutations = 0
+
+    # FeedPassManager surface (store.py): flush hooks let a lazy device
+    # tier sync before shrink/save read row values; mutation_count gates
+    # resident-row reuse across passes.
+    @property
+    def mutation_count(self) -> int:
+        return self._mutations
+
+    def register_flush_hook(self, fn) -> None:
+        self._flush_hooks.append(fn)
+
+    def _run_flush_hooks(self) -> None:
+        for fn in list(self._flush_hooks):
+            fn()
 
     def lookup_or_init(self, keys: np.ndarray) -> np.ndarray:
         return self.client.pull_sparse(self.table, keys, init_missing=True,
@@ -523,12 +544,16 @@ class RemoteEmbeddingStore:
         self.client.write_rows(self.table, keys, rows)
 
     def save_base(self, path: str) -> list[str]:
+        self._run_flush_hooks()
         return self.client.save(self.table, path, mode="base")
 
     def save_delta(self, path: str) -> list[str]:
+        self._run_flush_hooks()
         return self.client.save(self.table, path, mode="delta")
 
     def shrink(self, min_show: float, decay: float = 1.0) -> int:
+        self._run_flush_hooks()
+        self._mutations += 1
         return self.client.shrink(self.table, min_show, decay)
 
 

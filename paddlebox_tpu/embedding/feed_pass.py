@@ -1,0 +1,449 @@
+"""Incremental, overlapped pass-boundary working-set transfer.
+
+The reference's BoxHelper runs FeedPass in background threads between
+``BeginFeedPass`` and ``WaitFeedPassDone`` (box_wrapper.h:994-1072),
+overlapping the SSD→HBM table build of pass N+1 with the training of pass N
+(paired with the dataset's PreLoadIntoMemory, data_set.cc:1712); at EndPass
+only the pass delta is applied in the PS (box_wrapper.h:423).
+
+TPU-native equivalent — :class:`FeedPassManager`:
+
+- **Resident-row reuse.** The previous pass's device table is retained; the
+  next pass's table is built ON DEVICE from it with one gather/select, so
+  rows present in both passes never cross host↔device again. Only the
+  *fresh* keys' rows are fetched from the host store and shipped H2D.
+- **Lazy write-back.** The device table is the authoritative hot tier
+  during training (exactly the reference's model: EndPass applies the pass
+  in the PS — box_wrapper.h:423 — and only SaveDelta materializes bytes).
+  ``end_pass`` moves NOTHING D2H; it marks the pass's touched rows
+  *unsynced*. Rows cross D2H only when they (a) retire from the working
+  set at the next ``begin_pass`` (keys absent from the new pass), or
+  (b) a ``flush()`` runs — which the host store triggers automatically
+  before save_base/save_delta/export_serving/shrink via its flush hooks.
+  The pass boundary therefore moves O(key-churn delta), not O(table).
+- **Overlap.** ``begin_feed_pass(next_keys)`` runs the key diff + host
+  fetch + H2D staging on a background thread while the current pass trains;
+  ``wait_feed_pass_done()`` joins (the BeginFeedPass/WaitFeedPassDone pair,
+  box_helper_py.cc:44-54). The remaining boundary work is one device-side
+  combine plus the retiring-row D2H.
+
+Reuse is invalidated automatically when the host store mutates outside the
+pass cycle (shrink / load / delta replay — ``store.mutation_count``): a
+shrunk-away key must not resurrect from a stale device row. On such a
+mutation any not-yet-flushed device rows are discarded (the external
+restore/shrink wins), matching pass-granularity recovery semantics.
+"""
+
+from __future__ import annotations
+
+import functools
+import threading
+import time
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+import weakref
+
+from paddlebox_tpu.config import flags
+from paddlebox_tpu.embedding.store import HostEmbeddingStore
+from paddlebox_tpu.embedding.working_set import (PassWorkingSet, bucket_size,
+                                                 fetch_rows, transfer_bytes,
+                                                 _put_compressed)
+from paddlebox_tpu.parallel import mesh as mesh_lib
+from paddlebox_tpu.utils.profiler import stat_add, stat_set
+
+
+@functools.lru_cache(maxsize=8)
+def _combine_jit(out_sharding, donate: bool):
+    """new_table[i] = fresh[src[i]] if is_fresh[i] else prev[src[i]].
+
+    One device-side gather+select builds pass N+1's table from pass N's —
+    the H2D path only ever carries fresh rows. Cached per (sharding,
+    donate); shapes retrace inside jit and are bounded by bucket_size.
+    """
+    def combine(prev, fresh, src, is_fresh):
+        from_prev = prev[jnp.where(is_fresh, 0, src)]
+        from_fresh = fresh[jnp.where(is_fresh, src, 0)]
+        return jnp.where(is_fresh[:, None], from_fresh, from_prev)
+
+    kw: dict = {"donate_argnums": (0,)} if donate else {}
+    if out_sharding is not None:
+        kw["out_shardings"] = out_sharding
+    return jax.jit(combine, **kw)
+
+
+class _Staging:
+    """Result of one feed pass: fresh rows staged on device + the diff."""
+
+    __slots__ = ("keys", "pos_prev", "fresh_dev", "n_fresh", "h2d_bytes",
+                 "prev", "store_gen", "full_ws")
+
+    def __init__(self, **kw):
+        for k in self.__slots__:
+            setattr(self, k, kw.get(k))
+
+
+class FeedPassManager:
+    """Owns the persistent device working set across passes."""
+
+    def __init__(self, store: HostEmbeddingStore,
+                 mesh: jax.sharding.Mesh | None = None,
+                 min_rows_per_shard: int = 8):
+        self.store = store
+        self.mesh = mesh
+        self.min_rows_per_shard = min_rows_per_shard
+        # stores shared between trainers (RemoteEmbeddingStore) forbid
+        # resident reuse/lazy write-back — rebuild + eager write-back
+        self._eager = not getattr(store, "supports_resident_reuse", True)
+        self._current: PassWorkingSet | None = None
+        self._gen = -1                    # store.mutation_count at retain
+        # rows of _current whose device values are fresher than the store
+        # (flushed on retirement / save / shrink — lazy write-back)
+        self._unsynced: np.ndarray | None = None
+        self._thread: threading.Thread | None = None
+        self._staged: _Staging | None = None
+        self._feed_error: BaseException | None = None
+        # set while a training pass has the table donated step to step; a
+        # flush then would gather from a dead buffer, so it must refuse
+        self._in_pass = False
+        # the store flushes us before any operation that reads row values
+        # (save_base/save_delta/export_serving/shrink). WeakMethod: a
+        # garbage-collected manager must not pin its device table via the
+        # store's hook list forever.
+        ref = weakref.WeakMethod(self.flush)
+
+        def hook():
+            fn = ref()
+            if fn is not None:
+                fn()
+
+        self._hook = hook
+        store.register_flush_hook(hook)
+        # observability (also mirrored into the global StatRegistry)
+        self.last_h2d_bytes = 0
+        self.last_d2h_bytes = 0
+        self.last_fresh_rows = 0
+        self.last_reused_rows = 0
+        self.last_boundary_seconds = 0.0
+
+    # -- helpers -----------------------------------------------------------
+
+    def _n_shards(self) -> int:
+        return mesh_lib.num_shards(self.mesh) if self.mesh is not None else 1
+
+    def _tbl_sharding(self):
+        return (mesh_lib.table_sharding(self.mesh)
+                if self.mesh is not None else None)
+
+    def _repl_sharding(self):
+        return (mesh_lib.replicated_sharding(self.mesh)
+                if self.mesh is not None else None)
+
+    def _reuse_valid(self) -> bool:
+        return (not self._eager and self._current is not None
+                and self.store.mutation_count == self._gen)
+
+    # -- feed pass (BeginFeedPass / WaitFeedPassDone) ----------------------
+
+    def begin_feed_pass(self, keys: np.ndarray) -> None:
+        """Stage pass N+1's working set on a background thread while pass N
+        trains. Safe concurrently with training: it reads only the current
+        pass's key index (lookups, no inserts) and the host store (under
+        the store lock), and dispatches async H2D of the fresh rows."""
+        self.wait_feed_pass_done()        # one feed in flight at a time
+        keys = np.unique(np.asarray(keys).astype(np.uint64))
+        prev = self._current if self._reuse_valid() else None
+        gen = self.store.mutation_count
+
+        def run():
+            try:
+                self._staged = self._stage(keys, prev, gen)
+            except BaseException as e:    # re-raised at the join
+                self._feed_error = e
+
+        self._thread = threading.Thread(target=run, daemon=True,
+                                        name="pbtpu-feed-pass")
+        self._thread.start()
+
+    def wait_feed_pass_done(self) -> None:
+        t = self._thread
+        if t is not None:
+            t.join()
+            self._thread = None
+        if self._feed_error is not None:
+            e, self._feed_error = self._feed_error, None
+            self._staged = None
+            raise e
+
+    def _stage(self, keys: np.ndarray, prev: PassWorkingSet | None,
+               gen: int, test_mode: bool = False) -> _Staging:
+        """Diff `keys` against `prev` and put the fresh rows on device.
+        With prev=None, stages the full build instead. Runs on the feed
+        thread (train semantics) or synchronously (incl. eval peek)."""
+        cfg = self.store.cfg
+        if prev is None:
+            # nothing to diff against: stage the FULL build (still overlaps
+            # the whole host fetch + H2D with whatever the caller is doing)
+            ws = PassWorkingSet.begin_pass(
+                self.store, keys, self.mesh,
+                min_rows_per_shard=self.min_rows_per_shard,
+                test_mode=test_mode, bucket_rows=True)
+            return _Staging(keys=ws.sorted_keys, prev=None, store_gen=gen,
+                            full_ws=ws, n_fresh=len(ws.sorted_keys),
+                            h2d_bytes=transfer_bytes(cfg, ws.padded_rows))
+        pos = prev._tindex.lookup(keys)            # -1 = fresh
+        fresh_keys = keys[pos < 0]
+        fresh_rows = (self.store.peek_rows(fresh_keys) if test_mode
+                      else self.store.lookup_or_init(fresh_keys))
+        n_fresh = len(fresh_keys)
+        n_fresh_pad = bucket_size(max(1, n_fresh))
+        staged = np.zeros((n_fresh_pad, cfg.row_width), np.float32)
+        staged[:n_fresh] = fresh_rows
+        repl = self._repl_sharding()
+        if flags.transfer_compress_embedx and cfg.total_dim:
+            fresh_dev = _put_compressed(staged, cfg, repl)
+        elif repl is not None:
+            fresh_dev = jax.device_put(staged, repl)
+        else:
+            fresh_dev = jnp.asarray(staged)
+        return _Staging(keys=keys, pos_prev=pos, fresh_dev=fresh_dev,
+                        n_fresh=n_fresh,
+                        h2d_bytes=transfer_bytes(cfg, n_fresh_pad),
+                        prev=prev, store_gen=gen, full_ws=None)
+
+    # -- pass lifecycle ----------------------------------------------------
+
+    def begin_pass(self, keys: np.ndarray,
+                   test_mode: bool = False) -> PassWorkingSet:
+        """Materialize the pass working set, reusing resident device rows.
+
+        Consumes a matching staged feed pass if one exists; otherwise does
+        the same work synchronously. test_mode passes (eval) reuse resident
+        rows but never insert into the store, never donate the retained
+        table, and are not themselves retained (SetTestMode semantics).
+        """
+        t0 = time.perf_counter()
+        keys = np.unique(np.asarray(keys).astype(np.uint64))
+        staged = self._take_staging(keys, test_mode)
+        prev = self._current if self._reuse_valid() else None
+        if prev is None and self._current is not None:
+            # store mutated under us (shrink/restore) — the external state
+            # wins; stale device rows must not leak back (pass-granularity
+            # recovery semantics)
+            self._current = None
+            self._unsynced = None
+        if staged is not None and staged.full_ws is not None:
+            ws = staged.full_ws
+            self._account_begin(staged.h2d_bytes, 0, staged.n_fresh, 0, t0)
+            if not self._eager:
+                self._retain(ws)
+            return ws
+        if prev is None:
+            ws = PassWorkingSet.begin_pass(
+                self.store, keys, self.mesh,
+                min_rows_per_shard=self.min_rows_per_shard,
+                test_mode=test_mode, bucket_rows=True)
+            self._account_begin(transfer_bytes(self.store.cfg,
+                                               ws.padded_rows), 0,
+                                len(ws.sorted_keys), 0, t0)
+            if not test_mode and not self._eager:
+                self._retain(ws)
+            return ws
+        if staged is None:
+            staged = self._stage(keys, prev, self.store.mutation_count,
+                                 test_mode=test_mode)
+        d2h = 0
+        if not test_mode:
+            d2h = self._writeback_retiring(prev, keys)
+        ws, carried = self._combine(staged, test_mode)
+        self._account_begin(staged.h2d_bytes, d2h, staged.n_fresh,
+                            len(keys) - staged.n_fresh, t0)
+        if not test_mode:
+            self._retain(ws, carried)
+        return ws
+
+    def _writeback_retiring(self, prev: PassWorkingSet,
+                            new_keys: np.ndarray) -> int:
+        """Ship rows that are unsynced AND leaving the working set D2H —
+        their device copy is about to be dropped, and it is the only fresh
+        copy. Rows staying resident stay lazy. Returns bytes moved."""
+        if self._unsynced is None or not self._unsynced.any():
+            return 0
+        k = prev.num_keys
+        row_ids = np.flatnonzero(self._unsynced[1:1 + k]) + 1
+        pkeys = prev.sorted_keys[row_ids - 1]
+        # retiring = unsynced keys absent from the new pass (both sorted)
+        pos = np.searchsorted(new_keys, pkeys)
+        pos[pos >= len(new_keys)] = 0
+        staying = len(new_keys) > 0
+        if staying:
+            present = new_keys[pos] == pkeys
+        else:
+            present = np.zeros(len(pkeys), bool)
+        retiring = row_ids[~present]
+        if len(retiring) == 0:
+            return 0
+        rows, nbytes = fetch_rows(prev.table, retiring, self.store.cfg)
+        self.store.write_back(prev.sorted_keys[retiring - 1], rows)
+        self._unsynced[retiring] = False
+        stat_add("feed_pass.retired_rows", len(retiring))
+        return nbytes
+
+    def flush(self) -> int:
+        """Write every unsynced resident row back to the host store (the
+        SaveDelta materialization point). Registered as a store flush hook,
+        so save_base/save_delta/export_serving/shrink see fresh values
+        without callers having to know about the device tier.
+
+        Not legal while a training pass is open: the trainer donates the
+        table buffer every step, so a mid-pass gather could read a dead
+        buffer. Save/export/shrink belong between passes (the reference
+        has the same discipline — EndPass precedes SaveDelta)."""
+        ws = self._current
+        if (ws is None or ws.table is None or self._unsynced is None
+                or not self._unsynced.any()):
+            return 0
+        if self._in_pass:
+            raise RuntimeError(
+                "sparse flush (store save/export/shrink/get_rows) while a "
+                "training pass is open — finish the pass first")
+        if self.store.mutation_count != self._gen:
+            # the store was externally rewritten (restore/replay) since we
+            # retained — stale device rows must not overwrite it
+            self._unsynced[:] = False
+            return 0
+        k = ws.num_keys
+        row_ids = np.flatnonzero(self._unsynced[1:1 + k]) + 1
+        rows, nbytes = fetch_rows(ws.table, row_ids, self.store.cfg)
+        self.store.write_back(ws.sorted_keys[row_ids - 1], rows)
+        self._unsynced[:] = False
+        self.last_d2h_bytes += nbytes
+        stat_add("feed_pass.d2h_bytes", nbytes)
+        stat_add("feed_pass.flushed_rows", len(row_ids))
+        return nbytes
+
+    def _take_staging(self, keys: np.ndarray,
+                      test_mode: bool) -> _Staging | None:
+        self.wait_feed_pass_done()
+        staged, self._staged = self._staged, None
+        if staged is None:
+            return None
+        if test_mode:
+            # a staged feed inserted its fresh keys (train semantics);
+            # keep it for the next train pass instead of consuming it
+            self._staged = staged
+            return None
+        if (staged.store_gen != self.store.mutation_count
+                or staged.prev is not (self._current
+                                       if self._reuse_valid() else None)
+                or len(staged.keys) != len(keys)
+                or not np.array_equal(staged.keys, keys)):
+            return None                   # preloaded keys don't match
+        return staged
+
+    def _combine(self, staged: _Staging, test_mode: bool
+                 ) -> tuple[PassWorkingSet, np.ndarray]:
+        cfg = self.store.cfg
+        prev = staged.prev
+        keys = staged.keys
+        pos = staged.pos_prev
+        n_shards = self._n_shards()
+        need = len(keys) + 1
+        rps = bucket_size(max(self.min_rows_per_shard, -(-need // n_shards)))
+        n_pad = rps * n_shards
+        src = np.zeros(n_pad, np.int32)
+        is_fresh = np.zeros(n_pad, bool)
+        fresh_slot = np.cumsum(pos < 0) - 1     # row in fresh_dev, key order
+        k = len(keys)
+        src[1:1 + k] = np.where(pos >= 0, pos + 1, fresh_slot)
+        is_fresh[1:1 + k] = pos < 0
+        fn = _combine_jit(self._tbl_sharding(), donate=not test_mode)
+        table = fn(prev.table, staged.fresh_dev, src, is_fresh)
+        # carry the unsynced marks of resident rows into their new slots —
+        # their only fresh copy still lives on device
+        carried = np.zeros(n_pad, bool)
+        if self._unsynced is not None:
+            resident = pos >= 0
+            carried[1:1 + k][resident] = \
+                self._unsynced[pos[resident] + 1]
+        if not test_mode:
+            prev.table = None             # donated away
+        return PassWorkingSet(cfg, keys, table, rps, n_shards), carried
+
+    def end_pass(self, ws: PassWorkingSet, table: jax.Array | None = None,
+                 ) -> int:
+        """Close the pass: retain the device table (the authoritative hot
+        tier) and mark its touched rows unsynced. NO data moves here — the
+        reference's EndPass likewise applies the pass inside the PS
+        (box_wrapper.h:423); bytes materialize at retirement or flush."""
+        t0 = time.perf_counter()
+        if table is not None:
+            ws.table = table
+        if self._eager:
+            nbytes = ws.end_pass(self.store, ws.table)
+            self.last_d2h_bytes = nbytes
+            self.last_boundary_seconds = time.perf_counter() - t0
+            stat_add("feed_pass.d2h_bytes", nbytes)
+            return nbytes
+        if ws is not self._current:
+            self._retain(ws)
+        if self._unsynced is None or len(self._unsynced) != len(ws.touched):
+            self._unsynced = np.zeros_like(ws.touched)
+        np.logical_or(self._unsynced, ws.touched, out=self._unsynced)
+        self.last_d2h_bytes = 0
+        self.last_boundary_seconds = time.perf_counter() - t0
+        stat_set("feed_pass.last_dirty_rows", int(ws.touched.sum()))
+        return 0
+
+    def pass_opened(self) -> None:
+        """Trainer hook: the table is now being donated step-to-step;
+        flushes must refuse until pass_closed()."""
+        self._in_pass = True
+
+    def pass_closed(self) -> None:
+        self._in_pass = False
+
+    def drop(self) -> None:
+        """Flush pending rows, then release the retained device table
+        (frees its HBM; the next pass falls back to a full host build)."""
+        self.wait_feed_pass_done()
+        self.flush()
+        self._staged = None
+        self._current = None
+        self._unsynced = None
+        self._gen = -1
+
+    def close(self) -> None:
+        """Flush, release the device tier, and detach from the store's
+        flush hooks. After close() the manager must not be used; a NEW
+        manager on the same store starts clean (two live managers on one
+        HostEmbeddingStore are not supported — use an eager/shared store
+        for multi-trainer setups)."""
+        self.drop()
+        unregister = getattr(self.store, "unregister_flush_hook", None)
+        if unregister is not None:
+            unregister(self._hook)
+
+    # -- bookkeeping -------------------------------------------------------
+
+    def _retain(self, ws: PassWorkingSet,
+                carried: np.ndarray | None = None) -> None:
+        self._current = ws
+        self._gen = self.store.mutation_count
+        self._unsynced = (carried if carried is not None
+                          else np.zeros_like(ws.touched))
+
+    def _account_begin(self, h2d: int, d2h: int, fresh: int, reused: int,
+                       t0: float) -> None:
+        self.last_h2d_bytes = h2d
+        self.last_d2h_bytes = d2h
+        self.last_fresh_rows = fresh
+        self.last_reused_rows = reused
+        self.last_boundary_seconds = time.perf_counter() - t0
+        stat_add("feed_pass.h2d_bytes", h2d)
+        stat_add("feed_pass.d2h_bytes", d2h)
+        stat_set("feed_pass.last_fresh_rows", fresh)
+        stat_set("feed_pass.last_reused_rows", reused)

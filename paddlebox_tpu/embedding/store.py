@@ -35,6 +35,9 @@ from paddlebox_tpu.native.key_index import KeyIndex
 
 class HostEmbeddingStore:
     _GROW = 1.5
+    # single-trainer-owned: the device tier may retain rows across passes
+    # and write back lazily (see embedding/feed_pass.py)
+    supports_resident_reuse = True
 
     def __init__(self, cfg: EmbeddingConfig, initial_capacity: int = 1024):
         self.cfg = cfg
@@ -46,6 +49,32 @@ class HostEmbeddingStore:
         self._tombstones: set[int] = set()  # evicted since last save
         self._lock = threading.Lock()
         self._save_seq = 0
+        # bumped whenever rows change OUTSIDE the pass pull/push cycle
+        # (shrink/remove/delta replay) — consumers holding device-resident
+        # copies of rows (FeedPassManager) use it to invalidate reuse
+        self._mutations = 0
+
+        # called before any operation that READS row values for persistence
+        # or hygiene (save/export/shrink): lets a device-resident hot tier
+        # (FeedPassManager) write its unsynced rows back first, so lazy
+        # write-back is invisible to checkpoint/serving consumers
+        self._flush_hooks: list = []
+
+    @property
+    def mutation_count(self) -> int:
+        return self._mutations
+
+    def register_flush_hook(self, fn) -> None:
+        self._flush_hooks.append(fn)
+
+    def unregister_flush_hook(self, fn) -> None:
+        if fn in self._flush_hooks:
+            self._flush_hooks.remove(fn)
+
+    def _run_flush_hooks(self) -> None:
+        # outside the lock: hooks call write_back, which takes it
+        for fn in list(self._flush_hooks):
+            fn()
 
     def __len__(self) -> int:
         return self._n
@@ -122,6 +151,9 @@ class HostEmbeddingStore:
         return rows
 
     def get_rows(self, keys: np.ndarray) -> np.ndarray:
+        # user-facing read: make lazily-written device rows visible first
+        # (no-op unless a FeedPassManager holds unsynced rows)
+        self._run_flush_hooks()
         keys = np.asarray(keys).astype(np.uint64)
         with self._lock:
             idx = self._lookup_strict(keys)
@@ -171,7 +203,9 @@ class HostEmbeddingStore:
 
         Returns the number of evicted rows.
         """
+        self._run_flush_hooks()
         with self._lock:
+            self._mutations += 1
             if decay != 1.0:
                 self._rows[:self._n, 0] *= decay
                 # decayed counters must reach the next delta checkpoint
@@ -204,12 +238,14 @@ class HostEmbeddingStore:
         content of the reference's "xbox" serving model (SaveBase's xbox
         plane, box_wrapper.cc:1387-1420), minus its binary container.
         """
+        self._run_flush_hooks()
         with self._lock:
             keys = self._keys[:self._n].copy()
             vals = self._rows[:self._n, :self.cfg.pull_width].copy()
         return keys, vals
 
     def save_base(self, path: str) -> str:
+        self._run_flush_hooks()
         os.makedirs(path, exist_ok=True)
         with self._lock:
             fname = os.path.join(path, "base.npz")
@@ -222,6 +258,7 @@ class HostEmbeddingStore:
         return fname
 
     def save_delta(self, path: str) -> str:
+        self._run_flush_hooks()
         os.makedirs(path, exist_ok=True)
         with self._lock:
             self._save_seq += 1
@@ -270,10 +307,13 @@ class HostEmbeddingStore:
         for d in deltas[:meta["save_seq"]]:
             store.apply_delta_file(os.path.join(path, d))
         store._save_seq = meta["save_seq"]
+        # replayed state == on-disk state; nothing is pending for a delta
+        store._dirty[:store._n] = False
         return store
 
     def _remove(self, keys: np.ndarray) -> None:
         with self._lock:
+            self._mutations += 1
             present = self._index.lookup(keys) >= 0
             if not present.any():
                 return
@@ -290,6 +330,7 @@ class HostEmbeddingStore:
 
     def _ingest(self, keys: np.ndarray, rows: np.ndarray) -> None:
         with self._lock:
+            self._mutations += 1
             keys = np.asarray(keys).astype(np.uint64)
             idx, added = self._index.lookup_or_insert(keys)
             if added:
@@ -300,11 +341,13 @@ class HostEmbeddingStore:
                 res = np.isin(keys, tomb)
                 if res.any():
                     # a re-added key is live again: drop its pending
-                    # tombstone AND dirty its row, so the next delta
-                    # carries the new value instead of load() resurrecting
-                    # the stale pre-eviction row (mirrors lookup_or_init)
-                    self._dirty[idx[res]] = True
+                    # tombstone (its row is dirtied below with the rest)
                     self._tombstones.difference_update(
                         int(k) for k in keys[res].tolist())
             # last occurrence wins for duplicate keys (replay order)
             self._rows[idx] = rows
+            # every ingested row diverges from whatever the last save
+            # captured — the next delta must carry it, or load(base + own
+            # deltas) restores the pre-replay value. load() clears the mask
+            # after replay so the first post-load delta stays small.
+            self._dirty[idx] = True

@@ -52,6 +52,30 @@ def _split_cols(cfg: EmbeddingConfig):
     return e.start, e.stop
 
 
+def transfer_bytes(cfg: EmbeddingConfig, n_rows: int) -> int:
+    """Host<->device bytes for `n_rows` full rows under the current
+    transfer-compression flag (embedx crosses as bf16 when enabled)."""
+    if flags.transfer_compress_embedx and cfg.total_dim:
+        lo, hi = _split_cols(cfg)
+        return n_rows * (4 * (cfg.row_width - (hi - lo)) + 2 * (hi - lo))
+    return n_rows * cfg.row_width * 4
+
+
+def bucket_size(x: int) -> int:
+    """Round up to ~quarter-power-of-two buckets (4 sizes per octave).
+
+    Pass working sets vary in size every pass; exact sizing would recompile
+    the train step (and every pass-boundary kernel) per pass. Bucketing
+    bounds the number of distinct compiled shapes to O(log N) while wasting
+    at most ~25% rows (zero rows are never indexed — translate only maps to
+    1..K — and the per-step table scan cost is bandwidth-linear)."""
+    if x <= 16:
+        return int(x)
+    p = 1 << (int(x).bit_length() - 1)
+    step = p >> 2
+    return -(-int(x) // step) * step
+
+
 @functools.lru_cache(maxsize=8)  # bounded: each entry retains its Mesh
 def _combine_jit(lo: int, hi: int, sharding):
     def combine(rest, emb):
@@ -96,6 +120,54 @@ def _get_compressed(table, cfg: EmbeddingConfig) -> np.ndarray:
     return out
 
 
+# ---------------------------------------------------------------------------
+# Row-subset D2H: ship only a set of rows (the pass delta) instead of the
+# whole table — the transfer side of the reference's EndPass-applies-delta
+# semantics (box_wrapper.h:423). The gather runs on device; only the
+# gathered rows cross the tunnel/PCIe.
+# ---------------------------------------------------------------------------
+
+@functools.lru_cache(maxsize=8)
+def _gather_rows_jit(compress: bool, lo: int, hi: int):
+    def gather(table, idx):
+        rows = table[idx]
+        if compress:
+            rest = jnp.concatenate([rows[:, :lo], rows[:, hi:]], axis=1)
+            return rest, rows[:, lo:hi].astype(jnp.bfloat16)
+        return rows
+    return jax.jit(gather)
+
+
+def fetch_rows(table: jax.Array, row_idx: np.ndarray,
+               cfg: EmbeddingConfig) -> tuple[np.ndarray, int]:
+    """Device-side gather of `row_idx` rows, then D2H of just those rows.
+
+    Returns (rows float32 (k, row_width), d2h_bytes). The index vector is
+    padded to a size bucket so repeated pass boundaries reuse a handful of
+    compiled gathers instead of recompiling per dirty-row count.
+    """
+    k = len(row_idx)
+    if k == 0:
+        return np.zeros((0, cfg.row_width), np.float32), 0
+    k_pad = bucket_size(k)
+    idxp = np.zeros(k_pad, np.int32)
+    idxp[:k] = row_idx
+    compress = bool(flags.transfer_compress_embedx and cfg.total_dim)
+    lo, hi = _split_cols(cfg)
+    out = _gather_rows_jit(compress, lo, hi)(table, idxp)
+    if compress:
+        rest_d, emb_d = out
+        rest = np.asarray(jax.device_get(rest_d))
+        emb_bf = np.asarray(jax.device_get(emb_d))
+        rows = np.empty((k_pad, cfg.row_width), np.float32)
+        rows[:, :lo] = rest[:, :lo]
+        rows[:, lo:hi] = emb_bf.astype(np.float32)
+        rows[:, hi:] = rest[:, lo:]
+        return rows[:k], rest.nbytes + emb_bf.nbytes
+    rows = np.asarray(jax.device_get(out))
+    return rows[:k], rows.nbytes
+
+
 class PassWorkingSet:
     def __init__(self, cfg: EmbeddingConfig, sorted_keys: np.ndarray,
                  table: jax.Array, rows_per_shard: int, n_shards: int):
@@ -109,6 +181,11 @@ class PassWorkingSet:
         # sizes); ids follow sorted order so row mapping is unchanged
         self._tindex = KeyIndex(len(sorted_keys) or 1)
         self._tindex.rebuild(sorted_keys)
+        # host-side dirty-row mask: translate() records every row a batch
+        # referenced, so end_pass can ship only the pass delta D2H (the
+        # device never modifies a row that no batch indexed — push
+        # guarantees untouched rows keep their exact bits)
+        self.touched = np.zeros(self.padded_rows, dtype=bool)
 
     @property
     def num_keys(self) -> int:
@@ -124,11 +201,14 @@ class PassWorkingSet:
     def begin_pass(cls, store: HostEmbeddingStore, keys: np.ndarray,
                    mesh: jax.sharding.Mesh | None = None,
                    min_rows_per_shard: int = 8,
-                   test_mode: bool = False) -> "PassWorkingSet":
+                   test_mode: bool = False,
+                   bucket_rows: bool = False) -> "PassWorkingSet":
         """Build the pass working set on device (BeginFeedPass/EndFeedPass).
 
         test_mode=True reads rows without inserting unseen keys into the
-        store (eval passes must not grow or dirty it).
+        store (eval passes must not grow or dirty it). bucket_rows=True
+        rounds the per-shard row count up to a size bucket so consecutive
+        passes of similar size share compiled step shapes.
         """
         cfg = store.cfg
         keys = np.unique(np.asarray(keys).astype(np.uint64))
@@ -137,6 +217,8 @@ class PassWorkingSet:
         n_shards = mesh_lib.num_shards(mesh) if mesh is not None else 1
         need = len(keys) + 1                       # +1 for the null row
         rps = max(min_rows_per_shard, -(-need // n_shards))
+        if bucket_rows:
+            rps = bucket_size(rps)
         n_pad = rps * n_shards
         host_table = np.zeros((n_pad, cfg.row_width), dtype=np.float32)
         host_table[1:1 + len(keys)] = rows
@@ -163,21 +245,48 @@ class PassWorkingSet:
             idx = np.zeros(ids_arr.shape, dtype=np.int32)
             return idx
         flat = ids_arr.astype(np.uint64).reshape(-1)
-        pos = self._tindex.lookup(flat)      # -1 = not in this pass
+        if self._tindex.is_native:
+            pos = self._tindex.lookup(flat)  # -1 = not in this pass
+        else:
+            # dict-backed KeyIndex would loop per key; the keys are already
+            # sorted, so a vectorized searchsorted is the fast host path
+            pos = np.searchsorted(self.sorted_keys, flat)
+            pos[pos >= len(self.sorted_keys)] = 0
+            pos = np.where(self.sorted_keys[pos] == flat, pos, -1)
         idx = (pos + 1).astype(np.int32).reshape(ids_arr.shape)
         if mask is not None:
             idx = np.where(mask, idx, 0).astype(np.int32)
+        # record the pass delta: every row this batch will pull/push
+        self.touched[idx.reshape(-1)] = True
+        self.touched[0] = False          # null row is never persisted
         return idx
 
     def end_pass(self, store: HostEmbeddingStore,
-                 table: jax.Array | None = None) -> None:
-        """Persist the (possibly updated) device table back to the host store."""
+                 table: jax.Array | None = None,
+                 only_touched: bool | None = None) -> int:
+        """Persist the (possibly updated) device table back to the host store.
+
+        only_touched=None (default) ships just the rows translate() recorded
+        when any were recorded — the incremental EndPass (box_wrapper.h:423:
+        only the pass delta moves) — and falls back to a full write-back for
+        working sets that never went through translate (direct-table tests).
+        Returns the number of bytes moved D2H.
+        """
         t = table if table is not None else self.table
+        use_touched = (self.touched.any() if only_touched is None
+                       else only_touched)
+        if use_touched:
+            dirty = np.flatnonzero(self.touched[1:1 + self.num_keys]) + 1
+            rows, nbytes = fetch_rows(t, dirty, self.cfg)
+            store.write_back(self.sorted_keys[dirty - 1], rows)
+            return nbytes
         if flags.transfer_compress_embedx and self.cfg.total_dim:
             host = _get_compressed(t, self.cfg)
         else:
             host = np.asarray(jax.device_get(t))
+        nbytes = transfer_bytes(self.cfg, t.shape[0])
         store.write_back(self.sorted_keys, host[1:1 + self.num_keys])
+        return nbytes
 
     # convenience for single-host training loops
     def update_table(self, table: jax.Array) -> None:

@@ -12,6 +12,7 @@
 // own lock, matching how it already guarded the dict.
 
 #include <cstdint>
+#include <cstdio>
 #include <cstdlib>
 #include <cstring>
 
@@ -37,19 +38,36 @@ struct KeyIndex {
   // such restriction and the two backends must agree)
   int64_t sentinel_val = -1;
 
-  void alloc(uint64_t c) {
+  // Returns false (state unchanged) if the OS refuses the allocation —
+  // multi-GB tables must surface OOM, not dereference nullptr.
+  bool alloc(uint64_t c) {
+    auto* nk = static_cast<uint64_t*>(std::malloc(c * sizeof(uint64_t)));
+    auto* nv = static_cast<int64_t*>(std::malloc(c * sizeof(int64_t)));
+    if (nk == nullptr || nv == nullptr) {
+      std::free(nk);
+      std::free(nv);
+      return false;
+    }
     cap = c;
     mask = c - 1;
-    keys = static_cast<uint64_t*>(std::malloc(c * sizeof(uint64_t)));
-    vals = static_cast<int64_t*>(std::malloc(c * sizeof(int64_t)));
+    keys = nk;
+    vals = nv;
     std::memset(keys, 0xFF, c * sizeof(uint64_t));  // all kEmpty
+    return true;
   }
 
   void grow() {
     uint64_t old_cap = cap;
     uint64_t* old_keys = keys;
     int64_t* old_vals = vals;
-    alloc(cap * 2);
+    if (!alloc(cap * 2)) {
+      // mid-insert there is no error channel back through the batch API;
+      // fail loudly rather than corrupt the table
+      std::fprintf(stderr,
+                   "keyindex: out of memory growing to %llu slots\n",
+                   static_cast<unsigned long long>(cap * 2));
+      std::abort();
+    }
     for (uint64_t i = 0; i < old_cap; ++i) {
       if (old_keys[i] != kEmpty) {
         uint64_t s = splitmix64(old_keys[i]) & mask;
@@ -78,7 +96,10 @@ void* ki_create(int64_t capacity_hint) {
   auto* ki = new KeyIndex();
   uint64_t c = 1024;
   while (static_cast<int64_t>(c) < capacity_hint * 2) c <<= 1;
-  ki->alloc(c);
+  if (!ki->alloc(c)) {
+    delete ki;
+    return nullptr;  // ctypes layer falls back to the dict backend
+  }
   return ki;
 }
 
@@ -142,7 +163,14 @@ void ki_rebuild(void* h, const uint64_t* ks, int64_t n) {
   while (static_cast<int64_t>(c) < n * 2) c <<= 1;
   std::free(ki->keys);
   std::free(ki->vals);
-  ki->alloc(c);
+  ki->keys = nullptr;
+  ki->vals = nullptr;
+  if (!ki->alloc(c)) {
+    std::fprintf(stderr,
+                 "keyindex: out of memory rebuilding with %llu slots\n",
+                 static_cast<unsigned long long>(c));
+    std::abort();
+  }
   ki->size = 0;
   ki->sentinel_val = -1;
   for (int64_t i = 0; i < n; ++i) {

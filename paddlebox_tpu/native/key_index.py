@@ -52,8 +52,14 @@ class KeyIndex:
         self._lib = None if force_python else get_lib()
         if self._lib is not None:
             self._h = self._lib.ki_create(int(capacity_hint))
-        else:
+            if not self._h:  # native allocation failed → dict fallback
+                self._lib = None
+        if self._lib is None:
             self._d: dict[int, int] = {}
+
+    @property
+    def is_native(self) -> bool:
+        return self._lib is not None
 
     def __del__(self):
         lib = getattr(self, "_lib", None)

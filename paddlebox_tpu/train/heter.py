@@ -157,37 +157,65 @@ class HeterTrainer:
 
         q: queue.Queue = queue.Queue(maxsize=cfg.prefetch_depth)
         stop = object()
+        cancel = threading.Event()
         producer_errors: list[BaseException] = []
 
         def producer():
             try:
                 for pb in dataset.batches(cfg.global_batch_size,
                                           drop_last=True):
-                    q.put(self._host_pull(pb))
+                    item = self._host_pull(pb)
+                    while not cancel.is_set():
+                        try:
+                            q.put(item, timeout=0.2)
+                            break
+                        except queue.Full:
+                            continue
+                    if cancel.is_set():
+                        return
             except BaseException as e:
                 # surfaced after the loop — a pass must not silently
                 # complete on truncated data (reader failures are
                 # fail-stop, like the reference's PADDLE_ENFORCE path)
                 producer_errors.append(e)
             finally:
-                q.put(stop)
+                # blocking-put the sentinel (cancel-aware): dropping it on a
+                # momentarily-full queue would strand the consumer in q.get
+                while not cancel.is_set():
+                    try:
+                        q.put(stop, timeout=0.2)
+                        break
+                    except queue.Full:
+                        continue
 
         t = threading.Thread(target=producer, daemon=True)
         t.start()
-        while True:
-            item = q.get()
-            if item is stop:
-                break
-            uniq, inverse, pulled, mask, dense, labels = item
-            self.params, self.opt_state, loss, preds, sgrad = self._step(
-                self.params, self.opt_state, jnp.asarray(pulled),
-                jnp.asarray(mask), jnp.asarray(dense), jnp.asarray(labels))
-            self._host_push(uniq, inverse, mask, labels, np.asarray(sgrad))
-            with jax.default_device(self._cpu):
-                auc_acc.update(auc_fn, np.asarray(preds), labels)
-            losses.append(float(loss))
-            self.global_step += 1
-        t.join()
+        try:
+            while True:
+                item = q.get()
+                if item is stop:
+                    break
+                uniq, inverse, pulled, mask, dense, labels = item
+                self.params, self.opt_state, loss, preds, sgrad = self._step(
+                    self.params, self.opt_state, jnp.asarray(pulled),
+                    jnp.asarray(mask), jnp.asarray(dense),
+                    jnp.asarray(labels))
+                self._host_push(uniq, inverse, mask, labels,
+                                np.asarray(sgrad))
+                with jax.default_device(self._cpu):
+                    auc_acc.update(auc_fn, np.asarray(preds), labels)
+                losses.append(float(loss))
+                self.global_step += 1
+        finally:
+            # a consumer error must not strand the producer blocked on
+            # q.put holding pulled batches — cancel, drain, then join
+            cancel.set()
+            while t.is_alive():
+                try:
+                    q.get_nowait()
+                except queue.Empty:
+                    t.join(timeout=0.05)
+            t.join()
         if producer_errors:
             raise producer_errors[0]
         out = auc_acc.compute()

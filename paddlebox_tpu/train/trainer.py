@@ -31,6 +31,7 @@ from paddlebox_tpu.data.schema import DataFeedSchema
 from paddlebox_tpu.data.slot_record import PackedBatch, SparseLayout
 from paddlebox_tpu.embedding import (EmbeddingConfig, HostEmbeddingStore,
                                      PassWorkingSet, sharded)
+from paddlebox_tpu.embedding.feed_pass import FeedPassManager
 from paddlebox_tpu.metrics import auc as auc_lib
 from paddlebox_tpu.parallel import dense_sync
 from paddlebox_tpu.train import optimizers
@@ -151,6 +152,9 @@ class Trainer:
             self.params = jax.device_put(init_params, repl)
             self.opt_state = jax.device_put(self.tx.init(init_params), repl)
         self.timers = StageTimers(["read", "translate", "train", "auc"])
+        # incremental + overlapped pass boundaries (BoxHelper FeedPass):
+        # resident device rows are reused across passes, write-back is lazy
+        self.feed_mgr = FeedPassManager(store, mesh)
         self._step_fn = self._build_train_step()
         self._eval_fn = self._build_eval_step()
         self._auc_fn = jax.jit(auc_lib.auc_update)
@@ -199,8 +203,9 @@ class Trainer:
         def core(tshard, idx_l, mask_l, dense_l, labels_l, params):
             B_l = idx_l.shape[0]
             flat_idx = idx_l.reshape(-1)
-            pulled = sharded.routed_lookup(tshard, flat_idx, emb_cfg,
-                                           axes, capf, dedup=dedup)
+            pulled, dropped = sharded.routed_lookup(
+                tshard, flat_idx, emb_cfg, axes, capf, dedup=dedup,
+                return_dropped=True)
             pulled = pulled.reshape(B_l, T, emb_cfg.pull_width)
 
             def loss_fn(p, pulled_in):
@@ -224,7 +229,11 @@ class Trainer:
             new_shard = sharded.routed_push(tshard, flat_idx, sgrad,
                                             show_inc, clk_inc, emb_cfg,
                                             axes, capf, dedup=dedup)
-            return new_shard, gp, loss, preds
+            # capacity-drop monitor: global count of tokens the fixed-size
+            # all_to_all lanes could not carry this step (push routes the
+            # same tokens at the same capacity, so one count covers both)
+            dropped_g = lax.psum(dropped, axes)
+            return new_shard, gp, loss, preds, dropped_g
 
         return core
 
@@ -245,13 +254,14 @@ class Trainer:
             def body(tshard, idx_l, mask_l, dense_l, labels_l, p_st, o_st):
                 p = jax.tree.map(lambda a: a[0], p_st)
                 o = jax.tree.map(lambda a: a[0], o_st)
-                new_shard, gp, loss, preds = core(
+                new_shard, gp, loss, preds, drop_g = core(
                     tshard, idx_l, mask_l, dense_l, labels_l, p)
                 updates, new_o = tx.update(gp, o, p)
                 new_p = optax.apply_updates(p, updates)
                 loss_g = lax.pmean(loss, axes)
                 lift = lambda t: jax.tree.map(lambda a: a[None], t)
-                return new_shard, lift(new_p), lift(new_o), loss_g, preds
+                return (new_shard, lift(new_p), lift(new_o), loss_g, preds,
+                        drop_g)
 
             def step(table, params, opt_state, idx, mask, dense, labels):
                 return jax.shard_map(
@@ -259,12 +269,13 @@ class Trainer:
                     in_specs=(batch_spec, batch_spec, batch_spec, batch_spec,
                               batch_spec, batch_spec, batch_spec),
                     out_specs=(batch_spec, batch_spec, batch_spec, P(),
-                               batch_spec),
+                               batch_spec, P()),
                 )(table, idx, mask, dense, labels, params, opt_state)
 
             return jax.jit(step, donate_argnums=(0, 1, 2),
                            out_shardings=(tbl_sh, self._stacked_sh,
-                                          self._stacked_sh, repl, bat_sh))
+                                          self._stacked_sh, repl, bat_sh,
+                                          repl))
 
         if mode == "async":
             # grads are globally averaged and returned flat; the host-side
@@ -272,48 +283,49 @@ class Trainer:
             from jax.flatten_util import ravel_pytree
 
             def body(tshard, idx_l, mask_l, dense_l, labels_l, params):
-                new_shard, gp, loss, preds = core(
+                new_shard, gp, loss, preds, drop_g = core(
                     tshard, idx_l, mask_l, dense_l, labels_l, params)
                 gp = _mean_replicated_grad(gp, axes)
                 loss_g = lax.pmean(loss, axes)
-                return new_shard, gp, loss_g, preds
+                return new_shard, gp, loss_g, preds, drop_g
 
             def step(table, params, idx, mask, dense, labels):
-                new_table, gp, loss, preds = jax.shard_map(
+                new_table, gp, loss, preds, drop_g = jax.shard_map(
                     body, mesh=self.mesh,
                     in_specs=(batch_spec, batch_spec, batch_spec, batch_spec,
                               batch_spec, P()),
-                    out_specs=(batch_spec, P(), P(), batch_spec),
+                    out_specs=(batch_spec, P(), P(), batch_spec, P()),
                 )(table, idx, mask, dense, labels, params)
                 gp_flat = ravel_pytree(gp)[0]
-                return new_table, gp_flat, loss, preds
+                return new_table, gp_flat, loss, preds, drop_g
 
             return jax.jit(step, donate_argnums=(0,),
-                           out_shardings=(tbl_sh, repl, repl, bat_sh))
+                           out_shardings=(tbl_sh, repl, repl, bat_sh, repl))
 
         def body(tshard, idx_l, mask_l, dense_l, labels_l, params):
-            new_shard, gp, loss, preds = core(
+            new_shard, gp, loss, preds, drop_g = core(
                 tshard, idx_l, mask_l, dense_l, labels_l, params)
             gp = _mean_replicated_grad(gp, axes)
             loss_g = lax.pmean(loss, axes)
-            return new_shard, gp, loss_g, preds
+            return new_shard, gp, loss_g, preds, drop_g
 
         def step(table, params, opt_state, idx, mask, dense, labels):
-            new_table, gp, loss, preds = jax.shard_map(
+            new_table, gp, loss, preds, drop_g = jax.shard_map(
                 body, mesh=self.mesh,
                 in_specs=(batch_spec, batch_spec, batch_spec, batch_spec,
                           batch_spec, P()),
-                out_specs=(batch_spec, P(), P(), batch_spec),
+                out_specs=(batch_spec, P(), P(), batch_spec, P()),
             )(table, idx, mask, dense, labels, params)
             updates, new_opt = tx.update(gp, opt_state, params)
             new_params = optax.apply_updates(params, updates)
-            return new_table, new_params, new_opt, loss, preds
+            return new_table, new_params, new_opt, loss, preds, drop_g
 
         # Donation aliases the (large) table and the dense state in place;
         # pinned out_shardings make output signatures identical to the inputs
         # so the train_pass feedback loop never retraces.
         return jax.jit(step, donate_argnums=(0, 1, 2),
-                       out_shardings=(tbl_sh, repl, repl, repl, bat_sh))
+                       out_shardings=(tbl_sh, repl, repl, repl, bat_sh,
+                                      repl))
 
     def _build_param_sync(self) -> Callable:
         """K-step parameter averaging (SyncParam, boxps_worker.cc:481-521).
@@ -352,13 +364,13 @@ class Trainer:
 
         def body(tshard, idx_l, mask_l, dense_l, params):
             B_l = idx_l.shape[0]
-            pulled = sharded.routed_lookup(tshard, idx_l.reshape(-1),
-                                           emb_cfg, axes, capf,
-                                           dedup=dedup)
+            pulled, dropped = sharded.routed_lookup(
+                tshard, idx_l.reshape(-1), emb_cfg, axes, capf,
+                dedup=dedup, return_dropped=True)
             pulled = pulled.reshape(B_l, T, emb_cfg.pull_width)
             logits = model.apply(params, pulled, mask_l, dense_l, seg,
                                  self.layout.num_slots)
-            return jax.nn.sigmoid(logits)
+            return jax.nn.sigmoid(logits), lax.psum(dropped, axes)
 
         batch_spec = P(axes)
 
@@ -367,7 +379,7 @@ class Trainer:
             return jax.shard_map(
                 body, mesh=self.mesh,
                 in_specs=(batch_spec, batch_spec, batch_spec, batch_spec, P()),
-                out_specs=batch_spec,
+                out_specs=(batch_spec, P()),
             )(table, idx, mask, dense, params)
 
         return step
@@ -392,8 +404,8 @@ class Trainer:
         AddAucMonitor hook (boxps_worker.cc:582).
         """
         cfg = self.cfg
-        ws = PassWorkingSet.begin_pass(self.store, dataset.unique_keys(),
-                                       self.mesh)
+        ws = self.feed_mgr.begin_pass(dataset.unique_keys())
+        self.feed_mgr.pass_opened()
         table = ws.table
         params, opt_state = self.params, self.opt_state
         auc_acc = auc_lib.AucAccumulator(cfg.auc_buckets)
@@ -406,6 +418,7 @@ class Trainer:
         repl = mesh_lib.replicated_sharding(self.mesh)
         pass_step = 0
         dev_losses: list[Any] = []
+        dev_dropped: list[Any] = []
         # DumpField stream: the PREVIOUS batch's (step, preds, labels) is
         # written each iteration — by then those arrays are ready, so the
         # D2H copy doesn't stall the freshly-dispatched step — and the
@@ -422,11 +435,12 @@ class Trainer:
                     if mode == "async":
                         params = jax.device_put(
                             self._unravel(self.dense_table.pull()), repl)
-                        table, gp_flat, loss, preds = self._step_fn(
+                        table, gp_flat, loss, preds, dropped = self._step_fn(
                             table, params, idx, mask, dense, labels)
                         self.dense_table.push(np.asarray(gp_flat))
                     else:
-                        table, params, opt_state, loss, preds = self._step_fn(
+                        (table, params, opt_state, loss, preds,
+                         dropped) = self._step_fn(
                             table, params, opt_state, idx, mask, dense,
                             labels)
                         pass_step += 1
@@ -434,6 +448,10 @@ class Trainer:
                                 and pass_step % cfg.param_sync_step == 0):
                             params, opt_state = self._sync_fn(params,
                                                               opt_state)
+                # keep the ws pointing at the live buffer: the step donates
+                # its input table, and a concurrent flush (store read/save
+                # from another thread) must never gather from a dead buffer
+                ws.table = table
                 with self.timers("auc"), RecordEvent("auc_update"):
                     auc_acc.update(self._auc_fn, preds, labels)
                     if metrics is not None:
@@ -458,6 +476,7 @@ class Trainer:
                         raise FloatingPointError(
                             f"nan/inf loss at step {self.global_step}")
                 dev_losses.append(loss)
+                dev_dropped.append(dropped)
                 self.global_step += 1
         finally:
             # The step donates table/params/opt_state, so the objects bound
@@ -466,6 +485,7 @@ class Trainer:
             # catches and resumes from checkpoint — the Trainer must stay
             # usable).
             ws.table = table
+            self.feed_mgr.pass_closed()
             if mode == "async":
                 self.dense_table.flush()
                 self.params = jax.device_put(
@@ -487,14 +507,62 @@ class Trainer:
                 except Exception as e:
                     import warnings
                     warnings.warn(f"dump stream failed: {e}")
-        ws.end_pass(self.store, table)
+        self.feed_mgr.end_pass(ws, table)
         losses = [float(l) for l in dev_losses]  # one sync, post-loop
         out = auc_acc.compute()
         out["loss_first"] = losses[0] if losses else float("nan")
         out["loss_last"] = losses[-1] if losses else float("nan")
         out["loss_mean"] = float(np.mean(losses)) if losses else float("nan")
         out["steps"] = len(losses)
+        out["routed_dropped"] = self._check_dropped(dev_dropped)
         return out
+
+    def _check_dropped(self, dev_dropped: list) -> int:
+        """Capacity-drop policy: never silent (the reference never drops —
+        it sizes its buffers dynamically, box_wrapper_impl.h:44-81; a fixed
+        all_to_all lane is the static-shape trade and must be observable).
+
+        Counts go to the StatRegistry; Flags.routed_drop_fatal raises, and
+        by default the capacity factor doubles for the NEXT pass (adaptive
+        static capacity — the recompile-across-passes analogue of the
+        reference's dynamic resize)."""
+        import warnings
+        from paddlebox_tpu.utils.profiler import stat_add
+        total = int(sum(int(d) for d in dev_dropped))
+        if not total:
+            return 0
+        stat_add("trainer.routed_dropped", total)
+        msg = (f"{total} tokens exceeded all_to_all capacity this pass "
+               f"(capacity_factor={self.cfg.capacity_factor}); their "
+               f"pulls returned zero rows and their grads were dropped")
+        if config_flags.routed_drop_fatal:
+            raise RuntimeError(msg)
+        if config_flags.routed_drop_adapt:
+            self.cfg.capacity_factor = min(float(self.n_shards),
+                                           self.cfg.capacity_factor * 2.0)
+            msg += (f"; raising capacity_factor to "
+                    f"{self.cfg.capacity_factor} for the next pass "
+                    f"(recompiles the step)")
+            self._step_fn = self._build_train_step()
+            self._eval_fn = self._build_eval_step()
+        warnings.warn(msg)
+        return total
+
+    def preload_pass(self, keys: np.ndarray) -> None:
+        """BeginFeedPass: stage the next pass's working set (key diff, host
+        fetch, H2D of fresh rows) on a background thread while the current
+        pass trains — box_wrapper.h:994-1072, paired with the dataset's
+        preload_into_memory (data_set.cc:1712)."""
+        self.feed_mgr.begin_feed_pass(keys)
+
+    def wait_feed_pass_done(self) -> None:
+        """Join the background feed pass (BoxHelper::WaitFeedPassDone)."""
+        self.feed_mgr.wait_feed_pass_done()
+
+    def flush_sparse(self) -> int:
+        """Force lazily-retained device rows back to the host store (runs
+        automatically before store save/export/shrink via flush hooks)."""
+        return self.feed_mgr.flush()
 
     def eval_params(self):
         """Replicated dense params for eval/export — collapses the kstep
@@ -550,16 +618,20 @@ class Trainer:
         """Test-mode pass: no pushes, no dense updates, and the store is
         neither grown nor dirtied by unseen keys (SetTestMode)."""
         bs = self.cfg.global_batch_size
-        ws = PassWorkingSet.begin_pass(self.store, dataset.unique_keys(),
-                                       self.mesh, test_mode=True)
+        ws = self.feed_mgr.begin_pass(dataset.unique_keys(), test_mode=True)
         auc_acc = auc_lib.AucAccumulator(self.cfg.auc_buckets)
+        dev_dropped = []
         for pb in dataset.batches(bs, drop_last=False):
             n_valid = len(pb.floats)
             if n_valid < bs:
                 pb = pb.pad_to(bs)  # tail batch: pad + mask, don't drop
             idx, mask, dense, labels = self._put_batch(ws, pb)
-            preds = self._eval_fn(ws.table, self.eval_params(), idx, mask,
-                                  dense)
+            preds, dropped = self._eval_fn(ws.table, self.eval_params(),
+                                           idx, mask, dense)
             valid = jnp.arange(bs) < n_valid
             auc_acc.update(self._auc_masked_fn, preds, labels, valid)
-        return auc_acc.compute()
+            dev_dropped.append(dropped)
+        out = auc_acc.compute()
+        # drops poison eval predictions too — same non-silent policy
+        out["routed_dropped"] = self._check_dropped(dev_dropped)
+        return out

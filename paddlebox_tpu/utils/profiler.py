@@ -174,6 +174,10 @@ def stat_get(name: str) -> float:
     return STATS.get(name)
 
 
+def stat_set(name: str, value: float) -> None:
+    STATS.set(name, value)
+
+
 # ---------------------------------------------------------------------------
 # nan/inf guard (details/nan_inf_utils)
 # ---------------------------------------------------------------------------

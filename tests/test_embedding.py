@@ -191,9 +191,14 @@ def test_working_set_translate_and_roundtrip():
     # device table row for key 7 equals store row
     np.testing.assert_allclose(
         np.asarray(ws.table)[idx[0, 0]], store.get_rows([7])[0], rtol=1e-6)
-    # mutate device table, end_pass persists
+    # mutate device table; default end_pass ships only the pass delta —
+    # the rows translate() recorded (keys 7 and 555), not untouched ones
     t = ws.table.at[:, 2].set(3.5)
     ws.end_pass(store, t)
+    np.testing.assert_allclose(store.get_rows([7, 555])[:, 2], 3.5)
+    np.testing.assert_allclose(store.get_rows([100, 31])[:, 2], 0.0)
+    # explicit full write-back persists every working-set row
+    ws.end_pass(store, t, only_touched=False)
     np.testing.assert_allclose(store.get_rows(keys)[:, 2], 3.5)
 
 

@@ -166,8 +166,10 @@ def test_trainer_on_remote_store(cluster):
             (idx, np.ones((16, T), bool),
              rng.normal(size=(16, 1)).astype(np.float32),
              (rng.random(16) < 0.5).astype(np.float32))]
-    table, params, opt, loss, preds = tr._step_fn(table, params, opt, *args)
+    table, params, opt, loss, preds, dropped = tr._step_fn(
+        table, params, opt, *args)
     assert np.isfinite(float(loss))
+    assert int(dropped) == 0
     ws.table = table
     ws.end_pass(store, table)
     # the trained rows landed back on the servers
