@@ -3,36 +3,95 @@
 Prints ONE JSON line:
   {"metric": ..., "value": N, "unit": "examples/sec/chip", "vs_baseline": N}
 
-vs_baseline is measured against the north-star target of 1M examples/sec/chip
-(BASELINE.md; the reference publishes no numbers of its own). The measured
-path is the full jitted train step: routed embedding lookup (all_to_all on
-multi-chip meshes, direct gather on one), DeepFM forward/backward, dense-grad
-pmean, sparse push with in-table adagrad, exactly as `Trainer` runs it.
-Host-side batch translate is pre-staged (the reference's log_for_profile
-likewise separates read/trans from cal time; boxps_worker.cc:746-759).
+Two measurements, reported side by side (VERDICT r1 #2):
+
+1. **device_step** — the jitted train step alone (routed embedding lookup,
+   DeepFM fwd/bwd, dense pmean, sparse push with in-table adagrad), batches
+   pre-staged on device. This is the device-path microbenchmark, the
+   analogue of the reference's `cal` time in log_for_profile
+   (boxps_worker.cc:746-759). It is NOT full-pipeline training throughput.
+2. **e2e** — full `Trainer.train_pass` over TWO passes from a pre-built
+   `.pbar` archive: working-set build (incremental on pass 2), per-batch
+   translate, H2D, step, AUC — everything except parse (archive is
+   pre-parsed, matching the reference's `read`/`trans`/`cal` split).
+
+**Timing discipline**: every window is terminated by a real D2H
+`device_get` of the final step's loss. `jax.block_until_ready` returns
+EARLY over the axon tunnel (measured: a 55-TFLOP matmul chain "completed"
+in 5ms, then the actual result took 2.9s to materialize), so any number
+blocked on it alone is fiction — including this bench's own round-1 output.
+
+**Self-audit**: the device-step number carries analytic FLOPs/step and
+HBM bytes/step, and the implied MFU / HBM fractions against the detected
+chip's peaks. An implied MFU > 60% means the measurement window is broken,
+not that the code is fast — the bench then exits non-zero.
+
+vs_baseline is measured against the north-star target of 1M examples/sec
+per chip (BASELINE.md; the reference publishes no numbers of its own).
 """
 
 from __future__ import annotations
 
 import json
+import os
+import sys
 import time
 
 import numpy as np
 
 TARGET_PER_CHIP = 1_000_000.0  # BASELINE.md north star
 
+# (bf16 matmul FLOP/s, HBM bytes/s) per device_kind substring
+PEAKS = {
+    "v5 lite": (197e12, 819e9),
+    "v5e": (197e12, 819e9),
+    "v5p": (459e12, 2765e9),
+    "v6 lite": (918e12, 1640e9),
+    "v6e": (918e12, 1640e9),
+    "v4": (275e12, 1228e9),
+}
 
-def main() -> None:
-    import os
 
+def _peaks(device_kind: str):
+    dk = device_kind.lower()
+    for key, val in PEAKS.items():
+        if key in dk:
+            return val
+    return None
+
+
+def _mark(msg, t0=[None]):
+    if t0[0] is None:
+        t0[0] = time.time()
+    print(f"# bench [{time.time()-t0[0]:6.1f}s] {msg}", file=sys.stderr,
+          flush=True)
+
+
+def _sync_scalar(x) -> float:
+    """Force materialization with a real 4-byte D2H (see module docstring)."""
+    return float(np.asarray(x))
+
+
+def _analytic_cost(batch, num_slots, emb_dim, dense_dim, hidden, emb_cfg,
+                   n_pad_rows):
+    """Matmul-dominant FLOPs and HBM traffic of one train step."""
+    dims = [num_slots * emb_dim + dense_dim, *hidden, 1]
+    fwd = 2.0 * batch * sum(a * b for a, b in zip(dims[:-1], dims[1:]))
+    fwd += 2.0 * batch * num_slots * emb_dim * 4  # FM sum-square term
+    flops = 3.0 * fwd                              # fwd + ~2x bwd
+    toks = batch * num_slots
+    w, pw, gw = emb_cfg.row_width, emb_cfg.pull_width, emb_cfg.grad_width
+    hbm = 4.0 * (
+        toks * w + toks * pw            # gather read rows, write pulled
+        + toks * (gw + 3) * 2           # scatter payload write + add
+        + n_pad_rows * (gw + 3) * 2     # accumulator init + read
+        + n_pad_rows * w * 2            # merge-update table read+write
+        + batch * 2 * sum(dims))        # activations fwd+bwd (rough)
+    return flops, hbm
+
+
+def device_step_bench(small: bool):
     import jax
-
-    small = os.environ.get("PBTPU_BENCH_SMALL") == "1"  # CPU smoke mode
-    if small:
-        jax.config.update("jax_platforms", "cpu")
-    devices = jax.devices()
-    n_dev = len(devices)
-
     from paddlebox_tpu.data import DataFeedSchema
     from paddlebox_tpu.embedding import (EmbeddingConfig, HostEmbeddingStore,
                                          PassWorkingSet)
@@ -40,25 +99,20 @@ def main() -> None:
     from paddlebox_tpu.parallel import make_mesh, mesh as mesh_lib
     from paddlebox_tpu.train import Trainer, TrainerConfig
 
-    # Criteo-like geometry: 26 categorical slots (L=1) + 13 dense floats
-    num_slots, emb_dim = 26, 8
+    devices = jax.devices()
+    n_dev = len(devices)
+    num_slots, emb_dim, dense_dim, hidden = 26, 8, 13, (400, 400, 400)
     batch = (256 if small else 8192) * n_dev
-    schema = DataFeedSchema.ctr(num_sparse=num_slots, num_float=13,
+    schema = DataFeedSchema.ctr(num_sparse=num_slots, num_float=dense_dim,
                                 batch_size=batch, max_len=1)
     emb_cfg = EmbeddingConfig(dim=emb_dim, optimizer="adagrad",
                               learning_rate=0.05)
     store = HostEmbeddingStore(emb_cfg)
     mesh = make_mesh(n_dev)
-    model = DeepFMModel(num_slots=num_slots, emb_dim=emb_dim, dense_dim=13,
-                        hidden=(400, 400, 400))
+    model = DeepFMModel(num_slots=num_slots, emb_dim=emb_dim,
+                        dense_dim=dense_dim, hidden=hidden)
     tr = Trainer(model, store, schema, mesh,
                  TrainerConfig(global_batch_size=batch, auc_buckets=1 << 16))
-
-    import sys, time as _t
-    _t0 = _t.time()
-    def _mark(msg):
-        print(f"# bench [{_t.time()-_t0:6.1f}s] {msg}", file=sys.stderr,
-              flush=True)
     rng = np.random.default_rng(0)
     n_keys = 1 << (14 if small else 19)
     keys = rng.choice(1 << 50, n_keys, replace=False).astype(np.uint64)
@@ -68,59 +122,217 @@ def main() -> None:
     T = tr.layout.total_len
     sh = mesh_lib.batch_sharding(mesh)
 
-    # pre-staged batches (device-path throughput)
     n_staged = 4
     staged = []
     for _ in range(n_staged):
         raw = rng.choice(keys, size=(batch, T))
         mask = np.ones((batch, T), dtype=bool)
         idx = ws.translate(raw, mask)
-        dense = rng.normal(size=(batch, 13)).astype(np.float32)
+        dense = rng.normal(size=(batch, dense_dim)).astype(np.float32)
         labels = (rng.random(batch) < 0.25).astype(np.float32)
         staged.append(tuple(jax.device_put(a, sh) for a in
                             (idx, mask, dense, labels)))
-
     _mark("staged batches on device")
+
     table, params, opt = ws.table, tr.params, tr.opt_state
-    # warmup/compile
-    table, params, opt, loss, preds = tr._step_fn(table, params, opt,
-                                                  *staged[0])
-    jax.block_until_ready(loss)
-
-    # second warmup step: the first fed-back step settles any layout change
-    table, params, opt, loss, preds = tr._step_fn(table, params, opt,
-                                                  *staged[1])
-    jax.block_until_ready(loss)
-
+    for w in range(2):  # compile + settle fed-back layouts
+        table, params, opt, loss, preds, drop = tr._step_fn(
+            table, params, opt, *staged[w])
+    _sync_scalar(loss)
     _mark("warmup/compile done")
+
     n_steps = 5 if small else 200
     windows = []
     for _ in range(1 if small else 3):
         t0 = time.perf_counter()
         for i in range(n_steps):
-            table, params, opt, loss, preds = tr._step_fn(
+            table, params, opt, loss, preds, drop = tr._step_fn(
                 table, params, opt, *staged[i % n_staged])
-        jax.block_until_ready((table, params, opt, loss, preds))
+        loss_v = _sync_scalar(loss)  # real D2H terminates the window
         windows.append(time.perf_counter() - t0)
-    dt = min(windows)  # best sustained window (tunnel jitter is external)
+    dt = min(windows)
+    _mark("device-step windows done")
 
-    eps = n_steps * batch / dt
-    eps_chip = eps / n_dev
+    eps_chip = n_steps * batch / dt / n_dev
+    flops, hbm = _analytic_cost(batch, num_slots, emb_dim, dense_dim,
+                                hidden, emb_cfg, ws.padded_rows)
+    kind = devices[0].device_kind
+    peaks = _peaks(kind)
+    audit = {
+        "flops_per_step": flops,
+        "hbm_bytes_per_step": hbm,
+        "step_seconds": dt / n_steps,
+        "sync": "device_get(loss); block_until_ready returns early over "
+                "the tunnel and is not trusted",
+    }
+    if peaks is not None:
+        peak_f, peak_b = peaks
+        audit["peak_flops"] = peak_f
+        audit["peak_hbm_bytes"] = peak_b
+        audit["implied_mfu"] = flops / (dt / n_steps) / peak_f
+        audit["implied_hbm_frac"] = hbm / (dt / n_steps) / peak_b
+        audit["ok"] = (audit["implied_mfu"] <= 0.6
+                       and audit["implied_hbm_frac"] <= 1.0)
+    else:
+        audit["ok"] = True  # unknown hardware (CPU smoke): no peak table
+    detail = {
+        "device_kind": kind,
+        "devices": n_dev,
+        "global_batch": batch,
+        "steps": n_steps,
+        "seconds": round(dt, 3),
+        "window_seconds": [round(w, 3) for w in windows],
+        "working_set_keys": n_keys,
+        "loss_final": loss_v,
+        "audit": audit,
+    }
+    return eps_chip, detail
+
+
+def _synth_pass(schema, n_ex, num_slots, dense_slots, slot_space, seed,
+                prev=None, overlap=0.9):
+    """Vectorized synthetic SlotRecordBatch (pre-parsed pass data).
+
+    With `prev`, ~`overlap` of tokens resample prev's keys (consecutive
+    CTR passes share most of their working set) and the rest draw from a
+    disjoint key window — the day-over-day churn."""
+    from paddlebox_tpu.data.slot_record import SlotRecordBatch
+    rng = np.random.default_rng(seed)
+    sparse_values, sparse_offsets = [], []
+    offs = np.arange(n_ex + 1, dtype=np.int64)  # one token per slot
+    for s in range(num_slots):
+        if prev is None:
+            ids = rng.integers(0, slot_space, size=n_ex).astype(np.int64)
+            ids |= np.int64(s + 1) << np.int64(40)  # slot-salted sign space
+        else:
+            pool = np.unique(prev.sparse_values[s])
+            old = pool[rng.integers(0, len(pool), size=n_ex)]
+            fresh = rng.integers(slot_space, 2 * slot_space,
+                                 size=n_ex).astype(np.int64)
+            fresh |= np.int64(s + 1) << np.int64(40)
+            ids = np.where(rng.random(n_ex) < overlap, old, fresh)
+        sparse_values.append(ids)
+        sparse_offsets.append(offs.copy())
+    float_values = [(rng.random(n_ex) < 0.25).astype(np.float32)]  # label
+    float_values += [rng.normal(size=n_ex).astype(np.float32)
+                     for _ in range(len(dense_slots))]
+    return SlotRecordBatch(
+        schema=schema, num=n_ex,
+        sparse_values=sparse_values, sparse_offsets=sparse_offsets,
+        float_values=float_values,
+        ins_id=np.zeros(n_ex, dtype=np.uint64),
+        search_id=np.zeros(n_ex, dtype=np.uint64),
+        rank=np.zeros(n_ex, dtype=np.int32),
+        cmatch=np.zeros(n_ex, dtype=np.int32))
+
+
+def e2e_bench(small: bool):
+    """Two full train_pass calls from pre-built archives (parse excluded;
+    translate + H2D + step + metrics + pass boundaries included)."""
+    import tempfile
+
+    import jax
+    from paddlebox_tpu.data import DataFeedSchema, SlotDataset
+    from paddlebox_tpu.data.archive import read_archive, write_archive
+    from paddlebox_tpu.embedding import EmbeddingConfig, HostEmbeddingStore
+    from paddlebox_tpu.models import DeepFMModel
+    from paddlebox_tpu.parallel import make_mesh
+    from paddlebox_tpu.train import Trainer, TrainerConfig
+
+    n_dev = len(jax.devices())
+    num_slots, emb_dim, dense_dim = 26, 16, 13
+    batch = (256 if small else 8192) * n_dev
+    steps_per_pass = 4 if small else 56
+    n_ex = steps_per_pass * batch
+    slot_space = 4096 if small else 650_000     # → ~8.4M unique keys big
+    schema = DataFeedSchema.ctr(num_sparse=num_slots, num_float=dense_dim,
+                                batch_size=batch, max_len=1)
+    dense_slots = [s for s in schema.float_slots if s.name != "label"]
+
+    with tempfile.TemporaryDirectory(prefix="pbtpu_bench_") as tmp:
+        paths = []
+        rec = None
+        for p in range(2):
+            rec = _synth_pass(schema, n_ex, num_slots, dense_slots,
+                              slot_space, seed=p, prev=rec)
+            path = os.path.join(tmp, f"pass{p}.pbar")
+            write_archive(path, rec)
+            paths.append(path)
+        _mark("e2e archives written")
+        passes = [read_archive(p, schema) for p in paths]
+    _mark("e2e archives loaded (pre-parsed, excluded from timing)")
+
+    store = HostEmbeddingStore(EmbeddingConfig(dim=emb_dim,
+                                               optimizer="adagrad",
+                                               learning_rate=0.05))
+    mesh = make_mesh(n_dev)
+    tr = Trainer(DeepFMModel(num_slots=num_slots, emb_dim=emb_dim,
+                             dense_dim=dense_dim, hidden=(400, 400, 400)),
+                 store, schema, mesh,
+                 TrainerConfig(global_batch_size=batch,
+                               auc_buckets=1 << 16))
+    pass_secs, stats = [], []
+    for p, rec in enumerate(passes):
+        ds = SlotDataset(schema)
+        ds.records = rec
+        t0 = time.perf_counter()
+        out = tr.train_pass(ds)
+        pass_secs.append(time.perf_counter() - t0)
+        m = tr.feed_mgr
+        stats.append({
+            "steps": out["steps"],
+            "loss_mean": round(out["loss_mean"], 4),
+            "working_set_keys": int(len(ds.unique_keys())),
+            "boundary_h2d_bytes": m.last_h2d_bytes,
+            "boundary_d2h_bytes": m.last_d2h_bytes,
+            "fresh_rows": m.last_fresh_rows,
+            "reused_rows": m.last_reused_rows,
+            "boundary_seconds": round(m.last_boundary_seconds, 3),
+        })
+        _mark(f"e2e pass {p} done in {pass_secs[-1]:.1f}s "
+              f"({stats[-1]['working_set_keys']} keys)")
+    eps_chip = n_ex / min(pass_secs) / n_dev
+    return eps_chip, {
+        "examples_per_pass": n_ex,
+        "emb_dim": emb_dim,
+        "pass_seconds": [round(s, 2) for s in pass_secs],
+        "passes": stats,
+        "note": "translate+H2D+step+metrics+boundaries; parse excluded "
+                "(pre-built archive); host<->device rides the tunnel "
+                "(~30MB/s H2D), not a local PCIe/DMA path",
+    }
+
+
+def main() -> None:
+    import jax
+
+    small = os.environ.get("PBTPU_BENCH_SMALL") == "1"  # CPU smoke mode
+    if small:
+        jax.config.update("jax_platforms", "cpu")
+
+    eps_chip, detail = device_step_bench(small)
+    if os.environ.get("PBTPU_BENCH_E2E", "1") != "0":
+        try:
+            e2e_eps, e2e_detail = e2e_bench(small)
+            detail["e2e"] = e2e_detail
+            detail["e2e"]["examples_per_sec_per_chip"] = round(e2e_eps, 1)
+            detail["e2e"]["vs_baseline"] = round(e2e_eps / TARGET_PER_CHIP,
+                                                 4)
+        except Exception as e:  # e2e failure must not hide the step number
+            detail["e2e"] = {"error": repr(e)}
+
     print(json.dumps({
-        "metric": "deepfm_train_examples_per_sec_per_chip",
+        "metric": "deepfm_device_step_examples_per_sec_per_chip",
         "value": round(eps_chip, 1),
         "unit": "examples/sec/chip",
         "vs_baseline": round(eps_chip / TARGET_PER_CHIP, 4),
-        "detail": {
-            "devices": n_dev,
-            "global_batch": batch,
-            "steps": n_steps,
-            "seconds": round(dt, 3),
-            "window_seconds": [round(w, 3) for w in windows],
-            "working_set_keys": n_keys,
-            "loss_final": float(loss),
-        },
+        "detail": detail,
     }))
+    if not detail["audit"]["ok"]:
+        print("AUDIT FAIL: implied MFU/HBM exceeds hardware peaks — the "
+              "measurement window is broken; do not trust the number",
+              file=sys.stderr)
+        raise SystemExit(2)
 
 
 if __name__ == "__main__":

@@ -37,18 +37,19 @@ from paddlebox_tpu.embedding.optim import apply_updates
 
 
 def use_pallas() -> bool:
-    """Default ON for TPU (measured end-to-end win, see module docstring;
-    bench: 67.2M vs 58.0M examples/s/chip on DeepFM), OFF elsewhere (the
-    CPU interpreter exists for tests, not speed). PBTPU_PALLAS=0/1
-    overrides.
+    """Default OFF. The round-1 "+16% end-to-end win" was an artifact of
+    timing windows terminated by block_until_ready, which returns early
+    over the axon tunnel; with windows terminated by a real device_get,
+    the XLA scatter+select path is ~15% FASTER than this kernel (14.9ms vs
+    17.5ms DeepFM step, batch 8192, 512k-key working set, one v5e), and
+    the kernel's {1,0} operand layout constraint forces padded O(table)
+    copies that OOM multi-GB working sets (measured: 3x 5GB copies at
+    10.5M x 21 f32). PBTPU_PALLAS=1 re-enables for experiments.
 
     Read at TRACE time: set it before the first train step compiles.
     Flipping it later does nothing — jitted steps (donated, fed back) never
     retrace, so the already-compiled path keeps running."""
-    v = os.environ.get("PBTPU_PALLAS")
-    if v is not None:
-        return v == "1"
-    return jax.default_backend() == "tpu"
+    return os.environ.get("PBTPU_PALLAS") == "1"
 
 
 def _merge_update_kernel(table_ref, acc_ref, out_ref, *, cfg: EmbeddingConfig):

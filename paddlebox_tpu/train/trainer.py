@@ -390,10 +390,11 @@ class Trainer:
             idx = ws.translate(pb.ids, pb.mask)
             labels, dense = self.split_floats(pb.floats)
         sh = mesh_lib.batch_sharding(self.mesh)
-        return (jax.device_put(idx, sh),
-                jax.device_put(pb.mask, sh),
-                jax.device_put(dense.astype(np.float32), sh),
-                jax.device_put(labels.astype(np.float32), sh))
+        # ONE device_put for all four arrays: each put is a host->device
+        # round trip (very expensive on tunneled transports)
+        return jax.device_put(
+            (idx, pb.mask, dense.astype(np.float32),
+             labels.astype(np.float32)), sh)
 
     def train_pass(self, dataset, metrics: Any = None
                    ) -> dict[str, float]:
