@@ -105,8 +105,10 @@ def device_step_bench(small: bool):
     batch = (256 if small else 8192) * n_dev
     schema = DataFeedSchema.ctr(num_sparse=num_slots, num_float=dense_dim,
                                 batch_size=batch, max_len=1)
+    # PBTPU_BENCH_STORAGE=int8|int16 benches the quantized-table path
+    storage = os.environ.get("PBTPU_BENCH_STORAGE", "f32")
     emb_cfg = EmbeddingConfig(dim=emb_dim, optimizer="adagrad",
-                              learning_rate=0.05)
+                              learning_rate=0.05, storage=storage)
     store = HostEmbeddingStore(emb_cfg)
     mesh = make_mesh(n_dev)
     model = DeepFMModel(num_slots=num_slots, emb_dim=emb_dim,
@@ -177,6 +179,7 @@ def device_step_bench(small: bool):
         audit["ok"] = True  # unknown hardware (CPU smoke): no peak table
     detail = {
         "device_kind": kind,
+        "storage": storage,
         "devices": n_dev,
         "global_batch": batch,
         "steps": n_steps,

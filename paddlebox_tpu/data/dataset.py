@@ -136,13 +136,24 @@ class SlotDataset:
         sparse_names = [s.name for s in self.schema.sparse_slots]
         for name in slot_names:
             s = sparse_names.index(name)
-            offs = rec.sparse_offsets[s]
+            vals, offs = rec.sparse_values[s], rec.sparse_offsets[s]
             lens = offs[1:] - offs[:-1]
-            # permute whole per-example value lists among examples of equal length
-            # (cheap approximation that preserves per-example counts exactly:
-            # permute the flat values)
-            rec.sparse_values[s] = rng.permutation(rec.sparse_values[s])
-            del lens
+            # permute whole per-example value LISTS across examples (the
+            # reference swaps slot value vectors between instances,
+            # data_set.cc slots_shuffle) — example i receives example
+            # perm[i]'s entire list, keeping multi-value lists intact
+            perm = rng.permutation(rec.num)
+            new_lens = lens[perm]
+            new_offs = np.zeros(rec.num + 1, dtype=np.int64)
+            np.cumsum(new_lens, out=new_offs[1:])
+            total = int(new_offs[-1])
+            # vectorized ragged gather: output position t inside example j
+            # reads vals[offs[perm[j]] + (t - new_offs[j])]
+            src_start = np.repeat(offs[:-1][perm], new_lens)
+            local = np.arange(total, dtype=np.int64) - \
+                np.repeat(new_offs[:-1], new_lens)
+            rec.sparse_values[s] = vals[src_start + local]
+            rec.sparse_offsets[s] = new_offs
 
     def merge_by_ins_id(self, merge_size: int = 0) -> int:
         """Merge examples sharing an ins_id into one (MergeByInsId,

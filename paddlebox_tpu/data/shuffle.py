@@ -112,15 +112,15 @@ class TcpShuffleService:
         self._srv.bind((host, int(port)))
         self._srv.listen(self.world)
 
-    def exchange(self, outgoing: list[SlotRecordBatch | None], schema
-                 ) -> list[SlotRecordBatch]:
+    def exchange(self, outgoing: list[SlotRecordBatch | None], schema,
+                 timeout: float = 120.0) -> list[SlotRecordBatch]:
         received: list[SlotRecordBatch] = []
         lock = threading.Lock()
         expected = self.world - 1
+        done_peers = [0]
 
         def serve() -> None:
-            done = 0
-            while done < expected:
+            while done_peers[0] < expected:
                 conn, _ = self._srv.accept()
                 with conn:
                     while True:
@@ -132,7 +132,7 @@ class TcpShuffleService:
                         b = deserialize_batch(payload, schema)
                         with lock:
                             received.append(b)
-                done += 1
+                done_peers[0] += 1
 
         server = threading.Thread(target=serve, daemon=True)
         server.start()
@@ -146,7 +146,14 @@ class TcpShuffleService:
                     payload = serialize_batch(sub)
                     s.sendall(struct.pack(">Q", len(payload)) + payload)
                 s.sendall(struct.pack(">Q", 0))
-        server.join(timeout=120)
+        server.join(timeout=timeout)
+        if server.is_alive():
+            # a slow/dead peer past the deadline means records are MISSING;
+            # continuing would silently train on truncated data (reference
+            # shuffle errors are fail-stop, data_set.cc:1393-1417)
+            raise RuntimeError(
+                f"global shuffle exchange timed out after {timeout:.0f}s: "
+                f"received from {done_peers[0]}/{expected} peers")
         mine = outgoing[self.rank]
         if mine is not None and mine.num > 0:
             received.append(mine)

@@ -178,6 +178,7 @@ class SlotRecordBatch:
                 else np.zeros((n, 0), dtype=np.float32),
             rank=self.rank[start:end],
             cmatch=self.cmatch[start:end],
+            ins_id=self.ins_id[start:end],
         )
 
 
@@ -243,6 +244,7 @@ class PackedBatch:
     floats: np.ndarray
     rank: np.ndarray
     cmatch: np.ndarray
+    ins_id: np.ndarray | None = None   # uint64 (B,) — DumpField's ins_id
 
     def layout(self) -> SparseLayout:
         return SparseLayout.from_schema(self.schema)
@@ -265,7 +267,8 @@ class PackedBatch:
             schema=self.schema, num=self.num,
             ids=_pad(self.ids), mask=_pad(self.mask, False),
             floats=_pad(self.floats), rank=_pad(self.rank),
-            cmatch=_pad(self.cmatch))
+            cmatch=_pad(self.cmatch),
+            ins_id=None if self.ins_id is None else _pad(self.ins_id))
 
     def slot_ids(self, name: str) -> tuple[np.ndarray, np.ndarray]:
         """(ids, mask) view of one sparse slot, shape (B, max_len)."""

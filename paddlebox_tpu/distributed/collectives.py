@@ -14,7 +14,7 @@ tree-reduce the reference gets from gloo is overkill at these sizes).
 from __future__ import annotations
 
 import io
-import pickle
+import json
 from typing import Any, Callable
 
 import numpy as np
@@ -23,18 +23,28 @@ from paddlebox_tpu.distributed.store import FileStore
 
 
 def _dump(obj: Any) -> bytes:
+    """json + raw-ndarray framing — the same trust stance as ps.py: no
+    pickle on anything that crosses a process boundary (a rendezvous store
+    is exactly as attacker-reachable as a socket)."""
     if isinstance(obj, np.ndarray):
         buf = io.BytesIO()
-        np.save(buf, obj)
+        np.save(buf, obj, allow_pickle=False)
         return b"npy" + buf.getvalue()
-    return b"pkl" + pickle.dumps(obj)
+    try:
+        return b"jsn" + json.dumps(obj).encode()
+    except TypeError as e:
+        raise TypeError(
+            f"host collectives carry JSON values or ndarrays, got "
+            f"{type(obj).__name__}") from e
 
 
 def _load(raw: bytes) -> Any:
     tag, body = raw[:3], raw[3:]
     if tag == b"npy":
-        return np.load(io.BytesIO(body))
-    return pickle.loads(body)
+        return np.load(io.BytesIO(body), allow_pickle=False)
+    if tag == b"jsn":
+        return json.loads(body.decode())
+    raise ValueError(f"unknown collective frame tag {tag!r}")
 
 
 _REDUCERS: dict[str, Callable] = {

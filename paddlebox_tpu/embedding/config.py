@@ -52,6 +52,11 @@ class EmbeddingConfig:
     ftrl_beta: float = 1.0
     mf_create_threshold: float = 0.0  # min show before embedx trains (parity knob)
     seed: int = 0
+    # Device working-set storage for the embedx plane: "f32" (exact) or
+    # "int16"/"int8" (quantized with a per-row scale — the reference's
+    # Quant/ShowClk feature types, box_wrapper.cu pull variants; see
+    # embedding/quant.py). The HOST store stays f32 either way.
+    storage: str = "f32"
 
     def __post_init__(self) -> None:
         if self.optimizer not in _OPT_SLOTS:
@@ -59,6 +64,9 @@ class EmbeddingConfig:
                              f"choose from {sorted(_OPT_SLOTS)}")
         if self.dim < 0 or self.expand_dim < 0:
             raise ValueError("dim/expand_dim must be >= 0")
+        if self.storage not in ("f32", "int16", "int8"):
+            raise ValueError(f"storage must be f32|int16|int8, "
+                             f"got {self.storage!r}")
 
     # --- row geometry ---
     @property

@@ -48,6 +48,7 @@ import jax.numpy as jnp
 import weakref
 
 from paddlebox_tpu.config import flags
+from paddlebox_tpu.embedding import quant
 from paddlebox_tpu.embedding.store import HostEmbeddingStore
 from paddlebox_tpu.embedding.working_set import (PassWorkingSet, bucket_size,
                                                  fetch_rows, transfer_bytes,
@@ -65,9 +66,12 @@ def _combine_jit(out_sharding, donate: bool):
     donate); shapes retrace inside jit and are bounded by bucket_size.
     """
     def combine(prev, fresh, src, is_fresh):
-        from_prev = prev[jnp.where(is_fresh, 0, src)]
-        from_fresh = fresh[jnp.where(is_fresh, src, 0)]
-        return jnp.where(is_fresh[:, None], from_fresh, from_prev)
+        def one(p, f):
+            from_prev = p[jnp.where(is_fresh, 0, src)]
+            from_fresh = f[jnp.where(is_fresh, src, 0)]
+            return jnp.where(is_fresh[:, None], from_fresh, from_prev)
+        # tree.map: the table may be a QuantTable pytree (quant.py planes)
+        return jax.tree.map(one, prev, fresh)
 
     kw: dict = {"donate_argnums": (0,)} if donate else {}
     if out_sharding is not None:
@@ -203,7 +207,9 @@ class FeedPassManager:
         staged = np.zeros((n_fresh_pad, cfg.row_width), np.float32)
         staged[:n_fresh] = fresh_rows
         repl = self._repl_sharding()
-        if flags.transfer_compress_embedx and cfg.total_dim:
+        if cfg.storage != "f32":
+            fresh_dev = quant.device_table(staged, cfg, repl)
+        elif flags.transfer_compress_embedx and cfg.total_dim:
             fresh_dev = _put_compressed(staged, cfg, repl)
         elif repl is not None:
             fresh_dev = jax.device_put(staged, repl)

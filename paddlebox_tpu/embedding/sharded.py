@@ -35,9 +35,15 @@ from jax import lax
 
 from paddlebox_tpu.embedding.config import EmbeddingConfig
 from paddlebox_tpu.embedding.optim import apply_updates
+from paddlebox_tpu.embedding import quant
 from paddlebox_tpu.ops import pallas_kernels
 
 NULL_INDEX = 0  # reserved all-zero row; padding tokens point here
+
+
+def _take_rows(arr: jnp.ndarray, idx: jnp.ndarray) -> jnp.ndarray:
+    """Full-row gather behind an optimization barrier (see lookup)."""
+    return lax.optimization_barrier(jnp.take(arr, idx, axis=0))
 
 
 # ---------------------------------------------------------------------------
@@ -57,9 +63,18 @@ def lookup(table: jnp.ndarray, idx: jnp.ndarray,
     A fused column-sliced gather (``table[idx, :w]``) lowers to a
     catastrophically slow path on TPU (~26x: 568ms vs 22ms for 213k tokens
     from a 512k x 11 f32 table on one v5e, measured with forced D2H sync).
+
+    Quantized tables (cfg.storage != f32) gather both planes and
+    dequantize at the gather — f32 compute, int storage (quant.py).
     """
-    rows = lax.optimization_barrier(
-        jnp.take(table, idx.reshape(-1), axis=0))
+    flat = idx.reshape(-1)
+    if quant.is_quant(table):
+        fp = _take_rows(table.fp, flat)
+        qx = _take_rows(table.qx, flat)
+        x = qx.astype(jnp.float32) * fp[:, -1:]
+        pulled = jnp.concatenate([fp[:, :3], x], axis=1)
+        return pulled.reshape((*idx.shape, cfg.pull_width))
+    rows = _take_rows(table, flat)
     return rows[:, :cfg.pull_width].reshape((*idx.shape, cfg.pull_width))
 
 
@@ -91,18 +106,30 @@ def push(table: jnp.ndarray, idx: jnp.ndarray, grads: jnp.ndarray,
         [grads, shows[:, None], clks[:, None],
          jnp.ones((n, 1), grads.dtype)], axis=1)
     gw = cfg.grad_width
-    acc = jnp.zeros((table.shape[0], gw + 3), payload.dtype)
+    n_rows = quant.table_rows(table)
+    acc = jnp.zeros((n_rows, gw + 3), payload.dtype)
     acc = acc.at[idx].add(payload, mode="drop")
     # Untouched rows keep their exact bits (stateful optimizers like adam
-    # would otherwise decay momentum on every row). The null row only ever
-    # receives zero grads/increments (callers mask padding), and a fresh
-    # zero row is a fixed point of every optimizer — it stays exactly zero.
+    # would otherwise decay momentum on every row; a quantized row must not
+    # requantize — round twice — unless it really changed). The null row
+    # only ever receives zero grads/increments (callers mask padding), and
+    # a fresh zero row is a fixed point of every optimizer — it stays zero.
+    touched = acc[:, gw + 2] > 0
+    if quant.is_quant(table):
+        # dequant -> exact f32 update -> requant, one fused elementwise
+        # pass over the planes (no f32 table materializes in HBM)
+        rows = quant.assemble_rows(table.fp, table.qx, cfg)
+        new_rows = apply_updates(rows, acc[:, :gw], acc[:, gw],
+                                 acc[:, gw + 1], cfg)
+        new_fp, new_qx = quant.split_rows(new_rows, cfg)
+        return quant.QuantTable(
+            fp=jnp.where(touched[:, None], new_fp, table.fp),
+            qx=jnp.where(touched[:, None], new_qx, table.qx))
     if pallas_kernels.use_pallas():
         # single fused read-modify-write pass over the table
         return pallas_kernels.merge_update(table, acc, cfg)
     new_rows = apply_updates(table, acc[:, :gw], acc[:, gw], acc[:, gw + 1],
                              cfg)
-    touched = acc[:, gw + 2] > 0
     return jnp.where(touched[:, None], new_rows, table)
 
 
@@ -184,18 +211,32 @@ def routed_lookup(table_shard: jnp.ndarray, idx: jnp.ndarray,
         if return_dropped:
             return res[0][inverse], res[1]
         return res[inverse]
-    rps = table_shard.shape[0]
+    rps = quant.table_rows(table_shard)
     cap = _capacity(n, D, capacity_factor)
     order, sowner, pos, valid, send_idx = _route(idx, rps, D, cap)
     recv_idx = lax.all_to_all(send_idx, axis_name, 0, 0, tiled=True)
     local_row = jnp.where(recv_idx >= 0, recv_idx % rps, 0)
-    # full-row take + barrier + slice: see lookup() for the TPU rationale
-    vals = lax.optimization_barrier(
-        jnp.take(table_shard, local_row.reshape(-1),
-                 axis=0))[:, :cfg.pull_width]
-    vals = vals.reshape(D, cap, cfg.pull_width)
-    vals = jnp.where((recv_idx >= 0)[:, :, None], vals, 0.0)
-    back = lax.all_to_all(vals, axis_name, 0, 0, tiled=True)
+    lane_ok = (recv_idx >= 0)[:, :, None]
+    if quant.is_quant(table_shard):
+        # quantized a2a payload: the embedx plane crosses ICI as int8/16
+        # plus a 4-col f32 plane (show, clk, w, scale) — the reference's
+        # quant pull variants applied to the collective (box_wrapper.cu)
+        fp = _take_rows(table_shard.fp, local_row.reshape(-1))
+        qx = _take_rows(table_shard.qx, local_row.reshape(-1))
+        fp4 = jnp.concatenate([fp[:, :3], fp[:, -1:]], axis=1)
+        fp4 = jnp.where(lane_ok, fp4.reshape(D, cap, 4), 0.0)
+        qx = jnp.where(lane_ok, qx.reshape(D, cap, -1), 0)
+        back_fp = lax.all_to_all(fp4, axis_name, 0, 0, tiled=True)
+        back_qx = lax.all_to_all(qx, axis_name, 0, 0, tiled=True)
+        x = back_qx.astype(jnp.float32) * back_fp[:, :, -1:]
+        back = jnp.concatenate([back_fp[:, :, :3], x], axis=2)
+    else:
+        # full-row take + barrier + slice: see lookup() for the rationale
+        vals = _take_rows(table_shard,
+                          local_row.reshape(-1))[:, :cfg.pull_width]
+        vals = vals.reshape(D, cap, cfg.pull_width)
+        vals = jnp.where(lane_ok, vals, 0.0)
+        back = lax.all_to_all(vals, axis_name, 0, 0, tiled=True)
     # null-group rows (sowner == D) are clamped then zeroed by `valid`
     gathered = back[jnp.minimum(sowner, D - 1), jnp.minimum(pos, cap - 1)]
     gathered = jnp.where(valid[:, None], gathered, 0.0)
@@ -231,7 +272,7 @@ def routed_push(table_shard: jnp.ndarray, idx: jnp.ndarray,
         return routed_push(table_shard, uniq, merged[:, :gw],
                            merged[:, gw], merged[:, gw + 1], cfg,
                            axis_name, capacity_factor)
-    rps = table_shard.shape[0]
+    rps = quant.table_rows(table_shard)
     cap = _capacity(n, D, capacity_factor)
     order, sowner, pos, valid, send_idx = _route(idx, rps, D, cap)
     payload = jnp.concatenate(

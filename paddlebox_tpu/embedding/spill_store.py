@@ -1,0 +1,115 @@
+"""Disk spill tier for the host embedding store.
+
+The reference's table lives across SSD + host DRAM + GPU HBM inside
+libbox_ps: ``LoadSSD2Mem`` pulls the needed range up before a pass and the
+working-set build reads from there (box_wrapper.h:487-494; the SSD tier is
+what makes 10^10-key tables affordable — SURVEY.md §2.3). The round-1
+store was a pure in-RAM numpy arena, bounding table capacity by host DRAM.
+
+:class:`SpillEmbeddingStore` replaces the arena with a **memory-mapped row
+file** (the SSD tier — capacity bounded by disk) plus a fixed-size
+**direct-mapped RAM row cache** (the host-DRAM hot tier). Reads come from
+the cache when warm and fault in from the file otherwise; writes go
+through to the file (the authoritative tier) and refresh the cache. The
+pass-granular access pattern does the LoadSSD2Mem job implicitly: a
+working-set build (`lookup_or_init` over the pass's keys) pulls exactly
+the pass's rows through the cache.
+
+Everything else — key index, dirty/tombstone tracking, save_base/
+save_delta/load, shrink, flush hooks — is inherited unchanged from
+HostEmbeddingStore; the two stores are bit-for-bit interchangeable (the
+parity test trains the same model on both and compares trajectories).
+
+RAM budget: the key index (~16B/key) and per-row bookkeeping stay in RAM
+by design — same trade as the reference, whose PS keeps its key agent
+resident; the 4-byte/row dirty+cache metadata is small next to the index.
+"""
+
+from __future__ import annotations
+
+import os
+import tempfile
+
+import numpy as np
+
+from paddlebox_tpu.embedding.config import EmbeddingConfig
+from paddlebox_tpu.embedding.store import HostEmbeddingStore
+
+
+class SpillEmbeddingStore(HostEmbeddingStore):
+    _rows_persistent = True    # the row file keeps its bytes across grows
+
+    def __init__(self, cfg: EmbeddingConfig, spill_dir: str | None = None,
+                 cache_rows: int = 1 << 16, initial_capacity: int = 1024):
+        self._spill_dir = spill_dir or tempfile.mkdtemp(prefix="pbtpu_spill_")
+        os.makedirs(self._spill_dir, exist_ok=True)
+        self._rows_path = os.path.join(self._spill_dir, "rows.dat")
+        self._cache_slots = max(1, int(cache_rows))
+        # direct-mapped cache: slot = row_id % cache_slots
+        self._ctags = np.full(self._cache_slots, -1, dtype=np.int64)
+        self._cdata = np.zeros((self._cache_slots, cfg.row_width),
+                               dtype=np.float32)
+        self.cache_hits = 0
+        self.cache_misses = 0
+        super().__init__(cfg, initial_capacity)
+
+    # ---- storage hooks -------------------------------------------------
+
+    def _alloc_rows(self, capacity: int) -> np.memmap:
+        w = self.cfg.row_width
+        nbytes = capacity * w * 4
+        # grow the backing file (existing bytes are preserved; new bytes
+        # read as zeros), then remap at the larger shape
+        with open(self._rows_path, "ab") as f:
+            pass
+        cur = os.path.getsize(self._rows_path)
+        if cur < nbytes:
+            with open(self._rows_path, "r+b") as f:
+                f.truncate(nbytes)
+        return np.memmap(self._rows_path, dtype=np.float32, mode="r+",
+                         shape=(capacity, w))
+
+    def _read_rows(self, idx: np.ndarray) -> np.ndarray:
+        idx = np.asarray(idx, dtype=np.int64)
+        out = np.empty((len(idx), self.cfg.row_width), dtype=np.float32)
+        slot = idx % self._cache_slots
+        hit = self._ctags[slot] == idx
+        out[hit] = self._cdata[slot[hit]]
+        miss = ~hit
+        if miss.any():
+            mi = idx[miss]
+            rows = np.asarray(self._rows[mi])       # disk-tier read
+            out[miss] = rows
+            ms = slot[miss]
+            self._ctags[ms] = mi                    # install (last wins)
+            self._cdata[ms] = rows
+        self.cache_hits += int(hit.sum())
+        self.cache_misses += int(miss.sum())
+        return out
+
+    def _write_rows(self, idx: np.ndarray, rows: np.ndarray) -> None:
+        idx = np.asarray(idx, dtype=np.int64)
+        self._rows[idx] = rows                      # write-through to disk
+        slot = idx % self._cache_slots
+        hit = self._ctags[slot] == idx
+        if hit.any():
+            self._cdata[slot[hit]] = rows[hit]
+
+    def _rows_compacted(self) -> None:
+        # shrink/remove reassigned row ids; cached tags are meaningless
+        self._ctags[:] = -1
+
+    # ---- persistence extras -------------------------------------------
+
+    def save_base(self, path: str) -> str:
+        out = super().save_base(path)
+        self._rows.flush()                          # msync the spill file
+        return out
+
+    @property
+    def spill_dir(self) -> str:
+        return self._spill_dir
+
+    @property
+    def spill_file_bytes(self) -> int:
+        return os.path.getsize(self._rows_path)

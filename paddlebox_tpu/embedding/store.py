@@ -43,7 +43,7 @@ class HostEmbeddingStore:
         self.cfg = cfg
         self._index = KeyIndex(initial_capacity)
         self._keys = np.zeros(initial_capacity, dtype=np.uint64)
-        self._rows = np.zeros((initial_capacity, cfg.row_width), dtype=np.float32)
+        self._rows = self._alloc_rows(initial_capacity)
         self._n = 0
         self._dirty = np.zeros(initial_capacity, dtype=bool)
         self._tombstones: set[int] = set()  # evicted since last save
@@ -63,6 +63,22 @@ class HostEmbeddingStore:
     @property
     def mutation_count(self) -> int:
         return self._mutations
+
+    # ---- row-storage hooks (overridden by the disk spill tier) ----
+
+    _rows_persistent = False   # True when _alloc_rows reopens existing data
+
+    def _alloc_rows(self, capacity: int) -> np.ndarray:
+        return np.zeros((capacity, self.cfg.row_width), dtype=np.float32)
+
+    def _read_rows(self, idx: np.ndarray) -> np.ndarray:
+        return self._rows[idx].copy()
+
+    def _write_rows(self, idx: np.ndarray, rows: np.ndarray) -> None:
+        self._rows[idx] = rows
+
+    def _rows_compacted(self) -> None:
+        """Called after shrink/remove rebuilds reassign row ids."""
 
     def register_flush_hook(self, fn) -> None:
         self._flush_hooks.append(fn)
@@ -128,14 +144,14 @@ class HostEmbeddingStore:
                                     + np.flatnonzero(res)] = True
                         self._tombstones.difference_update(
                             int(k) for k in new_keys[res].tolist())
-            return self._rows[idx].copy()
+            return self._read_rows(idx)
 
     def write_back(self, keys: np.ndarray, rows: np.ndarray) -> None:
         """Persist updated rows after a pass (EndPass equivalent)."""
         keys = np.asarray(keys).astype(np.uint64)
         with self._lock:
             idx = self._lookup_strict(keys)
-            self._rows[idx] = rows
+            self._write_rows(idx, np.asarray(rows, dtype=np.float32))
             self._dirty[idx] = True
 
     def peek_rows(self, keys: np.ndarray) -> np.ndarray:
@@ -147,7 +163,7 @@ class HostEmbeddingStore:
         with self._lock:
             idx = self._index.lookup(keys)
             hit = idx >= 0
-            rows[hit] = self._rows[idx[hit]]
+            rows[hit] = self._read_rows(idx[hit])
         return rows
 
     def get_rows(self, keys: np.ndarray) -> np.ndarray:
@@ -157,7 +173,7 @@ class HostEmbeddingStore:
         keys = np.asarray(keys).astype(np.uint64)
         with self._lock:
             idx = self._lookup_strict(keys)
-            return self._rows[idx].copy()
+            return self._read_rows(idx)
 
     def _append_new_keys(self, idx: np.ndarray, keys: np.ndarray,
                          added: int) -> np.ndarray:
@@ -192,8 +208,9 @@ class HostEmbeddingStore:
         dirty = np.zeros(new_cap, dtype=bool)
         dirty[:self._n] = self._dirty[:self._n]
         self._dirty = dirty
-        rows = np.zeros((new_cap, self.cfg.row_width), dtype=np.float32)
-        rows[:self._n] = self._rows[:self._n]
+        rows = self._alloc_rows(new_cap)
+        if not self._rows_persistent:  # file-backed rows keep their bytes
+            rows[:self._n] = self._rows[:self._n]
         self._rows = rows
 
     # ---- hygiene (ShrinkTable, box_wrapper.h:492) ----
@@ -226,6 +243,7 @@ class HostEmbeddingStore:
                 # tombstone evictions so load(base + deltas) does not
                 # resurrect them
                 self._tombstones.update(int(k) for k in gone.tolist())
+                self._rows_compacted()   # row ids changed
             return evicted
 
     # ---- checkpoint (SaveBase/SaveDelta/Load, box_wrapper.cc:1387-1420) ----
@@ -327,6 +345,7 @@ class HostEmbeddingStore:
             self._rows[:self._n] = kept_rows
             self._dirty[:] = False
             self._dirty[:self._n] = kept_dirty
+            self._rows_compacted()       # row ids changed
 
     def _ingest(self, keys: np.ndarray, rows: np.ndarray) -> None:
         with self._lock:
@@ -345,7 +364,7 @@ class HostEmbeddingStore:
                     self._tombstones.difference_update(
                         int(k) for k in keys[res].tolist())
             # last occurrence wins for duplicate keys (replay order)
-            self._rows[idx] = rows
+            self._write_rows(idx, np.asarray(rows, dtype=np.float32))
             # every ingested row diverges from whatever the last save
             # captured — the next delta must carry it, or load(base + own
             # deltas) restores the pre-replay value. load() clears the mask

@@ -28,6 +28,7 @@ import jax
 import jax.numpy as jnp
 
 from paddlebox_tpu.config import flags
+from paddlebox_tpu.embedding import quant
 from paddlebox_tpu.embedding.config import EmbeddingConfig
 from paddlebox_tpu.embedding.store import HostEmbeddingStore
 from paddlebox_tpu.native.key_index import KeyIndex
@@ -54,7 +55,11 @@ def _split_cols(cfg: EmbeddingConfig):
 
 def transfer_bytes(cfg: EmbeddingConfig, n_rows: int) -> int:
     """Host<->device bytes for `n_rows` full rows under the current
-    transfer-compression flag (embedx crosses as bf16 when enabled)."""
+    storage/compression settings (quantized embedx crosses as int8/16;
+    the bf16 transfer-compression flag halves embedx for f32 tables)."""
+    if cfg.storage != "f32":
+        qbytes = 1 if cfg.storage == "int8" else 2
+        return n_rows * (4 * quant.fp_width(cfg) + qbytes * cfg.total_dim)
     if flags.transfer_compress_embedx and cfg.total_dim:
         lo, hi = _split_cols(cfg)
         return n_rows * (4 * (cfg.row_width - (hi - lo)) + 2 * (hi - lo))
@@ -152,6 +157,13 @@ def fetch_rows(table: jax.Array, row_idx: np.ndarray,
     k_pad = bucket_size(k)
     idxp = np.zeros(k_pad, np.int32)
     idxp[:k] = row_idx
+    if quant.is_quant(table):
+        fp_d = _gather_rows_jit(False, 0, 0)(table.fp, idxp)
+        qx_d = _gather_rows_jit(False, 0, 0)(table.qx, idxp)
+        fp = np.asarray(jax.device_get(fp_d))
+        qx = np.asarray(jax.device_get(qx_d))
+        rows = quant.decode_rows_np(fp, qx, cfg)
+        return rows[:k], fp.nbytes + qx.nbytes
     compress = bool(flags.transfer_compress_embedx and cfg.total_dim)
     lo, hi = _split_cols(cfg)
     out = _gather_rows_jit(compress, lo, hi)(table, idxp)
@@ -224,7 +236,14 @@ class PassWorkingSet:
         host_table[1:1 + len(keys)] = rows
         sharding = (mesh_lib.table_sharding(mesh) if mesh is not None
                     else None)
-        if flags.transfer_compress_embedx and cfg.total_dim:
+        if cfg.storage != "f32":
+            if flags.transfer_compress_embedx:
+                raise ValueError(
+                    "transfer_compress_embedx is redundant with quantized "
+                    "storage — the embedx plane already crosses as "
+                    f"{cfg.storage}")
+            table = quant.device_table(host_table, cfg, sharding)
+        elif flags.transfer_compress_embedx and cfg.total_dim:
             table = _put_compressed(host_table, cfg, sharding)
         elif sharding is not None:
             table = jax.device_put(host_table, sharding)
@@ -280,11 +299,18 @@ class PassWorkingSet:
             rows, nbytes = fetch_rows(t, dirty, self.cfg)
             store.write_back(self.sorted_keys[dirty - 1], rows)
             return nbytes
-        if flags.transfer_compress_embedx and self.cfg.total_dim:
+        if quant.is_quant(t):
+            host = quant.decode_rows_np(
+                np.asarray(jax.device_get(t.fp)),
+                np.asarray(jax.device_get(t.qx)), self.cfg)
+            n_rows = t.fp.shape[0]
+        elif flags.transfer_compress_embedx and self.cfg.total_dim:
             host = _get_compressed(t, self.cfg)
+            n_rows = t.shape[0]
         else:
             host = np.asarray(jax.device_get(t))
-        nbytes = transfer_bytes(self.cfg, t.shape[0])
+            n_rows = t.shape[0]
+        nbytes = transfer_bytes(self.cfg, n_rows)
         store.write_back(self.sorted_keys, host[1:1 + self.num_keys])
         return nbytes
 

@@ -1,3 +1,4 @@
 from paddlebox_tpu.train.trainer import Trainer, TrainerConfig  # noqa: F401
 from paddlebox_tpu.train.heter import HeterTrainer, HeterConfig  # noqa: F401
+from paddlebox_tpu.train.phased import PhasedTrainer  # noqa: F401
 from paddlebox_tpu.train import optimizers  # noqa: F401

@@ -1,0 +1,79 @@
+"""Join/update phase training — two compiled programs over one table.
+
+Reference semantics: ``BoxWrapper::FlipPhase`` (box_wrapper.h:625)
+alternates the training *program* between pass groups. The join phase
+trains with the CVM (show/clk) feature columns (use_cvm=True,
+fused_seqpool_cvm_op.cu:166-189); the update phase drops them
+(use_cvm=False, cu:212-228) — a narrower input layout and therefore a
+DIFFERENT dense network — while both phases pull/push the SAME sparse
+table. Metrics are accumulated per phase (the registry's phase gate,
+box_wrapper.h:630).
+
+TPU-native shape: two :class:`Trainer`s — one per phase's model — sharing
+one host store and ONE :class:`FeedPassManager`, so the device-resident
+working set carries across phase flips exactly like consecutive passes
+(the table never round-trips the host at a flip). Each phase keeps its own
+dense params/optimizer; the sparse table is the shared state, matching the
+reference's one-PS-two-programs layout.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+
+from paddlebox_tpu.data.schema import DataFeedSchema
+from paddlebox_tpu.embedding import HostEmbeddingStore
+from paddlebox_tpu.fleet.boxps import JOIN_PHASE
+from paddlebox_tpu.train.trainer import Trainer, TrainerConfig
+
+
+class PhasedTrainer:
+    """Two-phase (join/update) trainer over one shared sparse table."""
+
+    def __init__(self, join_model, update_model,
+                 store: HostEmbeddingStore, schema: DataFeedSchema,
+                 mesh: jax.sharding.Mesh,
+                 join_config: TrainerConfig | None = None,
+                 update_config: TrainerConfig | None = None,
+                 seed: int = 0):
+        if getattr(join_model, "use_cvm", True) is False:
+            raise ValueError("join_model must be built with use_cvm=True")
+        if getattr(update_model, "use_cvm", False) is True:
+            raise ValueError("update_model must be built with use_cvm=False")
+        self.join = Trainer(join_model, store, schema, mesh,
+                            join_config, seed=seed)
+        # the update program shares the feed manager: a phase flip reuses
+        # the resident working set instead of rebuilding it
+        self.update = Trainer(update_model, store, schema, mesh,
+                              update_config, seed=seed + 1,
+                              feed_mgr=self.join.feed_mgr)
+        self.store = store
+
+    def trainer_for(self, phase: int) -> Trainer:
+        return self.join if phase == JOIN_PHASE else self.update
+
+    def train_pass(self, dataset, box=None, metrics: Any = None,
+                   phase: int | None = None) -> dict[str, float]:
+        """One pass with the program selected by the phase bit.
+
+        Pass either a BoxPS facade (its current phase is used and its
+        metric registry receives the batches, gated by phase) or an
+        explicit ``phase``.
+        """
+        if phase is None:
+            if box is None:
+                raise ValueError("need box or explicit phase")
+            phase = box.phase
+        if metrics is None and box is not None:
+            metrics = box.metrics
+        out = self.trainer_for(phase).train_pass(dataset, metrics=metrics)
+        out["phase"] = phase
+        return out
+
+    def eval_pass(self, dataset, phase: int = JOIN_PHASE) -> dict[str, float]:
+        return self.trainer_for(phase).eval_pass(dataset)
+
+    def flush_sparse(self) -> int:
+        return self.join.flush_sparse()

@@ -52,8 +52,14 @@ class TrainerConfig:
     check_nan_inf: bool = False            # FLAGS_check_nan_inf
     nan_dump_dir: str | None = None        # dump-all-scope dir on nan trip
     dump_fields_path: str | None = None    # DumpField per-instance stream
+    # DumpField/DumpParam config (trainer_desc.proto:39-45). dump_fields
+    # names extra per-instance columns beyond (step, pred, label):
+    # "ins_id", any float slot name, or any sparse slot name (ids joined
+    # by ","). dump_param names dense-param path substrings; matched
+    # leaves are written to the stream at the end of each pass.
+    dump_fields: tuple = ()
+    dump_param: tuple = ()
     scale_sparse_grad_by_global_mean: bool = True
-    join_phase: bool = True                # use_cvm on (join) vs off (update)
     # Dense sync (BoxPSWorkerParameter.sync_mode, trainer_desc.proto:100-108)
     dense_sync_mode: str = "allreduce"     # allreduce | kstep | async
     param_sync_step: int = 1               # K for kstep mode
@@ -88,7 +94,8 @@ class Trainer:
 
     def __init__(self, model, store: HostEmbeddingStore,
                  schema: DataFeedSchema, mesh: jax.sharding.Mesh,
-                 config: TrainerConfig | None = None, seed: int = 0):
+                 config: TrainerConfig | None = None, seed: int = 0,
+                 feed_mgr: FeedPassManager | None = None):
         self.model = model
         self.store = store
         self.schema = schema
@@ -153,8 +160,10 @@ class Trainer:
             self.opt_state = jax.device_put(self.tx.init(init_params), repl)
         self.timers = StageTimers(["read", "translate", "train", "auc"])
         # incremental + overlapped pass boundaries (BoxHelper FeedPass):
-        # resident device rows are reused across passes, write-back is lazy
-        self.feed_mgr = FeedPassManager(store, mesh)
+        # resident device rows are reused across passes, write-back is lazy.
+        # Pass a shared manager when several trainers drive one table
+        # (join/update phase programs — see train/phased.py).
+        self.feed_mgr = feed_mgr or FeedPassManager(store, mesh)
         self._step_fn = self._build_train_step()
         self._eval_fn = self._build_eval_step()
         self._auc_fn = jax.jit(auc_lib.auc_update)
@@ -460,9 +469,10 @@ class Trainer:
                                           rank=pb.rank)
                 if dump_stream is not None:
                     if dump_pending is not None:
-                        s, p, y = dump_pending
-                        dump_stream.write_fields(s, p, y)
-                    dump_pending = (self.global_step, preds, labels)
+                        s, p, y, ex = dump_pending
+                        dump_stream.write_fields(s, p, y, ex)
+                    dump_pending = (self.global_step, preds, labels,
+                                    self._dump_extra_fields(pb))
                 if cfg.check_nan_inf:
                     lv = float(loss)
                     if not np.isfinite(lv):
@@ -502,8 +512,10 @@ class Trainer:
                 # failure is reported but never masks the training exception.
                 try:
                     if dump_pending is not None:
-                        s, p, y = dump_pending
-                        dump_stream.write_fields(s, p, y)
+                        s, p, y, ex = dump_pending
+                        dump_stream.write_fields(s, p, y, ex)
+                    if cfg.dump_param:
+                        self._dump_params(dump_stream)
                     dump_stream.close()
                 except Exception as e:
                     import warnings
@@ -548,6 +560,44 @@ class Trainer:
             self._eval_fn = self._build_eval_step()
         warnings.warn(msg)
         return total
+
+    def _dump_extra_fields(self, pb: PackedBatch) -> dict:
+        """Per-instance extra dump columns (DumpField's dump_fields list,
+        trainer_desc.proto:39-41): ins_id, float slots, sparse slot ids."""
+        extra: dict[str, Any] = {}
+        sparse_names = {s.name for s in self.schema.sparse_slots}
+        float_names = {s.name for s in self.schema.float_slots}
+        for f in self.cfg.dump_fields:
+            if f in ("pred", "label"):
+                continue                    # always in the base columns
+            if f == "ins_id":
+                ins = (pb.ins_id if pb.ins_id is not None
+                       else np.zeros(len(pb.floats), np.uint64))
+                extra["ins_id"] = ins
+            elif f in float_names:
+                extra[f] = pb.float_slot(f).reshape(len(pb.floats), -1)[:, 0]
+            elif f in sparse_names:
+                ids, m = pb.slot_ids(f)
+                extra[f] = np.array(
+                    [",".join(str(v) for v, ok in zip(row, mk) if ok)
+                     for row, mk in zip(ids, m)], dtype=object)
+            else:
+                raise KeyError(f"unknown dump field {f!r}")
+        return extra
+
+    def _dump_params(self, dump_stream) -> None:
+        """DumpParam (trainer_desc.proto:43-45): write matched dense
+        params to the stream at pass end."""
+        import jax.tree_util as jtu
+        flat = jtu.tree_flatten_with_path(self.eval_params())[0]
+        for path, leaf in flat:
+            name = "/".join(str(getattr(p, "key", getattr(p, "idx", p)))
+                            for p in path)
+            if not any(pat in name for pat in self.cfg.dump_param):
+                continue
+            vals = np.asarray(leaf).reshape(-1)
+            dump_stream.write(
+                f"param {name} " + ",".join(f"{v:.6g}" for v in vals))
 
     def preload_pass(self, keys: np.ndarray) -> None:
         """BeginFeedPass: stage the next pass's working set (key diff, host

@@ -129,3 +129,53 @@ def test_trainer_dump_and_nan_guard(tmp_path):
     with open(dump_path) as fh:
         dumped = fh.read().splitlines()
     assert len(dumped) == 16  # one line per trained example
+
+
+def test_dump_field_param_parity(tmp_path):
+    """Configurable DumpField columns (ins_id + slots) and DumpParam
+    (trainer_desc.proto:39-45)."""
+    from paddlebox_tpu.data import DataFeedSchema, SlotDataset
+    from paddlebox_tpu.embedding import EmbeddingConfig, HostEmbeddingStore
+    from paddlebox_tpu.models import DNNCTRModel
+    from paddlebox_tpu.parallel import make_mesh
+    from paddlebox_tpu.train import Trainer, TrainerConfig
+
+    schema = DataFeedSchema.ctr(num_sparse=3, num_float=1, batch_size=8,
+                                max_len=2)
+    rng = np.random.default_rng(0)
+    ds = SlotDataset(schema)
+    ds.with_ins_id = True
+    lines = []
+    for i in range(16):
+        parts = [f"1 {int(rng.random() < 0.4)}", f"1 {rng.random():.3f}"]
+        for s in range(3):
+            parts.append(f"2 {rng.integers(1, 1000)} {rng.integers(1, 1000)}")
+        lines.append(f"ins_{i:04d}\t" + " ".join(parts))
+    f = tmp_path / "part-0"
+    f.write_text("\n".join(lines) + "\n")
+    ds.set_filelist([str(f)])
+    ds.load_into_memory(global_shuffle=False)
+    assert ds.records.ins_id.any()
+
+    store = HostEmbeddingStore(EmbeddingConfig(dim=4))
+    mesh = make_mesh(1)
+    model = DNNCTRModel(num_slots=3, emb_dim=4, dense_dim=1, hidden=(8,))
+    dump_path = str(tmp_path / "fields.txt")
+    tr = Trainer(model, store, schema, mesh,
+                 TrainerConfig(global_batch_size=8, auc_buckets=1 << 8,
+                               dump_fields_path=dump_path,
+                               dump_fields=("ins_id", "dense_0", "slot_1"),
+                               dump_param=("mlp",)))
+    tr.train_pass(ds)
+    with open(dump_path) as fh:
+        dumped = fh.read().splitlines()
+    inst = [l for l in dumped if l.startswith("param") is False]
+    params = [l for l in dumped if l.startswith("param")]
+    assert len(inst) == 16
+    # every instance line carries the configured columns
+    for l in inst:
+        assert "ins_id:" in l and "dense_0:" in l and "slot_1:" in l
+    # slot_1 column carries comma-joined raw feature signs
+    assert any("," in l.split("slot_1:")[1] for l in inst)
+    # param dump matched the mlp tree
+    assert params and all(l.startswith("param mlp") for l in params)

@@ -1,0 +1,181 @@
+"""Quantized embedx storage (EmbeddingConfig.storage = int8/int16).
+
+Reference: Quant/ShowClk feature-type pull variants dequantize embedx at
+the pull (box_wrapper.cu:35-432); here the device working set stores the
+embedx plane quantized with a per-row scale and computes in f32
+(embedding/quant.py).
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from paddlebox_tpu.data import DataFeedSchema
+from paddlebox_tpu.data.parser import parse_multislot_lines
+from paddlebox_tpu.data.dataset import SlotDataset
+from paddlebox_tpu.embedding import (EmbeddingConfig, HostEmbeddingStore,
+                                     PassWorkingSet, quant, sharded)
+from paddlebox_tpu.models import DNNCTRModel
+from paddlebox_tpu.parallel import make_mesh
+from paddlebox_tpu.train import Trainer, TrainerConfig
+
+
+def _rows(cfg, n, seed=0):
+    rng = np.random.default_rng(seed)
+    rows = rng.normal(size=(n, cfg.row_width)).astype(np.float32) * 0.05
+    rows[:, 0] = rng.integers(0, 50, n)       # shows
+    rows[:, 1] = rng.integers(0, 5, n)        # clks
+    return rows
+
+
+@pytest.mark.parametrize("storage", ["int8", "int16"])
+def test_encode_decode_roundtrip(storage):
+    cfg = EmbeddingConfig(dim=8, storage=storage)
+    rows = _rows(cfg, 64)
+    fp, qx = quant.encode_rows_np(rows, cfg)
+    assert qx.dtype == np.dtype(storage)
+    back = quant.decode_rows_np(fp, qx, cfg)
+    # counters/w/opt state exact; embedx within one quantization step
+    np.testing.assert_array_equal(back[:, :3], rows[:, :3])
+    np.testing.assert_array_equal(back[:, cfg.opt_cols], rows[:, cfg.opt_cols])
+    scale = fp[:, -1]
+    err = np.abs(back[:, cfg.embedx_cols] - rows[:, cfg.embedx_cols])
+    assert (err <= 0.5 * scale[:, None] + 1e-9).all()
+
+
+def test_lookup_dequantizes(storage="int16"):
+    cfg = EmbeddingConfig(dim=8, storage=storage)
+    rows = _rows(cfg, 128)
+    table = quant.device_table(rows, cfg, None)
+    idx = jnp.asarray(np.arange(128, dtype=np.int32))
+    pulled = np.asarray(sharded.lookup(table, idx, cfg))
+    np.testing.assert_allclose(pulled[:, :3], rows[:, :3], rtol=1e-6)
+    np.testing.assert_allclose(pulled[:, 3:], rows[:, cfg.embedx_cols],
+                               atol=np.abs(rows[:, cfg.embedx_cols]
+                                           ).max() / 30000)
+
+
+def test_push_parity_with_f32():
+    """Several update steps on int16 storage track the f32 table closely
+    (exact f32 optimizer math between dequant/requant)."""
+    f32 = EmbeddingConfig(dim=8, learning_rate=0.1)
+    q16 = EmbeddingConfig(dim=8, learning_rate=0.1, storage="int16")
+    rows = _rows(f32, 256, seed=3)
+    t_f = jnp.asarray(rows)
+    t_q = quant.device_table(rows, q16, None)
+    rng = np.random.default_rng(0)
+    push_f = jax.jit(lambda t, i, g, s, c: sharded.push(t, i, g, s, c, f32))
+    push_q = jax.jit(lambda t, i, g, s, c: sharded.push(t, i, g, s, c, q16))
+    for step in range(5):
+        idx = jnp.asarray(rng.integers(1, 256, 64).astype(np.int32))
+        g = jnp.asarray(0.1 * rng.normal(size=(64, f32.grad_width))
+                        .astype(np.float32))
+        s = jnp.ones(64, jnp.float32)
+        c = jnp.zeros(64, jnp.float32)
+        t_f = push_f(t_f, idx, g, s, c)
+        t_q = push_q(t_q, idx, g, s, c)
+    final_q = quant.decode_rows_np(np.asarray(t_q.fp), np.asarray(t_q.qx),
+                                   q16)
+    final_f = np.asarray(t_f)
+    np.testing.assert_array_equal(final_q[:, :3], final_f[:, :3])
+    np.testing.assert_allclose(final_q[:, q16.opt_cols],
+                               final_f[:, f32.opt_cols], atol=1e-5)
+    emb_err = np.abs(final_q[:, q16.embedx_cols]
+                     - final_f[:, f32.embedx_cols])
+    assert emb_err.max() < 5e-4, emb_err.max()
+
+
+def test_untouched_rows_keep_exact_bits():
+    """Rows no batch referenced must not be re-rounded by the pass."""
+    cfg = EmbeddingConfig(dim=4, storage="int8", learning_rate=0.1)
+    rows = _rows(cfg, 64, seed=9)
+    t = quant.device_table(rows, cfg, None)
+    qx0 = np.asarray(t.qx).copy()
+    fp0 = np.asarray(t.fp).copy()
+    idx = jnp.asarray(np.array([5, 9], np.int32))
+    g = jnp.asarray(0.5 * np.ones((2, cfg.grad_width), np.float32))
+    t = sharded.push(t, idx, g, jnp.ones(2), jnp.zeros(2), cfg)
+    untouched = np.setdiff1d(np.arange(64), [5, 9])
+    np.testing.assert_array_equal(np.asarray(t.qx)[untouched],
+                                  qx0[untouched])
+    np.testing.assert_array_equal(np.asarray(t.fp)[untouched],
+                                  fp0[untouched])
+    assert not np.array_equal(np.asarray(t.fp)[[5, 9]], fp0[[5, 9]])
+
+
+NUM_SLOTS = 4
+
+
+def _ds(n, seed=0):
+    rng = np.random.default_rng(seed)
+    schema = DataFeedSchema.ctr(num_sparse=NUM_SLOTS, num_float=1,
+                                batch_size=64, max_len=2)
+    w = np.random.default_rng(21).normal(size=(NUM_SLOTS, 4000)) * 1.5
+    lines = []
+    for _ in range(n):
+        logits, parts, sl = 0.0, [], []
+        for s in range(NUM_SLOTS):
+            ids = rng.integers(0, 4000, size=2)
+            sl.append(ids)
+            logits += w[s, ids].sum()
+        p = 1 / (1 + np.exp(-logits * 0.6))
+        parts.append(f"1 {float(rng.random() < p)}")
+        parts.append(f"1 {rng.normal():.3f}")
+        for s, ids in enumerate(sl):
+            parts.append(
+                f"2 {' '.join(str(int(i) + s * 1000003) for i in ids)}")
+        lines.append(" ".join(parts))
+    ds = SlotDataset(schema)
+    ds.records = parse_multislot_lines(lines, schema)
+    return ds, schema
+
+
+def test_trainer_e2e_quant_storage_close_to_f32():
+    """Full sharded training with int16 storage matches f32 AUC/loss
+    within tolerance; boundary transfers shrink accordingly."""
+    ds, schema = _ds(512)
+    mesh = make_mesh(8)
+    out = {}
+    for storage in ("f32", "int16"):
+        store = HostEmbeddingStore(
+            EmbeddingConfig(dim=4, learning_rate=0.15, storage=storage))
+        tr = Trainer(DNNCTRModel(num_slots=NUM_SLOTS, emb_dim=4,
+                                 dense_dim=1, hidden=(16,)),
+                     store, schema, mesh,
+                     TrainerConfig(global_batch_size=64, dense_lr=5e-3,
+                                   auc_buckets=1 << 10))
+        r1 = tr.train_pass(ds)
+        r2 = tr.train_pass(ds)
+        out[storage] = (r1, r2, tr.feed_mgr.last_h2d_bytes)
+    for i in range(2):
+        assert out["int16"][i]["loss_mean"] == pytest.approx(
+            out["f32"][i]["loss_mean"], abs=5e-3)
+        assert out["int16"][i]["auc"] == pytest.approx(
+            out["f32"][i]["auc"], abs=0.02)
+    assert out["int16"][1]["loss_mean"] < out["int16"][0]["loss_mean"]
+    # pass-2 boundary H2D for int16 is smaller than f32's
+    assert out["int16"][2] < out["f32"][2]
+
+
+def test_quant_checkpoint_roundtrip_keeps_f32_host():
+    """The host store stays f32 regardless of device storage: save/load
+    reproduces trained values (within quant tolerance of the device)."""
+    ds, schema = _ds(128)
+    mesh = make_mesh(4)
+    store = HostEmbeddingStore(
+        EmbeddingConfig(dim=4, learning_rate=0.15, storage="int16"))
+    tr = Trainer(DNNCTRModel(num_slots=NUM_SLOTS, emb_dim=4, dense_dim=1,
+                             hidden=(16,)),
+                 store, schema, mesh,
+                 TrainerConfig(global_batch_size=64, auc_buckets=1 << 8))
+    tr.train_pass(ds)
+    keys = ds.unique_keys()
+    rows = store.get_rows(keys)              # flush hook fires
+    assert rows[:, 0].sum() > 0              # shows accumulated
+    import tempfile, os
+    with tempfile.TemporaryDirectory() as d:
+        store.save_base(os.path.join(d, "b"))
+        loaded = HostEmbeddingStore.load(os.path.join(d, "b"))
+        np.testing.assert_array_equal(loaded.get_rows(keys), rows)

@@ -1,0 +1,149 @@
+"""SpillEmbeddingStore: disk-backed row tier + RAM hot cache.
+
+Reference role: the SSD + host tiers behind libbox_ps (LoadSSD2Mem,
+box_wrapper.h:487-494) — table capacity bounded by disk, not DRAM.
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+from paddlebox_tpu.data import DataFeedSchema
+from paddlebox_tpu.data.parser import parse_multislot_lines
+from paddlebox_tpu.data.dataset import SlotDataset
+from paddlebox_tpu.embedding import (EmbeddingConfig, HostEmbeddingStore,
+                                     SpillEmbeddingStore)
+from paddlebox_tpu.models import DNNCTRModel
+from paddlebox_tpu.parallel import make_mesh
+from paddlebox_tpu.train import Trainer, TrainerConfig
+
+
+def cfg_small(**kw):
+    kw.setdefault("dim", 4)
+    kw.setdefault("optimizer", "adagrad")
+    kw.setdefault("learning_rate", 0.1)
+    return EmbeddingConfig(**kw)
+
+
+def _keys(lo, hi):
+    return np.arange(lo, hi, dtype=np.uint64) * np.uint64(2654435761) + 1
+
+
+def test_rows_live_on_disk(tmp_path):
+    c = cfg_small()
+    store = SpillEmbeddingStore(c, spill_dir=str(tmp_path / "spill"),
+                                cache_rows=64)
+    keys = _keys(0, 5000)
+    rows = store.lookup_or_init(keys)
+    assert store.spill_file_bytes >= 5000 * c.row_width * 4
+    # deterministic init matches the RAM store's
+    ram = HostEmbeddingStore(c)
+    np.testing.assert_array_equal(rows, ram.lookup_or_init(keys))
+
+
+def test_parity_with_ram_store_under_mixed_ops(tmp_path):
+    """Same op sequence on both stores → bit-identical state, even with a
+    cache FAR smaller than the key count (cold reads fault in from disk)."""
+    c = cfg_small()
+    rng = np.random.default_rng(0)
+    ram = HostEmbeddingStore(c)
+    spill = SpillEmbeddingStore(c, spill_dir=str(tmp_path / "s"),
+                                cache_rows=37)   # pathologically tiny
+    all_keys = _keys(0, 3000)
+    seen = set()
+    for step in range(6):
+        ks = rng.choice(all_keys, size=500, replace=False)
+        seen.update(int(k) for k in ks)
+        r1 = ram.lookup_or_init(ks)
+        r2 = spill.lookup_or_init(ks)
+        np.testing.assert_array_equal(r1, r2)
+        upd = r1 + rng.normal(size=r1.shape).astype(np.float32)
+        upd[:, 0] += 1.0                         # show counters
+        ram.write_back(ks, upd)
+        spill.write_back(ks, upd)
+    check = np.array(sorted(seen), dtype=np.uint64)[:500]
+    np.testing.assert_array_equal(ram.get_rows(check),
+                                  spill.get_rows(check))
+    assert spill.cache_misses > 0 and spill.cache_hits > 0
+
+
+def test_shrink_and_checkpoint_roundtrip(tmp_path):
+    c = cfg_small()
+    spill = SpillEmbeddingStore(c, spill_dir=str(tmp_path / "s"),
+                                cache_rows=50)
+    keys = _keys(0, 400)
+    rows = spill.lookup_or_init(keys)
+    rows[:200, 0] = 5.0                          # half get shows
+    spill.write_back(keys, rows)
+    evicted = spill.shrink(min_show=1.0)
+    assert evicted == 200
+    assert len(spill) == 200
+    # post-compaction reads are correct (cache was invalidated)
+    np.testing.assert_allclose(spill.get_rows(keys[:200])[:, 0], 5.0)
+    base = spill.save_base(str(tmp_path / "ckpt"))
+    assert os.path.exists(base)
+    loaded = HostEmbeddingStore.load(str(tmp_path / "ckpt"))
+    np.testing.assert_array_equal(loaded.get_rows(keys[:200]),
+                                  spill.get_rows(keys[:200]))
+
+
+NUM_SLOTS = 4
+
+
+def _ds(n, seed=0):
+    rng = np.random.default_rng(seed)
+    schema = DataFeedSchema.ctr(num_sparse=NUM_SLOTS, num_float=1,
+                                batch_size=64, max_len=2)
+    w = np.random.default_rng(7).normal(size=(NUM_SLOTS, 4000)) * 1.5
+    lines = []
+    for _ in range(n):
+        logits, parts, sl = 0.0, [], []
+        for s in range(NUM_SLOTS):
+            ids = rng.integers(0, 4000, size=2)
+            sl.append(ids)
+            logits += w[s, ids].sum()
+        p = 1 / (1 + np.exp(-logits * 0.6))
+        parts.append(f"1 {float(rng.random() < p)}")
+        parts.append(f"1 {rng.normal():.3f}")
+        for s, ids in enumerate(sl):
+            parts.append(
+                f"2 {' '.join(str(int(i) + s * 1000003) for i in ids)}")
+        lines.append(" ".join(parts))
+    ds = SlotDataset(schema)
+    ds.records = parse_multislot_lines(lines, schema)
+    return ds, schema
+
+
+def test_training_with_cache_under_half_of_keys(tmp_path):
+    """VERDICT r1 #3 'done' bar: train correctly with the RAM tier capped
+    below 50% of the table's keys; trajectory must match the RAM store
+    exactly (the spill tier is a storage choice, not a math change)."""
+    ds, schema = _ds(512)
+    n_keys = len(ds.unique_keys())
+    results = {}
+    mesh = make_mesh(8)
+    for name in ("ram", "spill"):
+        if name == "ram":
+            store = HostEmbeddingStore(cfg_small())
+        else:
+            store = SpillEmbeddingStore(
+                cfg_small(), spill_dir=str(tmp_path / "sp"),
+                cache_rows=max(1, n_keys // 3))   # < 50% of keys in RAM
+        tr = Trainer(DNNCTRModel(num_slots=NUM_SLOTS, emb_dim=4,
+                                 dense_dim=1, hidden=(16,)),
+                     store, schema, mesh,
+                     TrainerConfig(global_batch_size=64, dense_lr=5e-3,
+                                   auc_buckets=1 << 10))
+        out1 = tr.train_pass(ds)
+        out2 = tr.train_pass(ds)
+        results[name] = (out1, out2, store)
+    spill_store = results["spill"][2]
+    assert spill_store._cache_slots < 0.5 * n_keys
+    for i in range(2):
+        assert results["ram"][i]["loss_mean"] == \
+            pytest.approx(results["spill"][i]["loss_mean"], abs=1e-7)
+        assert results["ram"][i]["auc"] == \
+            pytest.approx(results["spill"][i]["auc"], abs=1e-7)
+    # second pass learned (sanity that the comparison is not vacuous)
+    assert results["spill"][1]["loss_mean"] < results["spill"][0]["loss_mean"]
