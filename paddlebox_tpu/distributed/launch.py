@@ -46,7 +46,12 @@ def launch(nprocs: int, cmd: list[str], store_dir: str | None = None,
     terminated (a hung peer would otherwise block on its next collective
     until the store timeout)."""
     store_dir = store_dir or tempfile.mkdtemp(prefix="pbtpu_store_")
-    endpoints = ",".join(f"127.0.0.1:{p}" for p in _free_ports(nprocs))
+    # one endpoint per rank (shuffle/PS transports) + a dedicated port for
+    # the jax.distributed coordinator — rank 0 binds its own endpoint for
+    # the TCP shuffle server, so the coordinator must not share it
+    ports = _free_ports(nprocs + 1)
+    endpoints = ",".join(f"127.0.0.1:{p}" for p in ports[:nprocs])
+    coordinator = f"127.0.0.1:{ports[nprocs]}"
     run_id = uuid.uuid4().hex[:12]
     procs: list[subprocess.Popen] = []
     for rank in range(nprocs):
@@ -54,6 +59,7 @@ def launch(nprocs: int, cmd: list[str], store_dir: str | None = None,
         env.update(base_env or {})
         env["PBTPU_TRAINER_ID"] = str(rank)
         env["PBTPU_TRAINER_ENDPOINTS"] = endpoints
+        env["PBTPU_COORDINATOR"] = coordinator
         env["PBTPU_STORE_DIR"] = store_dir
         env["PBTPU_RUN_ID"] = run_id
         procs.append(subprocess.Popen(cmd, env=env))

@@ -19,6 +19,7 @@ from paddlebox_tpu.distributed.store import FileStore
 
 ENV_RANK = "PBTPU_TRAINER_ID"
 ENV_ENDPOINTS = "PBTPU_TRAINER_ENDPOINTS"
+ENV_COORDINATOR = "PBTPU_COORDINATOR"
 ENV_STORE = "PBTPU_STORE_DIR"
 ENV_RUN_ID = "PBTPU_RUN_ID"
 
@@ -29,6 +30,11 @@ class RoleMaker:
     endpoints: list[str] = field(default_factory=lambda: ["localhost:0"])
     store_dir: str | None = None
     run_id: str = ""
+    # jax.distributed coordinator address; defaults to endpoints[0], but a
+    # launcher that also runs a TCP shuffle/PS server on rank 0's endpoint
+    # must hand out a dedicated port (PBTPU_COORDINATOR) to avoid the bind
+    # collision
+    coordinator: str | None = None
 
     @classmethod
     def from_env(cls) -> "RoleMaker":
@@ -36,7 +42,8 @@ class RoleMaker:
         eps = os.environ.get(ENV_ENDPOINTS, "localhost:0").split(",")
         return cls(rank=rank, endpoints=[e.strip() for e in eps if e.strip()],
                    store_dir=os.environ.get(ENV_STORE),
-                   run_id=os.environ.get(ENV_RUN_ID, ""))
+                   run_id=os.environ.get(ENV_RUN_ID, ""),
+                   coordinator=os.environ.get(ENV_COORDINATOR) or None)
 
     @property
     def world_size(self) -> int:
@@ -63,18 +70,33 @@ class RoleMaker:
         return HostCollectives(store, self.rank, self.world_size,
                                run_id=self.run_id)
 
-    def init_distributed(self) -> None:
+    def init_distributed(self, sim_cpu_devices: int | None = None) -> None:
         """Join the global JAX process group (real multi-host pods).
 
         After this, jax.devices() spans every host and a Mesh built from it
         gives the 2D (node, dp) topology whose collectives ride ICI within
         a host's chips and DCN across hosts.
+
+        ``sim_cpu_devices`` (or env ``PBTPU_SIM_CPU_DEVICES``) puts the
+        process on the CPU backend with that many virtual local devices and
+        gloo cross-process collectives — the reference's "real NCCL over
+        loopback" CI trick (test_collective_base.py:162-210) without
+        hardware: N processes x M virtual devices form one global mesh and
+        run the actual sharded train step. Must be called before any other
+        JAX use in the process.
         """
+        if sim_cpu_devices is None:
+            env = os.environ.get("PBTPU_SIM_CPU_DEVICES")
+            sim_cpu_devices = int(env) if env else None
+        import jax
+        if sim_cpu_devices:
+            jax.config.update("jax_platforms", "cpu")
+            jax.config.update("jax_num_cpu_devices", sim_cpu_devices)
+            jax.config.update("jax_cpu_collectives_implementation", "gloo")
         if self.world_size == 1:
             return
-        import jax
         jax.distributed.initialize(
-            coordinator_address=self.endpoints[0],
+            coordinator_address=self.coordinator or self.endpoints[0],
             num_processes=self.world_size,
             process_id=self.rank,
         )

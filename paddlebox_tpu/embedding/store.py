@@ -78,7 +78,10 @@ class HostEmbeddingStore:
         self._rows[idx] = rows
 
     def _rows_compacted(self) -> None:
-        """Called after shrink/remove rebuilds reassign row ids."""
+        """Called when row storage changed outside ``_write_rows`` — a
+        shrink/remove rebuild reassigned row ids, or an in-place mutation
+        (shrink's show decay) rewrote ``self._rows`` directly. Caching
+        tiers must invalidate."""
 
     def register_flush_hook(self, fn) -> None:
         self._flush_hooks.append(fn)
@@ -227,6 +230,8 @@ class HostEmbeddingStore:
                 self._rows[:self._n, 0] *= decay
                 # decayed counters must reach the next delta checkpoint
                 self._dirty[:self._n] = True
+                # in-place write bypassed _write_rows: drop cached copies
+                self._rows_compacted()
             keep = self._rows[:self._n, 0] >= min_show
             evicted = int((~keep).sum())
             if evicted:

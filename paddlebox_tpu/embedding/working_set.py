@@ -143,6 +143,13 @@ def _gather_rows_jit(compress: bool, lo: int, hi: int):
     return jax.jit(gather)
 
 
+@functools.lru_cache(maxsize=2)
+def _gather_rows_quant_jit():
+    # one dispatch for both planes; paired with a single device_get so a
+    # pass-boundary flush pays one D2H round trip, not two serialized ones
+    return jax.jit(lambda fp, qx, idx: (fp[idx], qx[idx]))
+
+
 def fetch_rows(table: jax.Array, row_idx: np.ndarray,
                cfg: EmbeddingConfig) -> tuple[np.ndarray, int]:
     """Device-side gather of `row_idx` rows, then D2H of just those rows.
@@ -158,12 +165,10 @@ def fetch_rows(table: jax.Array, row_idx: np.ndarray,
     idxp = np.zeros(k_pad, np.int32)
     idxp[:k] = row_idx
     if quant.is_quant(table):
-        fp_d = _gather_rows_jit(False, 0, 0)(table.fp, idxp)
-        qx_d = _gather_rows_jit(False, 0, 0)(table.qx, idxp)
-        fp = np.asarray(jax.device_get(fp_d))
-        qx = np.asarray(jax.device_get(qx_d))
+        fp_d, qx_d = _gather_rows_quant_jit()(table.fp, table.qx, idxp)
+        fp, qx = (np.asarray(a) for a in jax.device_get((fp_d, qx_d)))
         rows = quant.decode_rows_np(fp, qx, cfg)
-        return rows[:k], fp.nbytes + qx.nbytes
+        return rows[:k], transfer_bytes(cfg, k_pad)
     compress = bool(flags.transfer_compress_embedx and cfg.total_dim)
     lo, hi = _split_cols(cfg)
     out = _gather_rows_jit(compress, lo, hi)(table, idxp)

@@ -575,12 +575,14 @@ class Trainer:
                        else np.zeros(len(pb.floats), np.uint64))
                 extra["ins_id"] = ins
             elif f in float_names:
-                extra[f] = pb.float_slot(f).reshape(len(pb.floats), -1)[:, 0]
+                vals = pb.float_slot(f).reshape(len(pb.floats), -1)
+                # all components of a multi-value float field are dumped
+                # (comma-joined by the writer thread)
+                extra[f] = vals[:, 0] if vals.shape[1] == 1 else vals
             elif f in sparse_names:
-                ids, m = pb.slot_ids(f)
-                extra[f] = np.array(
-                    [",".join(str(v) for v, ok in zip(row, mk) if ok)
-                     for row, mk in zip(ids, m)], dtype=object)
+                # raw (ids, mask) pair — the per-instance id join runs on
+                # the DumpStream writer thread, not the training thread
+                extra[f] = pb.slot_ids(f)
             else:
                 raise KeyError(f"unknown dump field {f!r}")
         return extra

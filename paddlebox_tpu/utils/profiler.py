@@ -223,6 +223,23 @@ def dump_tree(path: str, tree: Any) -> str:
 # DumpStream (DumpField/DumpParam channel + threads)
 # ---------------------------------------------------------------------------
 
+def _col_formatter(v):
+    """Per-instance formatter for one dump column, run on the writer thread.
+
+    Accepts a 1-D array (scalar per instance), a 2-D array (multi-value
+    float slot — comma-joined), or an ``(ids, mask)`` pair (sparse slot —
+    the masked ids comma-joined). Keeping the per-instance string work here
+    is the point of the deferred job: the training thread never formats.
+    """
+    if isinstance(v, tuple):
+        ids, mask = v
+        return lambda i: ",".join(
+            str(x) for x, ok in zip(ids[i], mask[i]) if ok)
+    if getattr(v, "ndim", 1) >= 2:
+        return lambda i: ",".join(f"{x:g}" for x in v[i])
+    return lambda i: f"{v[i]}"
+
+
 class DumpStream:
     """Background-thread line dumper.
 
@@ -253,9 +270,11 @@ class DumpStream:
                     self._f.write(job)
                 else:  # deferred field-formatting job (see write_fields)
                     step, preds, labels, cols = job
+                    fmts = {k: _col_formatter(v) for k, v in cols.items()}
                     out = []
                     for i in range(len(preds)):
-                        tail = "".join(f" {k}:{cols[k][i]}" for k in cols)
+                        tail = "".join(f" {k}:{fmt(i)}"
+                                       for k, fmt in fmts.items())
                         out.append(f"{step} {i} {preds[i]:.6f} "
                                    f"{labels[i]:g}{tail}\n")
                     self._f.write("".join(out))
@@ -276,7 +295,14 @@ class DumpStream:
         the writer thread so the training loop isn't serialized behind it."""
         preds = host_local(preds).reshape(-1)
         labels = host_local(labels).reshape(-1)
-        cols = {k: host_local(v).reshape(-1) for k, v in (extra or {}).items()}
+
+        def col(v):
+            if isinstance(v, tuple):      # (ids, mask) sparse slot pair
+                return tuple(host_local(x) for x in v)
+            v = host_local(v)
+            return v if getattr(v, "ndim", 1) >= 2 else v.reshape(-1)
+
+        cols = {k: col(v) for k, v in (extra or {}).items()}
         self._q.put((int(step), preds, labels, cols))
 
     def close(self) -> None:

@@ -1,0 +1,94 @@
+"""Shared data + training recipe for the cross-process multi-host train test.
+
+Both the 2-process workers (tests/multihost_train_worker.py) and the
+single-process reference run (tests/test_multihost_train.py) import this, so
+parity is checked on literally the same code path — the only variable is
+whether the 4-device (2 node x 2 dp) global mesh spans one process or two.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+NUM_SLOTS = 4
+VOCAB = 50
+EXAMPLES_PER_RANK = 512
+WORLD = 2
+BATCH = 64
+PASSES = 2
+
+
+def make_schema():
+    from paddlebox_tpu.data import DataFeedSchema
+    return DataFeedSchema.ctr(num_sparse=NUM_SLOTS, num_float=1,
+                              batch_size=BATCH, max_len=2)
+
+
+def make_lines(rank: int) -> list[str]:
+    """Rank-local shard of a learnable synthetic CTR set, with ins_id.
+
+    Labels follow latent per-id weights so training has real signal; the
+    ins_id prefix gives every example a globally unique, deterministic
+    identity — the sort key that makes the post-shuffle global order
+    process-count-invariant.
+    """
+    rng = np.random.default_rng(100 + rank)
+    id_weight = np.random.default_rng(99).normal(
+        size=(NUM_SLOTS, VOCAB)) * 1.5
+    lines = []
+    for i in range(EXAMPLES_PER_RANK):
+        logits = 0.0
+        parts = []
+        ids_per_slot = []
+        for s in range(NUM_SLOTS):
+            k = rng.integers(1, 3)
+            ids = rng.integers(0, VOCAB, size=k)
+            ids_per_slot.append(ids)
+            logits += id_weight[s, ids].sum()
+        dense_val = rng.normal()
+        p = 1.0 / (1.0 + np.exp(-(logits * 0.8)))
+        label = float(rng.random() < p)
+        parts.append(f"1 {label}")
+        parts.append(f"1 {dense_val:.4f}")
+        for s, ids in enumerate(ids_per_slot):
+            signs = [str(int(v) + s * 1000003) for v in ids]
+            parts.append(f"{len(signs)} {' '.join(signs)}")
+        lines.append(f"ins_{rank}_{i}\t" + " ".join(parts))
+    return lines
+
+
+def sort_by_ins_id(records):
+    """Canonical global order: ascending ins_id hash (unique per example)."""
+    order = np.argsort(records.ins_id, kind="stable")
+    return records.select(order)
+
+
+def run_training(mesh, records, schema) -> dict:
+    """The recipe under test: sharded table + jitted SPMD step, 2 passes."""
+    from paddlebox_tpu.data import SlotDataset
+    from paddlebox_tpu.embedding import EmbeddingConfig, HostEmbeddingStore
+    from paddlebox_tpu.models import DNNCTRModel
+    from paddlebox_tpu.train import Trainer, TrainerConfig
+
+    ds = SlotDataset(schema)
+    ds.records = records
+    store = HostEmbeddingStore(EmbeddingConfig(dim=8, learning_rate=0.15))
+    model = DNNCTRModel(num_slots=NUM_SLOTS, emb_dim=8, dense_dim=1,
+                        hidden=(16, 8))
+    tr = Trainer(model, store, schema, mesh,
+                 TrainerConfig(global_batch_size=BATCH, dense_lr=3e-3,
+                               auc_buckets=1 << 12), seed=0)
+    out = {}
+    for p in range(PASSES):
+        res = tr.train_pass(ds)
+        out[f"pass{p}_loss_first"] = res["loss_first"]
+        out[f"pass{p}_loss_mean"] = res["loss_mean"]
+        out[f"pass{p}_auc"] = res["auc"]
+        out[f"pass{p}_steps"] = res["steps"]
+    tr.flush_sparse()                       # D2H of dirty rows (cross-proc)
+    keys = np.sort(records.unique_keys())
+    rows = store.get_rows(keys)
+    out["store_keys"] = int(len(store))
+    out["store_w_sum"] = float(np.abs(rows[:, 2]).sum())
+    out["store_show_sum"] = float(rows[:, 0].sum())
+    return out

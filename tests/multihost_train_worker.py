@@ -1,0 +1,85 @@
+"""Worker process for the cross-process multi-host train test.
+
+Launched (2 processes) by tests/test_multihost_train.py via
+paddlebox_tpu.distributed.launch. Each worker:
+
+1. joins the global JAX process group on the CPU backend (2 virtual local
+   devices each -> one 4-device global mesh across 2 processes),
+2. loads its rank-local file shard and runs the inter-host TCP global
+   shuffle routed by ins_id,
+3. reassembles the identical canonical global dataset on every rank
+   (archive write + barrier + read-all, sorted by ins_id),
+4. runs the real sharded train_pass recipe over the global mesh,
+5. rank 0 writes the metrics JSON the pytest side compares against a
+   single-process run of the same recipe.
+
+Mirrors the reference's subprocess trainer harness
+(test_collective_base.py:141 _run_cluster: real NCCL over loopback).
+"""
+
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+from paddlebox_tpu.distributed import RoleMaker  # noqa: E402
+
+rm = RoleMaker.from_env()
+rm.init_distributed(sim_cpu_devices=2)  # before any other JAX use
+
+import jax  # noqa: E402
+import numpy as np  # noqa: E402
+
+import multihost_train_common as common  # noqa: E402
+from paddlebox_tpu.data import SlotDataset  # noqa: E402
+from paddlebox_tpu.data.archive import read_archive, write_archive  # noqa: E402
+from paddlebox_tpu.data.shuffle import TcpShuffleService  # noqa: E402
+from paddlebox_tpu.data.slot_record import SlotRecordBatch  # noqa: E402
+from paddlebox_tpu.parallel import make_mesh  # noqa: E402
+
+assert rm.world_size == common.WORLD, rm
+assert len(jax.devices()) == 2 * common.WORLD, jax.devices()
+assert len(jax.local_devices()) == 2
+
+work_dir = os.environ["PBTPU_TEST_WORKDIR"]
+col = rm.collectives(timeout_s=180)
+schema = common.make_schema()
+
+# -- rank-local ingest + inter-host global shuffle (DCN transport) ---------
+shard_file = os.path.join(work_dir, f"input_{rm.rank}.txt")
+with open(shard_file, "w") as f:
+    f.write("\n".join(common.make_lines(rm.rank)) + "\n")
+svc = TcpShuffleService(rm.rank, rm.endpoints)
+ds = SlotDataset(schema, shuffle_service=svc)
+ds.with_ins_id = True
+ds.set_filelist([shard_file])
+col.barrier()                        # both shuffle servers listening
+ds.load_into_memory(global_shuffle=True, routing="ins_id")
+svc.close()
+
+# every record must have routed to the rank its ins_id hashes to
+from paddlebox_tpu.data.shuffle import hash64_array  # noqa: E402
+assert (hash64_array(ds.records.ins_id) % np.uint64(common.WORLD)
+        == rm.rank).all()
+n_tot = col.all_reduce(np.asarray([float(ds.records.num)]))
+assert n_tot[0] == common.WORLD * common.EXAMPLES_PER_RANK, n_tot
+
+# -- canonical global dataset on every rank (SPMD needs identical feeds) ---
+write_archive(os.path.join(work_dir, f"shard_{rm.rank}.pbar"), ds.records)
+col.barrier()
+parts = [read_archive(os.path.join(work_dir, f"shard_{r}.pbar"), schema)
+         for r in range(rm.world_size)]
+records = common.sort_by_ins_id(SlotRecordBatch.concat(parts))
+assert records.num == common.WORLD * common.EXAMPLES_PER_RANK
+
+# -- the real sharded training recipe over the 2-process global mesh -------
+mesh = make_mesh(num_nodes=common.WORLD)   # (2 node, 2 dp) across processes
+assert mesh.devices.shape == (common.WORLD, 2)
+out = common.run_training(mesh, records, schema)
+
+if rm.rank == 0:
+    with open(os.path.join(work_dir, "result.json"), "w") as f:
+        json.dump(out, f)
+print(f"rank {rm.rank} done: {out}", flush=True)

@@ -68,6 +68,25 @@ def test_parity_with_ram_store_under_mixed_ops(tmp_path):
     assert spill.cache_misses > 0 and spill.cache_hits > 0
 
 
+def test_shrink_decay_without_eviction_invalidates_cache(tmp_path):
+    """Regression: shrink's show decay writes self._rows in place (bypassing
+    _write_rows). With nothing evicted, no compaction runs — cached rows
+    must still see the decayed counters, matching the RAM store exactly."""
+    c = cfg_small()
+    ram = HostEmbeddingStore(c)
+    spill = SpillEmbeddingStore(c, spill_dir=str(tmp_path / "s"),
+                                cache_rows=1024)
+    keys = _keys(0, 100)
+    for st in (ram, spill):
+        rows = st.lookup_or_init(keys)
+        rows[:, 0] = 10.0
+        st.write_back(keys, rows)
+        st.get_rows(keys)                 # warm the spill store's cache
+        assert st.shrink(min_show=1.0, decay=0.5) == 0
+    np.testing.assert_array_equal(ram.get_rows(keys), spill.get_rows(keys))
+    assert spill.get_rows(keys)[:, 0].max() == 5.0
+
+
 def test_shrink_and_checkpoint_roundtrip(tmp_path):
     c = cfg_small()
     spill = SpillEmbeddingStore(c, spill_dir=str(tmp_path / "s"),
