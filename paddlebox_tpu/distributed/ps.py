@@ -36,6 +36,7 @@ from typing import Any, Sequence
 
 import numpy as np
 
+from paddlebox_tpu.embedding import gating
 from paddlebox_tpu.embedding.config import EmbeddingConfig
 from paddlebox_tpu.embedding.store import HostEmbeddingStore
 from paddlebox_tpu.parallel.dense_sync import AsyncDenseTable
@@ -108,7 +109,10 @@ class _SparseTable:
     def pull(self, keys: np.ndarray, init_missing: bool) -> np.ndarray:
         rows = (self.store.lookup_or_init(keys) if init_missing
                 else self.store.peek_rows(keys))
-        return rows[:, :self.cfg.pull_width]
+        # pull-layout view gates absent Variable/NNCross planes (gating.py);
+        # pull_rows (the storage-plane view) deliberately does not
+        return gating.gate_pull_xp(rows[:, :self.cfg.pull_width],
+                                   self.cfg, np)
 
     def pull_rows(self, keys: np.ndarray, init_missing: bool) -> np.ndarray:
         return (self.store.lookup_or_init(keys) if init_missing

@@ -8,14 +8,16 @@ We keep that layout, as one flat float32 row per feature:
 
     col 0            show      (impression counter, drives CVM + shrink)
     col 1            clk       (click counter)
-    col 2            embed_w   (scalar weight)
-    cols 3..3+dim    embedx    (embedding vector)
+    cols 2..2+n_w    embed_w   (scalar weight block; n_w = embed_w_num,
+                                > 1 for the ShareEmbedding feature type)
+    then  ..+dim     embedx    (embedding vector)
     tail             optimizer state (per `optimizer`)
 
-Pull (what a lookup returns to the model) = cols [0, 3+dim) — show, clk, w,
-embedx; matching the reference's pull value. Push = (d_w, d_embedx) grads plus
-show/clk increments, applied *inside the table* like the reference's PS-side
-optimizer (box_wrapper_impl.h:229 "optimizer update inside the PS").
+Pull (what a lookup returns to the model) = cols [0, fixed_cols + dim) —
+show, clk, w-block, embedx; matching the reference's pull value. Push =
+(d_w-block, d_embedx) grads plus show/clk increments, applied *inside the
+table* like the reference's PS-side optimizer (box_wrapper_impl.h:229
+"optimizer update inside the PS").
 
 Supported embedx dims mirror the reference's dispatch envelope
 (box_wrapper.cc:444-461): any dim works here (no template dispatch), the
@@ -50,7 +52,23 @@ class EmbeddingConfig:
     ftrl_l1: float = 1.0
     ftrl_l2: float = 1.0
     ftrl_beta: float = 1.0
-    mf_create_threshold: float = 0.0  # min show before embedx trains (parity knob)
+    # Variable/NNCross feature types (FeatureVarPullValueGpu /
+    # PullCopy*NNCross, box_wrapper.cu:161-260): each key's embedx — and,
+    # separately, its expand plane — exists only once the key has enough
+    # shows; absent planes pull as zeros and receive no grads. The
+    # reference's per-key `embedding_size`/`embed_expand_size` presence
+    # flags (total_dims bits, box_wrapper.cu:182-184) become show-threshold
+    # masks over fixed-shape rows — the static-shape rendering of a
+    # variable-length row. 0 = plane always present (the base feature type).
+    mf_create_threshold: float = 0.0
+    expand_create_threshold: float = 0.0
+    # ShareEmbedding feature type (FeaturePullValueGpuShareEmbedding,
+    # box_wrapper.cc:419-422; PushCopyBaseShareEmbedding box_wrapper.cu:543):
+    # several slots share one key space, the row carries one scalar embed
+    # weight PER SHARING SLOT (embed_g[SHARE_EMBEDDING_NUM]) plus the common
+    # embedx. Here: the w column becomes a block of `embed_w_num` columns;
+    # ops/share_embedding.py selects each slot's plane from the pull.
+    embed_w_num: int = 1
     seed: int = 0
     # Device working-set storage for the embedx plane: "f32" (exact) or
     # "int16"/"int8" (quantized with a per-row scale — the reference's
@@ -67,6 +85,18 @@ class EmbeddingConfig:
         if self.storage not in ("f32", "int16", "int8"):
             raise ValueError(f"storage must be f32|int16|int8, "
                              f"got {self.storage!r}")
+        if self.embed_w_num < 1:
+            raise ValueError("embed_w_num must be >= 1")
+        if self.embed_w_num > 1 and self.optimizer == "ftrl":
+            raise ValueError(
+                "share-embedding (embed_w_num > 1) is not supported with the "
+                "ftrl optimizer: FTRL's z/n state is per-feature scalar and "
+                "cannot serve a w block; use sgd/adagrad/adam")
+        if self.mf_create_threshold < 0 or self.expand_create_threshold < 0:
+            raise ValueError("create thresholds must be >= 0")
+        if self.expand_create_threshold > 0 and not self.expand_dim:
+            raise ValueError(
+                "expand_create_threshold needs expand_dim > 0")
 
     # --- row geometry ---
     @property
@@ -85,26 +115,35 @@ class EmbeddingConfig:
         return _OPT_SLOTS[self.optimizer]
 
     @property
+    def fixed_cols(self) -> int:
+        """show, clk, w-block — the columns before embedx."""
+        return 2 + self.embed_w_num
+
+    @property
     def pull_width(self) -> int:
-        """show, clk, w, embedx(+expand) — what lookup returns."""
-        return 3 + self.total_dim
+        """show, clk, w-block, embedx(+expand) — what lookup returns."""
+        return self.fixed_cols + self.total_dim
 
     @property
     def grad_width(self) -> int:
-        """d_w, d_embedx(+expand) — what push consumes."""
-        return 1 + self.total_dim
+        """d_w-block, d_embedx(+expand) — what push consumes."""
+        return self.embed_w_num + self.total_dim
 
     @property
     def row_width(self) -> int:
-        return 3 + self.total_dim + self.n_opt_slots
+        return self.fixed_cols + self.total_dim + self.n_opt_slots
 
     # column helpers
     SHOW, CLK, W = 0, 1, 2
 
     @property
+    def w_cols(self) -> slice:
+        return slice(2, self.fixed_cols)
+
+    @property
     def embedx_cols(self) -> slice:
-        return slice(3, 3 + self.total_dim)
+        return slice(self.fixed_cols, self.fixed_cols + self.total_dim)
 
     @property
     def opt_cols(self) -> slice:
-        return slice(3 + self.total_dim, self.row_width)
+        return slice(self.fixed_cols + self.total_dim, self.row_width)

@@ -1,0 +1,67 @@
+"""Variable/NNCross presence gating — ONE implementation for every path.
+
+Reference: the per-key ``embedding_size``/``embed_expand_size`` presence
+flags (``total_dims`` bits, box_wrapper.cu:182-184) make absent planes pull
+as zeros (PullCopy*NNCross zero fill, box_wrapper.cu:199-221) and take no
+grads (PushCopy writes ``embedx_g = 0``, box_wrapper.cu:531-536). Here
+presence is a show-threshold test over fixed-shape rows.
+
+Every consumer — the device pull (sharded.gate_pull), the grad gate
+(optim._gate_grads), and the host pulls (heter trainer, PS table, serving
+table) — routes through :func:`gate_planes` so the threshold semantics can
+never diverge between paths. The ONLY sanctioned difference is which show
+the caller passes: pulls gate on the row's current show; the push gate
+passes the post-increment show (a key crossing the threshold this step
+starts training immediately — the PS creates the plane at push time).
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+from paddlebox_tpu.embedding.config import EmbeddingConfig
+
+
+class GateSpec(NamedTuple):
+    """The four numbers gating needs — so consumers without a full
+    EmbeddingConfig (the serving table after load) can still gate."""
+    fixed_cols: int
+    dim: int
+    mf_create_threshold: float
+    expand_create_threshold: float
+
+    @classmethod
+    def from_cfg(cls, cfg: EmbeddingConfig) -> "GateSpec":
+        return cls(cfg.fixed_cols, cfg.dim, cfg.mf_create_threshold,
+                   cfg.expand_create_threshold)
+
+
+def needs_gating(cfg) -> bool:
+    """cfg: EmbeddingConfig or GateSpec."""
+    return cfg.mf_create_threshold > 0 or cfg.expand_create_threshold > 0
+
+
+def gate_planes(mf, ex, show, cfg, xp):
+    """Mask the embedx / expand planes by their create thresholds.
+
+    mf   : (..., dim)         embedx plane (values OR grads)
+    ex   : (..., expand_dim)  expand plane
+    show : (..., 1)           broadcastable show column
+    xp   : numpy or jax.numpy
+    """
+    if cfg.mf_create_threshold > 0:
+        mf = xp.where(show >= cfg.mf_create_threshold, mf, 0.0)
+    if cfg.expand_create_threshold > 0:
+        ex = xp.where(show >= cfg.expand_create_threshold, ex, 0.0)
+    return mf, ex
+
+
+def gate_pull_xp(pulled, cfg, xp):
+    """Gate a pull-layout block (..., pull_width); no-op at thresholds 0."""
+    if not needs_gating(cfg):
+        return pulled
+    fc = cfg.fixed_cols
+    mf, ex = gate_planes(pulled[..., fc:fc + cfg.dim],
+                         pulled[..., fc + cfg.dim:],
+                         pulled[..., 0:1], cfg, xp)
+    return xp.concatenate([pulled[..., :fc], mf, ex], axis=-1)

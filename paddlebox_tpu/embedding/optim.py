@@ -7,6 +7,19 @@ We follow the same design: each optimizer's state is a handful of scalar
 columns per feature (see config.py row layout), and ``apply_updates`` is a
 pure jittable function over a block of rows, so the update fuses into the
 push path on device.
+
+Feature-type hooks handled here:
+- ShareEmbedding (``embed_w_num > 1``): the scalar w becomes a w block; its
+  per-feature accumulator aggregates over the block exactly the way the
+  embedx accumulator aggregates over embedx columns. With ``embed_w_num=1``
+  every formula reduces to the original scalar-w math bit-for-bit.
+- Variable/NNCross (``mf_create_threshold``/``expand_create_threshold``):
+  grads to a plane that does not exist yet for a key (show below the
+  plane's create threshold) are dropped, mirroring the reference's
+  PushCopy writing ``embedx_g = 0`` for absent planes
+  (box_wrapper.cu:531-536). The threshold tests the POST-increment show, so
+  a key crossing it this step starts training immediately (the PS creates
+  the plane at push time).
 """
 
 from __future__ import annotations
@@ -14,7 +27,18 @@ from __future__ import annotations
 import jax.numpy as jnp
 
 from paddlebox_tpu.embedding.config import EmbeddingConfig
+from paddlebox_tpu.embedding import gating
 from paddlebox_tpu.ops.ftrl import ftrl_step
+
+
+def _gate_grads(g_x: jnp.ndarray, show: jnp.ndarray,
+                cfg: EmbeddingConfig) -> jnp.ndarray:
+    """Zero embedx/expand grads for keys whose plane is not yet created.
+
+    `show` is the POST-increment count — see gating.py on why."""
+    gx_mf, gx_ex = gating.gate_planes(
+        g_x[:, :cfg.dim], g_x[:, cfg.dim:], show[:, None], cfg, jnp)
+    return jnp.concatenate([gx_mf, gx_ex], axis=1)
 
 
 def apply_updates(rows: jnp.ndarray, grads: jnp.ndarray,
@@ -23,61 +47,80 @@ def apply_updates(rows: jnp.ndarray, grads: jnp.ndarray,
     """Apply one sparse update to a block of rows.
 
     rows     : (n, row_width) current table rows
-    grads    : (n, 1 + dim)   summed d_w, d_embedx for each row
-    show/clk : (n,)           impression / click count increments
+    grads    : (n, grad_width) summed d_w-block, d_embedx for each row
+    show/clk : (n,)            impression / click count increments
     Returns new rows. Rows whose grad is all-zero are unchanged (up to
     counter increments), so padded/null rows are safe to pass through.
     """
     d = cfg.total_dim
+    nw = cfg.embed_w_num
+    ob = cfg.fixed_cols + d                  # first optimizer-state column
     show = rows[:, 0] + show_inc
     clk = rows[:, 1] + clk_inc
-    w = rows[:, 2]
+    w = rows[:, cfg.w_cols]                  # (n, nw)
     x = rows[:, cfg.embedx_cols]
-    g_w = grads[:, 0]
-    g_x = grads[:, 1:]
+    g_w = grads[:, :nw]
+    g_x = grads[:, nw:]
+    if cfg.mf_create_threshold > 0 or cfg.expand_create_threshold > 0:
+        g_x = _gate_grads(g_x, show, cfg)
     lr = cfg.learning_rate
+
+    # per-feature SCALAR accumulators aggregate over their column block;
+    # for nw == 1 the w aggregates equal the plain scalar-w math
+    mean_gw = jnp.mean(g_w, axis=1)
+    mean_gw2 = jnp.mean(g_w * g_w, axis=1)
 
     if cfg.optimizer == "sgd":
         new_w = w - lr * g_w
         new_x = x - lr * g_x
         opt = rows[:, cfg.opt_cols]
     elif cfg.optimizer == "adagrad":
-        w_g2, x_g2 = rows[:, 3 + d], rows[:, 4 + d]
-        new_wg2 = w_g2 + g_w * g_w
-        mean_gx2 = jnp.mean(g_x * g_x, axis=1) if d else jnp.zeros_like(g_w)
+        w_g2, x_g2 = rows[:, ob], rows[:, ob + 1]
+        new_wg2 = w_g2 + mean_gw2
+        mean_gx2 = jnp.mean(g_x * g_x, axis=1) if d else jnp.zeros_like(show)
         new_xg2 = x_g2 + mean_gx2
         scale_w = lr * jnp.sqrt(cfg.initial_g2sum /
                                 (cfg.initial_g2sum + new_wg2))
         scale_x = lr * jnp.sqrt(cfg.initial_g2sum /
                                 (cfg.initial_g2sum + new_xg2))
-        new_w = w - scale_w * g_w
+        new_w = w - scale_w[:, None] * g_w
         new_x = x - scale_x[:, None] * g_x
         opt = jnp.stack([new_wg2, new_xg2], axis=1)
     elif cfg.optimizer == "adam":
         b1, b2 = cfg.beta1, cfg.beta2
-        w_m, w_v = rows[:, 3 + d], rows[:, 4 + d]
-        x_m, x_v = rows[:, 5 + d], rows[:, 6 + d]
-        mean_gx = jnp.mean(g_x, axis=1) if d else jnp.zeros_like(g_w)
-        mean_gx2 = jnp.mean(g_x * g_x, axis=1) if d else jnp.zeros_like(g_w)
-        nw_m = b1 * w_m + (1 - b1) * g_w
-        nw_v = b2 * w_v + (1 - b2) * g_w * g_w
+        w_m, w_v = rows[:, ob], rows[:, ob + 1]
+        x_m, x_v = rows[:, ob + 2], rows[:, ob + 3]
+        mean_gx = jnp.mean(g_x, axis=1) if d else jnp.zeros_like(show)
+        mean_gx2 = jnp.mean(g_x * g_x, axis=1) if d else jnp.zeros_like(show)
+        nw_m = b1 * w_m + (1 - b1) * mean_gw
+        nw_v = b2 * w_v + (1 - b2) * mean_gw2
         nx_m = b1 * x_m + (1 - b1) * mean_gx
         nx_v = b2 * x_v + (1 - b2) * mean_gx2
         eps = 1e-8
-        new_w = w - lr * nw_m / (jnp.sqrt(nw_v) + eps)
-        # per-feature scalar moments: direction from the element grad, scale
-        # from the feature-level second moment
+        # per-feature scalar moments. nw == 1 keeps the ORIGINAL scalar-w
+        # direction (nw_m) bit-for-bit — checkpoint continuation must not
+        # retrain differently after this feature landed. A w BLOCK needs a
+        # per-element direction while the moment stays feature-level, so it
+        # blends like embedx below.
+        if nw == 1:
+            w_dir = nw_m[:, None]
+        else:
+            w_dir = b1 * nw_m[:, None] + (1 - b1) * g_w
+        new_w = w - lr * w_dir / (jnp.sqrt(nw_v)[:, None] + eps)
         new_x = x - lr * (b1 * nx_m[:, None] + (1 - b1) * g_x) / (
             jnp.sqrt(nx_v)[:, None] + eps)
         opt = jnp.stack([nw_m, nw_v, nx_m, nx_v], axis=1)
     elif cfg.optimizer == "ftrl":
         # FTRL-proximal on the scalar w (the wide/LR component — its natural
         # habitat); adagrad on embedx with the remaining two state columns.
-        z, n = rows[:, 3 + d], rows[:, 4 + d]
-        new_w, new_z, new_n = ftrl_step(
-            g_w, z, n, w, lr, cfg.ftrl_l1, cfg.ftrl_l2, cfg.ftrl_beta)
-        x_g2 = rows[:, 5 + d]
-        mean_gx2 = jnp.mean(g_x * g_x, axis=1) if d else jnp.zeros_like(g_w)
+        # config.py forbids embed_w_num > 1 here.
+        z, n = rows[:, ob], rows[:, ob + 1]
+        new_w1, new_z, new_n = ftrl_step(
+            g_w[:, 0], z, n, w[:, 0], lr, cfg.ftrl_l1, cfg.ftrl_l2,
+            cfg.ftrl_beta)
+        new_w = new_w1[:, None]
+        x_g2 = rows[:, ob + 2]
+        mean_gx2 = jnp.mean(g_x * g_x, axis=1) if d else jnp.zeros_like(show)
         new_xg2 = x_g2 + mean_gx2
         scale_x = lr * jnp.sqrt(cfg.initial_g2sum /
                                 (cfg.initial_g2sum + new_xg2))
@@ -87,4 +130,4 @@ def apply_updates(rows: jnp.ndarray, grads: jnp.ndarray,
         raise ValueError(cfg.optimizer)
 
     return jnp.concatenate(
-        [show[:, None], clk[:, None], new_w[:, None], new_x, opt], axis=1)
+        [show[:, None], clk[:, None], new_w, new_x, opt], axis=1)

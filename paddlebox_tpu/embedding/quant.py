@@ -6,8 +6,9 @@ box_wrapper.cu:35-432) — trading a bounded precision loss for table
 capacity. TPU-native shape: the device working-set table becomes a
 two-plane pytree —
 
-    fp : f32 (N, 3 + n_opt_slots + 1)   show, clk, w, optimizer state,
-                                        and the per-row dequant scale
+    fp : f32 (N, fixed_cols + n_opt_slots + 1)
+                                        show, clk, w-block, optimizer
+                                        state, and the per-row dequant scale
     qx : int8|int16 (N, total_dim)      quantized embedx(+expand)
 
 Compute stays f32: lookups dequantize at the gather (``x = qx * scale``),
@@ -39,7 +40,7 @@ _QINFO = {"int8": (jnp.int8, 127.0), "int16": (jnp.int16, 32767.0)}
 
 
 class QuantTable(NamedTuple):
-    fp: jnp.ndarray     # f32 (N, 3 + n_opt + 1): show, clk, w, opt, scale
+    fp: jnp.ndarray     # f32 (N, fixed + n_opt + 1): show, clk, w*, opt, scale
     qx: jnp.ndarray     # int8/int16 (N, total_dim)
 
 
@@ -60,7 +61,7 @@ def qmax(cfg: EmbeddingConfig) -> float:
 
 
 def fp_width(cfg: EmbeddingConfig) -> int:
-    return 3 + cfg.n_opt_slots + 1
+    return cfg.fixed_cols + cfg.n_opt_slots + 1
 
 
 # ---------------------------------------------------------------------------
@@ -78,25 +79,27 @@ def encode_rows_np(rows: np.ndarray, cfg: EmbeddingConfig
     qx = np.round(x / scale[:, None]).astype(
         np.dtype(qdtype(cfg).__name__))
     fp = np.concatenate(
-        [rows[:, :3], rows[:, cfg.opt_cols], scale[:, None]],
+        [rows[:, :cfg.fixed_cols], rows[:, cfg.opt_cols], scale[:, None]],
         axis=1).astype(np.float32)
     return fp, qx
 
 
 def decode_rows_np(fp: np.ndarray, qx: np.ndarray,
                    cfg: EmbeddingConfig) -> np.ndarray:
+    fc = cfg.fixed_cols
     rows = np.empty((len(fp), cfg.row_width), np.float32)
-    rows[:, :3] = fp[:, :3]
+    rows[:, :fc] = fp[:, :fc]
     rows[:, cfg.embedx_cols] = qx.astype(np.float32) * fp[:, -1:]
-    rows[:, cfg.opt_cols] = fp[:, 3:3 + cfg.n_opt_slots]
+    rows[:, cfg.opt_cols] = fp[:, fc:fc + cfg.n_opt_slots]
     return rows
 
 
 def assemble_rows(fp: jnp.ndarray, qx: jnp.ndarray,
                   cfg: EmbeddingConfig) -> jnp.ndarray:
     """Traced planes → full f32 rows (fuses into the consumer)."""
+    fc = cfg.fixed_cols
     x = qx.astype(jnp.float32) * fp[:, -1:]
-    return jnp.concatenate([fp[:, :3], x, fp[:, 3:3 + cfg.n_opt_slots]],
+    return jnp.concatenate([fp[:, :fc], x, fp[:, fc:fc + cfg.n_opt_slots]],
                            axis=1)
 
 
@@ -110,7 +113,8 @@ def split_rows(rows: jnp.ndarray, cfg: EmbeddingConfig) -> QuantTable:
         scale = jnp.full((rows.shape[0],), 1e-12, jnp.float32)
     qx = jnp.round(x / scale[:, None]).astype(qdtype(cfg))
     fp = jnp.concatenate(
-        [rows[:, :3], rows[:, cfg.opt_cols], scale[:, None]], axis=1)
+        [rows[:, :cfg.fixed_cols], rows[:, cfg.opt_cols], scale[:, None]],
+        axis=1)
     return QuantTable(fp=fp, qx=qx)
 
 

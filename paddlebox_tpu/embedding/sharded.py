@@ -35,7 +35,7 @@ from jax import lax
 
 from paddlebox_tpu.embedding.config import EmbeddingConfig
 from paddlebox_tpu.embedding.optim import apply_updates
-from paddlebox_tpu.embedding import quant
+from paddlebox_tpu.embedding import gating, quant
 from paddlebox_tpu.ops import pallas_kernels
 
 NULL_INDEX = 0  # reserved all-zero row; padding tokens point here
@@ -44,6 +44,13 @@ NULL_INDEX = 0  # reserved all-zero row; padding tokens point here
 def _take_rows(arr: jnp.ndarray, idx: jnp.ndarray) -> jnp.ndarray:
     """Full-row gather behind an optimization barrier (see lookup)."""
     return lax.optimization_barrier(jnp.take(arr, idx, axis=0))
+
+
+def gate_pull(pulled: jnp.ndarray, cfg: EmbeddingConfig) -> jnp.ndarray:
+    """Variable/NNCross presence masks (PullCopy*NNCross zero fill,
+    box_wrapper.cu:199-221): a key whose show has not reached a plane's
+    create threshold pulls that plane as zeros. No-op at thresholds 0."""
+    return gating.gate_pull_xp(pulled, cfg, jnp)
 
 
 # ---------------------------------------------------------------------------
@@ -72,10 +79,11 @@ def lookup(table: jnp.ndarray, idx: jnp.ndarray,
         fp = _take_rows(table.fp, flat)
         qx = _take_rows(table.qx, flat)
         x = qx.astype(jnp.float32) * fp[:, -1:]
-        pulled = jnp.concatenate([fp[:, :3], x], axis=1)
-        return pulled.reshape((*idx.shape, cfg.pull_width))
+        pulled = jnp.concatenate([fp[:, :cfg.fixed_cols], x], axis=1)
+        return gate_pull(pulled, cfg).reshape((*idx.shape, cfg.pull_width))
     rows = _take_rows(table, flat)
-    return rows[:, :cfg.pull_width].reshape((*idx.shape, cfg.pull_width))
+    pulled = rows[:, :cfg.pull_width]
+    return gate_pull(pulled, cfg).reshape((*idx.shape, cfg.pull_width))
 
 
 def push(table: jnp.ndarray, idx: jnp.ndarray, grads: jnp.ndarray,
@@ -219,17 +227,18 @@ def routed_lookup(table_shard: jnp.ndarray, idx: jnp.ndarray,
     lane_ok = (recv_idx >= 0)[:, :, None]
     if quant.is_quant(table_shard):
         # quantized a2a payload: the embedx plane crosses ICI as int8/16
-        # plus a 4-col f32 plane (show, clk, w, scale) — the reference's
-        # quant pull variants applied to the collective (box_wrapper.cu)
+        # plus a small f32 plane (show, clk, w-block, scale) — the
+        # reference's quant pull variants applied to the collective
+        fc = cfg.fixed_cols
         fp = _take_rows(table_shard.fp, local_row.reshape(-1))
         qx = _take_rows(table_shard.qx, local_row.reshape(-1))
-        fp4 = jnp.concatenate([fp[:, :3], fp[:, -1:]], axis=1)
-        fp4 = jnp.where(lane_ok, fp4.reshape(D, cap, 4), 0.0)
+        fph = jnp.concatenate([fp[:, :fc], fp[:, -1:]], axis=1)
+        fph = jnp.where(lane_ok, fph.reshape(D, cap, fc + 1), 0.0)
         qx = jnp.where(lane_ok, qx.reshape(D, cap, -1), 0)
-        back_fp = lax.all_to_all(fp4, axis_name, 0, 0, tiled=True)
+        back_fp = lax.all_to_all(fph, axis_name, 0, 0, tiled=True)
         back_qx = lax.all_to_all(qx, axis_name, 0, 0, tiled=True)
         x = back_qx.astype(jnp.float32) * back_fp[:, :, -1:]
-        back = jnp.concatenate([back_fp[:, :, :3], x], axis=2)
+        back = jnp.concatenate([back_fp[:, :, :fc], x], axis=2)
     else:
         # full-row take + barrier + slice: see lookup() for the rationale
         vals = _take_rows(table_shard,
@@ -241,6 +250,7 @@ def routed_lookup(table_shard: jnp.ndarray, idx: jnp.ndarray,
     gathered = back[jnp.minimum(sowner, D - 1), jnp.minimum(pos, cap - 1)]
     gathered = jnp.where(valid[:, None], gathered, 0.0)
     out = jnp.zeros((n, cfg.pull_width), gathered.dtype).at[order].set(gathered)
+    out = gate_pull(out, cfg)
     if return_dropped:
         dropped = jnp.sum((~valid) & (sowner < D)).astype(jnp.int32)
         return out, dropped

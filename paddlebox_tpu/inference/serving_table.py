@@ -17,9 +17,12 @@ import os
 
 import numpy as np
 
+from paddlebox_tpu.embedding.gating import GateSpec, gate_pull_xp
+
 
 class ServingTable:
-    def __init__(self, keys: np.ndarray, vals: np.ndarray):
+    def __init__(self, keys: np.ndarray, vals: np.ndarray,
+                 gate: GateSpec | None = None):
         keys = np.asarray(keys, dtype=np.uint64)
         vals = np.asarray(vals, dtype=np.float32)
         if keys.ndim != 1 or vals.ndim != 2 or len(keys) != len(vals):
@@ -27,6 +30,10 @@ class ServingTable:
         order = np.argsort(keys, kind="stable")
         self.keys = keys[order]
         self.vals = vals[order]
+        # Variable/NNCross presence gating (gating.py) — serving must mask
+        # absent planes exactly like training pulls, or models see
+        # train/serve skew on below-threshold keys
+        self.gate = gate
         if len(self.keys) and (self.keys[1:] == self.keys[:-1]).any():
             raise ValueError("duplicate keys in serving table")
 
@@ -42,7 +49,7 @@ class ServingTable:
     def from_store(cls, store) -> "ServingTable":
         """Freeze a HostEmbeddingStore's pull plane for serving."""
         keys, vals = store.export_serving()
-        return cls(keys, vals)
+        return cls(keys, vals, gate=GateSpec.from_cfg(store.cfg))
 
     # ------------------------------------------------------------------
     def _probe(self, keys: np.ndarray) -> tuple[np.ndarray, np.ndarray]:
@@ -64,6 +71,8 @@ class ServingTable:
         else:
             out = np.zeros((len(flat), self.pull_width), np.float32)
         out = out.reshape(*ids.shape, self.pull_width)
+        if self.gate is not None:
+            out = gate_pull_xp(out, self.gate, np)
         if mask is not None:
             out = out * np.asarray(mask, np.float32)[..., None]
         return out.astype(np.float32)
@@ -118,12 +127,23 @@ class ServingTable:
         os.makedirs(path, exist_ok=True)
         fname = os.path.join(path, "serving.npz")
         np.savez_compressed(fname, keys=self.keys, rows=self.vals)
+        meta = {"num_keys": int(len(self.keys)),
+                "pull_width": int(self.pull_width)}
+        if self.gate is not None:
+            meta["gate"] = list(self.gate)
         with open(os.path.join(path, "serving_meta.json"), "w") as f:
-            json.dump({"num_keys": int(len(self.keys)),
-                       "pull_width": int(self.pull_width)}, f)
+            json.dump(meta, f)
         return fname
 
     @classmethod
     def load(cls, path: str) -> "ServingTable":
         z = np.load(os.path.join(path, "serving.npz"))
-        return cls(z["keys"], z["rows"])
+        gate = None
+        meta_path = os.path.join(path, "serving_meta.json")
+        if os.path.exists(meta_path):
+            with open(meta_path) as f:
+                g = json.load(f).get("gate")
+            if g is not None:
+                gate = GateSpec(int(g[0]), int(g[1]), float(g[2]),
+                                float(g[3]))
+        return cls(z["keys"], z["rows"], gate=gate)

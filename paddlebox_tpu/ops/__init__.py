@@ -8,3 +8,5 @@ from paddlebox_tpu.ops.cross_norm import (cross_norm_hadamard, data_norm,  # noq
                                           summary_update, init_summary)
 from paddlebox_tpu.ops.fused_concat import fused_concat  # noqa: F401
 from paddlebox_tpu.ops.extended import pull_box_extended_sparse  # noqa: F401
+from paddlebox_tpu.ops.share_embedding import (  # noqa: F401
+    ShareEmbeddingModel, select_share_embedding)

@@ -18,12 +18,12 @@ from paddlebox_tpu.embedding.config import EmbeddingConfig
 
 def pull_box_extended_sparse(pulled: jnp.ndarray, cfg: EmbeddingConfig
                              ) -> tuple[jnp.ndarray, jnp.ndarray]:
-    """pulled (..., pull_width) → (base (..., 3+dim), expand (..., expand_dim)).
+    """pulled (..., pull_width) → (base, expand (..., expand_dim)).
 
-    Base keeps the [show, clk, w, embedx] layout every downstream op expects;
-    expand is the trailing expand_dim columns.
+    Base keeps the [show, clk, w-block, embedx] layout every downstream op
+    expects; expand is the trailing expand_dim columns.
     """
     if cfg.expand_dim == 0:
         raise ValueError("pull_box_extended_sparse needs expand_dim > 0")
-    split = 3 + cfg.dim
+    split = cfg.fixed_cols + cfg.dim
     return pulled[..., :split], pulled[..., split:]

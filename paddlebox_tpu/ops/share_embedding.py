@@ -1,0 +1,76 @@
+"""ShareEmbedding feature type — per-slot selection from a shared w block.
+
+Reference: ``FeaturePullValueGpuShareEmbedding`` /
+``FeaturePushValueGpuShareEmbedding`` (dispatch box_wrapper.cc:419-422,
+492-495; kernels ``PushCopyBaseShareEmbedding``/``PushMergeCopyBase-
+ShareEmbedding`` box_wrapper.cu:543-674): several slots share one key space
+and one embedx vector, but the PS row carries a scalar embed weight **per
+sharing slot** (``embed_g[SHARE_EMBEDDING_NUM]``) so each slot trains its
+own wide/LR component over the shared key.
+
+TPU-native rendering: ``EmbeddingConfig(embed_w_num=N)`` widens the row's w
+column into an N-column block (config.py), pulls return
+``[show, clk, w_0..w_{N-1}, embedx]``, and :func:`select_share_embedding`
+maps that to the standard ``[show, clk, w, embedx]`` view with each slot
+reading ITS plane — a take_along_axis whose autodiff scatters each slot's
+w-grad back to only its own plane (exactly the reference's per-slot
+``embed_g`` routing), while embedx grads from all sharing slots merge on
+the common key row.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+from paddlebox_tpu.embedding.config import EmbeddingConfig
+
+
+def select_share_embedding(pulled: jnp.ndarray, segment_ids,
+                           slot_share_idx, cfg: EmbeddingConfig
+                           ) -> jnp.ndarray:
+    """(B, T, pull_width) → (B, T, 3 + total_dim) standard pull view.
+
+    segment_ids    : (T,) slot id per token position (SparseLayout)
+    slot_share_idx : (num_slots,) which w plane each slot reads, in
+                     [0, embed_w_num)
+    """
+    n = cfg.embed_w_num
+    share = jnp.asarray(slot_share_idx, jnp.int32)[
+        jnp.asarray(segment_ids, jnp.int32)]                   # (T,)
+    w_block = pulled[..., 2:2 + n]                             # (B, T, n)
+    w_sel = jnp.take_along_axis(
+        w_block, jnp.broadcast_to(share[None, :, None],
+                                  (*w_block.shape[:2], 1)), axis=2)
+    return jnp.concatenate([pulled[..., :2], w_sel, pulled[..., 2 + n:]],
+                           axis=-1)
+
+
+class ShareEmbeddingModel:
+    """Wrap any zoo model to consume a share-embedding table.
+
+    The wrapper narrows the pulled block to the standard layout (each slot
+    reading its shared-w plane) before the inner model applies, so every
+    existing model works over a shared key space unchanged.
+    """
+
+    def __init__(self, inner, slot_share_idx, cfg: EmbeddingConfig):
+        if len(slot_share_idx) == 0:
+            raise ValueError("slot_share_idx must name every slot")
+        idx = np.asarray(slot_share_idx, np.int32)
+        if idx.min() < 0 or idx.max() >= cfg.embed_w_num:
+            raise ValueError(
+                f"slot_share_idx entries must be in [0, {cfg.embed_w_num})")
+        self.inner = inner
+        self.slot_share_idx = idx
+        self.cfg = cfg
+        self.emb_dim = getattr(inner, "emb_dim", None)
+
+    def init(self, key):
+        return self.inner.init(key)
+
+    def apply(self, params, pulled, mask, dense, segment_ids, num_slots=None):
+        narrowed = select_share_embedding(pulled, segment_ids,
+                                          self.slot_share_idx, self.cfg)
+        return self.inner.apply(params, narrowed, mask, dense, segment_ids,
+                                num_slots)

@@ -46,7 +46,7 @@ import optax
 
 from paddlebox_tpu.data.schema import DataFeedSchema
 from paddlebox_tpu.data.slot_record import SparseLayout
-from paddlebox_tpu.embedding import HostEmbeddingStore
+from paddlebox_tpu.embedding import HostEmbeddingStore, gating
 from paddlebox_tpu.embedding.optim import apply_updates
 from paddlebox_tpu.metrics import auc as auc_lib
 from paddlebox_tpu.train import optimizers
@@ -128,6 +128,9 @@ class HeterTrainer:
         B, T = pb.mask.shape
         pulled = np.zeros((B * T, P), np.float32)
         pulled[mask] = rows[inverse, :P]
+        # Variable/NNCross presence gating — same mask the sharded device
+        # pull applies (gating.py), or heter and sharded trainers diverge
+        pulled = gating.gate_pull_xp(pulled, self.store.cfg, np)
         labels, dense = _split(pb, self.cfg.label_slot)
         return (uniq, inverse, pulled.reshape(B, T, P), pb.mask, dense,
                 labels)

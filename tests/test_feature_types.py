@@ -1,0 +1,291 @@
+"""ShareEmbedding and Variable/NNCross feature types (VERDICT missing #5).
+
+Reference: the feature-type dispatch at box_wrapper.cc:406-461 selects
+pull/push value structs per type; ShareEmbedding rows carry one embed
+weight per sharing slot (box_wrapper.cu:543-674), Variable/NNCross rows
+carry presence-gated embedx/expand planes that pull as zeros and take no
+grads while absent (box_wrapper.cu:161-260).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from paddlebox_tpu.data import DataFeedSchema, SlotDataset
+from paddlebox_tpu.data.parser import parse_multislot_lines
+from paddlebox_tpu.embedding import (EmbeddingConfig, HostEmbeddingStore,
+                                     PassWorkingSet, sharded)
+from paddlebox_tpu.models import DNNCTRModel
+from paddlebox_tpu.ops import ShareEmbeddingModel, select_share_embedding
+from paddlebox_tpu.parallel import make_mesh
+from paddlebox_tpu.train import Trainer, TrainerConfig
+
+
+# ---------------------------------------------------------------------------
+# config geometry
+# ---------------------------------------------------------------------------
+
+def test_share_embedding_row_geometry():
+    c = EmbeddingConfig(dim=4, embed_w_num=3)
+    assert c.fixed_cols == 5
+    assert c.pull_width == 5 + 4
+    assert c.grad_width == 3 + 4
+    assert c.row_width == 5 + 4 + 2          # adagrad: 2 state cols
+    assert c.w_cols == slice(2, 5)
+    assert c.embedx_cols == slice(5, 9)
+
+
+def test_share_embedding_rejects_ftrl():
+    with pytest.raises(ValueError, match="ftrl"):
+        EmbeddingConfig(dim=4, embed_w_num=2, optimizer="ftrl")
+
+
+def test_variable_thresholds_validate():
+    with pytest.raises(ValueError, match="expand_create_threshold"):
+        EmbeddingConfig(dim=4, expand_create_threshold=2.0)  # no expand_dim
+    EmbeddingConfig(dim=4, expand_dim=2, expand_create_threshold=2.0)
+
+
+# ---------------------------------------------------------------------------
+# optimizer block math
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("opt", ["sgd", "adagrad", "adam"])
+def test_w_block_reduces_to_scalar_w(opt):
+    """embed_w_num=1 must produce the exact legacy scalar-w update, and an
+    embed_w_num=2 row whose two w planes get the same grad must update both
+    planes identically (equal to the scalar result for sgd/adagrad; adam's
+    block path deliberately blends the per-element direction, so only the
+    plane-symmetry and the shared state/embedx columns are invariant)."""
+    from paddlebox_tpu.embedding.optim import apply_updates
+
+    c1 = EmbeddingConfig(dim=4, optimizer=opt, learning_rate=0.05)
+    c2 = EmbeddingConfig(dim=4, optimizer=opt, learning_rate=0.05,
+                         embed_w_num=2)
+    rng = np.random.default_rng(0)
+    n = 16
+    rows1 = rng.normal(size=(n, c1.row_width)).astype(np.float32)
+    grads1 = rng.normal(size=(n, c1.grad_width)).astype(np.float32)
+    si = rng.random(n).astype(np.float32)
+    ci = rng.random(n).astype(np.float32)
+    out1 = np.asarray(apply_updates(jnp.asarray(rows1), jnp.asarray(grads1),
+                                    jnp.asarray(si), jnp.asarray(ci), c1))
+
+    if opt == "adam":
+        # nw=1 must match the LEGACY scalar formula exactly (checkpoint
+        # continuation): new_w = w - lr * nw_m / (sqrt(nw_v) + eps)
+        b1, b2 = c1.beta1, c1.beta2
+        w, g_w = rows1[:, 2], grads1[:, 0]
+        w_m, w_v = rows1[:, 7], rows1[:, 8]
+        nw_m = b1 * w_m + (1 - b1) * g_w
+        nw_v = b2 * w_v + (1 - b2) * g_w * g_w
+        legacy_w = w - 0.05 * nw_m / (np.sqrt(nw_v) + 1e-8)
+        np.testing.assert_allclose(out1[:, 2], legacy_w, rtol=1e-6)
+
+    # widen to 2 identical w planes with identical grads
+    rows2 = np.concatenate(
+        [rows1[:, :2], rows1[:, 2:3], rows1[:, 2:3], rows1[:, 3:]], axis=1)
+    grads2 = np.concatenate(
+        [grads1[:, :1], grads1[:, :1], grads1[:, 1:]], axis=1)
+    out2 = np.asarray(apply_updates(jnp.asarray(rows2), jnp.asarray(grads2),
+                                    jnp.asarray(si), jnp.asarray(ci), c2))
+    np.testing.assert_allclose(out2[:, 2], out2[:, 3], rtol=1e-6)
+    if opt != "adam":
+        np.testing.assert_allclose(out2[:, 2], out1[:, 2], rtol=1e-6)
+    np.testing.assert_allclose(out2[:, 4:], out1[:, 3:], rtol=1e-6)
+
+
+# ---------------------------------------------------------------------------
+# select op
+# ---------------------------------------------------------------------------
+
+def test_select_share_embedding_forward_and_grad():
+    cfg = EmbeddingConfig(dim=2, embed_w_num=3)
+    B, T = 2, 4
+    seg = np.array([0, 0, 1, 2], np.int32)       # 3 slots over 4 positions
+    share = np.array([2, 0, 1], np.int32)        # slot -> w plane
+    pulled = jnp.asarray(
+        np.random.default_rng(0).normal(size=(B, T, cfg.pull_width))
+        .astype(np.float32))
+    out = select_share_embedding(pulled, seg, share, cfg)
+    assert out.shape == (B, T, 3 + 2)
+    # slot 0 tokens (pos 0, 1) read w plane 2 = column 2+2
+    np.testing.assert_allclose(out[:, 0, 2], pulled[:, 0, 4])
+    np.testing.assert_allclose(out[:, 2, 2], pulled[:, 2, 2])  # slot1→plane0
+    np.testing.assert_allclose(out[:, 3, 2], pulled[:, 3, 3])  # slot2→plane1
+    # show/clk/embedx pass through
+    np.testing.assert_allclose(out[..., :2], pulled[..., :2])
+    np.testing.assert_allclose(out[..., 3:], pulled[..., 5:])
+
+    # grads route ONLY to the selected plane
+    g = jax.grad(lambda p: select_share_embedding(p, seg, share, cfg)
+                 [..., 2].sum())(pulled)
+    g = np.asarray(g)
+    assert g[:, 0, 4].min() == 1.0 and g[:, 0, 2:4].max() == 0.0
+    assert g[:, 2, 2].min() == 1.0 and g[:, 2, 3:5].max() == 0.0
+
+
+# ---------------------------------------------------------------------------
+# variable feature: pull gating
+# ---------------------------------------------------------------------------
+
+def test_variable_pull_gates_planes_by_show():
+    cfg = EmbeddingConfig(dim=2, expand_dim=2, mf_create_threshold=5.0,
+                          expand_create_threshold=10.0)
+    store = HostEmbeddingStore(cfg)
+    keys = np.array([11, 22, 33], np.uint64)
+    rows = store.lookup_or_init(keys)
+    rows[:, 0] = [2.0, 7.0, 12.0]                # shows: none / mf / mf+expand
+    rows[:, cfg.embedx_cols] = 1.0
+    store.write_back(keys, rows)
+    ws = PassWorkingSet.begin_pass(store, keys)
+    idx = ws.translate(keys)
+    pulled = np.asarray(sharded.lookup(ws.table, jnp.asarray(idx), cfg))
+    fc = cfg.fixed_cols
+    assert pulled[0, fc:].max() == 0.0           # below both thresholds
+    assert pulled[1, fc:fc + 2].min() == 1.0     # embedx present
+    assert pulled[1, fc + 2:].max() == 0.0       # expand absent
+    assert pulled[2, fc:].min() == 1.0           # both present
+
+
+def test_variable_gating_on_host_paths():
+    """Every pull path gates identically: device lookup, PS table pull, and
+    the serving table (train/serve skew otherwise — gating.py)."""
+    from paddlebox_tpu.distributed.ps import _SparseTable
+    from paddlebox_tpu.inference.serving_table import ServingTable
+
+    cfg = EmbeddingConfig(dim=2, mf_create_threshold=5.0)
+    store = HostEmbeddingStore(cfg)
+    keys = np.array([11, 22], np.uint64)
+    rows = store.lookup_or_init(keys)
+    rows[:, 0] = [2.0, 7.0]
+    rows[:, cfg.embedx_cols] = 1.0
+    store.write_back(keys, rows)
+    fc = cfg.fixed_cols
+
+    tbl = _SparseTable(cfg)
+    tbl.store = store
+    ps_pull = tbl.pull(keys, init_missing=False)
+    assert ps_pull[0, fc:].max() == 0.0 and ps_pull[1, fc:].min() == 1.0
+
+    sv = ServingTable.from_store(store)
+    sv_pull = sv.lookup(keys)
+    assert sv_pull[0, fc:].max() == 0.0 and sv_pull[1, fc:].min() == 1.0
+    # gate survives a save/load roundtrip
+    import tempfile
+    d = tempfile.mkdtemp()
+    sv.save(d)
+    sv2 = ServingTable.load(d)
+    np.testing.assert_array_equal(sv2.lookup(keys), sv_pull)
+
+
+def test_variable_push_gates_grads_by_show():
+    from paddlebox_tpu.embedding.optim import apply_updates
+
+    cfg = EmbeddingConfig(dim=2, optimizer="sgd", learning_rate=1.0,
+                          mf_create_threshold=5.0)
+    rows = np.zeros((2, cfg.row_width), np.float32)
+    rows[0, 0] = 1.0                             # stays below threshold
+    rows[1, 0] = 10.0                            # above
+    grads = np.full((2, cfg.grad_width), 1.0, np.float32)
+    out = np.asarray(apply_updates(
+        jnp.asarray(rows), jnp.asarray(grads),
+        jnp.zeros(2), jnp.zeros(2), cfg))
+    assert out[0, cfg.embedx_cols].max() == 0.0  # embedx grad dropped
+    assert out[1, cfg.embedx_cols].max() == -1.0
+    assert out[0, 2] == -1.0                     # w always trains
+
+
+def test_variable_threshold_crossing_mid_training():
+    """A key crossing mf_create_threshold starts training embedx; the
+    threshold tests the post-increment show (plane created at push)."""
+    from paddlebox_tpu.embedding.optim import apply_updates
+
+    cfg = EmbeddingConfig(dim=2, optimizer="sgd", learning_rate=1.0,
+                          mf_create_threshold=3.0)
+    rows = np.zeros((1, cfg.row_width), np.float32)
+    rows[0, 0] = 2.5
+    grads = np.full((1, cfg.grad_width), 1.0, np.float32)
+    out = np.asarray(apply_updates(
+        jnp.asarray(rows), jnp.asarray(grads),
+        jnp.ones(1), jnp.zeros(1), cfg))        # show 2.5 -> 3.5 crosses
+    assert out[0, cfg.embedx_cols].max() == -1.0
+
+
+# ---------------------------------------------------------------------------
+# end-to-end training
+# ---------------------------------------------------------------------------
+
+NUM_SLOTS = 3
+
+
+def _shared_key_dataset(n=1024, seed=0):
+    """All slots draw ids from ONE shared key space (no slot salting) —
+    the data shape ShareEmbedding exists for."""
+    rng = np.random.default_rng(seed)
+    schema = DataFeedSchema.ctr(num_sparse=NUM_SLOTS, num_float=1,
+                                batch_size=64, max_len=2)
+    # per-(slot, id) latent weight: the shared embedx can model the id
+    # main effect, the per-slot w planes model the slot-specific offsets
+    idw = np.random.default_rng(7).normal(size=(NUM_SLOTS, 60)) * 1.2
+    lines = []
+    for _ in range(n):
+        logits, parts, per_slot = 0.0, [], []
+        for s in range(NUM_SLOTS):
+            ids = rng.integers(0, 60, size=rng.integers(1, 3))
+            per_slot.append(ids)
+            logits += idw[s, ids].sum()
+        label = float(rng.random() < 1.0 / (1.0 + np.exp(-0.8 * logits)))
+        parts.append(f"1 {label}")
+        parts.append(f"1 {rng.normal():.4f}")
+        for ids in per_slot:
+            parts.append(f"{len(ids)} {' '.join(str(int(v) + 1) for v in ids)}")
+        lines.append(" ".join(parts))
+    ds = SlotDataset(schema)
+    ds.records = parse_multislot_lines(lines, schema)
+    return ds, schema
+
+
+def test_share_embedding_end_to_end():
+    ds, schema = _shared_key_dataset()
+    cfg = EmbeddingConfig(dim=8, embed_w_num=NUM_SLOTS, learning_rate=0.15)
+    store = HostEmbeddingStore(cfg)
+    inner = DNNCTRModel(num_slots=NUM_SLOTS, emb_dim=8, dense_dim=1,
+                        hidden=(32, 16))
+    model = ShareEmbeddingModel(inner, np.arange(NUM_SLOTS), cfg)
+    tr = Trainer(model, store, schema, make_mesh(8),
+                 TrainerConfig(global_batch_size=128, dense_lr=3e-3,
+                               auc_buckets=1 << 12))
+    results = [tr.train_pass(ds) for _ in range(3)]
+    assert results[-1]["auc"] > 0.6, results
+    # all three w planes actually trained (each slot feeds its own)
+    tr.flush_sparse()
+    rows = store.get_rows(ds.unique_keys())
+    w_block = rows[:, cfg.w_cols]
+    assert (np.abs(w_block).sum(axis=0) > 0).all(), w_block.sum(axis=0)
+
+
+def test_variable_feature_end_to_end():
+    """High mf threshold: embedx stays at deterministic init (pull-gated,
+    grad-gated) while w/show train; same run with threshold 0 trains it."""
+    ds, schema = _shared_key_dataset(256, seed=3)
+    results = {}
+    for thresh in (1e9, 0.0):
+        cfg = EmbeddingConfig(dim=8, learning_rate=0.15,
+                              mf_create_threshold=thresh)
+        store = HostEmbeddingStore(cfg)
+        model = DNNCTRModel(num_slots=NUM_SLOTS, emb_dim=8, dense_dim=1,
+                            hidden=(16,))
+        tr = Trainer(model, store, schema, make_mesh(8),
+                     TrainerConfig(global_batch_size=64, dense_lr=3e-3,
+                                   auc_buckets=1 << 10))
+        tr.train_pass(ds)
+        tr.flush_sparse()
+        keys = ds.unique_keys()
+        emb = store.get_rows(keys)[:, cfg.embedx_cols]
+        init = store._init_rows(keys)[:, cfg.embedx_cols]
+        results[thresh] = np.abs(emb - init).max()
+        assert np.abs(store.get_rows(keys)[:, 2]).max() > 0  # w trained
+    assert results[1e9] == 0.0            # embedx untouched below threshold
+    assert results[0.0] > 0.0             # and trains normally without one
