@@ -17,20 +17,31 @@ from __future__ import annotations
 
 import gzip
 import importlib
+import os
 import subprocess
 from typing import Callable, Iterable, Iterator
 
 from paddlebox_tpu.data.parser import parse_multislot_buffer
 from paddlebox_tpu.data.schema import DataFeedSchema
 from paddlebox_tpu.data.slot_record import SlotRecordBatch
+from paddlebox_tpu.utils import fs as fs_lib
 
 ParserPlugin = Callable[[Iterable[str], DataFeedSchema], SlotRecordBatch]
 
 
 def open_lines(path: str) -> Iterator[str]:
-    opener = gzip.open if path.endswith(".gz") else open
-    with opener(path, "rt") as f:  # type: ignore[arg-type]
-        yield from f
+    """Stream text lines from a local or remote (scheme-carrying) path."""
+    fs, p = fs_lib.resolve(path)
+    raw = fs.open_read(p)
+    try:
+        if path.endswith(".gz"):
+            with gzip.open(raw, "rt") as f:
+                yield from f
+        else:
+            for line in raw:
+                yield line.decode("utf-8", errors="replace")
+    finally:
+        raw.close()
 
 
 def load_parser_plugin(spec: str) -> ParserPlugin:
@@ -54,22 +65,71 @@ def read_file(
     """Read one file into a columnar batch via the configured ingestion mode."""
     if path.endswith(".pbar"):  # pre-tokenized binary archive
         from paddlebox_tpu.data.archive import read_archive
+        if fs_lib.is_remote(path):
+            # npz wants a seekable file: stage remote archives locally
+            import tempfile
+            fs, p = fs_lib.resolve(path)
+            with tempfile.TemporaryDirectory() as d:
+                local = os.path.join(d, os.path.basename(p))
+                fs.get(p, local)
+                return read_archive(local, schema)
         return read_archive(path, schema)
     if pipe_command:
-        proc = subprocess.Popen(
-            f"{pipe_command} < {path}" if path else pipe_command,
-            shell=True, stdout=subprocess.PIPE,
-        )
+        if path and fs_lib.is_remote(path):
+            # remote input: the filesystem's cat streams into the command's
+            # stdin (the reference's HDFS reads ride the pipe the same way).
+            # The feed runs on its own thread — writing all of stdin before
+            # reading stdout deadlocks once either pipe buffer fills.
+            import shutil as _sh
+            import threading as _th
+            fs, p = fs_lib.resolve(path)
+            src = fs.open_read(p)
+            proc = subprocess.Popen(pipe_command, shell=True,
+                                    stdin=subprocess.PIPE,
+                                    stdout=subprocess.PIPE)
+            assert proc.stdin is not None and proc.stdout is not None
+            feed_err: list = []
+
+            def _feed():
+                try:
+                    _sh.copyfileobj(src, proc.stdin)
+                    proc.stdin.close()
+                    src.close()
+                except BaseException as e:  # surfaced after the read
+                    feed_err.append(e)
+
+            feeder = _th.Thread(target=_feed, daemon=True)
+            feeder.start()
+        else:
+            feeder = None
+            feed_err = []
+            proc = subprocess.Popen(
+                f"{pipe_command} < {path}" if path else pipe_command,
+                shell=True, stdout=subprocess.PIPE,
+            )
         assert proc.stdout is not None
         try:
             buf = proc.stdout.read()
         finally:
             ret = proc.wait()
+            if feeder is not None:
+                feeder.join()
+        if feed_err:
+            raise RuntimeError(
+                f"remote read into pipe_command {pipe_command!r} failed"
+            ) from feed_err[0]
         if ret != 0:
             raise RuntimeError(f"pipe_command {pipe_command!r} exited {ret}")
         return parse_multislot_buffer(buf, schema, with_ins_id=with_ins_id)
     if parser_plugin is not None:
         return parser_plugin(open_lines(path), schema)
+    if fs_lib.is_remote(path):
+        fs, p = fs_lib.resolve(path)
+        with fs.open_read(p) as f:
+            buf = f.read()
+        if path.endswith(".gz"):
+            buf = gzip.decompress(buf)
+        return parse_multislot_buffer(buf, schema, with_ins_id=with_ins_id)
     opener = gzip.open if path.endswith(".gz") else open
     with opener(path, "rb") as f:
         buf = f.read()

@@ -13,6 +13,13 @@ can discover the newest model. A mid-day crash is recovered by loading the
 newest base and replaying every delta donefile entry recorded after it —
 the reference's pass-granularity restart model (SURVEY.md §5 "Failure
 detection").
+
+The output root may be REMOTE (``hdfs://…``/``afs://…`` — any scheme
+registered with utils/fs.py; the reference saves day/pass models straight
+to HDFS, fleet_util.py:674-745, over the AFS client of InitAfsAPI). Remote
+saves stage locally then upload the checkpoint directory atomically-ish
+(donefile written only after the upload), loads download to a temp dir;
+local roots keep the direct-write path.
 """
 
 from __future__ import annotations
@@ -20,17 +27,23 @@ from __future__ import annotations
 import glob
 import json
 import os
+import tempfile
 import time
 from typing import Any
 
 from paddlebox_tpu.embedding import HostEmbeddingStore
 from paddlebox_tpu.utils import checkpoint as ckpt_lib
+from paddlebox_tpu.utils import fs as fs_lib
 
 
 class FleetUtil:
     def __init__(self, output_root: str):
-        self.root = output_root
-        os.makedirs(output_root, exist_ok=True)
+        self._fs, resolved = fs_lib.resolve(output_root)
+        self._remote = fs_lib.is_remote(output_root)
+        # file:// roots resolve to their plain local path; remote roots
+        # keep the scheme (the commands want full URIs)
+        self.root = output_root if self._remote else resolved
+        self._fs.makedirs(self.root)
 
     # ---- paths ----
 
@@ -46,9 +59,12 @@ class FleetUtil:
                    day: int) -> str:
         """Full day-level base model: sparse base + dense snapshot."""
         path = self.base_dir(day)
-        os.makedirs(path, exist_ok=True)
-        store.save_base(os.path.join(path, "sparse"))
-        ckpt_lib.save_pytree(dense_state, os.path.join(path, "dense.npz"))
+
+        def write(into: str) -> None:
+            store.save_base(os.path.join(into, "sparse"))
+            ckpt_lib.save_pytree(dense_state, os.path.join(into, "dense.npz"))
+
+        self._save_dir(path, write)
         self._write_donefile("base_model.donefile", day, 0, path)
         return path
 
@@ -61,30 +77,54 @@ class FleetUtil:
         fetch exactly entry["path"].
         """
         path = self.delta_dir(day, pass_id)
-        sparse_dir = os.path.join(path, "sparse")
-        os.makedirs(sparse_dir, exist_ok=True)
-        store.save_delta(sparse_dir)
-        ckpt_lib.save_pytree(dense_state, os.path.join(path, "dense.npz"))
+
+        def write(into: str) -> None:
+            sparse_dir = os.path.join(into, "sparse")
+            os.makedirs(sparse_dir, exist_ok=True)
+            store.save_delta(sparse_dir)
+            ckpt_lib.save_pytree(dense_state, os.path.join(into, "dense.npz"))
+
+        self._save_dir(path, write)
         self._write_donefile("delta_model.donefile", day, pass_id, path)
         return path
+
+    def _save_dir(self, path: str, write) -> None:
+        """Run `write(local_dir)` then land the directory at `path` —
+        directly for local roots, stage-and-upload for remote ones (the
+        donefile entry is only written after the upload completes, so a
+        torn upload is never discoverable)."""
+        if not self._remote:
+            os.makedirs(path, exist_ok=True)
+            write(path)
+            return
+        with tempfile.TemporaryDirectory(prefix="pbtpu_fleet_") as d:
+            stage = os.path.join(d, "m")
+            os.makedirs(stage)
+            write(stage)
+            parent = path.rsplit("/", 1)[0]
+            self._fs.makedirs(parent)
+            # a leftover target (torn upload, re-save of the same day/pass)
+            # must go first: `hadoop fs -put` into an EXISTING dir nests the
+            # stage under it (path/m) while the donefile names path
+            self._fs.rm(path)
+            self._fs.put(stage, path)
 
     def _write_donefile(self, name: str, day: int, pass_id: int,
                         path: str) -> None:
         line = json.dumps({"day": day, "pass": pass_id, "path": path,
                            "ts": int(time.time())})
-        with open(os.path.join(self.root, name), "a") as f:
-            f.write(line + "\n")
+        self._fs.write_text(os.path.join(self.root, name), line + "\n",
+                            append=True)
 
     def _entries(self, donefile: str) -> list[dict[str, Any]]:
         fname = os.path.join(self.root, donefile)
-        if not os.path.exists(fname):
+        if not self._fs.exists(fname):
             return []
         out = []
-        with open(fname) as f:
-            for line in f:
-                line = line.strip()
-                if line:
-                    out.append(json.loads(line))
+        for line in self._fs.read_lines(fname):
+            line = line.strip()
+            if line:
+                out.append(json.loads(line))
         return out
 
     def latest(self, donefile: str = "base_model.donefile"
@@ -110,21 +150,34 @@ class FleetUtil:
                 f"no base model{f' for day {day}' if day else ''} in {self.root}")
         base = bases[-1]
         day = int(base["day"])
-        store = HostEmbeddingStore.load(os.path.join(base["path"], "sparse"))
-        dense_file = os.path.join(base["path"], "dense.npz")
-        # replay deltas recorded after this base (mid-day-crash recovery:
-        # yesterday's base + today's pass deltas)
-        for d in self._entries("delta_model.donefile"):
-            if int(d["ts"]) < int(base["ts"]) or d["path"] == base["path"]:
-                continue
-            if int(d["day"]) < day:
-                continue
-            for f in sorted(glob.glob(os.path.join(d["path"], "sparse",
-                                                   "delta-*.npz"))):
-                store.apply_delta_file(f)
-            cand = os.path.join(d["path"], "dense.npz")
-            if os.path.exists(cand):
-                dense_file = cand
-            day = max(day, int(d["day"]))
-        dense = ckpt_lib.load_pytree(dense_template, dense_file)
+        with tempfile.TemporaryDirectory(prefix="pbtpu_fetch_") as tmp:
+            base_local = self._fetch_dir(base["path"], tmp, "base")
+            store = HostEmbeddingStore.load(os.path.join(base_local,
+                                                         "sparse"))
+            dense_file = os.path.join(base_local, "dense.npz")
+            # replay deltas recorded after this base (mid-day-crash
+            # recovery: yesterday's base + today's pass deltas)
+            for i, d in enumerate(self._entries("delta_model.donefile")):
+                if int(d["ts"]) < int(base["ts"]) or d["path"] == base["path"]:
+                    continue
+                if int(d["day"]) < day:
+                    continue
+                d_local = self._fetch_dir(d["path"], tmp, f"d{i}")
+                for f in sorted(glob.glob(os.path.join(d_local, "sparse",
+                                                       "delta-*.npz"))):
+                    store.apply_delta_file(f)
+                cand = os.path.join(d_local, "dense.npz")
+                if os.path.exists(cand):
+                    dense_file = cand
+                day = max(day, int(d["day"]))
+            dense = ckpt_lib.load_pytree(dense_template, dense_file)
         return store, dense, day
+
+    def _fetch_dir(self, path: str, tmp: str, tag: str) -> str:
+        """Local view of a checkpoint dir: itself locally, a download when
+        the root is remote."""
+        if not self._remote:
+            return path
+        local = os.path.join(tmp, tag)
+        self._fs.get(path, local)
+        return local

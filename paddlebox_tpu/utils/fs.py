@@ -1,0 +1,301 @@
+"""Pluggable filesystems — the AFS/HDFS I/O role.
+
+Reference: the Box stack reads filelists and writes day/pass checkpoints on
+AFS/HDFS — ``BoxWrapper::InitAfsAPI(fs_name, fs_user, pass, conf)``
+(box_wrapper.h:577) hands cluster credentials to libbox_ps's PaddleFileMgr,
+``HdfsStore`` (gloo_wrapper.h:106,45) does rendezvous on HDFS, and the
+fleet_util day/pass model save targets HDFS paths (fleet_util.py:674-745).
+The open-source glue shells out to ``hadoop fs`` clients for the same job.
+
+TPU-native rendering: one small interface with two implementations —
+
+- :class:`LocalFS`: plain POSIX (the default for schemeless paths).
+- :class:`CommandFS`: every operation is a configurable shell command
+  (``{path}``/``{src}``/``{dst}`` templates). This is deliberately the
+  general escape hatch of this environment: the same class speaks
+  ``hadoop fs``, ``gsutil``, ``aws s3``, or an in-house CLI, and a test can
+  back it with plain ``cat``/``cp``. The reference's closed AFS client
+  collapses into command templates the operator controls.
+
+Paths carry their filesystem by URI scheme (``hdfs://…``, ``afs://…``);
+:func:`resolve` splits a path into (filesystem, fs-native path).
+``init_afs_api`` mirrors the reference's call shape and registers a
+hadoop-style CommandFS for the ``afs``/``hdfs`` schemes.
+"""
+
+from __future__ import annotations
+
+import os
+import shlex
+import subprocess
+import tempfile
+from typing import IO, Iterator
+
+
+class FileSystem:
+    """Interface. Paths are fs-native (scheme included is fine — commands
+    usually want the full URI; LocalFS strips nothing because local paths
+    never carry a scheme)."""
+
+    def open_read(self, path: str) -> IO[bytes]:
+        raise NotImplementedError
+
+    def read_lines(self, path: str) -> Iterator[str]:
+        with self.open_read(path) as f:
+            for raw in f:
+                yield raw.decode("utf-8", errors="replace")
+
+    def write_text(self, path: str, text: str, append: bool = False) -> None:
+        raise NotImplementedError
+
+    def exists(self, path: str) -> bool:
+        raise NotImplementedError
+
+    def ls(self, path: str) -> list[str]:
+        raise NotImplementedError
+
+    def makedirs(self, path: str) -> None:
+        raise NotImplementedError
+
+    def put(self, local: str, remote: str) -> None:
+        """Upload a local file or directory tree."""
+        raise NotImplementedError
+
+    def get(self, remote: str, local: str) -> None:
+        """Download a remote file or directory tree."""
+        raise NotImplementedError
+
+    def rm(self, path: str) -> None:
+        raise NotImplementedError
+
+
+class LocalFS(FileSystem):
+    def open_read(self, path: str) -> IO[bytes]:
+        return open(path, "rb")
+
+    def write_text(self, path: str, text: str, append: bool = False) -> None:
+        os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)
+        with open(path, "a" if append else "w") as f:
+            f.write(text)
+
+    def exists(self, path: str) -> bool:
+        return os.path.exists(path)
+
+    def ls(self, path: str) -> list[str]:
+        return sorted(os.path.join(path, n) for n in os.listdir(path))
+
+    def makedirs(self, path: str) -> None:
+        os.makedirs(path, exist_ok=True)
+
+    def put(self, local: str, remote: str) -> None:
+        if local != remote:
+            import shutil
+            if os.path.isdir(local):
+                shutil.copytree(local, remote, dirs_exist_ok=True)
+            else:
+                os.makedirs(os.path.dirname(os.path.abspath(remote)),
+                            exist_ok=True)
+                shutil.copy2(local, remote)
+
+    def get(self, remote: str, local: str) -> None:
+        self.put(remote, local)
+
+    def rm(self, path: str) -> None:
+        import shutil
+        if os.path.isdir(path):
+            shutil.rmtree(path)
+        elif os.path.exists(path):
+            os.remove(path)
+
+
+class CommandFS(FileSystem):
+    """Shell-command-backed filesystem (hadoop fs / gsutil / aws s3 …).
+
+    Templates substitute ``{path}``, ``{src}``, ``{dst}``. Defaults speak
+    the ``hadoop fs`` dialect; pass your own for other CLIs. ``env`` merges
+    into the subprocess environment (credentials — the fs_user/fs_passwd of
+    InitAfsAPI travel here, never through the conversation of a command
+    line that ``ps`` could show, when the CLI supports env auth).
+    """
+
+    def __init__(self, cat: str = "hadoop fs -cat {path}",
+                 ls: str = "hadoop fs -ls {path}",
+                 put: str = "hadoop fs -put -f {src} {dst}",
+                 get: str = "hadoop fs -get {src} {dst}",
+                 mkdir: str = "hadoop fs -mkdir -p {path}",
+                 test: str = "hadoop fs -test -e {path}",
+                 rm: str = "hadoop fs -rm -r -f {path}",
+                 append: str | None = None,
+                 env: dict | None = None):
+        self._cmds = {"cat": cat, "ls": ls, "put": put, "get": get,
+                      "mkdir": mkdir, "test": test, "rm": rm,
+                      "append": append}
+        self._env = dict(os.environ, **(env or {}))
+
+    def _argv(self, op: str, **kw) -> list[str]:
+        tpl = self._cmds[op]
+        if tpl is None:
+            raise NotImplementedError(f"CommandFS has no {op!r} command")
+        return [a.format(**kw) for a in shlex.split(tpl)]
+
+    def _run(self, op: str, ok_codes: tuple = (0,),
+             **kw) -> subprocess.CompletedProcess:
+        proc = subprocess.run(self._argv(op, **kw), env=self._env,
+                              capture_output=True)
+        if proc.returncode not in ok_codes:
+            raise RuntimeError(
+                f"CommandFS {op} failed ({proc.returncode}): "
+                f"{proc.stderr.decode(errors='replace')[:500]}")
+        return proc
+
+    def open_read(self, path: str) -> IO[bytes]:
+        proc = subprocess.Popen(self._argv("cat", path=path),
+                                env=self._env, stdout=subprocess.PIPE,
+                                stderr=subprocess.PIPE)
+        assert proc.stdout is not None
+        return _CommandStream(proc)
+
+    def write_text(self, path: str, text: str, append: bool = False) -> None:
+        if append and self._cmds["append"] is None and self.exists(path):
+            # no append command: read-modify-write (donefile sizes are tiny)
+            with self.open_read(path) as f:
+                text = f.read().decode() + text
+            append = False
+        with tempfile.NamedTemporaryFile("w", suffix=".txt",
+                                         delete=False) as tmp:
+            tmp.write(text)
+            name = tmp.name
+        try:
+            if append and self._cmds["append"] is not None:
+                self._run("append", src=name, dst=path)
+            else:
+                self._run("put", src=name, dst=path)
+        finally:
+            os.unlink(name)
+
+    def exists(self, path: str) -> bool:
+        """Exit 0 = exists, exit 1 = does not exist; anything else (network
+        outage, auth failure) RAISES — conflating an outage with "absent"
+        would let write_text's append fallback truncate a donefile."""
+        return self._run("test", ok_codes=(0, 1),
+                         path=path).returncode == 0
+
+    def ls(self, path: str) -> list[str]:
+        out = self._run("ls", path=path).stdout.decode(errors="replace")
+        names = []
+        for line in out.splitlines():
+            # `hadoop fs -ls` ends each entry line with the path; plain `ls`
+            # prints bare names — take the last whitespace token either way
+            tok = line.split()[-1] if line.split() else ""
+            if tok and not line.startswith("Found "):
+                names.append(tok)
+        return sorted(names)
+
+    def makedirs(self, path: str) -> None:
+        self._run("mkdir", path=path)
+
+    def put(self, local: str, remote: str) -> None:
+        self._run("put", src=local, dst=remote)
+
+    def get(self, remote: str, local: str) -> None:
+        self._run("get", src=remote, dst=local)
+
+    def rm(self, path: str) -> None:
+        self._run("rm", path=path)
+
+
+class _CommandStream:
+    """File-like over a streaming subprocess stdout; close() reaps the
+    process and raises if the command failed (a silently-truncated filelist
+    must never parse as a short success)."""
+
+    def __init__(self, proc: subprocess.Popen):
+        self._proc = proc
+        self._f = proc.stdout
+
+    def read(self, *a):
+        return self._f.read(*a)
+
+    def __iter__(self):
+        return iter(self._f)
+
+    def close(self) -> None:
+        self._f.read()  # drain so the producer can exit
+        rc = self._proc.wait()
+        if rc != 0:
+            err = (self._proc.stderr.read().decode(errors="replace")
+                   if self._proc.stderr else "")
+            raise RuntimeError(f"CommandFS cat failed ({rc}): {err[:500]}")
+        if self._proc.stderr:
+            self._proc.stderr.close()
+        self._f.close()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+        return False
+
+
+# ---------------------------------------------------------------------------
+# scheme registry
+# ---------------------------------------------------------------------------
+
+_REGISTRY: dict[str, FileSystem] = {}
+_LOCAL = LocalFS()
+
+
+def register_fs(scheme: str, fs: FileSystem) -> None:
+    _REGISTRY[scheme.rstrip(":/").lower()] = fs
+
+
+def resolve(path: str) -> tuple[FileSystem, str]:
+    """Path → (filesystem, path). Schemeless (or file://) paths are local;
+    an unregistered scheme is an error, not a silent local fallback."""
+    if "://" in path:
+        scheme = path.split("://", 1)[0].lower()
+        if scheme == "file":
+            return _LOCAL, path.split("://", 1)[1]
+        fs = _REGISTRY.get(scheme)
+        if fs is None:
+            raise ValueError(
+                f"no filesystem registered for scheme {scheme!r} "
+                f"(register_fs / init_afs_api first)")
+        return fs, path
+    return _LOCAL, path
+
+
+def is_remote(path: str) -> bool:
+    return "://" in path and not path.lower().startswith("file://")
+
+
+def init_afs_api(fs_name: str, fs_user: str = "", fs_passwd: str = "",
+                 conf_path: str = "", hadoop_bin: str = "hadoop",
+                 schemes: tuple = ("afs", "hdfs")) -> CommandFS:
+    """Reference call shape (InitAfsAPI, box_wrapper.h:577; pybind
+    box_helper_py.cc:105): configure the cluster client once, then every
+    remote path in filelists/checkpoint roots just works.
+
+    fs_name is the defaultFS (e.g. ``hdfs://ns1``); credentials ride
+    ``-D`` confs like the reference's ugi string.
+    """
+    d = []
+    if fs_name:
+        d.append(f"-Dfs.defaultFS={fs_name}")
+    if fs_user:
+        d.append(f"-Dhadoop.job.ugi={fs_user},{fs_passwd}")
+    opts = " ".join(d)
+    # --config is a launcher option: it must precede the `fs` subcommand
+    conf = f" --config {conf_path}" if conf_path else ""
+    base = f"{hadoop_bin}{conf} fs {opts}".strip()
+    fs = CommandFS(cat=f"{base} -cat {{path}}",
+                   ls=f"{base} -ls {{path}}",
+                   put=f"{base} -put -f {{src}} {{dst}}",
+                   get=f"{base} -get {{src}} {{dst}}",
+                   mkdir=f"{base} -mkdir -p {{path}}",
+                   test=f"{base} -test -e {{path}}",
+                   rm=f"{base} -rm -r -f {{path}}")
+    for s in schemes:
+        register_fs(s, fs)
+    return fs

@@ -1,0 +1,256 @@
+"""Filesystem abstraction (AFS/HDFS role — VERDICT missing #8).
+
+CommandFS is exercised against a tiny argv-based mock CLI that maps
+``mock://…`` URIs onto a sandbox directory — the same contract a real
+``hadoop fs``/``gsutil`` deployment fills in production (InitAfsAPI,
+box_wrapper.h:577; HdfsStore gloo_wrapper.h:45).
+"""
+
+import os
+import sys
+import textwrap
+
+import numpy as np
+import pytest
+
+from paddlebox_tpu.utils import fs as fs_lib
+
+MOCK_CLI = textwrap.dedent("""
+    import os, shutil, sys
+    ROOT = os.environ["MOCKFS_ROOT"]
+
+    def local(p):
+        assert p.startswith("mock://"), p
+        return os.path.join(ROOT, p[len("mock://"):])
+
+    op = sys.argv[1]
+    if op == "cat":
+        with open(local(sys.argv[2]), "rb") as f:
+            sys.stdout.buffer.write(f.read())
+    elif op == "ls":
+        d = local(sys.argv[2])
+        for n in sorted(os.listdir(d)):
+            print(sys.argv[2].rstrip("/") + "/" + n)
+    elif op == "put":
+        # hadoop-faithful: put INTO an existing directory nests the source
+        # under it (this is the semantics FleetUtil._save_dir must survive)
+        src, dst = sys.argv[2], local(sys.argv[3])
+        os.makedirs(os.path.dirname(dst), exist_ok=True)
+        if os.path.isdir(dst):
+            dst = os.path.join(dst, os.path.basename(src.rstrip("/")))
+        if os.path.isdir(src):
+            if os.path.exists(dst):
+                shutil.rmtree(dst)
+            shutil.copytree(src, dst)
+        else:
+            shutil.copy2(src, dst)
+    elif op == "get":
+        src, dst = local(sys.argv[2]), sys.argv[3]
+        if os.path.isdir(src):
+            shutil.copytree(src, dst)
+        else:
+            shutil.copy2(src, dst)
+    elif op == "mkdir":
+        os.makedirs(local(sys.argv[2]), exist_ok=True)
+    elif op == "test":
+        sys.exit(0 if os.path.exists(local(sys.argv[2])) else 1)
+    elif op == "rm":
+        p = local(sys.argv[2])
+        if os.path.isdir(p):
+            shutil.rmtree(p)
+        elif os.path.exists(p):
+            os.remove(p)
+    else:
+        sys.exit(2)
+""")
+
+
+@pytest.fixture
+def mockfs(tmp_path, monkeypatch):
+    """Register a CommandFS for mock:// backed by the sandbox CLI."""
+    cli = tmp_path / "mockfs_cli.py"
+    cli.write_text(MOCK_CLI)
+    root = tmp_path / "mockfs_root"
+    root.mkdir()
+    base = f"{sys.executable} {cli}"
+    fs = fs_lib.CommandFS(
+        cat=f"{base} cat {{path}}", ls=f"{base} ls {{path}}",
+        put=f"{base} put {{src}} {{dst}}", get=f"{base} get {{src}} {{dst}}",
+        mkdir=f"{base} mkdir {{path}}", test=f"{base} test {{path}}",
+        rm=f"{base} rm {{path}}", env={"MOCKFS_ROOT": str(root)})
+    fs_lib.register_fs("mock", fs)
+    yield fs, root
+    fs_lib._REGISTRY.pop("mock", None)
+
+
+def test_resolve_and_unregistered_scheme(tmp_path):
+    fs, p = fs_lib.resolve(str(tmp_path / "x.txt"))
+    assert isinstance(fs, fs_lib.LocalFS) and p.endswith("x.txt")
+    fs, p = fs_lib.resolve("file:///etc/hosts")
+    assert isinstance(fs, fs_lib.LocalFS) and p == "/etc/hosts"
+    assert not fs_lib.is_remote("file:///etc/hosts")
+    assert fs_lib.is_remote("hdfs://ns1/a")
+    with pytest.raises(ValueError, match="no filesystem registered"):
+        fs_lib.resolve("nosuchscheme://a/b")
+
+
+def test_command_fs_roundtrip(mockfs, tmp_path):
+    fs, root = mockfs
+    fs.makedirs("mock://data")
+    assert not fs.exists("mock://data/a.txt")
+    fs.write_text("mock://data/a.txt", "hello\n")
+    fs.write_text("mock://data/a.txt", "world\n", append=True)  # rmw path
+    assert fs.exists("mock://data/a.txt")
+    with fs.open_read("mock://data/a.txt") as f:
+        assert f.read() == b"hello\nworld\n"
+    assert fs.ls("mock://data") == ["mock://data/a.txt"]
+    # directory put/get
+    src = tmp_path / "tree"
+    (src / "sub").mkdir(parents=True)
+    (src / "sub" / "f.bin").write_bytes(b"\x01\x02")
+    fs.put(str(src), "mock://up/tree")
+    dst = tmp_path / "back"
+    fs.get("mock://up/tree", str(dst))
+    assert (dst / "sub" / "f.bin").read_bytes() == b"\x01\x02"
+    fs.rm("mock://data/a.txt")
+    assert not fs.exists("mock://data/a.txt")
+
+
+def test_command_fs_cat_failure_raises(mockfs):
+    fs, _ = mockfs
+    stream = fs.open_read("mock://missing.txt")
+    with pytest.raises(RuntimeError, match="cat failed"):
+        stream.read()
+        stream.close()
+
+
+def test_dataset_loads_remote_filelist(mockfs):
+    """SlotDataset reads mock:// files exactly like local ones — the
+    reference's HDFS filelists (LoadIntoMemoryByCommand over hadoop cat)."""
+    from paddlebox_tpu.data import DataFeedSchema, SlotDataset
+
+    fs, root = mockfs
+    schema = DataFeedSchema.ctr(num_sparse=2, num_float=0, max_len=2)
+    lines = ["1 1 1 7 2 8 9", "1 0 1 3 1 4"]
+    fs.makedirs("mock://day1")
+    fs.write_text("mock://day1/part-0", "\n".join(lines) + "\n")
+    ds = SlotDataset(schema)
+    ds.set_filelist(["mock://day1/part-0"])
+    ds.load_into_memory(global_shuffle=False)
+    assert ds.num_examples == 2
+    np.testing.assert_array_equal(ds.records.sparse_values[0], [7, 3])
+
+
+def test_remote_pbar_archive(mockfs, tmp_path):
+    from paddlebox_tpu.data import DataFeedSchema
+    from paddlebox_tpu.data.archive import write_archive
+    from paddlebox_tpu.data.parser import parse_multislot_lines
+    from paddlebox_tpu.data.reader import read_file
+
+    fs, root = mockfs
+    schema = DataFeedSchema.ctr(num_sparse=1, num_float=0, max_len=2)
+    batch = parse_multislot_lines(["1 1 2 5 6", "1 0 1 9"], schema)
+    local = tmp_path / "p.pbar"
+    write_archive(str(local), batch)
+    fs.makedirs("mock://arch")
+    fs.put(str(local), "mock://arch/p.pbar")
+    got = read_file("mock://arch/p.pbar", schema)
+    assert got.num == 2
+    np.testing.assert_array_equal(got.sparse_values[0], [5, 6, 9])
+
+
+def test_fleet_util_remote_root(mockfs):
+    """Day/pass save + crash-recovery load against a remote root — the
+    reference's HDFS day/pass model layout (fleet_util.py:674-745)."""
+    from paddlebox_tpu.embedding import EmbeddingConfig, HostEmbeddingStore
+    from paddlebox_tpu.fleet.fleet_util import FleetUtil
+
+    fs, root = mockfs
+    cfg = EmbeddingConfig(dim=4)
+    store = HostEmbeddingStore(cfg)
+    keys = np.arange(1, 30, dtype=np.uint64)
+    rows = store.lookup_or_init(keys)
+    rows[:, 2] = 1.5
+    store.write_back(keys, rows)
+    dense = {"w": np.ones((3, 2), np.float32)}
+
+    fleet = FleetUtil("mock://fleet_out")
+    fleet.save_model(store, dense, day=20260730)
+    # pass delta: mutate a few rows, save delta
+    rows2 = store.get_rows(keys[:5])
+    rows2[:, 2] = 9.0
+    store.write_back(keys[:5], rows2)
+    fleet.save_delta_model(store, dense, day=20260730, pass_id=1)
+    assert fleet.latest()["day"] == 20260730
+
+    # fresh process view: load base + replay deltas from the remote root
+    fleet2 = FleetUtil("mock://fleet_out")
+    store2, dense2, day = fleet2.load_model({"w": np.zeros((3, 2))})
+    assert day == 20260730
+    np.testing.assert_array_equal(dense2["w"], dense["w"])
+    got = store2.get_rows(keys)
+    assert (got[:5, 2] == 9.0).all()
+    assert (got[5:, 2] == 1.5).all()
+
+
+def test_remote_pipe_command_large_stream_no_deadlock(mockfs):
+    """Multi-MB remote file through a pipe_command: the stdin feed and
+    stdout read overlap (a sequential write-then-read deadlocks once either
+    ~64KB pipe buffer fills)."""
+    from paddlebox_tpu.data import DataFeedSchema
+    from paddlebox_tpu.data.reader import read_file
+
+    fs, root = mockfs
+    schema = DataFeedSchema.ctr(num_sparse=1, num_float=0, max_len=1)
+    n = 60_000                                   # ~1.4MB of text
+    text = "\n".join(f"1 {i % 2} 1 {i % 97 + 1}" for i in range(n)) + "\n"
+    fs.makedirs("mock://big")
+    fs.write_text("mock://big/part-0", text)
+    got = read_file("mock://big/part-0", schema, pipe_command="cat")
+    assert got.num == n
+
+
+def test_fleet_util_remote_resave_replaces(mockfs):
+    """Re-saving the same day must REPLACE the remote checkpoint, not nest
+    it under the existing dir (hadoop `put` into an existing dir nests)."""
+    from paddlebox_tpu.embedding import EmbeddingConfig, HostEmbeddingStore
+    from paddlebox_tpu.fleet.fleet_util import FleetUtil
+
+    fs, root = mockfs
+    store = HostEmbeddingStore(EmbeddingConfig(dim=2))
+    keys = np.arange(1, 10, dtype=np.uint64)
+    rows = store.lookup_or_init(keys)
+    rows[:, 2] = 1.0
+    store.write_back(keys, rows)
+    fleet = FleetUtil("mock://resave")
+    fleet.save_model(store, {"w": np.zeros(2, np.float32)}, day=1)
+    rows[:, 2] = 2.0                     # torn-upload retry / same-day resave
+    store.write_back(keys, rows)
+    fleet.save_model(store, {"w": np.zeros(2, np.float32)}, day=1)
+    # no nested m/ dir; the load sees the SECOND save's values
+    assert not (root / "resave" / "1" / "base" / "m").exists()
+    store2, _, _ = FleetUtil("mock://resave").load_model(
+        {"w": np.zeros(2, np.float32)}, day=1)
+    assert (store2.get_rows(keys)[:, 2] == 2.0).all()
+
+
+def test_command_fs_exists_raises_on_outage(tmp_path):
+    """Exit codes beyond 0/1 (outage, auth failure) must RAISE, not read as
+    'absent' — the append fallback would otherwise truncate donefiles."""
+    fs = fs_lib.CommandFS(test="false")   # exit 1 = clean "absent"
+    assert fs.exists("x://whatever") is False
+    fs_bad = fs_lib.CommandFS(test="sh -c 'exit 2'")
+    with pytest.raises(RuntimeError, match="test failed"):
+        fs_bad.exists("x://whatever")
+
+
+def test_init_afs_api_registers_schemes():
+    fs = fs_lib.init_afs_api("hdfs://ns1", fs_user="u", fs_passwd="p",
+                             schemes=("afstest",))
+    try:
+        got, _ = fs_lib.resolve("afstest://a/b")
+        assert got is fs
+        # credential conf rides the command line the hadoop way
+        assert any("hadoop.job.ugi=u,p" in a for a in fs._argv("cat", path="x"))
+    finally:
+        fs_lib._REGISTRY.pop("afstest", None)
