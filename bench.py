@@ -132,8 +132,11 @@ def device_step_bench(small: bool):
         idx = ws.translate(raw, mask)
         dense = rng.normal(size=(batch, dense_dim)).astype(np.float32)
         labels = (rng.random(batch) < 0.25).astype(np.float32)
+        # the host binned-push plan is part of the pack pipeline (overlaps
+        # device compute in train_pass); staged here like the batch itself
+        plan = tr._host_plan(ws, idx)
         staged.append(tuple(jax.device_put(a, sh) for a in
-                            (idx, mask, dense, labels)))
+                            (idx, mask, dense, labels, *plan)))
     _mark("staged batches on device")
 
     table, params, opt = ws.table, tr.params, tr.opt_state

@@ -49,6 +49,14 @@ class Flags:
     # the next pass (bounded by the shard count, which cannot drop).
     routed_drop_fatal: bool = False         # (new)
     routed_drop_adapt: bool = True          # (new)
+    # Scatter-free push: sort+bin tokens and build the per-block merge with
+    # one-hot MXU matmuls, optimizer fused in VMEM (pallas_kernels.
+    # binned_push). Engages only on real-TPU f32 tables whose row count
+    # fits the block geometry; read at trace time like PBTPU_PALLAS.
+    binned_push: bool = True                # (new)
+    # bf16 planes the push payload crosses the MXU in: 3 ~= f32-exact,
+    # 1 = bf16 grads (~2x faster matmuls, CTR-tolerable rounding)
+    binned_push_splits: int = 3             # (new)
 
     # --- trainer (trainer_desc.proto:100-108, flags.cc:591-597) ---
     param_sync_step: int = 1                # BoxPSWorkerParameter.sync_dense_step

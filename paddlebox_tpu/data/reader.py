@@ -92,11 +92,19 @@ def read_file(
 
             def _feed():
                 try:
-                    _sh.copyfileobj(src, proc.stdin)
-                    proc.stdin.close()
-                    src.close()
-                except BaseException as e:  # surfaced after the read
-                    feed_err.append(e)
+                    try:
+                        _sh.copyfileobj(src, proc.stdin)
+                    except BrokenPipeError:
+                        pass    # consumer exited early (head-style
+                                # sampling commands) — not an error
+                    except BaseException as e:  # surfaced after the read
+                        feed_err.append(e)
+                finally:
+                    for f in (proc.stdin, src):
+                        try:
+                            f.close()
+                        except Exception:
+                            pass
 
             feeder = _th.Thread(target=_feed, daemon=True)
             feeder.start()

@@ -33,6 +33,7 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
+from paddlebox_tpu.config import flags as config_flags
 from paddlebox_tpu.embedding.config import EmbeddingConfig
 from paddlebox_tpu.embedding.optim import apply_updates
 from paddlebox_tpu.embedding import gating, quant
@@ -88,7 +89,7 @@ def lookup(table: jnp.ndarray, idx: jnp.ndarray,
 
 def push(table: jnp.ndarray, idx: jnp.ndarray, grads: jnp.ndarray,
          shows: jnp.ndarray, clks: jnp.ndarray,
-         cfg: EmbeddingConfig) -> jnp.ndarray:
+         cfg: EmbeddingConfig, plan=None) -> jnp.ndarray:
     """Merge-and-update: apply summed grads + show/clk increments in-table.
 
     idx   : (n,) int32 row indices (duplicates fine; 0 = null, must carry
@@ -110,6 +111,15 @@ def push(table: jnp.ndarray, idx: jnp.ndarray, grads: jnp.ndarray,
     rows).
     """
     n = idx.shape[0]
+    if (config_flags.binned_push and not quant.is_quant(table)
+            and pallas_kernels.binned_push_supported(
+                table, cfg, config_flags.binned_push_splits)):
+        # scatter-free merge+update: XLA's scatter is ~117ns/token of pure
+        # random-access latency; the binned kernel streams the same merge
+        # through the MXU (see pallas_kernels.binned_push)
+        return pallas_kernels.binned_push(
+            table, idx, grads, shows, clks, cfg,
+            n_split=config_flags.binned_push_splits, plan=plan)
     payload = jnp.concatenate(
         [grads, shows[:, None], clks[:, None],
          jnp.ones((n, 1), grads.dtype)], axis=1)
@@ -261,17 +271,19 @@ def routed_push(table_shard: jnp.ndarray, idx: jnp.ndarray,
                 grads: jnp.ndarray, shows: jnp.ndarray, clks: jnp.ndarray,
                 cfg: EmbeddingConfig, axis_name,
                 capacity_factor: float = 2.0,
-                dedup: bool = False) -> jnp.ndarray:
+                dedup: bool = False, plan=None) -> jnp.ndarray:
     """Distributed merge-update inside shard_map (reverse of routed_lookup).
 
     dedup merges per-token payloads onto unique tokens with ONE
     concatenated scatter-add before routing (see routed_lookup on when it
     pays; masked tokens carry zero payloads so their merge onto the null
-    slot is a no-op)."""
+    slot is a no-op). `plan` (host binned-push token grouping) applies to
+    the single-shard path only — post-all_to_all tokens have no host
+    plan."""
     n = idx.shape[0]
     D = _axis_size(axis_name)
     if D == 1:
-        return push(table_shard, idx, grads, shows, clks, cfg)
+        return push(table_shard, idx, grads, shows, clks, cfg, plan=plan)
     if dedup:
         uniq, inverse = dedup_tokens(idx)
         payload = jnp.concatenate(

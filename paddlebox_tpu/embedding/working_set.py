@@ -236,6 +236,10 @@ class PassWorkingSet:
         rps = max(min_rows_per_shard, -(-need // n_shards))
         if bucket_rows:
             rps = bucket_size(rps)
+        if rps >= 4096:
+            # align shard rows to the binned-push super-block (≤4095
+            # wasted rows; bucketed sizes already land on multiples)
+            rps = -(-rps // 4096) * 4096
         n_pad = rps * n_shards
         host_table = np.zeros((n_pad, cfg.row_width), dtype=np.float32)
         host_table[1:1 + len(keys)] = rows

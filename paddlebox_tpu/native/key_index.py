@@ -32,10 +32,42 @@ def _configure(lib: ctypes.CDLL) -> None:
     lib.ki_lookup_or_insert.argtypes = [c.c_void_p, u64p, c.c_int64, i64p]
     lib.ki_rebuild.restype = None
     lib.ki_rebuild.argtypes = [c.c_void_p, u64p, c.c_int64]
+    i32p = np.ctypeslib.ndpointer(np.int32, flags="C_CONTIGUOUS")
+    lib.pbtpu_block_plan.restype = None
+    lib.pbtpu_block_plan.argtypes = [i32p, c.c_int64, c.c_int32, c.c_int64,
+                                     i32p, i32p, i32p]
 
 
 def get_lib() -> ctypes.CDLL | None:
     return load_native("libkeyindex.so", _configure)
+
+
+def block_plan(idx: np.ndarray, super_block: int, n_blocks: int
+               ) -> tuple[np.ndarray, np.ndarray, np.ndarray]:
+    """Group token row-ids by table super-block (binned-push host plan).
+
+    Returns (order (n,) int32, rstart (n_blocks,) int32, end (n_blocks,)
+    int32). Native counting sort when the lib is available (~1ms at 213k
+    tokens on one core); numpy stable argsort (radix on the small block
+    keys) otherwise.
+    """
+    idx = np.ascontiguousarray(idx, dtype=np.int32)
+    n = len(idx)
+    lib = get_lib()
+    if lib is not None:
+        order = np.empty(n, np.int32)
+        rstart = np.empty(n_blocks, np.int32)
+        end = np.empty(n_blocks, np.int32)
+        lib.pbtpu_block_plan(idx, n, super_block, n_blocks, order, rstart,
+                             end)
+        return order, rstart, end
+    bk = np.clip(idx // super_block, 0, n_blocks - 1)
+    order = np.argsort(bk, kind="stable").astype(np.int32)
+    counts = np.bincount(bk, minlength=n_blocks)
+    ends = np.cumsum(counts)
+    starts = ends - counts
+    return (order, ((starts // 8) * 8).astype(np.int32),
+            ends.astype(np.int32))
 
 
 def native_available() -> bool:

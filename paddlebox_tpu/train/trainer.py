@@ -84,6 +84,9 @@ def _mean_replicated_grad(gp, axes):
     return jax.tree.map(lambda g: g / d, gp)
 
 
+_NO_PLAN = np.zeros(0, np.int32)   # zero-length = "no host binned plan"
+
+
 def _dense_tx(cfg: TrainerConfig) -> optax.GradientTransformation:
     return optimizers.make(cfg.dense_optimizer, cfg.dense_lr,
                            **cfg.dense_optimizer_kwargs)
@@ -164,6 +167,14 @@ class Trainer:
         # Pass a shared manager when several trainers drive one table
         # (join/update phase programs — see train/phased.py).
         self.feed_mgr = feed_mgr or FeedPassManager(store, mesh)
+        # Host-side binned-push plan (native counting sort in the pack
+        # pipeline) replaces the on-device argsort of the scatter-free
+        # push — single-shard TPU f32 tables only (post-all_to_all tokens
+        # have no host plan). Read at trace time like the other kernels.
+        self._use_plan = (
+            self.n_shards == 1 and config_flags.binned_push
+            and self.store.cfg.storage == "f32"
+            and jax.default_backend() == "tpu")
         self._step_fn = self._build_train_step()
         self._eval_fn = self._build_eval_step()
         self._auc_fn = jax.jit(auc_lib.auc_update)
@@ -209,7 +220,10 @@ class Trainer:
         # multi-shard meshes where ICI volume is what it buys down.
         dedup = config_flags.pullpush_dedup_keys and self.n_shards > 1
 
-        def core(tshard, idx_l, mask_l, dense_l, labels_l, params):
+        def core(tshard, idx_l, mask_l, dense_l, labels_l, params,
+                 order, rstart, endb):
+            # zero-length order == "no host plan" (static shape branch)
+            plan = (order, rstart, endb) if order.shape[0] else None
             B_l = idx_l.shape[0]
             flat_idx = idx_l.reshape(-1)
             pulled, dropped = sharded.routed_lookup(
@@ -237,7 +251,8 @@ class Trainer:
                        * labels_l[:, None]).reshape(-1)
             new_shard = sharded.routed_push(tshard, flat_idx, sgrad,
                                             show_inc, clk_inc, emb_cfg,
-                                            axes, capf, dedup=dedup)
+                                            axes, capf, dedup=dedup,
+                                            plan=plan)
             # capacity-drop monitor: global count of tokens the fixed-size
             # all_to_all lanes could not carry this step (push routes the
             # same tokens at the same capacity, so one count covers both)
@@ -260,11 +275,13 @@ class Trainer:
         if mode == "kstep":
             # local dense update inside shard_map; params carry a leading
             # shard axis (each device trains its own copy between syncs)
-            def body(tshard, idx_l, mask_l, dense_l, labels_l, p_st, o_st):
+            def body(tshard, idx_l, mask_l, dense_l, labels_l, p_st, o_st,
+                     order, rstart, endb):
                 p = jax.tree.map(lambda a: a[0], p_st)
                 o = jax.tree.map(lambda a: a[0], o_st)
                 new_shard, gp, loss, preds, drop_g = core(
-                    tshard, idx_l, mask_l, dense_l, labels_l, p)
+                    tshard, idx_l, mask_l, dense_l, labels_l, p,
+                    order, rstart, endb)
                 updates, new_o = tx.update(gp, o, p)
                 new_p = optax.apply_updates(p, updates)
                 loss_g = lax.pmean(loss, axes)
@@ -272,14 +289,17 @@ class Trainer:
                 return (new_shard, lift(new_p), lift(new_o), loss_g, preds,
                         drop_g)
 
-            def step(table, params, opt_state, idx, mask, dense, labels):
+            def step(table, params, opt_state, idx, mask, dense, labels,
+                     order=_NO_PLAN, rstart=_NO_PLAN, endb=_NO_PLAN):
                 return jax.shard_map(
                     body, mesh=self.mesh,
                     in_specs=(batch_spec, batch_spec, batch_spec, batch_spec,
-                              batch_spec, batch_spec, batch_spec),
+                              batch_spec, batch_spec, batch_spec, batch_spec,
+                              batch_spec, batch_spec),
                     out_specs=(batch_spec, batch_spec, batch_spec, P(),
                                batch_spec, P()),
-                )(table, idx, mask, dense, labels, params, opt_state)
+                )(table, idx, mask, dense, labels, params, opt_state,
+                  order, rstart, endb)
 
             return jax.jit(step, donate_argnums=(0, 1, 2),
                            out_shardings=(tbl_sh, self._stacked_sh,
@@ -291,40 +311,50 @@ class Trainer:
             # AsyncDenseTable owns the optimizer (BoxPSAsynDenseTable)
             from jax.flatten_util import ravel_pytree
 
-            def body(tshard, idx_l, mask_l, dense_l, labels_l, params):
+            def body(tshard, idx_l, mask_l, dense_l, labels_l, params,
+                     order, rstart, endb):
                 new_shard, gp, loss, preds, drop_g = core(
-                    tshard, idx_l, mask_l, dense_l, labels_l, params)
+                    tshard, idx_l, mask_l, dense_l, labels_l, params,
+                    order, rstart, endb)
                 gp = _mean_replicated_grad(gp, axes)
                 loss_g = lax.pmean(loss, axes)
                 return new_shard, gp, loss_g, preds, drop_g
 
-            def step(table, params, idx, mask, dense, labels):
+            def step(table, params, idx, mask, dense, labels,
+                     order=_NO_PLAN, rstart=_NO_PLAN, endb=_NO_PLAN):
                 new_table, gp, loss, preds, drop_g = jax.shard_map(
                     body, mesh=self.mesh,
                     in_specs=(batch_spec, batch_spec, batch_spec, batch_spec,
-                              batch_spec, P()),
+                              batch_spec, P(), batch_spec, batch_spec,
+                              batch_spec),
                     out_specs=(batch_spec, P(), P(), batch_spec, P()),
-                )(table, idx, mask, dense, labels, params)
+                )(table, idx, mask, dense, labels, params,
+                  order, rstart, endb)
                 gp_flat = ravel_pytree(gp)[0]
                 return new_table, gp_flat, loss, preds, drop_g
 
             return jax.jit(step, donate_argnums=(0,),
                            out_shardings=(tbl_sh, repl, repl, bat_sh, repl))
 
-        def body(tshard, idx_l, mask_l, dense_l, labels_l, params):
+        def body(tshard, idx_l, mask_l, dense_l, labels_l, params,
+                 order, rstart, endb):
             new_shard, gp, loss, preds, drop_g = core(
-                tshard, idx_l, mask_l, dense_l, labels_l, params)
+                tshard, idx_l, mask_l, dense_l, labels_l, params,
+                order, rstart, endb)
             gp = _mean_replicated_grad(gp, axes)
             loss_g = lax.pmean(loss, axes)
             return new_shard, gp, loss_g, preds, drop_g
 
-        def step(table, params, opt_state, idx, mask, dense, labels):
+        def step(table, params, opt_state, idx, mask, dense, labels,
+                 order=_NO_PLAN, rstart=_NO_PLAN, endb=_NO_PLAN):
             new_table, gp, loss, preds, drop_g = jax.shard_map(
                 body, mesh=self.mesh,
                 in_specs=(batch_spec, batch_spec, batch_spec, batch_spec,
-                          batch_spec, P()),
+                          batch_spec, P(), batch_spec, batch_spec,
+                          batch_spec),
                 out_specs=(batch_spec, P(), P(), batch_spec, P()),
-            )(table, idx, mask, dense, labels, params)
+            )(table, idx, mask, dense, labels, params,
+              order, rstart, endb)
             updates, new_opt = tx.update(gp, opt_state, params)
             new_params = optax.apply_updates(params, updates)
             return new_table, new_params, new_opt, loss, preds, drop_g
@@ -394,16 +424,36 @@ class Trainer:
         return step
 
     # ------------------------------------------------------------------
-    def _put_batch(self, ws: PassWorkingSet, pb: PackedBatch):
+    def _put_batch(self, ws: PassWorkingSet, pb: PackedBatch,
+                   with_plan: bool = True):
         with self.timers("translate"):
             idx = ws.translate(pb.ids, pb.mask)
             labels, dense = self.split_floats(pb.floats)
+            plan = (self._host_plan(ws, idx) if with_plan
+                    else (np.zeros(0, np.int32),) * 3)
         sh = mesh_lib.batch_sharding(self.mesh)
-        # ONE device_put for all four arrays: each put is a host->device
+        # ONE device_put for all arrays: each put is a host->device
         # round trip (very expensive on tunneled transports)
         return jax.device_put(
             (idx, pb.mask, dense.astype(np.float32),
-             labels.astype(np.float32)), sh)
+             labels.astype(np.float32), *plan), sh)
+
+    def _host_plan(self, ws: PassWorkingSet, idx: np.ndarray):
+        """Binned-push token grouping, on the host pack pipeline
+        (pallas_kernels.binned_push's `plan`). Zero-length arrays mean
+        "no plan" — the step's static-shape branch then keeps the
+        on-device grouping (or the XLA scatter path off-TPU)."""
+        empty = (np.zeros(0, np.int32),) * 3
+        if not self._use_plan:
+            return empty
+        from paddlebox_tpu.ops import pallas_kernels
+        geom = pallas_kernels.binned_push_geometry(
+            self.store.cfg, ws.padded_rows,
+            config_flags.binned_push_splits)
+        if geom is None:
+            return empty
+        from paddlebox_tpu.native.key_index import block_plan
+        return block_plan(idx.reshape(-1), geom[0], geom[1])
 
     def train_pass(self, dataset, metrics: Any = None
                    ) -> dict[str, float]:
@@ -440,19 +490,20 @@ class Trainer:
         try:
             for pb in dataset.batches(cfg.global_batch_size, drop_last=True):
                 with RecordEvent("pack_batch"):
-                    idx, mask, dense, labels = self._put_batch(ws, pb)
+                    (idx, mask, dense, labels,
+                     *plan) = self._put_batch(ws, pb)
                 with self.timers("train"), RecordEvent("train_step"):
                     if mode == "async":
                         params = jax.device_put(
                             self._unravel(self.dense_table.pull()), repl)
                         table, gp_flat, loss, preds, dropped = self._step_fn(
-                            table, params, idx, mask, dense, labels)
+                            table, params, idx, mask, dense, labels, *plan)
                         self.dense_table.push(np.asarray(gp_flat))
                     else:
                         (table, params, opt_state, loss, preds,
                          dropped) = self._step_fn(
                             table, params, opt_state, idx, mask, dense,
-                            labels)
+                            labels, *plan)
                         pass_step += 1
                         if (mode == "kstep"
                                 and pass_step % cfg.param_sync_step == 0):
@@ -678,7 +729,9 @@ class Trainer:
             n_valid = len(pb.floats)
             if n_valid < bs:
                 pb = pb.pad_to(bs)  # tail batch: pad + mask, don't drop
-            idx, mask, dense, labels = self._put_batch(ws, pb)
+            # eval never pushes: skip the host plan + its H2D entirely
+            idx, mask, dense, labels, *_ = self._put_batch(ws, pb,
+                                                           with_plan=False)
             preds, dropped = self._eval_fn(ws.table, self.eval_params(),
                                            idx, mask, dense)
             valid = jnp.arange(bs) < n_valid

@@ -149,11 +149,14 @@ class CommandFS(FileSystem):
         return proc
 
     def open_read(self, path: str) -> IO[bytes]:
+        # stderr spools to a temp file: a chatty CLI (hadoop log4j noise)
+        # writing >64KB to a PIPE nobody drains would deadlock the stream
+        errf = tempfile.TemporaryFile()
         proc = subprocess.Popen(self._argv("cat", path=path),
                                 env=self._env, stdout=subprocess.PIPE,
-                                stderr=subprocess.PIPE)
+                                stderr=errf)
         assert proc.stdout is not None
-        return _CommandStream(proc)
+        return _CommandStream(proc, errf)
 
     def write_text(self, path: str, text: str, append: bool = False) -> None:
         if append and self._cmds["append"] is None and self.exists(path):
@@ -209,9 +212,10 @@ class _CommandStream:
     process and raises if the command failed (a silently-truncated filelist
     must never parse as a short success)."""
 
-    def __init__(self, proc: subprocess.Popen):
+    def __init__(self, proc: subprocess.Popen, errf=None):
         self._proc = proc
         self._f = proc.stdout
+        self._errf = errf
 
     def read(self, *a):
         return self._f.read(*a)
@@ -220,14 +224,16 @@ class _CommandStream:
         return iter(self._f)
 
     def close(self) -> None:
-        self._f.read()  # drain so the producer can exit
+        while self._f.read(1 << 20):     # bounded-chunk drain (early-exit
+            pass                         # consumers of multi-GB files)
         rc = self._proc.wait()
+        err = ""
+        if self._errf is not None:
+            self._errf.seek(0)
+            err = self._errf.read(4096).decode(errors="replace")
+            self._errf.close()
         if rc != 0:
-            err = (self._proc.stderr.read().decode(errors="replace")
-                   if self._proc.stderr else "")
             raise RuntimeError(f"CommandFS cat failed ({rc}): {err[:500]}")
-        if self._proc.stderr:
-            self._proc.stderr.close()
         self._f.close()
 
     def __enter__(self):
@@ -281,10 +287,14 @@ def init_afs_api(fs_name: str, fs_user: str = "", fs_passwd: str = "",
     ``-D`` confs like the reference's ugi string.
     """
     d = []
+    env = {}
     if fs_name:
         d.append(f"-Dfs.defaultFS={fs_name}")
     if fs_user:
-        d.append(f"-Dhadoop.job.ugi={fs_user},{fs_passwd}")
+        # credentials ride HADOOP_CLIENT_OPTS (the client-JVM env hook),
+        # not the wrapper argv — `ps` on the launcher shows no secret
+        env["HADOOP_CLIENT_OPTS"] = (
+            f"-Dhadoop.job.ugi={fs_user},{fs_passwd}")
     opts = " ".join(d)
     # --config is a launcher option: it must precede the `fs` subcommand
     conf = f" --config {conf_path}" if conf_path else ""
@@ -295,7 +305,8 @@ def init_afs_api(fs_name: str, fs_user: str = "", fs_passwd: str = "",
                    get=f"{base} -get {{src}} {{dst}}",
                    mkdir=f"{base} -mkdir -p {{path}}",
                    test=f"{base} -test -e {{path}}",
-                   rm=f"{base} -rm -r -f {{path}}")
+                   rm=f"{base} -rm -r -f {{path}}",
+                   env=env)
     for s in schemes:
         register_fs(s, fs)
     return fs

@@ -1,0 +1,125 @@
+"""Binned (scatter-free) push kernel — ops/pallas_kernels.binned_push.
+
+CPU coverage runs the Pallas interpreter; parity is against the XLA
+scatter+update path (summation ORDER differs, so tolerances not bitwise).
+The real-TPU Mosaic path is exercised by bench.py and measured there
+(see the kernel's module comment for numbers).
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from paddlebox_tpu.config import flags
+from paddlebox_tpu.embedding import sharded
+from paddlebox_tpu.embedding.config import EmbeddingConfig
+from paddlebox_tpu.native.key_index import block_plan
+from paddlebox_tpu.ops import pallas_kernels as pk
+
+N, TOK = 8192, 3000
+
+
+def _xla_push(table, idx, grads, shows, clks, cfg):
+    old = flags.binned_push
+    flags.binned_push = False
+    try:
+        return np.asarray(jax.jit(
+            lambda *a: sharded.push(*a, cfg))(table, idx, grads, shows,
+                                              clks))
+    finally:
+        flags.binned_push = old
+
+
+def _case(cfg, seed=0, n_rows=N, tok=TOK, skew=False):
+    rng = np.random.default_rng(seed)
+    if skew:
+        # half the tokens hammer 20 hot rows in one super-block
+        hot = rng.integers(0, 20, size=tok // 2)
+        cold = rng.integers(0, n_rows, size=tok - tok // 2)
+        idx = np.concatenate([hot, cold]).astype(np.int32)
+    else:
+        idx = rng.integers(0, n_rows, size=tok).astype(np.int32)
+    grads = rng.normal(size=(tok, cfg.grad_width)).astype(np.float32)
+    shows = np.ones(tok, np.float32)
+    clks = (rng.random(tok) < 0.3).astype(np.float32)
+    table = (rng.normal(size=(n_rows, cfg.row_width)) * 0.01
+             ).astype(np.float32)
+    return (jnp.asarray(table), jnp.asarray(idx), jnp.asarray(grads),
+            jnp.asarray(shows), jnp.asarray(clks))
+
+
+@pytest.mark.parametrize("opt", ["sgd", "adagrad", "adam", "ftrl"])
+def test_parity_vs_xla_scatter(opt):
+    cfg = EmbeddingConfig(dim=4, optimizer=opt, learning_rate=0.1)
+    table, idx, grads, shows, clks = _case(cfg)
+    want = _xla_push(table, idx, grads, shows, clks, cfg)
+    got = np.asarray(pk.binned_push(table, idx, grads, shows, clks, cfg,
+                                    interpret=True))
+    np.testing.assert_allclose(got, want, atol=2e-5, rtol=1e-4)
+
+
+def test_parity_with_host_plan_and_skew():
+    cfg = EmbeddingConfig(dim=8, optimizer="adagrad", learning_rate=0.05)
+    table, idx, grads, shows, clks = _case(cfg, seed=3, skew=True)
+    want = _xla_push(table, idx, grads, shows, clks, cfg)
+    SB, NB = pk.binned_push_geometry(cfg, N)
+    plan_np = block_plan(np.asarray(idx), SB, NB)
+    plan = tuple(jnp.asarray(a) for a in plan_np)
+    got = np.asarray(pk.binned_push(table, idx, grads, shows, clks, cfg,
+                                    plan=plan, interpret=True))
+    np.testing.assert_allclose(got, want, atol=2e-5, rtol=1e-4)
+
+
+def test_untouched_rows_bit_identical():
+    """Rows no token references must keep their exact bits (stateful
+    optimizers would otherwise decay momentum everywhere)."""
+    cfg = EmbeddingConfig(dim=4, optimizer="adam")
+    table, idx, grads, shows, clks = _case(cfg, seed=7, tok=200)
+    got = np.asarray(pk.binned_push(table, idx, grads, shows, clks, cfg,
+                                    interpret=True))
+    touched = np.zeros(N, bool)
+    touched[np.asarray(idx)] = True
+    np.testing.assert_array_equal(got[~touched], np.asarray(table)[~touched])
+
+
+def test_out_of_range_tokens_dropped():
+    """idx >= n_rows (the routed path's empty-lane convention) must be
+    dropped, matching the XLA path's mode='drop'."""
+    cfg = EmbeddingConfig(dim=4, optimizer="sgd", learning_rate=1.0)
+    table, idx, grads, shows, clks = _case(cfg, seed=9, tok=512)
+    idx = jnp.asarray(np.where(np.arange(512) % 3 == 0, N, np.asarray(idx))
+                      .astype(np.int32))
+    want = _xla_push(table, idx, grads, shows, clks, cfg)
+    got = np.asarray(pk.binned_push(table, idx, grads, shows, clks, cfg,
+                                    interpret=True))
+    np.testing.assert_allclose(got, want, atol=2e-5, rtol=1e-4)
+
+
+def test_geometry_and_support():
+    cfg = EmbeddingConfig(dim=8)
+    assert pk.binned_push_geometry(cfg, 524288) == (4096, 128)
+    assert pk.binned_push_geometry(cfg, 524289) is None  # odd row count
+    # wide payloads that cannot fit one 128-lane packed row fall back
+    wide = EmbeddingConfig(dim=64)  # grad_width 65 -> PP 72; 2+3*72 > 128
+    assert pk.binned_push_geometry(wide, 524288) is None
+    assert pk.binned_push_geometry(wide, 524288, n_split=1) == (4096, 128)
+    # quant tables and non-TPU backends keep the XLA path
+    assert not pk.binned_push_supported(jnp.zeros((4096, 13)), cfg) \
+        or jax.default_backend() == "tpu"
+
+
+def test_block_plan_native_matches_numpy_fallback():
+    rng = np.random.default_rng(0)
+    idx = rng.integers(0, 528384, size=50_000).astype(np.int32)
+    SB, NB = 4096, 129
+    order, rstart, end = block_plan(idx, SB, NB)
+    # a valid grouping: every position appears once, blocks contiguous
+    assert np.array_equal(np.sort(order), np.arange(len(idx)))
+    bk = idx[order] // SB
+    assert (np.diff(bk) >= 0).all()
+    counts = np.bincount(idx // SB, minlength=NB)
+    ends = np.cumsum(counts)
+    np.testing.assert_array_equal(end, ends)
+    np.testing.assert_array_equal(rstart, ((ends - counts) // 8) * 8)

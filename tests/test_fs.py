@@ -250,7 +250,10 @@ def test_init_afs_api_registers_schemes():
     try:
         got, _ = fs_lib.resolve("afstest://a/b")
         assert got is fs
-        # credential conf rides the command line the hadoop way
-        assert any("hadoop.job.ugi=u,p" in a for a in fs._argv("cat", path="x"))
+        # credentials ride the subprocess ENV (HADOOP_CLIENT_OPTS), never
+        # the wrapper argv where `ps` would show them
+        assert "hadoop.job.ugi=u,p" in fs._env.get("HADOOP_CLIENT_OPTS", "")
+        assert not any("hadoop.job.ugi" in a
+                       for a in fs._argv("cat", path="x"))
     finally:
         fs_lib._REGISTRY.pop("afstest", None)
