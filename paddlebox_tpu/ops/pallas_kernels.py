@@ -150,7 +150,10 @@ def _bp_geometry(cfg: EmbeddingConfig, n_rows: int, n_split: int = 3):
         # the packed row (2 id cols + n_split payload planes) must fit one
         # 128-lane DMA tile; wide-dim tables keep the XLA path
         return None
-    G = max(1, 128 // PP)
+    # largest power of two <= 128 // PP: lane routing only needs
+    # G * PP <= 128, and a non-pow2 G (PP=24 -> 128//24=5) would fail the
+    # SB % G check below and silently lose the kernel for those widths
+    G = 1 << ((128 // PP).bit_length() - 1)
     SB = 4096
     while SB >= 512:
         if n_rows % SB == 0 and SB % G == 0:
@@ -235,6 +238,9 @@ def binned_push_geometry(cfg: EmbeddingConfig, n_rows: int,
     return SB, n_rows // SB
 
 
+_geom_fallback_logged: set = set()
+
+
 def binned_push_supported(table, cfg: EmbeddingConfig,
                           n_split: int = 3) -> bool:
     """Engages on real-TPU f32 tables whose row count and payload width
@@ -243,7 +249,19 @@ def binned_push_supported(table, cfg: EmbeddingConfig,
         return False
     if jax.default_backend() != "tpu":
         return False
-    return _bp_geometry(cfg, table.shape[0], n_split) is not None
+    if _bp_geometry(cfg, table.shape[0], n_split) is None:
+        # the ~37%-slower XLA scatter path engaging on an eligible table
+        # must be visible, not silent (ADVICE r2)
+        key = (table.shape[0], cfg.grad_width, n_split)
+        if key not in _geom_fallback_logged:
+            _geom_fallback_logged.add(key)
+            import warnings
+            warnings.warn(
+                f"binned_push geometry unavailable for table rows="
+                f"{table.shape[0]} grad_width={cfg.grad_width} "
+                f"n_split={n_split}; falling back to the XLA scatter path")
+        return False
+    return True
 
 
 def binned_push(table: jnp.ndarray, idx: jnp.ndarray, grads: jnp.ndarray,

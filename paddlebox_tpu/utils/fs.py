@@ -136,7 +136,14 @@ class CommandFS(FileSystem):
         tpl = self._cmds[op]
         if tpl is None:
             raise NotImplementedError(f"CommandFS has no {op!r} command")
-        return [a.format(**kw) for a in shlex.split(tpl)]
+        # substitute only the known placeholders (not str.format): literal
+        # '{'/'}' are legal in object names and in command templates.
+        # Single-pass re.sub so a substituted VALUE containing "{dst}" etc.
+        # is never re-scanned by a later placeholder.
+        import re
+        pat = re.compile("|".join(re.escape("{" + k + "}") for k in kw))
+        return [pat.sub(lambda m: kw[m.group(0)[1:-1]], tok)
+                for tok in shlex.split(tpl)]
 
     def _run(self, op: str, ok_codes: tuple = (0,),
              **kw) -> subprocess.CompletedProcess:
@@ -187,11 +194,14 @@ class CommandFS(FileSystem):
         out = self._run("ls", path=path).stdout.decode(errors="replace")
         names = []
         for line in out.splitlines():
-            # `hadoop fs -ls` ends each entry line with the path; plain `ls`
-            # prints bare names — take the last whitespace token either way
-            tok = line.split()[-1] if line.split() else ""
-            if tok and not line.startswith("Found "):
-                names.append(tok)
+            if not line.strip() or line.startswith("Found "):
+                continue
+            # hadoop-style -ls lines carry 8 whitespace fields with the
+            # path LAST (it may contain spaces — split at most 7 times so
+            # the path field keeps them); bare-name listings (plain `ls`)
+            # are a single field. Custom ls templates must emit one of
+            # those two shapes.
+            names.append(line.split(None, 7)[-1])
         return sorted(names)
 
     def makedirs(self, path: str) -> None:
@@ -224,17 +234,30 @@ class _CommandStream:
         return iter(self._f)
 
     def close(self) -> None:
-        while self._f.read(1 << 20):     # bounded-chunk drain (early-exit
-            pass                         # consumers of multi-GB files)
+        if self._f.closed:
+            return
+        # An early-exit consumer (head of a multi-GB remote file) must not
+        # pay a full download inside close(): if any bytes remain, kill the
+        # producer and skip the exit-code check — the strict rc!=0 check
+        # (truncated filelists must never parse as short successes) is
+        # reserved for fully-consumed streams, where it is meaningful.
+        if self._f.read(1):
+            self._proc.kill()
+            self._proc.wait()
+            self._f.close()
+            if self._errf is not None:
+                self._errf.close()
+            return
         rc = self._proc.wait()
         err = ""
         if self._errf is not None:
             self._errf.seek(0)
             err = self._errf.read(4096).decode(errors="replace")
             self._errf.close()
+            self._errf = None
+        self._f.close()   # before the raise: no fd leak, close idempotent
         if rc != 0:
             raise RuntimeError(f"CommandFS cat failed ({rc}): {err[:500]}")
-        self._f.close()
 
     def __enter__(self):
         return self

@@ -123,3 +123,22 @@ def test_block_plan_native_matches_numpy_fallback():
     ends = np.cumsum(counts)
     np.testing.assert_array_equal(end, ends)
     np.testing.assert_array_equal(rstart, ((ends - counts) // 8) * 8)
+
+
+def test_geometry_non_pow2_lane_groups():
+    """PP=24 widths (e.g. dim=16: grad 17 -> P 20 -> PP 24) must round G
+    down to a power of two (ADVICE r2) instead of losing the kernel."""
+    cfg = EmbeddingConfig(dim=16)
+    geom = pk._bp_geometry(cfg, 524288)
+    assert geom is not None
+    P, PP, G, SB = geom
+    assert PP == 24 and G == 4 and SB % G == 0
+
+
+def test_parity_dim16_pow2_groups():
+    cfg = EmbeddingConfig(dim=16, optimizer="adagrad", learning_rate=0.05)
+    table, idx, grads, shows, clks = _case(cfg, seed=5)
+    want = _xla_push(table, idx, grads, shows, clks, cfg)
+    got = np.asarray(pk.binned_push(table, idx, grads, shows, clks, cfg,
+                                    interpret=True))
+    np.testing.assert_allclose(got, want, atol=2e-5, rtol=1e-4)

@@ -257,3 +257,59 @@ def test_init_afs_api_registers_schemes():
                        for a in fs._argv("cat", path="x"))
     finally:
         fs_lib._REGISTRY.pop("afstest", None)
+
+
+def test_command_fs_braces_in_paths(tmp_path):
+    """Literal '{'/'}' are legal in object names (ADVICE r2): the template
+    substitution must touch only the known placeholders."""
+    fs = fs_lib.CommandFS(cat="cat {path}")
+    p = tmp_path / "weird{0}name.txt"
+    p.write_text("hello")
+    with fs.open_read(str(p)) as f:
+        assert f.read() == b"hello"
+    # braces in the template itself (e.g. an awk program) survive too
+    fs2 = fs_lib.CommandFS(test="sh -c 'case {path} in *x*) exit 0;; *) exit 1;; esac' --ignored")
+    assert fs2._argv("test", path="a{b}x")[-2].count("{path}") == 0
+
+
+def test_command_fs_ls_paths_with_spaces(tmp_path):
+    """hadoop -ls style lines keep embedded spaces in the path field."""
+    listing = tmp_path / "listing.txt"
+    listing.write_text(
+        "Found 2 items\n"
+        "-rw-r--r--   3 user group 12 2026-01-01 10:00 /data/name with spaces\n"
+        "drwxr-xr-x   - user group  0 2026-01-01 10:00 /data/plain\n")
+    fs = fs_lib.CommandFS(ls=f"cat {listing}")
+    assert fs.ls("ignored://") == ["/data/name with spaces", "/data/plain"]
+
+
+def test_command_stream_early_close_kills_producer():
+    """Closing a partially-read stream must not drain the whole remote file
+    (ADVICE r2): the producer is killed and no rc check applies."""
+    import time
+    fs = fs_lib.CommandFS(
+        cat="sh -c 'yes data-{path} | head -c 100000000; sleep 30'")
+    t0 = time.time()
+    with fs.open_read("x") as f:
+        head = f.read(64)
+    assert head.startswith(b"data-x")
+    assert time.time() - t0 < 5.0  # neither a full drain nor the sleep
+    # fully-consumed streams still get the strict rc check
+    fs_bad = fs_lib.CommandFS(cat="sh -c 'echo hi; exit 3'")
+    with pytest.raises(RuntimeError, match="cat failed"):
+        with fs_bad.open_read("x") as f:
+            f.read()
+
+
+def test_argv_no_resubstitution_and_close_idempotent(tmp_path):
+    fs = fs_lib.CommandFS(put="cp {src} {dst}")
+    # a src VALUE containing "{dst}" must not be re-substituted
+    argv = fs._argv("put", src="/tmp/x{dst}y", dst="/data/out")
+    assert argv == ["cp", "/tmp/x{dst}y", "/data/out"]
+    # failing fully-consumed stream: raises once, close() is idempotent
+    fs_bad = fs_lib.CommandFS(cat="sh -c 'echo hi; exit 3'")
+    f = fs_bad.open_read("x")
+    f.read()
+    with pytest.raises(RuntimeError, match="cat failed"):
+        f.close()
+    f.close()  # second close (e.g. with-block __exit__) must be a no-op
