@@ -159,6 +159,18 @@ def device_step_bench(small: bool):
     _mark("device-step windows done")
 
     eps_chip = n_steps * batch / dt / n_dev
+    ws.table, tr.params, tr.opt_state = table, params, opt  # post-donation
+    attribution = None
+    if n_dev == 1 and os.environ.get("PBTPU_BENCH_ATTR", "1") != "0":
+        # per-stage device-time breakdown (log_for_profile's cal-split
+        # analogue, boxps_worker.cc:746-759): a throughput regression
+        # must name its stage
+        from paddlebox_tpu.utils.step_probe import attribute_step
+        attribution = attribute_step(tr, ws, staged[0], dt / n_steps,
+                                     k=4 if small else 24,
+                                     n_loop=10 if small else 100)
+        _mark(f"stage attribution done (coverage "
+              f"{attribution['coverage']:.0%})")
     flops, hbm = _analytic_cost(batch, num_slots, emb_dim, dense_dim,
                                 hidden, emb_cfg, ws.padded_rows)
     kind = devices[0].device_kind
@@ -192,6 +204,8 @@ def device_step_bench(small: bool):
         "loss_final": loss_v,
         "audit": audit,
     }
+    if attribution is not None:
+        detail["stage_attribution"] = attribution
     return eps_chip, detail
 
 

@@ -57,6 +57,18 @@ class Flags:
     # bf16 planes the push payload crosses the MXU in: 3 ~= f32-exact,
     # 1 = bf16 grads (~2x faster matmuls, CTR-tolerable rounding)
     binned_push_splits: int = 3             # (new)
+    # Physical column count of the f32 device table. TPU random-row
+    # gathers run ~2x faster from 64/128-column sources than from narrow
+    # odd widths (measured on v5e: 213k-row gather 4.3ms at width 13,
+    # 2.1ms at 64/128; widths 24-32 are WORSE than 13). Default OFF: with
+    # the acc-only binned_push (one fused XLA update pass over the table)
+    # the full train step measured FASTER at logical width (8.0ms vs
+    # 11.8ms on one v5e, batch 8192) — the wide where/update pass costs
+    # more than the gather saves — and padding multiplies HBM footprint
+    # (no lane padding in HBM: a 64-wide table really stores 64 cols).
+    # Opt-in for lookup-dominated workloads: "auto" = 64 (or 128 for
+    # wide rows); 0 = logical width; N = explicit width >= row_width.
+    table_pad_width: Any = 0                # (new)
 
     # --- trainer (trainer_desc.proto:100-108, flags.cc:591-597) ---
     param_sync_step: int = 1                # BoxPSWorkerParameter.sync_dense_step

@@ -67,6 +67,10 @@ def _combine_jit(out_sharding, donate: bool):
     """
     def combine(prev, fresh, src, is_fresh):
         def one(p, f):
+            if f.shape[1] < p.shape[1]:
+                # fresh rows arrive at logical width (H2D carries no pad
+                # bytes); the resident table is device_width wide
+                f = jnp.pad(f, ((0, 0), (0, p.shape[1] - f.shape[1])))
             from_prev = p[jnp.where(is_fresh, 0, src)]
             from_fresh = f[jnp.where(is_fresh, src, 0)]
             return jnp.where(is_fresh[:, None], from_fresh, from_prev)

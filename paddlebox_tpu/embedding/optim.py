@@ -129,5 +129,10 @@ def apply_updates(rows: jnp.ndarray, grads: jnp.ndarray,
     else:  # pragma: no cover - config validates
         raise ValueError(cfg.optimizer)
 
-    return jnp.concatenate(
+    out = jnp.concatenate(
         [show[:, None], clk[:, None], new_w, new_x, opt], axis=1)
+    if rows.shape[1] > out.shape[1]:
+        # device tables may be padded past row_width to the fast gather
+        # width (working_set.device_width); pad columns pass through
+        out = jnp.concatenate([out, rows[:, out.shape[1]:]], axis=1)
+    return out

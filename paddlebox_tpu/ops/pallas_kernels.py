@@ -3,10 +3,13 @@
 Two generations of kernels live here:
 
 - ``binned_push`` (the production path, flags.binned_push): replaces the
-  XLA token scatter-add AND the table update with block-binned one-hot
-  MXU matmuls + a fused in-VMEM optimizer — see its section comment. This
-  is the single largest perf lever in the framework (train step 15.2ms ->
-  10.9ms on one v5e at batch 8192, 546k -> 748k examples/sec/chip).
+  XLA token scatter-add with block-binned one-hot MXU matmuls that build
+  a per-row merge accumulator; the optimizer then applies as ONE fused
+  XLA pass over the table — see the section comment. This is the single
+  largest perf lever in the framework (train step 15.2ms -> 8.0ms on one
+  v5e at batch 8192 across rounds 2-3, 546k -> 1.02M examples/sec/chip;
+  the round-3 move of the optimizer OUT of the kernel bought 11.1 ->
+  8.0ms alone).
 - ``merge_update`` (kept for experiments, default off): fuses only the
   table-update scan after XLA's scatter has built the accumulator.
 
@@ -117,9 +120,10 @@ def merge_update(table: jnp.ndarray, acc: jnp.ndarray, cfg: EmbeddingConfig,
 # cost). This kernel replaces it with MXU matmuls: tokens are sorted by row
 # id (one argsort), bucketed to contiguous table "super-blocks", and each
 # super-block's accumulator is built as one-hot(local_row) @ payload — a
-# streaming matmul instead of random-access writes — then the in-table
-# optimizer applies to the block while it sits in VMEM (the merge + update
-# pass of PushMergeCopy, box_wrapper.cu:630-830, as ONE device pass).
+# streaming matmul instead of random-access writes. The optimizer then
+# applies OUTSIDE the kernel as one fused full-width XLA pass (the merge +
+# update halves of PushMergeCopy, box_wrapper.cu:630-830; see
+# _binned_acc_kernel's docstring for why the split wins on TPU).
 #
 # Exactness: payload crosses the MXU as a 3-plane bf16 split (hi/mid/lo by
 # mantissa masking — integer ops, so --xla_allow_excess_precision cannot
@@ -133,9 +137,10 @@ def merge_update(table: jnp.ndarray, acc: jnp.ndarray, cfg: EmbeddingConfig,
 # payload is routed into its group's lane block), so narrow CTR payloads
 # do not waste ~10x MXU throughput on lane padding.
 #
-# Measured (one v5e, 524k x 13 f32 table, 213k tokens, adagrad, forced-D2H
-# windows): XLA scatter+update 16.6 ms/call, this kernel 11.3 ms/call
-# (~12.5 vs ~7.2 device).
+# Measured (one v5e, 528k x 13 f32 table, 213k tokens, adagrad, forced-D2H
+# repeat-in-one-jit windows): XLA scatter+update ~16.6 ms/call; round-2
+# kernel (in-VMEM optimizer) 5.2 ms; this acc-only split 3.6 ms
+# (kernel ~2.4 + XLA update ~0.3 + prep, overlapping in the fused step).
 # ---------------------------------------------------------------------------
 
 _BP_TILE = 1024          # tokens per DMA/matmul tile
@@ -162,9 +167,20 @@ def _bp_geometry(cfg: EmbeddingConfig, n_rows: int, n_split: int = 3):
     return None
 
 
-def _binned_push_kernel(rstart_ref, end_ref, packed_ref, table_ref, out_ref,
-                        acc_ref, pack_s, sem, *, cfg: EmbeddingConfig,
-                        P: int, PP: int, G: int, SB: int, n_split: int):
+def _binned_acc_kernel(rstart_ref, end_ref, packed_ref, acc_ref,
+                       pack_s, sem, *, PP: int, G: int, SB: int,
+                       n_split: int):
+    """Per-block merge accumulator via one-hot MXU matmuls.
+
+    Writes this block's accumulator in GROUPED layout (RB, G*PP) — row
+    ``local % RB``, lane block ``(local // RB) * PP`` — which the caller
+    untangles with a reshape/transpose that XLA fuses into the table
+    update. The optimizer deliberately does NOT run in here: a
+    (block, group)-tiled elementwise chain wastes ~90% of each VPU lane
+    on narrow CTR rows, while the same update as ONE fused XLA pass over
+    the whole table runs at full width (measured on one v5e, 528k x 13
+    adagrad: in-kernel update ~3.5ms of the old 5.2ms kernel vs 0.5ms as
+    a fused XLA pass over the grouped acc)."""
     RB = SB // G
     TILE = _BP_TILE
     b = pl.program_id(0)
@@ -215,17 +231,6 @@ def _binned_push_kernel(rstart_ref, end_ref, packed_ref, table_ref, out_ref,
         return 0
 
     lax.fori_loop(0, n_t, body, 0)
-    # unpack lane groups + fused in-table optimizer, one group at a time
-    # (a concat of offset slices does not lower in Mosaic)
-    gw = cfg.grad_width
-    for g in range(G):
-        acc_g = acc_ref[:, g * PP:g * PP + P]
-        rows_g = table_ref[g * RB:(g + 1) * RB, :]
-        new_g = apply_updates(rows_g, acc_g[:, :gw], acc_g[:, gw],
-                              acc_g[:, gw + 1], cfg)
-        touched = acc_g[:, gw + 2] > 0
-        out_ref[g * RB:(g + 1) * RB, :] = jnp.where(touched[:, None],
-                                                    new_g, rows_g)
 
 
 def binned_push_geometry(cfg: EmbeddingConfig, n_rows: int,
@@ -326,21 +331,27 @@ def binned_push(table: jnp.ndarray, idx: jnp.ndarray, grads: jnp.ndarray,
     packed = jnp.concatenate(cols, axis=1)
     packed = jnp.pad(packed, ((0, 0), (0, 128 - packed.shape[1])))
     vma = getattr(jax.typeof(table), "vma", frozenset())
-    kernel = functools.partial(_binned_push_kernel, cfg=cfg, P=P, PP=PP,
+    RB = SB // G
+    kernel = functools.partial(_binned_acc_kernel, PP=PP,
                                G=G, SB=SB, n_split=n_split)
-    return pl.pallas_call(
+    acc_g = pl.pallas_call(
         kernel,
-        out_shape=jax.ShapeDtypeStruct((n_rows, table.shape[1]),
-                                       table.dtype, vma=vma),
+        out_shape=jax.ShapeDtypeStruct((NB * RB, G * PP), jnp.float32,
+                                       vma=vma),
         grid_spec=pltpu.PrefetchScalarGridSpec(
             num_scalar_prefetch=2, grid=(NB,),
-            in_specs=[pl.BlockSpec(memory_space=pl.ANY),
-                      pl.BlockSpec((SB, table.shape[1]),
-                                   lambda b, *_: (b, 0))],
-            out_specs=pl.BlockSpec((SB, table.shape[1]),
-                                   lambda b, *_: (b, 0)),
-            scratch_shapes=[pltpu.VMEM((SB // G, G * PP), jnp.float32),
-                            pltpu.VMEM((2, TILE, 128), jnp.float32),
+            in_specs=[pl.BlockSpec(memory_space=pl.ANY)],
+            out_specs=pl.BlockSpec((RB, G * PP), lambda b, *_: (b, 0)),
+            scratch_shapes=[pltpu.VMEM((2, TILE, 128), jnp.float32),
                             pltpu.SemaphoreType.DMA((2,))]),
         interpret=interpret,
-    )(rstart, end, packed, table)
+    )(rstart, end, packed)
+    # untangle the grouped layout (fuses into the update pass) and apply
+    # the optimizer as ONE full-width XLA pass over the table
+    acc = acc_g.reshape(NB, RB, G, PP).transpose(0, 2, 1, 3).reshape(
+        n_rows, PP)[:, :P]
+    gw = cfg.grad_width
+    new_rows = apply_updates(table, acc[:, :gw], acc[:, gw],
+                             acc[:, gw + 1], cfg)
+    touched = acc[:, gw + 2] > 0
+    return jnp.where(touched[:, None], new_rows, table)

@@ -198,11 +198,18 @@ class Trainer:
         return labels, dense
 
     # ------------------------------------------------------------------
-    def _fwd_bwd_push(self):
+    def _fwd_bwd_push(self, ablate: tuple = ()):
         """Shared shard_map core: routed pull → fwd/bwd → routed push.
 
         Returns a fn(tshard, idx_l, mask_l, dense_l, labels_l, params_local)
-        → (new_shard, local_dense_grads, local_loss, preds)."""
+        → (new_shard, local_dense_grads, local_loss, preds).
+
+        ablate: subset of {"lookup", "fwdbwd", "push"} — replaces that
+        stage with a shape-preserving no-op. Used by the bench's stage
+        attribution (step_probe.attribute_step): the marginal device cost
+        of a stage is full-step time minus the ablated step's time, the
+        only measurement that accounts for XLA's cross-stage overlap.
+        Never set in training."""
         cfg = self.cfg
         emb_cfg = self.store.cfg
         axes = tuple(self.mesh.axis_names)
@@ -226,9 +233,15 @@ class Trainer:
             plan = (order, rstart, endb) if order.shape[0] else None
             B_l = idx_l.shape[0]
             flat_idx = idx_l.reshape(-1)
-            pulled, dropped = sharded.routed_lookup(
-                tshard, flat_idx, emb_cfg, axes, capf, dedup=dedup,
-                return_dropped=True)
+            if "lookup" in ablate:
+                pulled = lax.optimization_barrier(
+                    jnp.zeros((B_l * T, emb_cfg.pull_width), jnp.float32)
+                    + labels_l[0] * 0)
+                dropped = jnp.zeros((), jnp.int32)
+            else:
+                pulled, dropped = sharded.routed_lookup(
+                    tshard, flat_idx, emb_cfg, axes, capf, dedup=dedup,
+                    return_dropped=True)
             pulled = pulled.reshape(B_l, T, emb_cfg.pull_width)
 
             def loss_fn(p, pulled_in):
@@ -238,21 +251,32 @@ class Trainer:
                     optax.sigmoid_binary_cross_entropy(logits, labels_l))
                 return loss, jax.nn.sigmoid(logits)
 
-            grad_fn = jax.value_and_grad(loss_fn, argnums=(0, 1),
-                                         has_aux=True)
-            (loss, preds), (gp, gpull) = grad_fn(params, pulled)
-            # sparse grads: only (w, embedx) columns train; show/clk are
-            # counters (CVM grads to them are dropped, like cvm_op's grad)
-            sgrad = gpull[..., 2:].reshape(B_l * T, emb_cfg.grad_width)
-            if cfg.scale_sparse_grad_by_global_mean:
-                sgrad = sgrad / D
-            show_inc = mask_l.reshape(-1).astype(jnp.float32)
-            clk_inc = (mask_l.astype(jnp.float32)
-                       * labels_l[:, None]).reshape(-1)
-            new_shard = sharded.routed_push(tshard, flat_idx, sgrad,
-                                            show_inc, clk_inc, emb_cfg,
-                                            axes, capf, dedup=dedup,
-                                            plan=plan)
+            if "fwdbwd" in ablate:
+                loss = jnp.sum(pulled) * 1e-8
+                preds = jnp.zeros((B_l,), jnp.float32)
+                gp = jax.tree.map(jnp.zeros_like, params)
+                sgrad = lax.optimization_barrier(
+                    jnp.zeros((B_l * T, emb_cfg.grad_width), jnp.float32)
+                    + loss * 0)
+            else:
+                grad_fn = jax.value_and_grad(loss_fn, argnums=(0, 1),
+                                             has_aux=True)
+                (loss, preds), (gp, gpull) = grad_fn(params, pulled)
+                # sparse grads: only (w, embedx) columns train; show/clk
+                # are counters (CVM grads dropped, like cvm_op's grad)
+                sgrad = gpull[..., 2:].reshape(B_l * T, emb_cfg.grad_width)
+                if cfg.scale_sparse_grad_by_global_mean:
+                    sgrad = sgrad / D
+            if "push" in ablate:
+                new_shard = tshard
+            else:
+                show_inc = mask_l.reshape(-1).astype(jnp.float32)
+                clk_inc = (mask_l.astype(jnp.float32)
+                           * labels_l[:, None]).reshape(-1)
+                new_shard = sharded.routed_push(tshard, flat_idx, sgrad,
+                                                show_inc, clk_inc, emb_cfg,
+                                                axes, capf, dedup=dedup,
+                                                plan=plan)
             # capacity-drop monitor: global count of tokens the fixed-size
             # all_to_all lanes could not carry this step (push routes the
             # same tokens at the same capacity, so one count covers both)
@@ -261,11 +285,11 @@ class Trainer:
 
         return core
 
-    def _build_train_step(self) -> Callable:
+    def _build_train_step(self, ablate: tuple = ()) -> Callable:
         cfg = self.cfg
         axes = tuple(self.mesh.axis_names)
         tx = self.tx
-        core = self._fwd_bwd_push()
+        core = self._fwd_bwd_push(ablate)
         batch_spec = P(axes)
         repl = mesh_lib.replicated_sharding(self.mesh)
         tbl_sh = mesh_lib.table_sharding(self.mesh)

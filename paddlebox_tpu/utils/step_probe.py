@@ -1,0 +1,260 @@
+"""Per-stage device-time attribution of the train step.
+
+The reference prints a read/trans/cal/sync split per pass
+(``log_for_profile``, boxps_worker.cc:746-759); this module is the
+device-side analogue for the jitted TPU step: it measures each stage of
+the step — embedding ``lookup``, ``dense_fwd_bwd``, ``sparse_push``
+(which includes the payload reorder, pack, and binned kernel), and the
+``dispatch_floor`` (per-program launch cost, measured with a no-op step
+of identical signature) — as wall-free DEVICE time; the remainder is
+``unattributed_seconds`` (fusion/overlap differences between isolated
+stages and the real fused step). The bench embeds the result
+(``attribute_step``) so a throughput regression names its stage.
+
+Measurement discipline (see bench.py module docstring): a single jit call
+over the tunnel costs ~4-6ms of dispatch, and ``block_until_ready``
+returns early — so every stage is measured by repeating it K times INSIDE
+one jit, chained through ``lax.optimization_barrier`` so XLA can neither
+hoist the loop-invariant body nor dead-code it, and every window is
+terminated by a real 4-byte D2H. Per-call time is (window - empty_window)
+/ K, where the empty window (same K-iteration fori_loop over a barrier
+no-op) measures the dispatch + loop floor.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+
+def _sync(x) -> float:
+    return float(np.asarray(jax.tree.leaves(x)[0].reshape(-1)[0]))
+
+
+def timed_repeat(fn: Callable, args: tuple, k: int = 32,
+                 warmup: int = 2) -> float:
+    """Device seconds per fn(*args) call, dispatch-subtracted.
+
+    fn must return an array (or pytree). Iterations are data-chained so
+    the body stays inside the loop and none of it dead-codes: EVERY leaf
+    of the output is reduced with jnp.sum, the sums feed the next
+    iteration's carry through an optimization_barrier, and the carry
+    perturbs fn's first argument. The sum is a full read of the output —
+    a small, bandwidth-bounded overhead included in the reported time
+    (it cancels when comparing variants with equal output shapes).
+    """
+
+    def chained(carry_arg, *rest):
+        def body(_, state):
+            c, acc = state
+            out = fn(c, *rest)
+            # full data dependence on out: nothing in fn can be DCE'd
+            s = jnp.asarray(0.0, jnp.float32)
+            for leaf in jax.tree.leaves(out):
+                s = s + jnp.sum(leaf).astype(jnp.float32)
+            c2, s2 = lax.optimization_barrier((c, s))
+            # s2 is opaque past the barrier: XLA cannot fold the float
+            # multiply-by-zero, so the carry genuinely depends on out
+            bump = (s2 * 0.0).astype(carry_arg.dtype)
+            return c2 + bump, acc + s2
+        final, acc = lax.fori_loop(0, k, body,
+                                   (carry_arg, jnp.float32(0.0)))
+        return acc
+
+    def empty(carry_arg):
+        def body(_, state):
+            c, acc = state
+            c2, a2 = lax.optimization_barrier((c, acc))
+            return c2, a2 + 1.0
+        _, acc = lax.fori_loop(0, k, body,
+                               (carry_arg, jnp.float32(0.0)))
+        return acc
+
+    jfn = jax.jit(chained)
+    jempty = jax.jit(empty)
+    for _ in range(warmup):
+        _sync(jfn(*args))
+        _sync(jempty(args[0]))
+    best = min(_window(jfn, args) for _ in range(5))
+    floor = min(_window(jempty, (args[0],)) for _ in range(5))
+    if timed_repeat.debug:
+        print(f"#   timed_repeat k={k} best={best*1e3:.2f}ms "
+              f"floor={floor*1e3:.2f}ms", flush=True)
+    return max(0.0, (best - floor)) / k
+
+
+timed_repeat.debug = False
+
+
+def _window(jfn, args) -> float:
+    t0 = time.perf_counter()
+    _sync(jfn(*args))
+    return time.perf_counter() - t0
+
+
+def measure_step_floor(trainer, ws, staged, n: int = 100) -> float:
+    """Per-step dispatch/launch/aliasing floor: a no-op step with the train
+    step's exact signature (same donation, same out_shardings), looped like
+    the bench loop. What remains after subtracting real compute stages from
+    the step time is mostly THIS — per-program launch cost — and it is a
+    real, measured stage, not a fudge residual."""
+    from paddlebox_tpu.parallel import mesh as mesh_lib
+
+    repl = mesh_lib.replicated_sharding(trainer.mesh)
+    tbl_sh = mesh_lib.table_sharding(trainer.mesh)
+
+    def noop(table, params, opt_state, idx, mask, dense, labels, *plan):
+        loss = jnp.sum(labels) * 0.0
+        return table, params, opt_state, loss
+
+    fn = jax.jit(noop, donate_argnums=(0, 1, 2),
+                 out_shardings=(tbl_sh, repl, repl, repl))
+    table, params, opt = ws.table, trainer.params, trainer.opt_state
+    for _ in range(2):
+        table, params, opt, loss = fn(table, params, opt, *staged)
+    _sync(loss)
+    best = None
+    for _ in range(3):
+        t0 = time.perf_counter()
+        for _ in range(n):
+            table, params, opt, loss = fn(table, params, opt, *staged)
+        _sync(loss)
+        w = time.perf_counter() - t0
+        best = w if best is None else min(best, w)
+    ws.table = table
+    trainer.params, trainer.opt_state = params, opt
+    return best / n
+
+
+def _run_step_loop(fn, table, params, opt, staged, n: int) -> tuple:
+    """Bench-identical donation loop; returns (sec/step, final arrays)."""
+    for _ in range(2):
+        table, params, opt, loss, preds, drop = fn(table, params, opt,
+                                                   *staged)
+    _sync(loss)
+    best = None
+    for _ in range(3):
+        t0 = time.perf_counter()
+        for _ in range(n):
+            table, params, opt, loss, preds, drop = fn(table, params, opt,
+                                                       *staged)
+        _sync(loss)
+        w = time.perf_counter() - t0
+        best = w if best is None else min(best, w)
+    return best / n, (table, params, opt)
+
+
+def attribute_step(trainer, ws, staged, step_seconds: float,
+                   k: int = 24, n_loop: int = 100) -> dict:
+    """Stage breakdown of one train step, as device seconds.
+
+    Primary account — **telescoping cumulative ablation**: the trainer
+    builds the SAME jitted step with successively more stages replaced by
+    shape-preserving no-ops (``Trainer._build_train_step(ablate=...)``,
+    biggest stage removed first), each measured with the bench's own
+    donation loop. Successive differences sum exactly to the full step,
+    so coverage is ~100% by construction; a stage's delta is its marginal
+    cost given the stages removed before it (XLA overlaps stages, so
+    shared time lands on the earliest-removed stage that exposes it).
+    ``glue_residual`` is what the emptied-out step still costs above the
+    no-op ``dispatch_floor`` (grad scaling, dense optimizer, psum, output
+    plumbing). Isolated per-stage times are reported as ``isolated`` —
+    they over-count overlap and bound each stage from above.
+
+    trainer : Trainer in "allreduce" dense-sync mode (the bench config)
+    ws      : the PassWorkingSet whose table the step trains
+    staged  : one staged batch tuple (idx, mask, dense, labels, *plan)
+    step_seconds : measured full-step seconds (the number to attribute)
+    """
+    from paddlebox_tpu.embedding import sharded
+
+    assert trainer.cfg.dense_sync_mode == "allreduce", (
+        "stage attribution instruments the allreduce step")
+    idx, mask, dense, labels, *plan = staged
+    emb_cfg = trainer.store.cfg
+    flat_idx = jnp.asarray(np.asarray(idx).reshape(-1))
+    B = idx.shape[0]
+    T = trainer.layout.total_len
+
+    # --- telescoping cumulative ablation (primary): remove stages
+    # biggest-first; successive differences sum EXACTLY to the full step,
+    # so the account is complete by construction. A stage's delta is its
+    # marginal cost GIVEN the stages removed before it — shared/overlapped
+    # time is charged to the earliest-removed stage that exposes it.
+    state = (ws.table, trainer.params, trainer.opt_state)
+    times = [step_seconds]
+    for abl in (("push",), ("push", "lookup"),
+                ("push", "lookup", "fwdbwd")):
+        fn = trainer._build_train_step(ablate=abl)
+        sec, state = _run_step_loop(fn, *state, staged, n_loop)
+        times.append(sec)
+    ws.table, trainer.params, trainer.opt_state = state
+    floor = measure_step_floor(trainer, ws, staged, n=n_loop)
+    stages = {
+        "sparse_push": times[0] - times[1],
+        "lookup": times[1] - times[2],
+        "dense_fwd_bwd": times[2] - times[3],
+        "glue_residual": times[3] - floor,
+        "dispatch_floor": floor,
+    }
+
+    # --- isolated stage times (secondary; shows cross-stage overlap) ---
+    table, params = ws.table, trainer.params
+
+    def lookup_fn(fidx, tbl):
+        return sharded.lookup(tbl, fidx, emb_cfg).reshape(
+            B, T, emb_cfg.pull_width)
+
+    isolated = {"lookup": timed_repeat(lookup_fn, (flat_idx, table), k=k)}
+
+    import optax
+    model = trainer.model
+    seg = trainer.layout.segment_ids
+    num_slots = trainer.layout.num_slots
+    pulled0 = jax.jit(lookup_fn)(flat_idx, table)
+
+    def fwdbwd(pulled, p):
+        def loss_fn(pp, pin):
+            logits = model.apply(pp, pin, mask, dense, seg, num_slots)
+            return jnp.mean(
+                optax.sigmoid_binary_cross_entropy(logits, labels))
+        _, (gp, gpull) = jax.value_and_grad(loss_fn, argnums=(0, 1))(
+            p, pulled)
+        return gpull
+
+    isolated["dense_fwd_bwd"] = timed_repeat(fwdbwd, (pulled0, params),
+                                             k=k)
+    gpull0 = jax.jit(fwdbwd)(pulled0, params)
+    sgrad0 = jax.jit(lambda g: g[..., 2:].reshape(-1, emb_cfg.grad_width)
+                     )(gpull0)
+    shows0 = jnp.asarray(np.asarray(mask).reshape(-1).astype(np.float32))
+    clks0 = jnp.zeros_like(shows0)
+    plan_t = tuple(plan) if plan and plan[0].shape[0] else None
+
+    def push_fn(sg, tbl):
+        return sharded.push(tbl, flat_idx, sg, shows0, clks0, emb_cfg,
+                            plan=plan_t)
+
+    isolated["sparse_push"] = timed_repeat(push_fn, (sgrad0, table), k=k)
+
+    attributed = float(sum(stages.values()))
+    return {
+        "stages": {n: round(s, 6) for n, s in stages.items()},
+        "isolated": {n: round(s, 6) for n, s in isolated.items()},
+        "attributed_seconds": round(attributed, 6),
+        "step_seconds": round(step_seconds, 6),
+        "unattributed_seconds": round(step_seconds - attributed, 6),
+        "coverage": round(attributed / step_seconds, 3)
+        if step_seconds else 0.0,
+        "method": "stages = telescoping cumulative ablation (full -> "
+                  "-push -> -push-lookup -> -push-lookup-fwdbwd -> no-op "
+                  "floor, bench-identical donation loops; differences "
+                  "sum to the full step); isolated = each stage repeated "
+                  "in one jit (over-counts XLA overlap); "
+                  "device_get-terminated windows",
+    }

@@ -116,8 +116,9 @@ def test_background_feed_pass_overlap():
     # staged fresh rows match deterministic store init
     fresh = _keys(9000, 9050)
     idxf = ws2.translate(fresh)
-    np.testing.assert_allclose(np.asarray(ws2.table)[idxf],
-                               store.get_rows(fresh), rtol=1e-6)
+    np.testing.assert_allclose(
+        np.asarray(ws2.table)[idxf, :c.row_width],
+        store.get_rows(fresh), rtol=1e-6)
 
 
 def test_stale_staging_discarded_on_key_mismatch():
@@ -133,8 +134,9 @@ def test_stale_staging_discarded_on_key_mismatch():
     ws2 = mgr.begin_pass(actual)               # different keys arrive
     assert set(ws2.sorted_keys.tolist()) == set(actual.tolist())
     idx = ws2.translate(actual)
-    np.testing.assert_allclose(np.asarray(ws2.table)[idx],
-                               store.get_rows(actual), rtol=1e-6)
+    np.testing.assert_allclose(
+        np.asarray(ws2.table)[idx, :c.row_width],
+        store.get_rows(actual), rtol=1e-6)
 
 
 def test_shrink_invalidates_resident_reuse():
@@ -198,7 +200,8 @@ def test_reuse_on_sharded_mesh():
     idx2 = ws2.translate(p1[30:])
     np.testing.assert_allclose(np.asarray(ws2.table)[idx2, 2], 2.0)
     np.testing.assert_allclose(
-        np.asarray(ws2.table)[ws2.translate(_keys(8000, 8030))],
+        np.asarray(ws2.table)[ws2.translate(_keys(8000, 8030)),
+                              :c.row_width],
         store.get_rows(_keys(8000, 8030)), rtol=1e-6)
 
 
