@@ -49,6 +49,10 @@ class Flags:
     # the next pass (bounded by the shard count, which cannot drop).
     routed_drop_fatal: bool = False         # (new)
     routed_drop_adapt: bool = True          # (new)
+    # Pack-pipeline depth: translate + host plan + H2D for batch k+1 run
+    # on a background thread while step k trains (the MiniBatchGpuPack
+    # role, data_feed.h:1372-1535). 0 = synchronous.
+    prefetch_batches: int = 2               # (new)
     # Scatter-free push: sort+bin tokens and build the per-block merge with
     # one-hot MXU matmuls, optimizer fused in VMEM (pallas_kernels.
     # binned_push). Engages only on real-TPU f32 tables whose row count

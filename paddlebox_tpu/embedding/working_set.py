@@ -275,10 +275,17 @@ class PassWorkingSet:
         rps = max(min_rows_per_shard, -(-need // n_shards))
         if bucket_rows:
             rps = bucket_size(rps)
+        # align shard rows to the super-block the binned-push geometry
+        # would target for this table size (pallas_kernels.
+        # bp_row_alignment) — big tables get big-block divisibility,
+        # small ones keep the cheap 4096 alignment; the waste is zero
+        # rows that are never indexed
         if rps >= 4096:
-            # align shard rows to the binned-push super-block (≤4095
-            # wasted rows; bucketed sizes already land on multiples)
-            rps = -(-rps // 4096) * 4096
+            from paddlebox_tpu.ops.pallas_kernels import bp_row_alignment
+            align = (bp_row_alignment(cfg, rps * n_shards,
+                                      flags.binned_push_splits)
+                     if cfg.storage == "f32" else 4096)
+            rps = -(-rps // align) * align
         n_pad = rps * n_shards
         host_table = np.zeros((n_pad, cfg.row_width), dtype=np.float32)
         host_table[1:1 + len(keys)] = rows

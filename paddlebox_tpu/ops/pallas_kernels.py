@@ -159,17 +159,57 @@ def _bp_geometry(cfg: EmbeddingConfig, n_rows: int, n_split: int = 3):
     # G * PP <= 128, and a non-pow2 G (PP=24 -> 128//24=5) would fail the
     # SB % G check below and silently lose the kernel for those widths
     G = 1 << ((128 // PP).bit_length() - 1)
-    SB = 4096
+    # Adaptive super-block. SB trades one-hot dot FLOPs against grid
+    # overhead: each token's one-hot row is RB = SB/G wide (dot work
+    # ~ tokens * RB * 128), while each block costs a fixed ~20us of
+    # DMA/prologue (cost ~ n_rows/SB) — so SB* ~ sqrt(c * G * n_rows),
+    # c fitted on v5e (~3). A 10.5M-row table at SB=4096 is 2560
+    # mostly-empty grid steps (measured +2.6ms); the bench's 557k-row
+    # table at SB=16384 wastes 4x MXU work (measured +1.4ms). RB is
+    # capped at 2048: the (TILE, RB) one-hot operand blew v5e's 16MB
+    # scoped-vmem limit at RB=4096 (the tile also halves past RB 1024 —
+    # _bp_tile).
+    target = int((3.0 * G * n_rows) ** 0.5)
+    best = None
+    SB = min(2048 * G, 1 << 16)
     while SB >= 512:
         if n_rows % SB == 0 and SB % G == 0:
-            return P, PP, G, SB
+            if best is None or abs(SB - target) < abs(best - target):
+                best = SB
         SB //= 2
-    return None
+    if best is None:
+        return None
+    return P, PP, G, best
+
+
+def bp_row_alignment(cfg: EmbeddingConfig, rows: int,
+                     n_split: int = 3) -> int:
+    """Row-count alignment that lets `_bp_geometry` pick its TARGET
+    super-block for a table of ~`rows` rows: the power of two nearest
+    SB* = sqrt(3*G*rows), clamped to [4096, RB-cap]. Working-set
+    builders align shard row counts to this — big tables get big-block
+    divisibility, small tables keep the cheap 4096 alignment."""
+    P = cfg.grad_width + 3
+    PP = -(-P // 8) * 8
+    if 2 + n_split * PP > 128:
+        return 4096
+    G = 1 << ((128 // PP).bit_length() - 1)
+    target = int((3.0 * G * max(1, rows)) ** 0.5)
+    pow2 = 1 << max(0, target.bit_length() - 1)
+    if target - pow2 > 2 * pow2 - target:       # round to nearest pow2
+        pow2 <<= 1
+    return max(4096, min(pow2, 2048 * G, 1 << 16))
+
+
+def _bp_tile(SB: int, G: int) -> int:
+    """Tokens per DMA/matmul tile: halved for big blocks so the
+    (TILE, RB) one-hot operand stays ~2MB."""
+    return _BP_TILE if SB // G <= 1024 else _BP_TILE // 2
 
 
 def _binned_acc_kernel(rstart_ref, end_ref, packed_ref, acc_ref,
                        pack_s, sem, *, PP: int, G: int, SB: int,
-                       n_split: int):
+                       n_split: int, TILE: int):
     """Per-block merge accumulator via one-hot MXU matmuls.
 
     Writes this block's accumulator in GROUPED layout (RB, G*PP) — row
@@ -182,7 +222,6 @@ def _binned_acc_kernel(rstart_ref, end_ref, packed_ref, acc_ref,
     adagrad: in-kernel update ~3.5ms of the old 5.2ms kernel vs 0.5ms as
     a fused XLA pass over the grouped acc)."""
     RB = SB // G
-    TILE = _BP_TILE
     b = pl.program_id(0)
     start = rstart_ref[b]
     endv = end_ref[b]
@@ -292,7 +331,7 @@ def binned_push(table: jnp.ndarray, idx: jnp.ndarray, grads: jnp.ndarray,
     assert geom is not None, "caller must check binned_push_supported"
     P, PP, G, SB = geom
     NB = n_rows // SB
-    TILE = _BP_TILE
+    TILE = _bp_tile(SB, G)
     tok = idx.shape[0]
     payload = jnp.concatenate(
         [grads, shows[:, None], clks[:, None],
@@ -333,7 +372,7 @@ def binned_push(table: jnp.ndarray, idx: jnp.ndarray, grads: jnp.ndarray,
     vma = getattr(jax.typeof(table), "vma", frozenset())
     RB = SB // G
     kernel = functools.partial(_binned_acc_kernel, PP=PP,
-                               G=G, SB=SB, n_split=n_split)
+                               G=G, SB=SB, n_split=n_split, TILE=TILE)
     acc_g = pl.pallas_call(
         kernel,
         out_shape=jax.ShapeDtypeStruct((NB * RB, G * PP), jnp.float32,

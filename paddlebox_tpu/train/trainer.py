@@ -17,6 +17,7 @@ Dense sync modes (trainer_desc.proto:100-108 → here):
 from __future__ import annotations
 
 import dataclasses
+import threading
 from typing import Any, Callable
 
 import jax
@@ -161,7 +162,8 @@ class Trainer:
         else:
             self.params = jax.device_put(init_params, repl)
             self.opt_state = jax.device_put(self.tx.init(init_params), repl)
-        self.timers = StageTimers(["read", "translate", "train", "auc"])
+        self.timers = StageTimers(["read", "translate", "train", "auc",
+                                   "drain"])
         # incremental + overlapped pass boundaries (BoxHelper FeedPass):
         # resident device rows are reused across passes, write-back is lazy.
         # Pass a shared manager when several trainers drive one table
@@ -462,6 +464,59 @@ class Trainer:
             (idx, pb.mask, dense.astype(np.float32),
              labels.astype(np.float32), *plan), sh)
 
+    def _pack_iter(self, dataset, ws: PassWorkingSet, batch_size: int):
+        """Yield (pb, staged) with translate + host plan + H2D dispatched
+        on a background thread, `flags.prefetch_batches` batches ahead of
+        the training loop — the MiniBatchGpuPack pipeline
+        (data_feed.h:1372-1535). The main thread's queue wait is timed as
+        the "read" stage (starvation = the pass is host-bound)."""
+        depth = config_flags.prefetch_batches
+        if depth <= 0:
+            for pb in dataset.batches(batch_size, drop_last=True):
+                yield pb, self._put_batch(ws, pb)
+            return
+        import queue as queue_mod
+        q: Any = queue_mod.Queue(maxsize=depth)
+        done = object()
+        cancel = threading.Event()
+
+        def producer():
+            try:
+                for pb in dataset.batches(batch_size, drop_last=True):
+                    if cancel.is_set():
+                        return          # abandoned consumer: stop packing
+                    q.put((pb, self._put_batch(ws, pb)))
+                q.put(done)
+            except BaseException as e:      # re-raised on the main thread
+                q.put(("__pack_error__", e))
+
+        t = threading.Thread(target=producer, daemon=True,
+                             name="pbtpu-pack")
+        t.start()
+        try:
+            while True:
+                with self.timers("read"):
+                    item = q.get()
+                if item is done:
+                    break
+                if (isinstance(item, tuple) and len(item) == 2
+                        and item[0] == "__pack_error__"):
+                    raise item[1]
+                yield item
+        finally:
+            # consumer abandoned mid-pass (nan trip, exception): signal
+            # the producer to stop after its current batch — without the
+            # event it would translate + H2D the entire remaining
+            # dataset before the exception could propagate — and drain
+            # the queue so a blocked put() wakes up to see the event
+            cancel.set()
+            while t.is_alive():
+                try:
+                    q.get_nowait()
+                except queue_mod.Empty:
+                    t.join(timeout=0.1)
+            t.join()
+
     def _host_plan(self, ws: PassWorkingSet, idx: np.ndarray):
         """Binned-push token grouping, on the host pack pipeline
         (pallas_kernels.binned_push's `plan`). Zero-length arrays mean
@@ -479,17 +534,25 @@ class Trainer:
         from paddlebox_tpu.native.key_index import block_plan
         return block_plan(idx.reshape(-1), geom[0], geom[1])
 
-    def train_pass(self, dataset, metrics: Any = None
+    def train_pass(self, dataset, metrics: Any = None,
+                   preload_keys: np.ndarray | None = None
                    ) -> dict[str, float]:
         """One pass over the dataset (§3.1 hot loop + §3.4 lifecycle).
 
         `metrics`: optional MetricRegistry; every registered metric gets
         this pass's (pred, label, cmatch, rank) per batch — the
         AddAucMonitor hook (boxps_worker.cc:582).
+        `preload_keys`: the NEXT pass's keys; when given, the next
+        working set's key diff + host fetch + H2D staging run on the
+        feed thread WHILE this pass trains (the PreLoadIntoMemory +
+        BeginFeedPass pairing, data_set.cc:1712 / box_wrapper.h:994) —
+        the next ``train_pass`` consumes the staging at its boundary.
         """
         cfg = self.cfg
         ws = self.feed_mgr.begin_pass(dataset.unique_keys())
         self.feed_mgr.pass_opened()
+        if preload_keys is not None:
+            self.preload_pass(preload_keys)
         table = ws.table
         params, opt_state = self.params, self.opt_state
         auc_acc = auc_lib.AucAccumulator(cfg.auc_buckets)
@@ -512,10 +575,10 @@ class Trainer:
                        if cfg.dump_fields_path else None)
         dump_pending: tuple[int, Any, Any] | None = None
         try:
-            for pb in dataset.batches(cfg.global_batch_size, drop_last=True):
+            for pb, staged in self._pack_iter(dataset, ws,
+                                              cfg.global_batch_size):
                 with RecordEvent("pack_batch"):
-                    (idx, mask, dense, labels,
-                     *plan) = self._put_batch(ws, pb)
+                    idx, mask, dense, labels, *plan = staged
                 with self.timers("train"), RecordEvent("train_step"):
                     if mode == "async":
                         params = jax.device_put(
@@ -596,7 +659,10 @@ class Trainer:
                     import warnings
                     warnings.warn(f"dump stream failed: {e}")
         self.feed_mgr.end_pass(ws, table)
-        losses = [float(l) for l in dev_losses]  # one sync, post-loop
+        with self.timers("drain"):
+            # one sync, post-loop: every queued step completes here, so
+            # this is where async-dispatch wall time actually lands
+            losses = [float(l) for l in dev_losses]
         out = auc_acc.compute()
         out["loss_first"] = losses[0] if losses else float("nan")
         out["loss_last"] = losses[-1] if losses else float("nan")

@@ -1,0 +1,237 @@
+"""Pure-NumPy golden reference for the DeepFM training step.
+
+Independent ground truth for the whole train step — forward, backward,
+sparse adagrad push with CVM counters, dense adam — written against the
+framework's DOCUMENTED semantics (embedding/config.py row layout,
+embedding/optim.py update rules, ops/seqpool_cvm.py CVM transform,
+models/deepfm.py architecture) with NO jax and NO framework imports, so a
+systematic numeric error anywhere in the jitted path (a constant factor
+on sparse grads, a CVM column off-by-one, a mis-wired optimizer slot)
+shows up as trajectory divergence instead of passing a self-referential
+test. This is the OpTest pattern of the reference
+(python/paddle/fluid/tests/unittests/op_test.py) applied to the full
+step.
+
+Only the benchmark configuration is modeled: embed_w_num=1, no
+expand/gating thresholds, max_len=1 uniform slot layout, adagrad sparse
+optimizer, adam dense optimizer, f32 or int16/int8 device storage.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+def splitmix_init_rows(keys, row_width, embedx_lo, embedx_hi,
+                       initial_range, seed=0):
+    """Deterministic per-key row init (store._init_rows)."""
+    n = len(keys)
+    rows = np.zeros((n, row_width), dtype=np.float32)
+    d = embedx_hi - embedx_lo
+    if d:
+        k = keys.astype(np.uint64)[:, None]
+        j = np.arange(d, dtype=np.uint64)[None, :]
+        with np.errstate(over="ignore"):
+            z = (k * np.uint64(0x9E3779B97F4A7C15)
+                 + (j + np.uint64(seed)) * np.uint64(0xBF58476D1CE4E5B9))
+            z ^= z >> np.uint64(30)
+            z *= np.uint64(0x94D049BB133111EB)
+            z ^= z >> np.uint64(27)
+        u = (z >> np.uint64(11)).astype(np.float64) / float(1 << 53)
+        rows[:, embedx_lo:embedx_hi] = ((2.0 * u - 1.0)
+                                        * initial_range).astype(np.float32)
+    return rows
+
+
+def _sigmoid(x):
+    return 1.0 / (1.0 + np.exp(-x))
+
+
+def _bce_mean(logits, y):
+    # optax.sigmoid_binary_cross_entropy: max(l,0) - l*y + log1p(exp(-|l|))
+    return float(np.mean(np.maximum(logits, 0.0) - logits * y
+                         + np.log1p(np.exp(-np.abs(logits)))))
+
+
+class GoldenDeepFM:
+    """Numpy twin of Trainer(DeepFMModel, adagrad store, adam dense).
+
+    init_params: {"mlp": [{"w","b"}...], "bias", "wide_dense"} as numpy
+    arrays (extracted once from the framework's init — parameter
+    INITIALIZATION is jax PRNG territory; everything after step 0 is
+    recomputed independently here).
+    table: (N, row_width) f32 — row 0 is the null row.
+    """
+
+    def __init__(self, table, init_params, num_slots, emb_dim, dense_dim,
+                 hidden, lr_sparse=0.05, initial_g2sum=3.0,
+                 dense_lr=1e-3, storage="f32"):
+        self.S, self.E, self.D = num_slots, emb_dim, dense_dim
+        self.row_width = table.shape[1]
+        self.pull_width = 3 + emb_dim           # show, clk, w, embedx
+        self.gw = 1 + emb_dim                   # d_w, d_embedx
+        self.lr, self.ig2 = lr_sparse, initial_g2sum
+        self.dense_lr = dense_lr
+        self.storage = storage
+        self.qmax = {"f32": None, "int16": 32767.0, "int8": 127.0}[storage]
+        self.table = table.astype(np.float32).copy()
+        if self.qmax is not None:
+            self._requant(np.ones(len(table), bool))
+        self.params = {
+            "mlp": [{"w": p["w"].astype(np.float32).copy(),
+                     "b": p["b"].astype(np.float32).copy()}
+                    for p in init_params["mlp"]],
+            "bias": init_params["bias"].astype(np.float32).copy(),
+        }
+        if dense_dim:
+            self.params["wide_dense"] = \
+                init_params["wide_dense"].astype(np.float32).copy()
+        self.m = {k: _tree_zeros(v) for k, v in self.params.items()}
+        self.v = {k: _tree_zeros(v) for k, v in self.params.items()}
+        self.t = 0
+
+    # -- quantized storage round trip (quant.py split/assemble) ---------
+    def _requant(self, rows_mask):
+        """Emulate int8/16 device storage: embedx lives quantized with a
+        per-row scale; each push dequantizes, updates, requantizes."""
+        lo, hi = 3, 3 + self.E
+        x = self.table[rows_mask, lo:hi]
+        scale = np.maximum(np.abs(x).max(axis=1) / self.qmax, 1e-12
+                           ).astype(np.float32)
+        q = _round_half_even(x / scale[:, None])
+        self.table[rows_mask, lo:hi] = (q * scale[:, None]
+                                        ).astype(np.float32)
+
+    # -- one train step --------------------------------------------------
+    def step(self, idx, mask, dense, labels):
+        """idx (B, S) int32 working-set rows; mask (B, S) bool; dense
+        (B, D) f32; labels (B,) f32. Returns the step loss; mutates
+        table/params in place exactly once, like Trainer._step_fn."""
+        B, S, E = idx.shape[0], self.S, self.E
+        maskf = mask.astype(np.float32)
+        pulled = self.table[idx.reshape(-1), :self.pull_width].reshape(
+            B, S, self.pull_width)
+        x = pulled * maskf[..., None]           # masked tokens contribute 0
+        # CVM join transform (L=1: pooling is identity)
+        show, clk = x[..., 0], x[..., 1]
+        log_show = np.log(show + 1.0)
+        log_ctr = np.log(clk + 1.0) - log_show
+        w = x[..., 2]
+        v = x[..., 3:]
+        feats = np.concatenate(
+            [log_show[..., None], log_ctr[..., None], w[..., None], v],
+            axis=-1).astype(np.float32)
+        wide = w.sum(axis=1)
+        if self.D:
+            wide = wide + dense @ self.params["wide_dense"]
+        sum_v = v.sum(axis=1)
+        fm = 0.5 * ((sum_v * sum_v).sum(axis=1)
+                    - (v * v).sum(axis=(1, 2)))
+        xd = feats.reshape(B, -1)
+        if self.D:
+            xd = np.concatenate([xd, dense], axis=1)
+        # MLP forward, keeping pre-relu activations for backward
+        hs, zs = [xd], []
+        h = xd
+        layers = self.params["mlp"]
+        for i, p in enumerate(layers):
+            z = h @ p["w"] + p["b"]
+            zs.append(z)
+            h = np.maximum(z, 0.0) if i < len(layers) - 1 else z
+            hs.append(h)
+        deep = h[:, 0]
+        logits = (wide + fm + deep + self.params["bias"][0]
+                  ).astype(np.float32)
+        loss = _bce_mean(logits, labels)
+
+        # ---- backward ----
+        g = ((_sigmoid(logits) - labels) / B).astype(np.float32)
+        grads = {"bias": np.array([g.sum()], np.float32), "mlp": []}
+        if self.D:
+            grads["wide_dense"] = dense.T @ g
+        dh = np.zeros_like(hs[-1])
+        dh[:, 0] = g
+        mlp_grads = [None] * len(layers)
+        for i in reversed(range(len(layers))):
+            dz = dh if i == len(layers) - 1 else dh * (zs[i] > 0)
+            mlp_grads[i] = {"w": hs[i].T @ dz, "b": dz.sum(axis=0)}
+            dh = dz @ layers[i]["w"].T
+        grads["mlp"] = mlp_grads
+        dxd = dh                                 # grad wrt MLP input
+        d_feats = dxd[:, :S * (3 + E)].reshape(B, S, 3 + E).copy()
+        d_feats[..., 2] += g[:, None]            # wide path
+        d_v = d_feats[..., 3:] + g[:, None, None] * (sum_v[:, None, :] - v)
+        d_w = d_feats[..., 2]
+        # show/clk grads are DROPPED by the push (CVM counters train
+        # nothing) — only (w, embedx) columns leave the model
+        sgrad = np.concatenate([d_w[..., None], d_v], axis=-1)
+        sgrad = (sgrad * maskf[..., None]).reshape(B * S, self.gw)
+
+        # ---- sparse push: scatter-merge + in-table adagrad ----
+        show_inc = maskf.reshape(-1)
+        clk_inc = (maskf * labels[:, None]).reshape(-1)
+        payload = np.concatenate(
+            [sgrad, show_inc[:, None], clk_inc[:, None],
+             np.ones((B * S, 1), np.float32)], axis=1)
+        acc = np.zeros((len(self.table), self.gw + 3), np.float32)
+        np.add.at(acc, idx.reshape(-1), payload)
+        gw = self.gw
+        touched = acc[:, gw + 2] > 0
+        tbl = self.table
+        if self.qmax is not None:
+            pass          # table already stores dequantized values
+        new_show = tbl[:, 0] + acc[:, gw]
+        new_clk = tbl[:, 1] + acc[:, gw + 1]
+        g_w, g_x = acc[:, 0], acc[:, 1:gw]
+        w_g2, x_g2 = tbl[:, 3 + E], tbl[:, 4 + E]
+        new_wg2 = w_g2 + g_w * g_w
+        new_xg2 = x_g2 + (g_x * g_x).mean(axis=1)
+        scale_w = self.lr * np.sqrt(self.ig2 / (self.ig2 + new_wg2))
+        scale_x = self.lr * np.sqrt(self.ig2 / (self.ig2 + new_xg2))
+        new = np.concatenate(
+            [new_show[:, None], new_clk[:, None],
+             (tbl[:, 2] - scale_w * g_w)[:, None],
+             tbl[:, 3:3 + E] - scale_x[:, None] * g_x,
+             new_wg2[:, None], new_xg2[:, None]], axis=1)
+        self.table = np.where(touched[:, None], new, tbl).astype(np.float32)
+        if self.qmax is not None:
+            self._requant(touched)
+
+        # ---- dense adam (optax.adam defaults) ----
+        self.t += 1
+        b1, b2, eps = 0.9, 0.999, 1e-8
+        bc1 = 1.0 - b1 ** self.t
+        bc2 = 1.0 - b2 ** self.t
+
+        def upd(path, p, gr):
+            m = self.m[path[0]]
+            vv = self.v[path[0]]
+            for k in path[1:]:
+                m, vv = m[k], vv[k]
+            m *= b1
+            m += (1 - b1) * gr
+            vv *= b2
+            vv += (1 - b2) * gr * gr
+            p -= self.dense_lr * (m / bc1) / (np.sqrt(vv / bc2) + eps)
+
+        upd(("bias",), self.params["bias"], grads["bias"])
+        if self.D:
+            upd(("wide_dense",), self.params["wide_dense"],
+                grads["wide_dense"])
+        for i in range(len(layers)):
+            for k in ("w", "b"):
+                upd(("mlp", i, k), self.params["mlp"][i][k],
+                    grads["mlp"][i][k])
+        return loss
+
+
+def _tree_zeros(x):
+    if isinstance(x, dict):
+        return {k: _tree_zeros(v) for k, v in x.items()}
+    if isinstance(x, list):
+        return [_tree_zeros(v) for v in x]
+    return np.zeros_like(np.asarray(x), dtype=np.float32)
+
+
+def _round_half_even(x):
+    return np.round(x)       # numpy rounds half to even, like jnp.round

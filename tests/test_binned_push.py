@@ -99,12 +99,20 @@ def test_out_of_range_tokens_dropped():
 
 def test_geometry_and_support():
     cfg = EmbeddingConfig(dim=8)
-    assert pk.binned_push_geometry(cfg, 524288) == (4096, 128)
+    # adaptive SB: nearest dividing block to SB* ~ sqrt(3 * G * n_rows)
+    assert pk.binned_push_geometry(cfg, 524288) == (4096, 128)   # G=8
     assert pk.binned_push_geometry(cfg, 524289) is None  # odd row count
+    assert pk.binned_push_geometry(cfg, 129 * 4096) == (4096, 129)
     # wide payloads that cannot fit one 128-lane packed row fall back
     wide = EmbeddingConfig(dim=64)  # grad_width 65 -> PP 72; 2+3*72 > 128
     assert pk.binned_push_geometry(wide, 524288) is None
-    assert pk.binned_push_geometry(wide, 524288, n_split=1) == (4096, 128)
+    assert pk.binned_push_geometry(wide, 524288, n_split=1) == (1024, 512)
+    # PP=24 (dim 16): G=4
+    assert pk.binned_push_geometry(EmbeddingConfig(dim=16),
+                                   524288) == (2048, 256)
+    # big tables take bigger blocks (fewer grid steps)
+    assert pk.binned_push_geometry(EmbeddingConfig(dim=16),
+                                   262 * 32768) == (8192, 1048)
     # quant tables and non-TPU backends keep the XLA path
     assert not pk.binned_push_supported(jnp.zeros((4096, 13)), cfg) \
         or jax.default_backend() == "tpu"

@@ -1,0 +1,122 @@
+"""Golden numeric parity: the framework's full train step vs the
+pure-NumPy reference in golden_deepfm.py (VERDICT r2 missing #1).
+
+Every other correctness test validates the framework against itself; this
+one trains the SAME DeepFM+adagrad+CVM+adam configuration for 60 steps in
+both implementations and asserts the per-step loss trajectory and the
+final sparse-table / dense-param state agree to floating-point tolerance
+— a systematic numeric error anywhere in the jitted step (scaling,
+column wiring, optimizer slots) diverges the trajectories. The OpTest
+pattern (op_test.py) applied to the whole step, on f32 AND int16 device
+storage.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+
+from paddlebox_tpu.data import DataFeedSchema
+from paddlebox_tpu.embedding import (EmbeddingConfig, HostEmbeddingStore,
+                                     PassWorkingSet)
+from paddlebox_tpu.models import DeepFMModel
+from paddlebox_tpu.parallel import make_mesh
+from paddlebox_tpu.train import Trainer, TrainerConfig
+
+from tests.golden_deepfm import GoldenDeepFM, splitmix_init_rows
+
+NUM_SLOTS, EMB_DIM, DENSE_DIM = 4, 4, 3
+HIDDEN = (16, 16)
+BATCH, STEPS, N_KEYS = 32, 60, 300
+
+
+def _run_pair(storage, golden_lr_mult=1.0):
+    cfg = EmbeddingConfig(dim=EMB_DIM, optimizer="adagrad",
+                          learning_rate=0.05, storage=storage)
+    store = HostEmbeddingStore(cfg)
+    schema = DataFeedSchema.ctr(num_sparse=NUM_SLOTS, num_float=DENSE_DIM,
+                                batch_size=BATCH, max_len=1)
+    mesh = make_mesh(1)
+    tr = Trainer(DeepFMModel(num_slots=NUM_SLOTS, emb_dim=EMB_DIM,
+                             dense_dim=DENSE_DIM, hidden=HIDDEN),
+                 store, schema, mesh, TrainerConfig(global_batch_size=BATCH))
+    rng = np.random.default_rng(7)
+    keys = np.unique(rng.choice(1 << 40, N_KEYS).astype(np.uint64))
+    ws = PassWorkingSet.begin_pass(store, keys, mesh)
+
+    # independent init cross-check: the golden recomputes the
+    # deterministic splitmix row init from the documented formula
+    gold_rows = splitmix_init_rows(ws.sorted_keys, cfg.row_width,
+                                   3, 3 + EMB_DIM, cfg.initial_range)
+    n_pad = ws.padded_rows
+    gold_table = np.zeros((n_pad, cfg.row_width), np.float32)
+    gold_table[1:1 + len(keys)] = gold_rows
+    if storage == "f32":
+        np.testing.assert_array_equal(np.asarray(ws.table), gold_table)
+
+    init_params = jax.tree.map(np.asarray, tr.params)
+    gold = GoldenDeepFM(gold_table, init_params, NUM_SLOTS, EMB_DIM,
+                        DENSE_DIM, HIDDEN,
+                        lr_sparse=cfg.learning_rate * golden_lr_mult,
+                        initial_g2sum=cfg.initial_g2sum,
+                        dense_lr=tr.cfg.dense_lr, storage=storage)
+
+    table, params, opt = ws.table, tr.params, tr.opt_state
+    fw_losses, gold_losses = [], []
+    for step in range(STEPS):
+        raw = rng.choice(keys, size=(BATCH, NUM_SLOTS))
+        mask = rng.random((BATCH, NUM_SLOTS)) < 0.9   # some padding
+        idx = ws.translate(raw, mask)
+        # independent translate cross-check: sorted-keys searchsorted + 1
+        pos = np.searchsorted(ws.sorted_keys, raw.astype(np.uint64))
+        gold_idx = np.where(mask, pos + 1, 0).astype(np.int32)
+        np.testing.assert_array_equal(idx, gold_idx)
+        dense = rng.normal(size=(BATCH, DENSE_DIM)).astype(np.float32)
+        labels = (rng.random(BATCH) < 0.3).astype(np.float32)
+        table, params, opt, loss, preds, drop = tr._step_fn(
+            table, params, opt, idx, mask, dense, labels)
+        fw_losses.append(float(loss))
+        gold_losses.append(gold.step(idx, mask, dense, labels))
+    return np.array(fw_losses), np.array(gold_losses), table, params, gold
+
+
+@pytest.mark.parametrize("storage", ["f32", "int16"])
+def test_trajectory_parity(storage):
+    fw, gold, table, params, g = _run_pair(storage)
+    # per-step loss trajectory: fp reassociation differs (XLA fuses),
+    # systematic errors (a factor on sparse grads, a column off-by-one)
+    # blow past this within a few steps
+    np.testing.assert_allclose(fw, gold, rtol=2e-4, atol=2e-5)
+    # final state parity
+    from paddlebox_tpu.embedding import quant
+    if quant.is_quant(table):
+        fw_table = quant.decode_rows_np(
+            np.asarray(table.fp), np.asarray(table.qx),
+            EmbeddingConfig(dim=EMB_DIM, optimizer="adagrad",
+                            learning_rate=0.05, storage=storage))
+    else:
+        fw_table = np.asarray(table)[:, :g.table.shape[1]]
+    np.testing.assert_allclose(fw_table, g.table, rtol=1e-3, atol=2e-5)
+    fw_params = jax.tree.map(np.asarray, params)
+    for got, want in ((fw_params["bias"], g.params["bias"]),
+                      (fw_params.get("wide_dense"),
+                       g.params.get("wide_dense"))):
+        if want is not None:
+            np.testing.assert_allclose(got, want, rtol=1e-3, atol=1e-5)
+    for i, layer in enumerate(fw_params["mlp"]):
+        np.testing.assert_allclose(layer["w"], g.params["mlp"][i]["w"],
+                                   rtol=2e-3, atol=2e-5)
+        np.testing.assert_allclose(layer["b"], g.params["mlp"][i]["b"],
+                                   rtol=2e-3, atol=2e-5)
+
+
+def test_detects_systematic_error():
+    """Teeth check: a real systematic deviation must blow the parity
+    tolerance. A 2x factor on the sparse learning rate (equivalent to a
+    2x sparse-grad bug) is injected into the GOLDEN side only; the
+    trajectories must diverge beyond what test_trajectory_parity
+    accepts — otherwise the harness could never catch the class of bug
+    it exists for."""
+    fw, gold, *_ = _run_pair("f32", golden_lr_mult=2.0)
+    with pytest.raises(AssertionError):
+        np.testing.assert_allclose(fw, gold, rtol=2e-4, atol=2e-5)

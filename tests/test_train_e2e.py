@@ -220,3 +220,26 @@ def test_capacity_drop_fatal_flag(mesh8):
             tr.train_pass(ds)
     finally:
         flags.routed_drop_fatal = old
+
+
+def test_train_pass_preloads_next_working_set(mesh8):
+    """train_pass(preload_keys=...) stages the NEXT pass's working set on
+    the feed thread while this pass trains (PreLoadIntoMemory +
+    BeginFeedPass pairing); the next pass consumes the staging and reuses
+    resident rows."""
+    ds1, schema = synth_dataset(256, seed=1)
+    ds2, _ = synth_dataset(256, seed=2, schema=schema)
+    store = HostEmbeddingStore(EmbeddingConfig(dim=8, learning_rate=0.1))
+    tr = Trainer(DeepFMModel(num_slots=NUM_SLOTS, emb_dim=8, dense_dim=1,
+                             hidden=(16,)),
+                 store, schema, mesh8,
+                 TrainerConfig(global_batch_size=64))
+    out1 = tr.train_pass(ds1, preload_keys=ds2.unique_keys())
+    assert np.isfinite(out1["loss_mean"])
+    out2 = tr.train_pass(ds2)
+    assert np.isfinite(out2["loss_mean"])
+    m = tr.feed_mgr
+    # the staging was consumed: pass 2 reused the overlap of key sets
+    shared = np.intersect1d(ds1.unique_keys(), ds2.unique_keys())
+    assert m.last_reused_rows == len(shared)
+    assert m.last_fresh_rows == len(ds2.unique_keys()) - len(shared)
