@@ -90,7 +90,9 @@ def _analytic_cost(batch, num_slots, emb_dim, dense_dim, hidden, emb_cfg,
     return flops, hbm
 
 
-def device_step_bench(small: bool):
+def device_step_bench(small: bool, mode: str = "allreduce",
+                      storage: str | None = None, attribution: bool = True,
+                      n_steps: int | None = None, n_windows: int = 3):
     import jax
     from paddlebox_tpu.data import DataFeedSchema
     from paddlebox_tpu.embedding import (EmbeddingConfig, HostEmbeddingStore,
@@ -105,8 +107,9 @@ def device_step_bench(small: bool):
     batch = (256 if small else 8192) * n_dev
     schema = DataFeedSchema.ctr(num_sparse=num_slots, num_float=dense_dim,
                                 batch_size=batch, max_len=1)
-    # PBTPU_BENCH_STORAGE=int8|int16 benches the quantized-table path
-    storage = os.environ.get("PBTPU_BENCH_STORAGE", "f32")
+    # PBTPU_BENCH_STORAGE=int8|int16 overrides the headline storage mode
+    if storage is None:
+        storage = os.environ.get("PBTPU_BENCH_STORAGE", "f32")
     emb_cfg = EmbeddingConfig(dim=emb_dim, optimizer="adagrad",
                               learning_rate=0.05, storage=storage)
     store = HostEmbeddingStore(emb_cfg)
@@ -114,7 +117,8 @@ def device_step_bench(small: bool):
     model = DeepFMModel(num_slots=num_slots, emb_dim=emb_dim,
                         dense_dim=dense_dim, hidden=hidden)
     tr = Trainer(model, store, schema, mesh,
-                 TrainerConfig(global_batch_size=batch, auc_buckets=1 << 16))
+                 TrainerConfig(global_batch_size=batch, auc_buckets=1 << 16,
+                               dense_sync_mode=mode))
     rng = np.random.default_rng(0)
     n_keys = 1 << (14 if small else 19)
     keys = rng.choice(1 << 50, n_keys, replace=False).astype(np.uint64)
@@ -139,38 +143,67 @@ def device_step_bench(small: bool):
                             (idx, mask, dense, labels, *plan)))
     _mark("staged batches on device")
 
-    table, params, opt = ws.table, tr.params, tr.opt_state
-    for w in range(2):  # compile + settle fed-back layouts
-        table, params, opt, loss, preds, drop = tr._step_fn(
-            table, params, opt, *staged[w])
-    _sync_scalar(loss)
-    _mark("warmup/compile done")
+    repl = mesh_lib.replicated_sharding(mesh)
 
-    n_steps = 5 if small else 200
+    def run_steps(table, k):
+        """k steps in the selected dense-sync mode, returning the final
+        loss array (mode-faithful: kstep syncs every param_sync_step,
+        async pulls/pushes the host dense table each step — the real
+        cost profile of trainer_desc.proto:100-108's modes)."""
+        nonlocal params, opt
+        for i in range(k):
+            b = staged[i % n_staged]
+            if mode == "async":
+                p = jax.device_put(tr._unravel(tr.dense_table.pull()),
+                                   repl)
+                table, gp_flat, loss, preds, drop = tr._step_fn(
+                    table, p, *b)
+                tr.dense_table.push(np.asarray(gp_flat))
+            elif mode == "kstep":
+                table, params, opt, loss, preds, drop = tr._step_fn(
+                    table, params, opt, *b)
+                params, opt = tr._sync_fn(params, opt)
+            else:
+                table, params, opt, loss, preds, drop = tr._step_fn(
+                    table, params, opt, *b)
+        return table, loss
+
+    params, opt = tr.params, tr.opt_state
+    if mode == "async":
+        tr.dense_table.start()
+    table, loss = run_steps(ws.table, 2)   # compile + settle layouts
+    _sync_scalar(loss)
+    _mark(f"warmup/compile done ({mode}/{storage})")
+
+    if n_steps is None:
+        n_steps = 5 if small else 200
     windows = []
-    for _ in range(1 if small else 3):
+    for _ in range(1 if small else n_windows):
         t0 = time.perf_counter()
-        for i in range(n_steps):
-            table, params, opt, loss, preds, drop = tr._step_fn(
-                table, params, opt, *staged[i % n_staged])
+        table, loss = run_steps(table, n_steps)
         loss_v = _sync_scalar(loss)  # real D2H terminates the window
         windows.append(time.perf_counter() - t0)
     dt = min(windows)
-    _mark("device-step windows done")
+    if mode == "async":
+        tr.dense_table.flush()
+    _mark(f"device-step windows done ({mode}/{storage})")
 
     eps_chip = n_steps * batch / dt / n_dev
-    ws.table, tr.params, tr.opt_state = table, params, opt  # post-donation
-    attribution = None
-    if n_dev == 1 and os.environ.get("PBTPU_BENCH_ATTR", "1") != "0":
+    ws.table = table                       # post-donation rebind
+    if mode != "async":
+        tr.params, tr.opt_state = params, opt
+    attr_result = None
+    if attribution and mode == "allreduce" and n_dev == 1 \
+            and os.environ.get("PBTPU_BENCH_ATTR", "1") != "0":
         # per-stage device-time breakdown (log_for_profile's cal-split
         # analogue, boxps_worker.cc:746-759): a throughput regression
         # must name its stage
         from paddlebox_tpu.utils.step_probe import attribute_step
-        attribution = attribute_step(tr, ws, staged[0], dt / n_steps,
+        attr_result = attribute_step(tr, ws, staged[0], dt / n_steps,
                                      k=4 if small else 24,
                                      n_loop=10 if small else 100)
         _mark(f"stage attribution done (coverage "
-              f"{attribution['coverage']:.0%})")
+              f"{attr_result['coverage']:.0%})")
     flops, hbm = _analytic_cost(batch, num_slots, emb_dim, dense_dim,
                                 hidden, emb_cfg, ws.padded_rows)
     kind = devices[0].device_kind
@@ -195,6 +228,7 @@ def device_step_bench(small: bool):
     detail = {
         "device_kind": kind,
         "storage": storage,
+        "dense_sync_mode": mode,
         "devices": n_dev,
         "global_batch": batch,
         "steps": n_steps,
@@ -204,8 +238,8 @@ def device_step_bench(small: bool):
         "loss_final": loss_v,
         "audit": audit,
     }
-    if attribution is not None:
-        detail["stage_attribution"] = attribution
+    if attr_result is not None:
+        detail["stage_attribution"] = attr_result
     return eps_chip, detail
 
 
@@ -368,6 +402,29 @@ def main() -> None:
         jax.config.update("jax_platforms", "cpu")
 
     eps_chip, detail = device_step_bench(small)
+    if os.environ.get("PBTPU_BENCH_MATRIX", "1") != "0":
+        # one device-step datapoint per dense-sync mode and per storage
+        # mode (VERDICT r3 item #6): regressions in the non-headline
+        # configs become visible round over round
+        matrix = {}
+        for mname, mmode, mstorage in (
+                ("kstep_f32", "kstep", "f32"),
+                ("async_f32", "async", "f32"),
+                ("allreduce_int16", "allreduce", "int16"),
+                ("allreduce_int8", "allreduce", "int8")):
+            try:
+                m_eps, m_detail = device_step_bench(
+                    small, mode=mmode, storage=mstorage,
+                    attribution=False, n_steps=3 if small else 50,
+                    n_windows=2)
+                matrix[mname] = {
+                    "examples_per_sec_per_chip": round(m_eps, 1),
+                    "step_seconds": m_detail["audit"]["step_seconds"],
+                }
+            except Exception as e:   # a matrix point must not kill the run
+                matrix[mname] = {"error": repr(e)}
+            _mark(f"matrix point {mname} done")
+        detail["matrix"] = matrix
     if os.environ.get("PBTPU_BENCH_E2E", "1") != "0":
         try:
             e2e_eps, e2e_detail = e2e_bench(small)

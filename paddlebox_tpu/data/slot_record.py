@@ -179,6 +179,7 @@ class SlotRecordBatch:
             rank=self.rank[start:end],
             cmatch=self.cmatch[start:end],
             ins_id=self.ins_id[start:end],
+            search_id=self.search_id[start:end],
         )
 
 
@@ -245,6 +246,10 @@ class PackedBatch:
     rank: np.ndarray
     cmatch: np.ndarray
     ins_id: np.ndarray | None = None   # uint64 (B,) — DumpField's ins_id
+    # uint64 (B,) PV group id — rank_attention models build rank_offset
+    # from (rank, search_id); batches from merge_by_search_id keep a
+    # PV's examples adjacent
+    search_id: np.ndarray | None = None
 
     def layout(self) -> SparseLayout:
         return SparseLayout.from_schema(self.schema)
@@ -268,7 +273,9 @@ class PackedBatch:
             ids=_pad(self.ids), mask=_pad(self.mask, False),
             floats=_pad(self.floats), rank=_pad(self.rank),
             cmatch=_pad(self.cmatch),
-            ins_id=None if self.ins_id is None else _pad(self.ins_id))
+            ins_id=None if self.ins_id is None else _pad(self.ins_id),
+            search_id=(None if self.search_id is None
+                       else _pad(self.search_id)))
 
     def slot_ids(self, name: str) -> tuple[np.ndarray, np.ndarray]:
         """(ids, mask) view of one sparse slot, shape (B, max_len)."""

@@ -101,10 +101,17 @@ def _recv_msg(sock: socket.socket) -> tuple[dict, list[np.ndarray]] | None:
 class _SparseTable:
     """One server's shard of a sparse table: store + in-table optimizer."""
 
+    N_STRIPES = 16
+
     def __init__(self, cfg: EmbeddingConfig):
         self.cfg = cfg
         self.store = HostEmbeddingStore(cfg)
-        self._lock = threading.Lock()
+        # striped push locks (VERDICT r2 weak #4): concurrent trainers
+        # pushing disjoint key ranges proceed in parallel; only same-key
+        # read-modify-writes serialize (fleet_wrapper.h:200 regime). The
+        # store's own short index lock stays the only global section.
+        self._stripe_locks = [threading.Lock()
+                              for _ in range(self.N_STRIPES)]
 
     def pull(self, keys: np.ndarray, init_missing: bool) -> np.ndarray:
         rows = (self.store.lookup_or_init(keys) if init_missing
@@ -125,18 +132,30 @@ class _SparseTable:
     def push(self, keys: np.ndarray, grads: np.ndarray, shows: np.ndarray,
              clks: np.ndarray) -> None:
         """Merge duplicate keys, then apply the in-table optimizer — the
-        PS-side update of PushSparseGPU (box_wrapper_impl.h:229)."""
+        PS-side update of PushSparseGPU (box_wrapper_impl.h:229).
+
+        The duplicate merge runs LOCK-FREE (it only touches this push's
+        own arrays); the per-key read-modify-write then runs under the
+        key's stripe lock, so concurrent pushers only serialize where
+        they actually collide."""
         from paddlebox_tpu.embedding.optim import apply_updates
-        with self._lock:  # pushes serialize per table shard
-            uniq, inv = np.unique(keys, return_inverse=True)
-            gw = grads.shape[1]
-            m = np.zeros((len(uniq), gw + 2), np.float32)
-            np.add.at(m, inv, np.concatenate(
-                [grads, shows[:, None], clks[:, None]], axis=1))
-            rows = self.store.lookup_or_init(uniq)
-            new_rows = np.asarray(apply_updates(
-                rows, m[:, :gw], m[:, gw], m[:, gw + 1], self.cfg))
-            self.store.write_back(uniq, new_rows)
+        uniq, inv = np.unique(keys, return_inverse=True)
+        gw = grads.shape[1]
+        m = np.zeros((len(uniq), gw + 2), np.float32)
+        np.add.at(m, inv, np.concatenate(
+            [grads, shows[:, None], clks[:, None]], axis=1))
+        with np.errstate(over="ignore"):
+            stripes = ((uniq * np.uint64(0x9E3779B97F4A7C15))
+                       >> np.uint64(60)).astype(np.int64) \
+                % self.N_STRIPES
+        for s in np.unique(stripes):
+            sel = stripes == s
+            ku, mu = uniq[sel], m[sel]
+            with self._stripe_locks[s]:
+                rows = self.store.lookup_or_init(ku)
+                new_rows = np.asarray(apply_updates(
+                    rows, mu[:, :gw], mu[:, gw], mu[:, gw + 1], self.cfg))
+                self.store.write_back(ku, new_rows)
 
 
 class PSServer:

@@ -88,7 +88,9 @@ def save_inference_model(path: str, model: Any, params: Any,
     table = (store_or_table if isinstance(store_or_table, ServingTable)
              else ServingTable.from_store(store_or_table))
     table.save(path)
-    checkpoint.save_pytree(params, os.path.join(path, "dense.npz"))
+    # uncompressed: mmap-able by non-Python clients (serving_score.c)
+    checkpoint.save_pytree(params, os.path.join(path, "dense.npz"),
+                           compress=False)
     meta = {
         "format_version": FORMAT_VERSION,
         "model": model.name,

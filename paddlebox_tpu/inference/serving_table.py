@@ -126,7 +126,11 @@ class ServingTable:
     def save(self, path: str) -> str:
         os.makedirs(path, exist_ok=True)
         fname = os.path.join(path, "serving.npz")
-        np.savez_compressed(fname, keys=self.keys, rows=self.vals)
+        # UNCOMPRESSED on purpose: stored zip members are plain .npy
+        # bytes at fixed offsets, so any-language clients mmap the key
+        # and value arrays directly (native/serving_score.c proves the
+        # format; the reference ships Go/R clients for its xbox plane)
+        np.savez(fname, keys=self.keys, rows=self.vals)
         meta = {"num_keys": int(len(self.keys)),
                 "pull_width": int(self.pull_width)}
         if self.gate is not None:

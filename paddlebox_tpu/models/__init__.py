@@ -4,8 +4,9 @@ from paddlebox_tpu.models.wide_deep import WideDeepModel  # noqa: F401
 from paddlebox_tpu.models.dcn import DCNv2Model  # noqa: F401
 from paddlebox_tpu.models.dlrm import DLRMModel  # noqa: F401
 from paddlebox_tpu.models.mmoe import MMoEModel  # noqa: F401
+from paddlebox_tpu.models.pv_rank import PVRankModel  # noqa: F401
 
 MODEL_REGISTRY = {
     m.name: m for m in (DNNCTRModel, DeepFMModel, WideDeepModel,
-                        DCNv2Model, DLRMModel, MMoEModel)
+                        DCNv2Model, DLRMModel, MMoEModel, PVRankModel)
 }

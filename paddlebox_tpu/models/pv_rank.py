@@ -1,0 +1,103 @@
+"""PV (page-view) ad model: rank attention over same-PV peers.
+
+The reference's ad-ranking path: the data feed merges a page view's ads
+into one group (MergePvInstance / merge_by_search_id), builds the
+``rank_offset`` matrix per batch (GetRankOffset, data_feed.h:1552-1706,
+data_feed.cu:208 CopyRankOffsetKernel), and the model attends over the
+pulled features of the OTHER ads in the same PV with rank-pair-specific
+parameters (rank_attention_op.cu) plus per-slot unshared projections
+(batch_fc_op.cu). This module is that model family end-to-end on TPU:
+
+- per-slot unshared projection of the CVM slot features — ``batch_fc``
+  with the slot axis as the group axis;
+- ``rank_attention`` over same-PV peers;
+- an MLP head over [slot features, attention output, dense].
+
+Trainer integration: the model declares ``batch_extras`` — a host-side
+hook the pack pipeline calls per batch (overlapped with device compute,
+like every other host-side pack stage) to build rank_offset from the
+batch's (rank, search_id) columns. Peer indices are built PER SHARD:
+the batch axis shards contiguously across the mesh, so each shard's
+attention peers must live on the same shard — PVs straddling a shard
+boundary lose their cross-boundary peers (the reference keeps a PV on
+one card for the same reason: pv_batch granularity, data_set.cc).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from paddlebox_tpu.models.nn import mlp_apply, mlp_init
+from paddlebox_tpu.ops import fused_seqpool_cvm
+from paddlebox_tpu.ops.batch_fc import batch_fc
+from paddlebox_tpu.ops.rank_attention import build_rank_offset, rank_attention
+
+
+class PVRankModel:
+    name = "pv_rank"
+    num_extras = 1      # rank_offset — staged by the trainer per batch
+
+    def __init__(self, num_slots: int, emb_dim: int, dense_dim: int = 0,
+                 hidden: tuple[int, ...] = (64, 32), max_rank: int = 3,
+                 slot_proj: int = 8, att_dim: int = 8, use_cvm: bool = True):
+        self.num_slots = num_slots
+        self.emb_dim = emb_dim
+        self.dense_dim = dense_dim
+        self.hidden = tuple(hidden)
+        self.max_rank = max_rank
+        self.slot_proj = slot_proj
+        self.att_dim = att_dim
+        self.use_cvm = use_cvm
+        self.slot_feat = (3 + emb_dim) if use_cvm else (1 + emb_dim)
+        self.x_dim = num_slots * slot_proj
+        self.dims = (self.x_dim + att_dim + dense_dim, *self.hidden, 1)
+
+    def init(self, key):
+        k1, k2, k3, k4 = jax.random.split(key, 4)
+        S, C, d, K = (self.num_slots, self.slot_feat, self.slot_proj,
+                      self.max_rank)
+        return {
+            "slot_w": jax.random.normal(k1, (S, C, d), jnp.float32)
+            * (2.0 / (C + d)) ** 0.5,
+            "slot_b": jnp.zeros((S, d), jnp.float32),
+            "rank_param": jax.random.normal(
+                k2, (K * K * self.x_dim, self.att_dim), jnp.float32) * 0.02,
+            "mlp": mlp_init(k3, self.dims),
+            "bias": jnp.zeros((1,), jnp.float32),
+        }
+
+    def batch_extras(self, pb, n_shards: int = 1) -> tuple[np.ndarray]:
+        """Host-side pack stage: rank_offset with SHARD-LOCAL peer
+        indices (one build per contiguous batch shard — see module
+        docstring on PV/shard granularity)."""
+        B = len(pb.rank)
+        groups = (pb.search_id if pb.search_id is not None
+                  else np.zeros(B, np.uint64))
+        bl = B // n_shards
+        parts = [build_rank_offset(pb.rank[s * bl:(s + 1) * bl],
+                                   groups[s * bl:(s + 1) * bl],
+                                   self.max_rank)
+                 for s in range(n_shards)]
+        return (np.concatenate(parts, axis=0),)
+
+    def apply(self, params, pulled, mask, dense, segment_ids,
+              num_slots=None, rank_offset=None):
+        assert rank_offset is not None, (
+            "PVRankModel needs the rank_offset extra (trainer stages it "
+            "via batch_extras)")
+        B = pulled.shape[0]
+        feats = fused_seqpool_cvm(pulled, mask, segment_ids,
+                                  self.num_slots, use_cvm=self.use_cvm,
+                                  flatten=False)          # (B, S, C)
+        # per-slot UNSHARED projection: slots are the batch_fc group axis
+        proj = batch_fc(jnp.swapaxes(feats, 0, 1), params["slot_w"],
+                        params["slot_b"], activation="relu")   # (S, B, d)
+        x = jnp.swapaxes(proj, 0, 1).reshape(B, self.x_dim)
+        att = rank_attention(x, rank_offset, params["rank_param"],
+                             self.max_rank)               # (B, att_dim)
+        h = jnp.concatenate([x, att, dense], axis=1) if self.dense_dim \
+            else jnp.concatenate([x, att], axis=1)
+        deep = mlp_apply(params["mlp"], h)[:, 0]
+        return deep + params["bias"][0]

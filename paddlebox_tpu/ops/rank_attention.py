@@ -49,13 +49,49 @@ def build_rank_offset(ranks: np.ndarray, pv_groups: np.ndarray,
 
     ranks     : (B,) int 1-based ad rank within its PV (0 = invalid)
     pv_groups : (B,) int group id, equal for examples of the same PV
-    Returns (B, 2*max_rank+1) int32.
+    Returns (B, 2*max_rank+1) int32. Vectorized — this runs on the
+    per-batch pack hot path (PVRankModel.batch_extras); when several
+    members of a PV share a rank, the last (highest index) wins, like
+    the reference kernel's last-writer scatter.
     """
+    ranks = np.asarray(ranks)
+    pv_groups = np.asarray(pv_groups)
+    B = len(ranks)
+    out = np.zeros((B, 2 * max_rank + 1), dtype=np.int32)
+    out[:, 0] = ranks
+    if B == 0:
+        return out
+    sel = np.flatnonzero((ranks >= 1) & (ranks <= max_rank))
+    if len(sel):
+        # last member per (group, rank): lexsort by (group, rank, idx)
+        order = np.lexsort((sel, ranks[sel], pv_groups[sel]))
+        s = sel[order]
+        gg, rr = pv_groups[s], ranks[s]
+        is_last = np.ones(len(s), bool)
+        is_last[:-1] = (gg[1:] != gg[:-1]) | (rr[1:] != rr[:-1])
+        lg, lr, lj = gg[is_last], rr[is_last], s[is_last]
+        ug, gpos = np.unique(lg, return_inverse=True)
+        peer_r = np.zeros((len(ug), max_rank), np.int32)
+        peer_j = np.zeros((len(ug), max_rank), np.int32)
+        peer_r[gpos, lr - 1] = lr
+        peer_j[gpos, lr - 1] = lj
+        gi = np.searchsorted(ug, pv_groups)
+        gi_c = np.minimum(gi, len(ug) - 1)
+        want = (ranks > 0) & (ug[gi_c] == pv_groups)
+        out[:, 1::2] = np.where(want[:, None], peer_r[gi_c], 0)
+        out[:, 2::2] = np.where(want[:, None], peer_j[gi_c], 0)
+    return out
+
+
+def build_rank_offset_reference(ranks: np.ndarray, pv_groups: np.ndarray,
+                                max_rank: int) -> np.ndarray:
+    """Straightforward per-member loop — ground truth for the vectorized
+    builder's tests (mirrors CopyRankOffsetKernel literally)."""
     B = len(ranks)
     out = np.zeros((B, 2 * max_rank + 1), dtype=np.int32)
     out[:, 0] = ranks
     by_group: dict[int, list[int]] = {}
-    for i, g in enumerate(pv_groups.tolist()):
+    for i, g in enumerate(np.asarray(pv_groups).tolist()):
         by_group.setdefault(g, []).append(i)
     for g, members in by_group.items():
         for i in members:

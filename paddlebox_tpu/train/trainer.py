@@ -169,6 +169,18 @@ class Trainer:
         # Pass a shared manager when several trainers drive one table
         # (join/update phase programs — see train/phased.py).
         self.feed_mgr = feed_mgr or FeedPassManager(store, mesh)
+        # Model-extras protocol: a model may declare `batch_extras(pb,
+        # n_shards)` (+ `num_extras`) — a host-side pack-pipeline stage
+        # producing per-batch arrays (e.g. PVRankModel's rank_offset)
+        # that the step forwards to model.apply after the standard
+        # arguments. Extras shard like the batch (contiguous dim-0).
+        self._extras_fn = getattr(model, "batch_extras", None)
+        self._n_extras = getattr(model, "num_extras", 0)
+        if self._extras_fn is not None and self.cfg.dense_sync_mode != \
+                "allreduce":
+            raise NotImplementedError(
+                "models with batch_extras support the allreduce "
+                "dense-sync mode only")
         # Host-side binned-push plan (native counting sort in the pack
         # pipeline) replaces the on-device argsort of the scatter-free
         # push — single-shard TPU f32 tables only (post-all_to_all tokens
@@ -230,7 +242,7 @@ class Trainer:
         dedup = config_flags.pullpush_dedup_keys and self.n_shards > 1
 
         def core(tshard, idx_l, mask_l, dense_l, labels_l, params,
-                 order, rstart, endb):
+                 order, rstart, endb, *extras_l):
             # zero-length order == "no host plan" (static shape branch)
             plan = (order, rstart, endb) if order.shape[0] else None
             B_l = idx_l.shape[0]
@@ -248,7 +260,7 @@ class Trainer:
 
             def loss_fn(p, pulled_in):
                 logits = model.apply(p, pulled_in, mask_l, dense_l, seg,
-                                     num_slots)
+                                     num_slots, *extras_l)
                 loss = jnp.mean(
                     optax.sigmoid_binary_cross_entropy(logits, labels_l))
                 return loss, jax.nn.sigmoid(logits)
@@ -362,25 +374,27 @@ class Trainer:
             return jax.jit(step, donate_argnums=(0,),
                            out_shardings=(tbl_sh, repl, repl, bat_sh, repl))
 
+        n_extras = self._n_extras
+
         def body(tshard, idx_l, mask_l, dense_l, labels_l, params,
-                 order, rstart, endb):
+                 order, rstart, endb, *extras_l):
             new_shard, gp, loss, preds, drop_g = core(
                 tshard, idx_l, mask_l, dense_l, labels_l, params,
-                order, rstart, endb)
+                order, rstart, endb, *extras_l)
             gp = _mean_replicated_grad(gp, axes)
             loss_g = lax.pmean(loss, axes)
             return new_shard, gp, loss_g, preds, drop_g
 
         def step(table, params, opt_state, idx, mask, dense, labels,
-                 order=_NO_PLAN, rstart=_NO_PLAN, endb=_NO_PLAN):
+                 order=_NO_PLAN, rstart=_NO_PLAN, endb=_NO_PLAN, *extras):
             new_table, gp, loss, preds, drop_g = jax.shard_map(
                 body, mesh=self.mesh,
                 in_specs=(batch_spec, batch_spec, batch_spec, batch_spec,
                           batch_spec, P(), batch_spec, batch_spec,
-                          batch_spec),
+                          batch_spec) + (batch_spec,) * n_extras,
                 out_specs=(batch_spec, P(), P(), batch_spec, P()),
             )(table, idx, mask, dense, labels, params,
-              order, rstart, endb)
+              order, rstart, endb, *extras)
             updates, new_opt = tx.update(gp, opt_state, params)
             new_params = optax.apply_updates(params, updates)
             return new_table, new_params, new_opt, loss, preds, drop_g
@@ -427,42 +441,59 @@ class Trainer:
         capf = self.cfg.capacity_factor
         dedup = config_flags.pullpush_dedup_keys and self.n_shards > 1
 
-        def body(tshard, idx_l, mask_l, dense_l, params):
+        num_slots = self.layout.num_slots
+        n_extras = self._n_extras
+
+        def body(tshard, idx_l, mask_l, dense_l, params, *extras_l):
             B_l = idx_l.shape[0]
             pulled, dropped = sharded.routed_lookup(
                 tshard, idx_l.reshape(-1), emb_cfg, axes, capf,
                 dedup=dedup, return_dropped=True)
             pulled = pulled.reshape(B_l, T, emb_cfg.pull_width)
             logits = model.apply(params, pulled, mask_l, dense_l, seg,
-                                 self.layout.num_slots)
+                                 num_slots, *extras_l)
             return jax.nn.sigmoid(logits), lax.psum(dropped, axes)
 
         batch_spec = P(axes)
 
         @jax.jit
-        def step(table, params, idx, mask, dense):
+        def step(table, params, idx, mask, dense, *extras):
             return jax.shard_map(
                 body, mesh=self.mesh,
-                in_specs=(batch_spec, batch_spec, batch_spec, batch_spec, P()),
+                in_specs=(batch_spec, batch_spec, batch_spec, batch_spec,
+                          P()) + (batch_spec,) * n_extras,
                 out_specs=(batch_spec, P()),
-            )(table, idx, mask, dense, params)
+            )(table, idx, mask, dense, params, *extras)
 
         return step
 
     # ------------------------------------------------------------------
-    def _put_batch(self, ws: PassWorkingSet, pb: PackedBatch,
-                   with_plan: bool = True):
+    def _pack_host(self, ws: PassWorkingSet, pb: PackedBatch,
+                   with_plan: bool = True) -> tuple:
+        """Host half of the pack: translate + host plan + extras. Safe on
+        the pack thread — it touches no device API (the in-process CPU
+        backend deadlocks its collective rendezvous when another thread
+        dispatches transfers mid-step, and single-dispatcher discipline
+        costs nothing: the put itself is an async dispatch)."""
         with self.timers("translate"):
             idx = ws.translate(pb.ids, pb.mask)
             labels, dense = self.split_floats(pb.floats)
             plan = (self._host_plan(ws, idx) if with_plan
                     else (np.zeros(0, np.int32),) * 3)
-        sh = mesh_lib.batch_sharding(self.mesh)
+            extras = (self._extras_fn(pb, self.n_shards)
+                      if self._extras_fn is not None else ())
+        return (idx, pb.mask, dense.astype(np.float32),
+                labels.astype(np.float32), *plan, *extras)
+
+    def _stage_device(self, host_tuple: tuple):
         # ONE device_put for all arrays: each put is a host->device
         # round trip (very expensive on tunneled transports)
-        return jax.device_put(
-            (idx, pb.mask, dense.astype(np.float32),
-             labels.astype(np.float32), *plan), sh)
+        return jax.device_put(host_tuple,
+                              mesh_lib.batch_sharding(self.mesh))
+
+    def _put_batch(self, ws: PassWorkingSet, pb: PackedBatch,
+                   with_plan: bool = True):
+        return self._stage_device(self._pack_host(ws, pb, with_plan))
 
     def _pack_iter(self, dataset, ws: PassWorkingSet, batch_size: int):
         """Yield (pb, staged) with translate + host plan + H2D dispatched
@@ -485,7 +516,10 @@ class Trainer:
                 for pb in dataset.batches(batch_size, drop_last=True):
                     if cancel.is_set():
                         return          # abandoned consumer: stop packing
-                    q.put((pb, self._put_batch(ws, pb)))
+                    # host work only — the device_put happens on the
+                    # consumer thread (single-dispatcher discipline,
+                    # see _pack_host)
+                    q.put((pb, self._pack_host(ws, pb)))
                 q.put(done)
             except BaseException as e:      # re-raised on the main thread
                 q.put(("__pack_error__", e))
@@ -502,7 +536,8 @@ class Trainer:
                 if (isinstance(item, tuple) and len(item) == 2
                         and item[0] == "__pack_error__"):
                     raise item[1]
-                yield item
+                pb, host_tuple = item
+                yield pb, self._stage_device(host_tuple)
         finally:
             # consumer abandoned mid-pass (nan trip, exception): signal
             # the producer to stop after its current batch — without the
@@ -820,10 +855,11 @@ class Trainer:
             if n_valid < bs:
                 pb = pb.pad_to(bs)  # tail batch: pad + mask, don't drop
             # eval never pushes: skip the host plan + its H2D entirely
-            idx, mask, dense, labels, *_ = self._put_batch(ws, pb,
-                                                           with_plan=False)
+            staged = self._put_batch(ws, pb, with_plan=False)
+            idx, mask, dense, labels = staged[:4]
+            extras = staged[7:]          # past the 3 empty plan slots
             preds, dropped = self._eval_fn(ws.table, self.eval_params(),
-                                           idx, mask, dense)
+                                           idx, mask, dense, *extras)
             valid = jnp.arange(bs) < n_valid
             auc_acc.update(self._auc_masked_fn, preds, labels, valid)
             dev_dropped.append(dropped)

@@ -30,11 +30,14 @@ def _path_str(path) -> str:
     return "/".join(parts)
 
 
-def save_pytree(tree: Any, fname: str) -> str:
+def save_pytree(tree: Any, fname: str, compress: bool = True) -> str:
+    """compress=False writes STORED zip members (plain .npy bytes at a
+    fixed offset) so non-Python clients can mmap the arrays directly —
+    the serving export uses this (native/serving_score.c)."""
     leaves = jax.tree_util.tree_flatten_with_path(tree)[0]
     arrays = {_path_str(path): np.asarray(leaf) for path, leaf in leaves}
     os.makedirs(os.path.dirname(fname) or ".", exist_ok=True)
-    np.savez_compressed(fname, **arrays)
+    (np.savez_compressed if compress else np.savez)(fname, **arrays)
     return fname
 
 

@@ -19,7 +19,7 @@ def mesh8():
 
 def test_registry_complete():
     assert set(MODEL_REGISTRY) == {"dnn_ctr", "deepfm", "wide_deep",
-                                   "dcn_v2", "dlrm", "mmoe"}
+                                   "dcn_v2", "dlrm", "mmoe", "pv_rank"}
 
 
 @pytest.mark.parametrize("model_cls,kw", [

@@ -1,0 +1,129 @@
+"""PV / rank-attention path end-to-end (VERDICT r2 missing #3).
+
+The load-bearing ad-model pipeline: merge_by_search_id groups a page
+view's ads, the pack pipeline builds rank_offset per batch
+(model.batch_extras — GetRankOffset, data_feed.h:1552-1706), and
+PVRankModel (rank_attention + per-slot batch_fc + MLP) trains through
+the full Trainer.train_pass lifecycle on the 8-device mesh.
+"""
+
+import numpy as np
+import pytest
+
+from paddlebox_tpu.data import DataFeedSchema, SlotDataset
+from paddlebox_tpu.data.slot_record import SlotRecordBatch
+from paddlebox_tpu.embedding import EmbeddingConfig, HostEmbeddingStore
+from paddlebox_tpu.models import PVRankModel
+from paddlebox_tpu.parallel import make_mesh
+from paddlebox_tpu.train import Trainer, TrainerConfig
+
+NUM_SLOTS, EMB_DIM, MAX_RANK = 3, 4, 3
+
+
+def synth_pv_dataset(n_pv, seed=0, schema=None):
+    """Page views of 1..MAX_RANK ads. The label carries a RANK-PAIR
+    interaction signal: an ad converts more when a strong peer sits at
+    rank 1 — learnable only through rank_attention's pairwise params."""
+    rng = np.random.default_rng(seed)
+    schema = schema or DataFeedSchema.ctr(num_sparse=NUM_SLOTS,
+                                          num_float=1, batch_size=32,
+                                          max_len=1)
+    sv = [[] for _ in range(NUM_SLOTS)]
+    labels, ranks, sids, dense = [], [], [], []
+    id_w = np.random.default_rng(5).normal(size=400) * 1.2
+    for pv in range(n_pv):
+        k = int(rng.integers(1, MAX_RANK + 1))
+        ids_at_rank1 = None
+        members = []
+        for r in range(1, k + 1):
+            ids = rng.integers(1, 400, size=NUM_SLOTS)
+            if r == 1:
+                ids_at_rank1 = ids
+            members.append((r, ids))
+        for r, ids in members:
+            base = id_w[ids].sum() * 0.5
+            # pairwise term: rank-1 peer's strength boosts lower ranks
+            peer = id_w[ids_at_rank1].sum() * (0.8 if r > 1 else 0.0)
+            p = 1.0 / (1.0 + np.exp(-(base + peer - 0.3 * r)))
+            labels.append(float(rng.random() < p))
+            ranks.append(r)
+            sids.append(pv + 1)
+            dense.append(rng.normal())
+            for s in range(NUM_SLOTS):
+                sv[s].append(ids[s] + s * 1000003)
+    n = len(labels)
+    offs = np.arange(n + 1, dtype=np.int64)
+    ds = SlotDataset(schema)
+    ds.records = SlotRecordBatch(
+        schema=schema, num=n,
+        sparse_values=[np.asarray(v, np.int64) for v in sv],
+        sparse_offsets=[offs.copy() for _ in range(NUM_SLOTS)],
+        float_values=[np.asarray(labels, np.float32),
+                      np.asarray(dense, np.float32)],
+        ins_id=np.arange(n, dtype=np.uint64),
+        search_id=np.asarray(sids, np.uint64),
+        rank=np.asarray(ranks, np.int32),
+        cmatch=np.zeros(n, np.int32))
+    return ds, schema
+
+
+@pytest.fixture(scope="module")
+def mesh8():
+    return make_mesh(8)
+
+
+def test_pv_rank_trains_through_train_pass(mesh8):
+    ds, schema = synth_pv_dataset(600)
+    groups = ds.merge_by_search_id()
+    assert (np.diff(groups) >= 0).all()      # PVs contiguous
+    store = HostEmbeddingStore(EmbeddingConfig(dim=EMB_DIM,
+                                               learning_rate=0.15))
+    model = PVRankModel(num_slots=NUM_SLOTS, emb_dim=EMB_DIM, dense_dim=1,
+                        hidden=(32, 16), max_rank=MAX_RANK)
+    tr = Trainer(model, store, schema, mesh8,
+                 TrainerConfig(global_batch_size=32))
+    outs = [tr.train_pass(ds) for _ in range(4)]
+    losses = [o["loss_mean"] for o in outs]
+    assert losses[-1] < losses[0], losses
+    assert outs[-1]["auc"] > 0.6, outs[-1]["auc"]
+    # rank params actually trained
+    rp = np.asarray(tr.params["rank_param"])
+    assert np.abs(rp).max() > 0.02
+    # eval pass runs the extras path too
+    ev = tr.eval_pass(ds)
+    assert ev["auc"] > 0.6
+
+
+def test_packed_batches_carry_search_id(mesh8):
+    ds, schema = synth_pv_dataset(40, seed=3)
+    ds.merge_by_search_id()
+    pb = next(iter(ds.batches(16, drop_last=True)))
+    assert pb.search_id is not None and len(pb.search_id) == 16
+    # rank_offset built per shard slices peers shard-locally
+    model = PVRankModel(num_slots=NUM_SLOTS, emb_dim=EMB_DIM,
+                        max_rank=MAX_RANK)
+    (ro,) = model.batch_extras(pb, n_shards=4)
+    assert ro.shape == (16, 2 * MAX_RANK + 1)
+    bl = 16 // 4
+    for s in range(4):
+        sl = ro[s * bl:(s + 1) * bl]
+        peer_idx = sl[:, 2::2]
+        assert peer_idx.max(initial=0) < bl   # shard-local indices
+
+
+def test_vectorized_rank_offset_matches_reference():
+    from paddlebox_tpu.ops.rank_attention import (
+        build_rank_offset, build_rank_offset_reference)
+    rng = np.random.default_rng(0)
+    for trial in range(20):
+        B = int(rng.integers(1, 80))
+        K = int(rng.integers(1, 6))
+        groups = rng.integers(0, 12, size=B).astype(np.uint64)
+        ranks = rng.integers(0, K + 2, size=B).astype(np.int32)  # incl >K
+        got = build_rank_offset(ranks, groups, K)
+        want = build_rank_offset_reference(ranks, groups, K)
+        np.testing.assert_array_equal(got, want)
+    # empty batch
+    np.testing.assert_array_equal(
+        build_rank_offset(np.zeros(0, np.int32), np.zeros(0, np.uint64), 3),
+        np.zeros((0, 7), np.int32))
