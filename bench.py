@@ -150,7 +150,7 @@ def device_step_bench(small: bool, mode: str = "allreduce",
         loss array (mode-faithful: kstep syncs every param_sync_step,
         async pulls/pushes the host dense table each step — the real
         cost profile of trainer_desc.proto:100-108's modes)."""
-        nonlocal params, opt
+        nonlocal params, opt, dstate
         for i in range(k):
             b = staged[i % n_staged]
             if mode == "async":
@@ -164,11 +164,12 @@ def device_step_bench(small: bool, mode: str = "allreduce",
                     table, params, opt, *b)
                 params, opt = tr._sync_fn(params, opt)
             else:
-                table, params, opt, loss, preds, drop = tr._step_fn(
-                    table, params, opt, *b)
+                out = tr._step_fn(table, *dstate, *b)
+                table, dstate, loss, _, _ = tr.split_step_out(out)
         return table, loss
 
     params, opt = tr.params, tr.opt_state
+    dstate = tr.pack_dense() if mode == "allreduce" else None
     if mode == "async":
         tr.dense_table.start()
     table, loss = run_steps(ws.table, 2)   # compile + settle layouts
@@ -190,7 +191,9 @@ def device_step_bench(small: bool, mode: str = "allreduce",
 
     eps_chip = n_steps * batch / dt / n_dev
     ws.table = table                       # post-donation rebind
-    if mode != "async":
+    if mode == "allreduce":
+        tr.params, tr.opt_state = tr.unpack_dense(dstate)
+    elif mode == "kstep":
         tr.params, tr.opt_state = params, opt
     attr_result = None
     if attribution and mode == "allreduce" and n_dev == 1 \

@@ -53,6 +53,13 @@ class Flags:
     # on a background thread while step k trains (the MiniBatchGpuPack
     # role, data_feed.h:1372-1535). 0 = synchronous.
     prefetch_batches: int = 2               # (new)
+    # Carry the dense params + f32 optimizer state through the jitted
+    # step as TWO flat vectors instead of ~30 pytree leaves: each
+    # argument leaf costs host-side dispatch processing, measured
+    # 0.6ms/step on a tunneled v5e (the reference's single param_sync_
+    # tensor, boxps_worker.cc:453-472). Allreduce mode only; read at
+    # Trainer construction.
+    flat_dense_state: bool = True           # (new)
     # Scatter-free push: sort+bin tokens and build the per-block merge with
     # one-hot MXU matmuls, optimizer fused in VMEM (pallas_kernels.
     # binned_push). Engages only on real-TPU f32 tables whose row count

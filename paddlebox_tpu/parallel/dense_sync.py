@@ -43,6 +43,61 @@ def flatten_dense(params) -> tuple[np.ndarray, Callable]:
     return np.asarray(flat, dtype=np.float32), unravel
 
 
+def make_dense_packer(params_template, opt_template):
+    """(pack, unpack, n_args): flatten the dense params and the f32
+    leaves of the optimizer state into TWO flat vectors plus the non-f32
+    aux leaves (optimizer step counts).
+
+    Why: every jitted-step argument leaf costs host-side dispatch
+    processing; a DeepFM trainer carries ~30 dense-state leaves and the
+    consolidation measured 0.6ms/step on a tunneled v5e (the reference
+    aliases all dense params into one param_sync_ tensor for the same
+    reason, boxps_worker.cc:453-472). pack/unpack are jit-traceable —
+    inside the step they are free reshapes/slices fused by XLA — and
+    exact: unpack(pack(x)) == x leaf for leaf.
+
+    Returns None when a params leaf is not float32 (no flat fast path).
+    """
+    import jax.numpy as jnp
+
+    p_leaves = jax.tree.leaves(params_template)
+    if any(l.dtype != jnp.float32 for l in p_leaves):
+        return None
+    _, unravel_p = ravel_pytree(params_template)
+    o_leaves, o_def = jax.tree.flatten(opt_template)
+    is_f32 = [l.dtype == jnp.float32 for l in o_leaves]
+    f32_shapes = [l.shape for l, m in zip(o_leaves, is_f32) if m]
+    f32_sizes = [int(np.prod(s)) if s else 1 for s in f32_shapes]
+    n_aux = sum(1 for m in is_f32 if not m)
+
+    def pack(params, opt_state):
+        pf = ravel_pytree(params)[0]
+        leaves = jax.tree.leaves(opt_state)
+        f32s = [jnp.ravel(l) for l, m in zip(leaves, is_f32) if m]
+        of = (jnp.concatenate(f32s) if f32s
+              else jnp.zeros((0,), jnp.float32))
+        aux = tuple(l for l, m in zip(leaves, is_f32) if not m)
+        return (pf, of, *aux)
+
+    def unpack(state):
+        pf, of = state[0], state[1]
+        aux = state[2:]
+        params = unravel_p(pf)
+        out, off, ai, fi = [], 0, 0, 0
+        for m in is_f32:
+            if m:
+                sz, sh = f32_sizes[fi], f32_shapes[fi]
+                out.append(of[off:off + sz].reshape(sh))
+                off += sz
+                fi += 1
+            else:
+                out.append(aux[ai])
+                ai += 1
+        return params, jax.tree.unflatten(o_def, out)
+
+    return pack, unpack, 2 + n_aux
+
+
 class AsyncDenseTable:
     """Host-resident async dense parameter server (BoxPSAsynDenseTable).
 

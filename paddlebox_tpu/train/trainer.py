@@ -162,6 +162,21 @@ class Trainer:
         else:
             self.params = jax.device_put(init_params, repl)
             self.opt_state = jax.device_put(self.tx.init(init_params), repl)
+        # Flat dense-state transport (flags.flat_dense_state): the step
+        # carries (params_flat, opt_f32_flat, *aux) instead of ~30 pytree
+        # leaves — each argument leaf costs host-side dispatch time
+        # (dense_sync.make_dense_packer). Allreduce only; public
+        # self.params/self.opt_state stay pytrees — pack/unpack at pass
+        # boundaries via pack_dense/unpack_dense.
+        self._dense_packer = None
+        if (self.cfg.dense_sync_mode == "allreduce"
+                and config_flags.flat_dense_state):
+            # self.opt_state (built above in the allreduce branch) serves
+            # as the shape/dtype template — no second tx.init
+            self._dense_packer = dense_sync.make_dense_packer(
+                init_params, self.opt_state)
+        self._n_dense_args = (self._dense_packer[2]
+                              if self._dense_packer else 2)
         self.timers = StageTimers(["read", "translate", "train", "auc",
                                    "drain"])
         # incremental + overlapped pass boundaries (BoxHelper FeedPass):
@@ -195,6 +210,37 @@ class Trainer:
         self._auc_masked_fn = jax.jit(
             lambda s, p, y, m: auc_lib.auc_update(s, p, y, mask=m))
         self.global_step = 0
+
+    # ------------------------------------------------------------------
+    def pack_dense(self, params=None, opt_state=None) -> tuple:
+        """(params, opt_state) → the dense-state tuple `_step_fn`
+        consumes (identity pair when the flat path is off). Callers use
+        `tr._step_fn(table, *tr.pack_dense(...), idx, ...)` uniformly."""
+        params = self.params if params is None else params
+        opt_state = self.opt_state if opt_state is None else opt_state
+        if self._dense_packer is None:
+            return (params, opt_state)
+        return self._dense_packer[0](params, opt_state)
+
+    def unpack_dense(self, state: tuple):
+        """Inverse of pack_dense → (params, opt_state) pytrees."""
+        if self._dense_packer is None:
+            return state[0], state[1]
+        return self._dense_packer[1](state)
+
+    # zero-length plan arrays = "no host binned-push plan" (the step's
+    # trace-time static branch); external _step_fn callers pass three of
+    # these when they have no plan
+    NO_PLAN = _NO_PLAN
+
+    def split_step_out(self, out: tuple):
+        """Step output tuple → (table, dense_state, loss, preds, dropped).
+
+        The step returns (table, *dense_state, loss, preds, dropped);
+        dense_state length varies with the flat-transport mode — every
+        caller must slice through THIS helper, not by hand."""
+        nd = self._n_dense_args
+        return out[0], out[1:1 + nd], out[-3], out[-2], out[-1]
 
     # ------------------------------------------------------------------
     def _float_split(self) -> tuple[int, int, int]:
@@ -385,8 +431,8 @@ class Trainer:
             loss_g = lax.pmean(loss, axes)
             return new_shard, gp, loss_g, preds, drop_g
 
-        def step(table, params, opt_state, idx, mask, dense, labels,
-                 order=_NO_PLAN, rstart=_NO_PLAN, endb=_NO_PLAN, *extras):
+        def run_body(table, params, opt_state, idx, mask, dense, labels,
+                     order, rstart, endb, *extras):
             new_table, gp, loss, preds, drop_g = jax.shard_map(
                 body, mesh=self.mesh,
                 in_specs=(batch_spec, batch_spec, batch_spec, batch_spec,
@@ -398,6 +444,29 @@ class Trainer:
             updates, new_opt = tx.update(gp, opt_state, params)
             new_params = optax.apply_updates(params, updates)
             return new_table, new_params, new_opt, loss, preds, drop_g
+
+        if self._dense_packer is not None:
+            pack_fn, unpack_fn, n_dense = self._dense_packer
+
+            def step_flat(table, *args):
+                dstate = args[:n_dense]
+                (idx, mask, dense, labels, order, rstart,
+                 endb, *extras) = args[n_dense:]
+                params, opt_state = unpack_fn(dstate)
+                new_table, new_params, new_opt, loss, preds, drop_g = \
+                    run_body(table, params, opt_state, idx, mask, dense,
+                             labels, order, rstart, endb, *extras)
+                return (new_table, *pack_fn(new_params, new_opt), loss,
+                        preds, drop_g)
+
+            return jax.jit(step_flat, donate_argnums=(0, 1, 2),
+                           out_shardings=(tbl_sh,) + (repl,) * n_dense
+                           + (repl, bat_sh, repl))
+
+        def step(table, params, opt_state, idx, mask, dense, labels,
+                 order=_NO_PLAN, rstart=_NO_PLAN, endb=_NO_PLAN, *extras):
+            return run_body(table, params, opt_state, idx, mask, dense,
+                            labels, order, rstart, endb, *extras)
 
         # Donation aliases the (large) table and the dense state in place;
         # pinned out_shardings make output signatures identical to the inputs
@@ -590,6 +659,9 @@ class Trainer:
             self.preload_pass(preload_keys)
         table = ws.table
         params, opt_state = self.params, self.opt_state
+        # flat dense-state transport (see pack_dense); identity when off
+        dstate = (self.pack_dense(params, opt_state)
+                  if self._dense_packer is not None else None)
         auc_acc = auc_lib.AucAccumulator(cfg.auc_buckets)
         # device arrays collected without per-step host sync (the hot loop
         # must stay dispatch-async to overlap host pack with device compute)
@@ -621,6 +693,12 @@ class Trainer:
                         table, gp_flat, loss, preds, dropped = self._step_fn(
                             table, params, idx, mask, dense, labels, *plan)
                         self.dense_table.push(np.asarray(gp_flat))
+                    elif dstate is not None:
+                        out = self._step_fn(table, *dstate, idx, mask,
+                                            dense, labels, *plan)
+                        (table, dstate, loss, preds,
+                         dropped) = self.split_step_out(out)
+                        pass_step += 1
                     else:
                         (table, params, opt_state, loss, preds,
                          dropped) = self._step_fn(
@@ -652,10 +730,14 @@ class Trainer:
                         # dump-all-scope before raising (nan_inf_utils trip
                         # handler, boxps_worker.cc:575-580)
                         if cfg.nan_dump_dir:
+                            # flat transport: the live params are inside
+                            # dstate, not the pass-start `params` binding
+                            live_params = (self.unpack_dense(dstate)[0]
+                                           if dstate is not None else params)
                             dump_tree(
                                 f"{cfg.nan_dump_dir}/nan_step"
                                 f"{self.global_step}",
-                                {"params": params, "loss": loss,
+                                {"params": live_params, "loss": loss,
                                  "preds": preds, "labels": labels})
                         raise FloatingPointError(
                             f"nan/inf loss at step {self.global_step}")
@@ -678,6 +760,8 @@ class Trainer:
             else:
                 if mode == "kstep":  # end-of-pass sync (trainer Finalize)
                     params, opt_state = self._sync_fn(params, opt_state)
+                if dstate is not None:
+                    params, opt_state = self.unpack_dense(dstate)
                 self.params, self.opt_state = params, opt_state
             if dump_stream is not None:
                 # flush the tail batch even when the pass raised — a nan

@@ -99,54 +99,61 @@ def _window(jfn, args) -> float:
 
 def measure_step_floor(trainer, ws, staged, n: int = 100) -> float:
     """Per-step dispatch/launch/aliasing floor: a no-op step with the train
-    step's exact signature (same donation, same out_shardings), looped like
-    the bench loop. What remains after subtracting real compute stages from
-    the step time is mostly THIS — per-program launch cost — and it is a
-    real, measured stage, not a fudge residual."""
+    step's exact signature (same dense-state transport, same donation,
+    same out_shardings), looped like the bench loop. What remains after
+    subtracting real compute stages from the step time is mostly THIS —
+    per-program launch cost — and it is a real, measured stage, not a
+    fudge residual."""
     from paddlebox_tpu.parallel import mesh as mesh_lib
 
     repl = mesh_lib.replicated_sharding(trainer.mesh)
     tbl_sh = mesh_lib.table_sharding(trainer.mesh)
+    nd = trainer._n_dense_args
 
-    def noop(table, params, opt_state, idx, mask, dense, labels, *plan):
+    def noop(table, *args):
+        labels = args[nd + 3]
         loss = jnp.sum(labels) * 0.0
-        return table, params, opt_state, loss
+        return (table, *args[:nd], loss)
 
-    fn = jax.jit(noop, donate_argnums=(0, 1, 2),
-                 out_shardings=(tbl_sh, repl, repl, repl))
-    table, params, opt = ws.table, trainer.params, trainer.opt_state
+    fn = jax.jit(noop, donate_argnums=tuple(range(1 + nd)),
+                 out_shardings=(tbl_sh,) + (repl,) * nd + (repl,))
+    table = ws.table
+    dstate = trainer.pack_dense()
     for _ in range(2):
-        table, params, opt, loss = fn(table, params, opt, *staged)
+        out = fn(table, *dstate, *staged)
+        table, dstate, loss = out[0], out[1:1 + nd], out[-1]
     _sync(loss)
     best = None
     for _ in range(3):
         t0 = time.perf_counter()
         for _ in range(n):
-            table, params, opt, loss = fn(table, params, opt, *staged)
+            out = fn(table, *dstate, *staged)
+            table, dstate, loss = out[0], out[1:1 + nd], out[-1]
         _sync(loss)
         w = time.perf_counter() - t0
         best = w if best is None else min(best, w)
     ws.table = table
-    trainer.params, trainer.opt_state = params, opt
+    trainer.params, trainer.opt_state = trainer.unpack_dense(dstate)
     return best / n
 
 
-def _run_step_loop(fn, table, params, opt, staged, n: int) -> tuple:
-    """Bench-identical donation loop; returns (sec/step, final arrays)."""
+def _run_step_loop(trainer, fn, table, dstate, staged, n: int) -> tuple:
+    """Bench-identical donation loop over (table, *dense_state); returns
+    (sec/step, (table, dstate))."""
     for _ in range(2):
-        table, params, opt, loss, preds, drop = fn(table, params, opt,
-                                                   *staged)
+        out = fn(table, *dstate, *staged)
+        table, dstate, loss, _, _ = trainer.split_step_out(out)
     _sync(loss)
     best = None
     for _ in range(3):
         t0 = time.perf_counter()
         for _ in range(n):
-            table, params, opt, loss, preds, drop = fn(table, params, opt,
-                                                       *staged)
+            out = fn(table, *dstate, *staged)
+            table, dstate, loss, _, _ = trainer.split_step_out(out)
         _sync(loss)
         w = time.perf_counter() - t0
         best = w if best is None else min(best, w)
-    return best / n, (table, params, opt)
+    return best / n, (table, dstate)
 
 
 def attribute_step(trainer, ws, staged, step_seconds: float,
@@ -186,14 +193,16 @@ def attribute_step(trainer, ws, staged, step_seconds: float,
     # so the account is complete by construction. A stage's delta is its
     # marginal cost GIVEN the stages removed before it — shared/overlapped
     # time is charged to the earliest-removed stage that exposes it.
-    state = (ws.table, trainer.params, trainer.opt_state)
+    table, dstate = ws.table, trainer.pack_dense()
     times = [step_seconds]
     for abl in (("push",), ("push", "lookup"),
                 ("push", "lookup", "fwdbwd")):
         fn = trainer._build_train_step(ablate=abl)
-        sec, state = _run_step_loop(fn, *state, staged, n_loop)
+        sec, (table, dstate) = _run_step_loop(trainer, fn, table, dstate,
+                                              staged, n_loop)
         times.append(sec)
-    ws.table, trainer.params, trainer.opt_state = state
+    ws.table = table
+    trainer.params, trainer.opt_state = trainer.unpack_dense(dstate)
     floor = measure_step_floor(trainer, ws, staged, n=n_loop)
     stages = {
         "sparse_push": times[0] - times[1],

@@ -61,7 +61,7 @@ def _run_pair(storage, golden_lr_mult=1.0):
                         initial_g2sum=cfg.initial_g2sum,
                         dense_lr=tr.cfg.dense_lr, storage=storage)
 
-    table, params, opt = ws.table, tr.params, tr.opt_state
+    table, dstate = ws.table, tr.pack_dense()
     fw_losses, gold_losses = [], []
     for step in range(STEPS):
         raw = rng.choice(keys, size=(BATCH, NUM_SLOTS))
@@ -73,10 +73,12 @@ def _run_pair(storage, golden_lr_mult=1.0):
         np.testing.assert_array_equal(idx, gold_idx)
         dense = rng.normal(size=(BATCH, DENSE_DIM)).astype(np.float32)
         labels = (rng.random(BATCH) < 0.3).astype(np.float32)
-        table, params, opt, loss, preds, drop = tr._step_fn(
-            table, params, opt, idx, mask, dense, labels)
+        out = tr._step_fn(table, *dstate, idx, mask, dense, labels,
+                          tr.NO_PLAN, tr.NO_PLAN, tr.NO_PLAN)
+        table, dstate, loss, _, _ = tr.split_step_out(out)
         fw_losses.append(float(loss))
         gold_losses.append(gold.step(idx, mask, dense, labels))
+    params = tr.unpack_dense(dstate)[0]
     return np.array(fw_losses), np.array(gold_losses), table, params, gold
 
 

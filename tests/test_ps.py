@@ -161,13 +161,14 @@ def test_trainer_on_remote_store(cluster):
     sh = mesh_lib.batch_sharding(mesh)
     raw = rng.choice(keys, size=(16, T))
     idx = ws.translate(raw, np.ones((16, T), bool))
-    table, params, opt = ws.table, tr.params, tr.opt_state
+    table, dstate = ws.table, tr.pack_dense()
     args = [jax.device_put(np.asarray(a), sh) for a in
             (idx, np.ones((16, T), bool),
              rng.normal(size=(16, 1)).astype(np.float32),
              (rng.random(16) < 0.5).astype(np.float32))]
-    table, params, opt, loss, preds, dropped = tr._step_fn(
-        table, params, opt, *args)
+    out = tr._step_fn(table, *dstate, *args,
+                      tr.NO_PLAN, tr.NO_PLAN, tr.NO_PLAN)
+    table, _, loss, _, dropped = tr.split_step_out(out)
     assert np.isfinite(float(loss))
     assert int(dropped) == 0
     ws.table = table
