@@ -92,8 +92,10 @@ def _analytic_cost(batch, num_slots, emb_dim, dense_dim, hidden, emb_cfg,
 
 def device_step_bench(small: bool, mode: str = "allreduce",
                       storage: str | None = None, attribution: bool = True,
-                      n_steps: int | None = None, n_windows: int = 3):
+                      n_steps: int | None = None, n_windows: int = 3,
+                      batch_per_dev: int | None = None, n_split: int = 3):
     import jax
+    from paddlebox_tpu.config import flags as config_flags
     from paddlebox_tpu.data import DataFeedSchema
     from paddlebox_tpu.embedding import (EmbeddingConfig, HostEmbeddingStore,
                                          PassWorkingSet)
@@ -101,10 +103,13 @@ def device_step_bench(small: bool, mode: str = "allreduce",
     from paddlebox_tpu.parallel import make_mesh, mesh as mesh_lib
     from paddlebox_tpu.train import Trainer, TrainerConfig
 
+    config_flags.binned_push_splits = n_split
     devices = jax.devices()
     n_dev = len(devices)
     num_slots, emb_dim, dense_dim, hidden = 26, 8, 13, (400, 400, 400)
-    batch = (256 if small else 8192) * n_dev
+    if batch_per_dev is None:
+        batch_per_dev = 256 if small else 8192
+    batch = batch_per_dev * n_dev
     schema = DataFeedSchema.ctr(num_sparse=num_slots, num_float=dense_dim,
                                 batch_size=batch, max_len=1)
     # PBTPU_BENCH_STORAGE=int8|int16 overrides the headline storage mode
@@ -288,6 +293,12 @@ def e2e_bench(small: bool):
     translate + H2D + step + metrics + pass boundaries included)."""
     import tempfile
 
+    from paddlebox_tpu.config import flags as config_flags
+    # device_step_bench's matrix points mutate this trace-time flag (the
+    # bf16-push point leaves it at 1); the e2e semantics must stay the
+    # default 3-plane f32-exact push regardless of run order
+    config_flags.binned_push_splits = 3
+
     import jax
     from paddlebox_tpu.data import DataFeedSchema, SlotDataset
     from paddlebox_tpu.data.archive import read_archive, write_archive
@@ -410,16 +421,24 @@ def main() -> None:
         # mode (VERDICT r3 item #6): regressions in the non-headline
         # configs become visible round over round
         matrix = {}
-        for mname, mmode, mstorage in (
-                ("kstep_f32", "kstep", "f32"),
-                ("async_f32", "async", "f32"),
-                ("allreduce_int16", "allreduce", "int16"),
-                ("allreduce_int8", "allreduce", "int8")):
+        for mname, kw in (
+                ("kstep_f32", dict(mode="kstep", storage="f32")),
+                ("async_f32", dict(mode="async", storage="f32")),
+                ("allreduce_int16", dict(storage="int16")),
+                ("allreduce_int8", dict(storage="int8")),
+                # batch scaling: the ~1.3ms/step dispatch floor amortizes
+                ("allreduce_f32_b16384",
+                 dict(storage="f32",
+                      batch_per_dev=512 if small else 16384)),
+                # bf16 push payload (1-plane MXU split): faster, rounds
+                # sparse grads to bf16 — the capacity/precision trade of
+                # the reference's quantized push variants
+                ("allreduce_f32_push_bf16",
+                 dict(storage="f32", n_split=1))):
             try:
                 m_eps, m_detail = device_step_bench(
-                    small, mode=mmode, storage=mstorage,
-                    attribution=False, n_steps=3 if small else 50,
-                    n_windows=2)
+                    small, attribution=False,
+                    n_steps=3 if small else 50, n_windows=2, **kw)
                 matrix[mname] = {
                     "examples_per_sec_per_chip": round(m_eps, 1),
                     "step_seconds": m_detail["audit"]["step_seconds"],
