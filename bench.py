@@ -91,9 +91,10 @@ def _analytic_cost(batch, num_slots, emb_dim, dense_dim, hidden, emb_cfg,
 
 
 def device_step_bench(small: bool, mode: str = "allreduce",
-                      storage: str | None = None, attribution: bool = True,
+                      storage: str | None = None,
                       n_steps: int | None = None, n_windows: int = 3,
-                      batch_per_dev: int | None = None, n_split: int = 3):
+                      batch_per_dev: int | None = None, n_split: int = 3,
+                      return_ctx: bool = False):
     import jax
     from paddlebox_tpu.config import flags as config_flags
     from paddlebox_tpu.data import DataFeedSchema
@@ -200,18 +201,9 @@ def device_step_bench(small: bool, mode: str = "allreduce",
         tr.params, tr.opt_state = tr.unpack_dense(dstate)
     elif mode == "kstep":
         tr.params, tr.opt_state = params, opt
-    attr_result = None
-    if attribution and mode == "allreduce" and n_dev == 1 \
-            and os.environ.get("PBTPU_BENCH_ATTR", "1") != "0":
-        # per-stage device-time breakdown (log_for_profile's cal-split
-        # analogue, boxps_worker.cc:746-759): a throughput regression
-        # must name its stage
-        from paddlebox_tpu.utils.step_probe import attribute_step
-        attr_result = attribute_step(tr, ws, staged[0], dt / n_steps,
-                                     k=4 if small else 24,
-                                     n_loop=10 if small else 100)
-        _mark(f"stage attribution done (coverage "
-              f"{attr_result['coverage']:.0%})")
+    # stage attribution is NOT run here: _enrich is its single entry
+    # point (under main's print-always guard, after this frame's staged
+    # batches would otherwise be redundantly resident)
     flops, hbm = _analytic_cost(batch, num_slots, emb_dim, dense_dim,
                                 hidden, emb_cfg, ws.padded_rows)
     kind = devices[0].device_kind
@@ -246,9 +238,45 @@ def device_step_bench(small: bool, mode: str = "allreduce",
         "loss_final": loss_v,
         "audit": audit,
     }
-    if attr_result is not None:
-        detail["stage_attribution"] = attr_result
+    if return_ctx:
+        # live handles for a later attribution pass (main runs it under
+        # the print-always guard); the caller MUST drop these before the
+        # matrix runs or the headline buffers stay resident
+        return eps_chip, detail, {
+            "tr": tr, "ws": ws, "staged0": staged[0],
+            "step_seconds": dt / n_steps, "mode": mode, "n_dev": n_dev}
     return eps_chip, detail
+
+
+def _attribute_with_retry(tr, ws, staged0, step_seconds, small):
+    """Stage attribution (log_for_profile's cal-split analogue,
+    boxps_worker.cc:746-759) with ONE retry — BENCH_r03 was killed by a
+    transient tunnel error here (VERDICT r3 missing #2). Transient and
+    deterministic failures are indistinguishable up front, so the retry
+    fires on any Exception; one wasted re-attempt on a deterministic bug
+    is the accepted cost. The retry runs on the next loop iteration,
+    OUTSIDE the except block, so the failed attempt's exception state
+    (whose traceback pins the dead run's device buffers) is fully
+    released before the second attempt."""
+    from paddlebox_tpu.utils.step_probe import attribute_step
+    errors = []
+    for attempt in (0, 1):
+        try:
+            res = attribute_step(tr, ws, staged0, step_seconds,
+                                 k=4 if small else 24,
+                                 n_loop=10 if small else 100)
+            _mark(f"stage attribution done (coverage "
+                  f"{res['coverage']:.0%})")
+            return res
+        except Exception as e:
+            errors.append(repr(e))
+            del e
+        if not attempt:
+            _mark(f"stage attribution failed ({errors[0]}); retrying "
+                  f"once")
+    # the FIRST error is the root cause (a retry after a mid-execution
+    # donation loss fails fast with a derivative 'Array deleted' error)
+    return {"error": errors[0], "retry_error": errors[1]}
 
 
 def _synth_pass(schema, n_ex, num_slots, dense_slots, slot_space, seed,
@@ -415,7 +443,67 @@ def main() -> None:
     if small:
         jax.config.update("jax_platforms", "cpu")
 
-    eps_chip, detail = device_step_bench(small)
+    # Headline windows: one retry (aimed at transient tunnel errors, but
+    # fired on any Exception — the two are indistinguishable up front; a
+    # deterministic bug just fails identically twice). If both attempts
+    # die there is no honest number to report and the run fails.
+    # The failed attempt's exception is dropped BEFORE retrying — its
+    # traceback pins the dead run's device buffers (table + staged
+    # batches), and holding them across the retry would double HBM
+    # exactly when the chip is already unhappy.
+    for attempt in (0, 1):
+        try:
+            eps_chip, detail, ctx = device_step_bench(
+                small, return_ctx=True)
+            break
+        except Exception as e:
+            if attempt:
+                raise
+            _mark(f"headline bench failed ({e!r}); retrying once")
+            del e
+    # From here on, NOTHING may prevent the one JSON line from printing
+    # (VERDICT r3 weak #2: the artifact was hostage to its most fragile
+    # stage). Attribution/matrix/e2e enrich `detail` in place; any
+    # escape — including KeyboardInterrupt mid-attribution — is recorded
+    # in detail and the line still prints. Non-Exception escapes (Ctrl-C,
+    # SystemExit) re-raise after the print so the recorded rc still says
+    # the run was interrupted.
+    pending = None
+    try:
+        _enrich(small, detail, ctx)
+    except BaseException as e:
+        detail["bench_error"] = repr(e)
+        if not isinstance(e, Exception):
+            pending = e
+
+    print(json.dumps({
+        "metric": "deepfm_device_step_examples_per_sec_per_chip",
+        "value": round(eps_chip, 1),
+        "unit": "examples/sec/chip",
+        "vs_baseline": round(eps_chip / TARGET_PER_CHIP, 4),
+        "detail": detail,
+    }), flush=True)
+    if pending is not None:
+        raise pending
+    if not detail["audit"]["ok"]:
+        print("AUDIT FAIL: implied MFU/HBM exceeds hardware peaks — the "
+              "measurement window is broken; do not trust the number",
+              file=sys.stderr)
+        raise SystemExit(2)
+
+
+def _enrich(small: bool, detail: dict, ctx: dict) -> None:
+    """Attribution + matrix + e2e datapoints, mutating `detail` in place
+    so partial progress survives any failure (main prints whatever
+    landed)."""
+    if ctx["mode"] == "allreduce" and ctx["n_dev"] == 1 \
+            and os.environ.get("PBTPU_BENCH_ATTR", "1") != "0":
+        detail["stage_attribution"] = _attribute_with_retry(
+            ctx["tr"], ctx["ws"], ctx["staged0"], ctx["step_seconds"],
+            small)
+    # release the headline run's device buffers before the matrix
+    # re-allocates its own table + staged batches
+    ctx.clear()
     if os.environ.get("PBTPU_BENCH_MATRIX", "1") != "0":
         # one device-step datapoint per dense-sync mode and per storage
         # mode (VERDICT r3 item #6): regressions in the non-headline
@@ -437,7 +525,7 @@ def main() -> None:
                  dict(storage="f32", n_split=1))):
             try:
                 m_eps, m_detail = device_step_bench(
-                    small, attribution=False,
+                    small,
                     n_steps=3 if small else 50, n_windows=2, **kw)
                 matrix[mname] = {
                     "examples_per_sec_per_chip": round(m_eps, 1),
@@ -456,19 +544,6 @@ def main() -> None:
                                                  4)
         except Exception as e:  # e2e failure must not hide the step number
             detail["e2e"] = {"error": repr(e)}
-
-    print(json.dumps({
-        "metric": "deepfm_device_step_examples_per_sec_per_chip",
-        "value": round(eps_chip, 1),
-        "unit": "examples/sec/chip",
-        "vs_baseline": round(eps_chip / TARGET_PER_CHIP, 4),
-        "detail": detail,
-    }))
-    if not detail["audit"]["ok"]:
-        print("AUDIT FAIL: implied MFU/HBM exceeds hardware peaks — the "
-              "measurement window is broken; do not trust the number",
-              file=sys.stderr)
-        raise SystemExit(2)
 
 
 if __name__ == "__main__":
