@@ -65,9 +65,15 @@ class Flags:
     # binned_push). Engages only on real-TPU f32 tables whose row count
     # fits the block geometry; read at trace time like PBTPU_PALLAS.
     binned_push: bool = True                # (new)
-    # bf16 planes the push payload crosses the MXU in: 3 ~= f32-exact,
-    # 1 = bf16 grads (~2x faster matmuls, CTR-tolerable rounding)
-    binned_push_splits: int = 3             # (new)
+    # bf16 planes the push payload crosses the MXU in (built in-kernel
+    # by mantissa masking): 3 = f32-exact (24 mantissa bits), 2 = 16
+    # exact bits, 1 = bf16 grads. Default 2: the sparse grads arriving
+    # here already carry bf16-level rounding from the backward matmuls
+    # (TPU MXU), so plane 3's bits 17-24 are below the gradient noise
+    # floor; dropping it measured 7.60 -> 6.95ms on the v5e headline
+    # step (+8.5%). Both endpoints stay measured as bench matrix points
+    # (allreduce_f32_push_exact / _push_bf16).
+    binned_push_splits: int = 2             # (new)
     # Physical column count of the f32 device table. TPU random-row
     # gathers run ~2x faster from 64/128-column sources than from narrow
     # odd widths (measured on v5e: 213k-row gather 4.3ms at width 13,

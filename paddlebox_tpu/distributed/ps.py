@@ -192,6 +192,12 @@ class PSServer:
                     if cmd == "stop":
                         self.request.sendall(_pack({"ok": True}))
                         outer._srv.shutdown()
+                        # the dense tables run background updater
+                        # threads; a remote stop must end them too or
+                        # they outlive the server (thread leak — the
+                        # class of residue that aborts long test runs)
+                        for t in outer.dense.values():
+                            t.stop()
                         return
                     try:
                         rh, ra = outer._handlers[cmd](header, arrays)

@@ -112,11 +112,11 @@ def push(table: jnp.ndarray, idx: jnp.ndarray, grads: jnp.ndarray,
     """
     n = idx.shape[0]
     if (config_flags.binned_push and not quant.is_quant(table)
-            and pallas_kernels.binned_push_supported(
-                table, cfg, config_flags.binned_push_splits)):
-        # scatter-free merge+update: XLA's scatter is ~117ns/token of pure
-        # random-access latency; the binned kernel streams the same merge
-        # through the MXU (see pallas_kernels.binned_push)
+            and pallas_kernels.binned_push_supported(table, cfg)):
+        # scatter-free merge+update for narrow rows: the binned kernel
+        # streams the merge through the MXU and measures ~2x the XLA
+        # scatter there; wide rows (G=1) keep the scatter, which
+        # measures faster (binned_push_supported docstring)
         return pallas_kernels.binned_push(
             table, idx, grads, shows, clks, cfg,
             n_split=config_flags.binned_push_splits, plan=plan)

@@ -276,14 +276,14 @@ class PassWorkingSet:
         if bucket_rows:
             rps = bucket_size(rps)
         # align shard rows to the super-block the binned-push geometry
-        # would target for this table size (pallas_kernels.
-        # bp_row_alignment) — big tables get big-block divisibility,
+        # would target for a table of THIS SHARD's size (the kernel runs
+        # per shard on rps rows, so the alignment target is rps, not the
+        # global row count) — big tables get big-block divisibility,
         # small ones keep the cheap 4096 alignment; the waste is zero
         # rows that are never indexed
         if rps >= 4096:
             from paddlebox_tpu.ops.pallas_kernels import bp_row_alignment
-            align = (bp_row_alignment(cfg, rps * n_shards,
-                                      flags.binned_push_splits)
+            align = (bp_row_alignment(cfg, rps)
                      if cfg.storage == "f32" else 4096)
             rps = -(-rps // align) * align
         n_pad = rps * n_shards

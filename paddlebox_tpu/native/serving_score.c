@@ -117,28 +117,52 @@ static int npz_open(const char *path, Npz *z) {
 }
 
 static int npy_parse(const uint8_t *data, uint64_t size, NpyArray *a) {
-    if (size < 10 || memcmp(data, "\x93NUMPY", 6) != 0) {
+    if (size < 12 || memcmp(data, "\x93NUMPY", 6) != 0) {
         fprintf(stderr, "bad npy magic\n"); return -1;
     }
     int major = data[6];
     uint32_t hlen;
-    const char *hdr;
-    if (major == 1) { hlen = rd16(data + 8); hdr = (const char *)data + 10; }
-    else { hlen = rd32(data + 8); hdr = (const char *)data + 12; }
+    uint64_t hoff;
+    if (major == 1) { hlen = rd16(data + 8); hoff = 10; }
+    else { hlen = rd32(data + 8); hoff = 12; }
+    /* the header is newline- but not NUL-terminated inside the mmap:
+     * validate it against the entry size and scan a bounded, NUL-
+     * terminated copy so a malformed archive can never walk the
+     * strstr/strchr chain past the mapping */
+    if (hlen > size - hoff || hlen >= 65536) {
+        fprintf(stderr, "npy header length %u exceeds entry (%llu)\n",
+                hlen, (unsigned long long)size);
+        return -1;
+    }
+    char hbuf[65536];
+    memcpy(hbuf, data + hoff, hlen);
+    hbuf[hlen] = 0;
+    const char *hdr = hbuf;
     const char *d = strstr(hdr, "'descr'");
     const char *f = strstr(hdr, "'fortran_order'");
     const char *s = strstr(hdr, "'shape'");
     if (!d || !f || !s) { fprintf(stderr, "bad npy header\n"); return -1; }
+    /* the fixed-offset skips below (d+8, f+15) must stay inside the
+     * NUL-terminated copy; a crafted header ending exactly at a marker
+     * would otherwise push the scan one past the terminator */
+    if (d + 8 >= hdr + hlen || f + 15 >= hdr + hlen) {
+        fprintf(stderr, "bad npy header\n"); return -1;
+    }
     const char *q = strchr(d + 8, '\'');
     if (!q) return -1;
     const char *q2 = strchr(q + 1, '\'');
+    if (!q2) return -1;
     size_t dl = (size_t)(q2 - q - 1);
     if (dl >= sizeof(a->dtype)) dl = sizeof(a->dtype) - 1;
     memcpy(a->dtype, q + 1, dl);
     a->dtype[dl] = 0;
-    if (strstr(f + 15, "True") && strstr(f + 15, "True") < strchr(f, ')'))
+    const char *fend = strchr(f, ',');
+    if (!fend) fend = hdr + hlen;
+    const char *ftrue = strstr(f + 15, "True");
+    if (ftrue && ftrue < fend)
         { fprintf(stderr, "fortran order unsupported\n"); return -1; }
     const char *lp = strchr(s, '(');
+    if (!lp) { fprintf(stderr, "bad npy shape\n"); return -1; }
     a->ndim = 0;
     a->shape[0] = a->shape[1] = 1;
     const char *cur = lp + 1;
@@ -150,6 +174,31 @@ static int npy_parse(const uint8_t *data, uint64_t size, NpyArray *a) {
         } else cur++;
     }
     if (a->ndim == 0) a->ndim = 1;          /* scalar-ish: () treated (1,) */
+    /* the declared extent must fit the entry: a crafted shape like
+     * (1e9,) over a few-KB member would otherwise send every later
+     * reader (key_find binary search, plane pointers) far past the
+     * mapping */
+    long itemsize = 0;
+    for (size_t i = 0; a->dtype[i]; i++) {
+        if (a->dtype[i] >= '0' && a->dtype[i] <= '9') {
+            itemsize = strtol(a->dtype + i, NULL, 10);
+            break;
+        }
+    }
+    if (itemsize <= 0 || itemsize > 16
+        || a->shape[0] < 0 || a->shape[1] < 0
+        || (uint64_t)a->shape[0] > (1ull << 40)
+        || (uint64_t)a->shape[1] > (1ull << 40)) {
+        fprintf(stderr, "bad npy dtype/shape\n"); return -1;
+    }
+    uint64_t need = (uint64_t)a->shape[0] * (uint64_t)a->shape[1]
+                    * (uint64_t)itemsize;
+    if (need > size - hoff - hlen) {
+        fprintf(stderr, "npy shape exceeds entry: need %llu have %llu\n",
+                (unsigned long long)need,
+                (unsigned long long)(size - hoff - hlen));
+        return -1;
+    }
     a->data = data + (major == 1 ? 10 : 12) + hlen;
     return 0;
 }

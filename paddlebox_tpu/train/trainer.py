@@ -40,6 +40,11 @@ from paddlebox_tpu.parallel import mesh as mesh_lib
 from paddlebox_tpu.utils.profiler import RecordEvent, DumpStream, dump_tree
 from paddlebox_tpu.utils.timer import StageTimers
 
+# arity of the binned-push host plan inside a staged batch tuple:
+# (idx, mask, dense, labels, *plan[PLAN_ARITY], *extras) — _pack_host,
+# _host_plan, and eval_pass's extras slice all key off this
+PLAN_ARITY = 3
+
 
 @dataclasses.dataclass
 class TrainerConfig:
@@ -548,7 +553,7 @@ class Trainer:
             idx = ws.translate(pb.ids, pb.mask)
             labels, dense = self.split_floats(pb.floats)
             plan = (self._host_plan(ws, idx) if with_plan
-                    else (np.zeros(0, np.int32),) * 3)
+                    else (np.zeros(0, np.int32),) * PLAN_ARITY)
             extras = (self._extras_fn(pb, self.n_shards)
                       if self._extras_fn is not None else ())
         return (idx, pb.mask, dense.astype(np.float32),
@@ -626,13 +631,12 @@ class Trainer:
         (pallas_kernels.binned_push's `plan`). Zero-length arrays mean
         "no plan" — the step's static-shape branch then keeps the
         on-device grouping (or the XLA scatter path off-TPU)."""
-        empty = (np.zeros(0, np.int32),) * 3
+        empty = (np.zeros(0, np.int32),) * PLAN_ARITY
         if not self._use_plan:
             return empty
         from paddlebox_tpu.ops import pallas_kernels
         geom = pallas_kernels.binned_push_geometry(
-            self.store.cfg, ws.padded_rows,
-            config_flags.binned_push_splits)
+            self.store.cfg, ws.padded_rows)
         if geom is None:
             return empty
         from paddlebox_tpu.native.key_index import block_plan
@@ -681,9 +685,9 @@ class Trainer:
         dump_stream = (DumpStream(cfg.dump_fields_path, mode="a")
                        if cfg.dump_fields_path else None)
         dump_pending: tuple[int, Any, Any] | None = None
+        pack_it = self._pack_iter(dataset, ws, cfg.global_batch_size)
         try:
-            for pb, staged in self._pack_iter(dataset, ws,
-                                              cfg.global_batch_size):
+            for pb, staged in pack_it:
                 with RecordEvent("pack_batch"):
                     idx, mask, dense, labels, *plan = staged
                 with self.timers("train"), RecordEvent("train_step"):
@@ -745,6 +749,12 @@ class Trainer:
                 dev_dropped.append(dropped)
                 self.global_step += 1
         finally:
+            # close the pack generator explicitly so its finally (cancel
+            # event + producer join) runs NOW, not whenever GC finalizes
+            # the suspended frame — on a non-refcounting interpreter the
+            # daemon producer would otherwise keep translating and
+            # touching ws for the rest of the dataset
+            pack_it.close()
             # The step donates table/params/opt_state, so the objects bound
             # before the loop are dead buffers; rebind to the last good step
             # even when a batch raised (the pass/day crash-recovery flow
@@ -941,7 +951,7 @@ class Trainer:
             # eval never pushes: skip the host plan + its H2D entirely
             staged = self._put_batch(ws, pb, with_plan=False)
             idx, mask, dense, labels = staged[:4]
-            extras = staged[7:]          # past the 3 empty plan slots
+            extras = staged[4 + PLAN_ARITY:]   # past the empty plan slots
             preds, dropped = self._eval_fn(ws.table, self.eval_params(),
                                            idx, mask, dense, *extras)
             valid = jnp.arange(bs) < n_valid

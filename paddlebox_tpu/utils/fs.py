@@ -140,6 +140,10 @@ class CommandFS(FileSystem):
         # '{'/'}' are legal in object names and in command templates.
         # Single-pass re.sub so a substituted VALUE containing "{dst}" etc.
         # is never re-scanned by a later placeholder.
+        if not kw:
+            # "|".join([]) would compile to an everywhere-matching empty
+            # pattern whose replacement callback KeyErrors on kw[""]
+            return shlex.split(tpl)
         import re
         pat = re.compile("|".join(re.escape("{" + k + "}") for k in kw))
         return [pat.sub(lambda m: kw[m.group(0)[1:-1]], tok)
