@@ -49,6 +49,13 @@ class Flags:
     # the next pass (bounded by the shard count, which cannot drop).
     routed_drop_fatal: bool = False         # (new)
     routed_drop_adapt: bool = True          # (new)
+    # Size the all_to_all capacity from the pass's ACTUAL per-(device,
+    # destination) token histogram before the first step compiles, so a
+    # skewed pass can never train lossily while the adaptive doubling
+    # catches up (the reference never drops — it sizes buffers
+    # dynamically, box_wrapper_impl.h:44-81). One extra vectorized
+    # translate scan over the pass data; multi-shard meshes only.
+    routed_capacity_preplan: bool = True    # (new)
     # Pack-pipeline depth: translate + host plan + H2D for batch k+1 run
     # on a background thread while step k trains (the MiniBatchGpuPack
     # role, data_feed.h:1372-1535). 0 = synchronous.

@@ -65,7 +65,7 @@ class GoldenDeepFM:
 
     def __init__(self, table, init_params, num_slots, emb_dim, dense_dim,
                  hidden, lr_sparse=0.05, initial_g2sum=3.0,
-                 dense_lr=1e-3, storage="f32"):
+                 dense_lr=1e-3, storage="f32", dense_opt="adam"):
         self.S, self.E, self.D = num_slots, emb_dim, dense_dim
         self.row_width = table.shape[1]
         self.pull_width = 3 + emb_dim           # show, clk, w, embedx
@@ -89,6 +89,11 @@ class GoldenDeepFM:
         self.m = {k: _tree_zeros(v) for k, v in self.params.items()}
         self.v = {k: _tree_zeros(v) for k, v in self.params.items()}
         self.t = 0
+        # "adam" = optax.adam (allreduce/kstep modes); "async_merge" =
+        # the host dense table's hand-rolled Adam-like rule (reference
+        # ThreadUpdate, boxps_worker.cc:173-225: betas 0.99/0.9999, NO
+        # bias correction — parallel/dense_sync.AsyncDenseTable._apply)
+        self.dense_opt = dense_opt
 
     # -- quantized storage round trip (quant.py split/assemble) ---------
     def _requant(self, rows_mask):
@@ -197,22 +202,36 @@ class GoldenDeepFM:
         if self.qmax is not None:
             self._requant(touched)
 
-        # ---- dense adam (optax.adam defaults) ----
+        # ---- dense update ----
         self.t += 1
-        b1, b2, eps = 0.9, 0.999, 1e-8
-        bc1 = 1.0 - b1 ** self.t
-        bc2 = 1.0 - b2 ** self.t
+        if self.dense_opt == "async_merge":
+            b1, b2, eps = 0.99, 0.9999, 1e-8   # no bias correction
 
-        def upd(path, p, gr):
-            m = self.m[path[0]]
-            vv = self.v[path[0]]
-            for k in path[1:]:
-                m, vv = m[k], vv[k]
-            m *= b1
-            m += (1 - b1) * gr
-            vv *= b2
-            vv += (1 - b2) * gr * gr
-            p -= self.dense_lr * (m / bc1) / (np.sqrt(vv / bc2) + eps)
+            def upd(path, p, gr):
+                m = self.m[path[0]]
+                vv = self.v[path[0]]
+                for k in path[1:]:
+                    m, vv = m[k], vv[k]
+                m *= b1
+                m += (1 - b1) * gr
+                vv *= b2
+                vv += (1 - b2) * gr * gr
+                p -= self.dense_lr * m / (np.sqrt(vv) + eps)
+        else:
+            b1, b2, eps = 0.9, 0.999, 1e-8
+            bc1 = 1.0 - b1 ** self.t
+            bc2 = 1.0 - b2 ** self.t
+
+            def upd(path, p, gr):
+                m = self.m[path[0]]
+                vv = self.v[path[0]]
+                for k in path[1:]:
+                    m, vv = m[k], vv[k]
+                m *= b1
+                m += (1 - b1) * gr
+                vv *= b2
+                vv += (1 - b2) * gr * gr
+                p -= self.dense_lr * (m / bc1) / (np.sqrt(vv / bc2) + eps)
 
         upd(("bias",), self.params["bias"], grads["bias"])
         if self.D:

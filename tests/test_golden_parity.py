@@ -30,16 +30,44 @@ HIDDEN = (16, 16)
 BATCH, STEPS, N_KEYS = 32, 60, 300
 
 
-def _run_pair(storage, golden_lr_mult=1.0):
+def _run_pair(storage, mode="allreduce", n_dev=1, golden_lr_mult=1.0,
+              sync_step=7):
+    """Train STEPS batches through the real Trainer step in the given
+    dense-sync mode / shard count AND through the NumPy twin; return the
+    loss trajectories + final states.
+
+    - allreduce: the bench headline config (flat dense transport).
+    - kstep: per-step local dense updates, _sync_fn every `sync_step`
+      steps plus at the end (trainer Finalize) — on one device the sync
+      is a numeric identity, so the golden adam trajectory must be
+      reproduced THROUGH the kstep plumbing (stacked params, sync calls).
+    - async: the host AsyncDenseTable (pull -> device step -> push grads)
+      with a flush() after every push so exactly one grad applies per
+      step — the deterministic projection of the reference's
+      ThreadUpdate merge loop (boxps_worker.cc:173-225); the golden
+      applies the same no-bias-correction 0.99/0.9999 rule.
+    - n_dev=8: the routed mesh path (all_to_all sparse lookup/push, dp
+      grad pmean) against the SAME single-table golden — routing must be
+      semantics-preserving.
+    """
+    from paddlebox_tpu.parallel import mesh as mesh_lib
+
     cfg = EmbeddingConfig(dim=EMB_DIM, optimizer="adagrad",
                           learning_rate=0.05, storage=storage)
     store = HostEmbeddingStore(cfg)
     schema = DataFeedSchema.ctr(num_sparse=NUM_SLOTS, num_float=DENSE_DIM,
                                 batch_size=BATCH, max_len=1)
-    mesh = make_mesh(1)
+    mesh = make_mesh(n_dev)
     tr = Trainer(DeepFMModel(num_slots=NUM_SLOTS, emb_dim=EMB_DIM,
                              dense_dim=DENSE_DIM, hidden=HIDDEN),
-                 store, schema, mesh, TrainerConfig(global_batch_size=BATCH))
+                 store, schema, mesh,
+                 TrainerConfig(global_batch_size=BATCH,
+                               dense_sync_mode=mode,
+                               param_sync_step=sync_step,
+                               # mesh8: uniform keys over 8 shards at
+                               # batch 32 can exceed the default 2.0
+                               # slack; any drop would desync the golden
+                               capacity_factor=8.0 if n_dev > 1 else 2.0))
     rng = np.random.default_rng(7)
     keys = np.unique(rng.choice(1 << 40, N_KEYS).astype(np.uint64))
     ws = PassWorkingSet.begin_pass(store, keys, mesh)
@@ -51,40 +79,80 @@ def _run_pair(storage, golden_lr_mult=1.0):
     n_pad = ws.padded_rows
     gold_table = np.zeros((n_pad, cfg.row_width), np.float32)
     gold_table[1:1 + len(keys)] = gold_rows
-    if storage == "f32":
+    if storage == "f32" and n_dev == 1:
         np.testing.assert_array_equal(np.asarray(ws.table), gold_table)
 
     init_params = jax.tree.map(np.asarray, tr.params)
+    if mode == "kstep":
+        # kstep keeps per-shard dense copies (stack_for_shards leading
+        # axis); the golden models one logical copy
+        init_params = jax.tree.map(lambda a: a[0], init_params)
     gold = GoldenDeepFM(gold_table, init_params, NUM_SLOTS, EMB_DIM,
                         DENSE_DIM, HIDDEN,
                         lr_sparse=cfg.learning_rate * golden_lr_mult,
                         initial_g2sum=cfg.initial_g2sum,
-                        dense_lr=tr.cfg.dense_lr, storage=storage)
+                        dense_lr=tr.cfg.dense_lr, storage=storage,
+                        dense_opt=("async_merge" if mode == "async"
+                                   else "adam"))
 
-    table, dstate = ws.table, tr.pack_dense()
+    sh = mesh_lib.batch_sharding(mesh)
+    repl = mesh_lib.replicated_sharding(mesh)
+    table = ws.table
+    dstate = tr.pack_dense() if mode == "allreduce" else None
+    params, opt = tr.params, tr.opt_state
+    if mode == "async":
+        tr.dense_table.start()
     fw_losses, gold_losses = [], []
     for step in range(STEPS):
         raw = rng.choice(keys, size=(BATCH, NUM_SLOTS))
         mask = rng.random((BATCH, NUM_SLOTS)) < 0.9   # some padding
         idx = ws.translate(raw, mask)
-        # independent translate cross-check: sorted-keys searchsorted + 1
-        pos = np.searchsorted(ws.sorted_keys, raw.astype(np.uint64))
-        gold_idx = np.where(mask, pos + 1, 0).astype(np.int32)
-        np.testing.assert_array_equal(idx, gold_idx)
+        if n_dev == 1:
+            # independent translate cross-check: searchsorted + 1
+            pos = np.searchsorted(ws.sorted_keys, raw.astype(np.uint64))
+            gold_idx = np.where(mask, pos + 1, 0).astype(np.int32)
+            np.testing.assert_array_equal(idx, gold_idx)
         dense = rng.normal(size=(BATCH, DENSE_DIM)).astype(np.float32)
         labels = (rng.random(BATCH) < 0.3).astype(np.float32)
-        out = tr._step_fn(table, *dstate, idx, mask, dense, labels,
-                          tr.NO_PLAN, tr.NO_PLAN, tr.NO_PLAN)
-        table, dstate, loss, _, _ = tr.split_step_out(out)
+        batch = tuple(jax.device_put(a, sh) for a in
+                      (idx, mask, dense, labels)) + \
+            (tr.NO_PLAN, tr.NO_PLAN, tr.NO_PLAN)
+        if mode == "async":
+            p = jax.device_put(tr._unravel(tr.dense_table.pull()), repl)
+            table, gp_flat, loss, _, dropped = tr._step_fn(
+                table, p, *batch)
+            tr.dense_table.push(np.asarray(gp_flat))
+            tr.dense_table.flush()      # deterministic: 1 grad per apply
+        elif mode == "kstep":
+            table, params, opt, loss, _, dropped = tr._step_fn(
+                table, params, opt, *batch)
+            if (step + 1) % sync_step == 0:
+                params, opt = tr._sync_fn(params, opt)
+        else:
+            out = tr._step_fn(table, *dstate, *batch)
+            table, dstate, loss, _, dropped = tr.split_step_out(out)
+        if n_dev > 1:
+            assert int(np.asarray(dropped).sum()) == 0, \
+                "routed capacity drop would desync the golden trajectory"
         fw_losses.append(float(loss))
         gold_losses.append(gold.step(idx, mask, dense, labels))
-    params = tr.unpack_dense(dstate)[0]
+    if mode == "async":
+        fin = jax.tree.map(np.asarray,
+                           tr._unravel(tr.dense_table.pull()))
+        tr.dense_table.stop()
+        params = fin
+    elif mode == "kstep":
+        params, opt = tr._sync_fn(params, opt)   # trainer Finalize
+        params = jax.tree.map(lambda a: np.asarray(a)[0], params)
+    else:
+        params = tr.unpack_dense(dstate)[0]
     return np.array(fw_losses), np.array(gold_losses), table, params, gold
 
 
-@pytest.mark.parametrize("storage", ["f32", "int16"])
-def test_trajectory_parity(storage):
-    fw, gold, table, params, g = _run_pair(storage)
+@pytest.mark.parametrize("mode", ["allreduce", "kstep", "async"])
+@pytest.mark.parametrize("storage", ["f32", "int16", "int8"])
+def test_trajectory_parity(storage, mode):
+    fw, gold, table, params, g = _run_pair(storage, mode=mode)
     # per-step loss trajectory: fp reassociation differs (XLA fuses),
     # systematic errors (a factor on sparse grads, a column off-by-one)
     # blow past this within a few steps
@@ -110,6 +178,18 @@ def test_trajectory_parity(storage):
                                    rtol=2e-3, atol=2e-5)
         np.testing.assert_allclose(layer["b"], g.params["mlp"][i]["b"],
                                    rtol=2e-3, atol=2e-5)
+
+
+def test_trajectory_parity_mesh8_routed():
+    """The 8-shard routed path (all_to_all sparse lookup/push, dp-mean
+    dense grads) against the SAME single-table NumPy golden: sharding
+    must be a pure layout choice with no numeric consequence beyond fp
+    reassociation (the reference's multi-GPU PullSparse/PushSparse
+    contract, box_wrapper_impl.h:44-81)."""
+    fw, gold, table, params, g = _run_pair("f32", n_dev=8)
+    np.testing.assert_allclose(fw, gold, rtol=5e-4, atol=5e-5)
+    fw_table = np.asarray(table)[:, :g.table.shape[1]]
+    np.testing.assert_allclose(fw_table, g.table, rtol=2e-3, atol=5e-5)
 
 
 def test_detects_systematic_error():
