@@ -153,7 +153,7 @@ def device_step_bench(small: bool, mode: str = "allreduce",
     sh = mesh_lib.batch_sharding(mesh)
 
     n_staged = 4
-    staged = []
+    host_batches = []
     for _ in range(n_staged):
         raw = rng.choice(keys, size=(batch, T))
         if max_len > 1 and T == num_slots * max_len:
@@ -170,8 +170,20 @@ def device_step_bench(small: bool, mode: str = "allreduce",
         # the host binned-push plan is part of the pack pipeline (overlaps
         # device compute in train_pass); staged here like the batch itself
         plan = tr._host_plan(ws, idx)
-        staged.append(tuple(jax.device_put(a, sh) for a in
-                            (idx, mask, dense, labels, *plan)))
+        host_batches.append((idx, mask, dense, labels, *plan))
+    staged = [tuple(jax.device_put(a, sh) for a in hb)
+              for hb in host_batches]
+    # superstep operands: the same batches stacked for k-per-dispatch
+    # groups (what train_pass stages by default — steps_per_dispatch)
+    ksd = tr.cfg.steps_per_dispatch if tr._superstep_fn is not None else 1
+    staged_stacked = None
+    if ksd > 1:
+        assert n_staged % ksd == 0 or ksd % n_staged == 0
+        reps = max(1, ksd // n_staged)
+        seq = (host_batches * reps)[:ksd]
+        staged_stacked = jax.device_put(
+            tuple(np.stack(cols) for cols in zip(*seq)),
+            mesh_lib.stacked_batch_sharding(mesh))
     _mark("staged batches on device")
 
     repl = mesh_lib.replicated_sharding(mesh)
@@ -180,8 +192,16 @@ def device_step_bench(small: bool, mode: str = "allreduce",
         """k steps in the selected dense-sync mode, returning the final
         loss array (mode-faithful: kstep syncs every param_sync_step,
         async pulls/pushes the host dense table each step — the real
-        cost profile of trainer_desc.proto:100-108's modes)."""
+        cost profile of trainer_desc.proto:100-108's modes). Allreduce
+        runs the trainer's default k-microbatch superstep (one dispatch
+        per steps_per_dispatch batches, like train_pass)."""
         nonlocal params, opt, dstate
+        if mode == "allreduce" and staged_stacked is not None:
+            assert k % ksd == 0, (k, ksd)
+            for _ in range(k // ksd):
+                out = tr._superstep_fn(table, *dstate, *staged_stacked)
+                table, dstate, loss, _, _ = tr.split_step_out(out)
+            return table, loss[-1:]
         for i in range(k):
             b = staged[i % n_staged]
             if mode == "async":
@@ -203,12 +223,16 @@ def device_step_bench(small: bool, mode: str = "allreduce",
     dstate = tr.pack_dense() if mode == "allreduce" else None
     if mode == "async":
         tr.dense_table.start()
-    table, loss = run_steps(ws.table, 2)   # compile + settle layouts
+    # compile + settle layouts (one superstep group when that's the path)
+    table, loss = run_steps(ws.table, ksd if staged_stacked is not None
+                            else 2)
     _sync_scalar(loss)
     _mark(f"warmup/compile done ({mode}/{storage})")
 
     if n_steps is None:
         n_steps = 5 if small else 200
+    if staged_stacked is not None:
+        n_steps = -(-n_steps // ksd) * ksd     # whole superstep groups
     windows = []
     for _ in range(1 if small else n_windows):
         t0 = time.perf_counter()
@@ -255,6 +279,7 @@ def device_step_bench(small: bool, mode: str = "allreduce",
         "device_kind": kind,
         "storage": storage,
         "dense_sync_mode": mode,
+        "steps_per_dispatch": ksd,
         "devices": n_dev,
         "global_batch": batch,
         "steps": n_steps,

@@ -94,6 +94,36 @@ def test_spread_pass_grows_minimally():
     assert tr.cfg.capacity_factor <= 4.0
 
 
+def test_eval_tail_skew_rescans_and_stays_isolated():
+    """A train-pass memo (tail dropped) must NOT satisfy an eval pass
+    that scores the padded tail: 4 spread full batches + a half-batch
+    tail flooding one shard. Eval must rescan (drop_last key), size its
+    OWN capacity, drop nothing — and leave the train factor alone."""
+    mesh = make_mesh(8)
+    n_full = 4 * BATCH
+
+    def keys(rng, n, s):
+        ks = (rng.integers(0, 4096, size=n)
+              | (np.int64(s + 1) << 40)).astype(np.int64)
+        # tail examples: contiguous distinct keys -> one shard
+        tail = np.arange(n - n_full, dtype=np.int64) * NUM_SLOTS \
+            + s + 10_000_000
+        ks[n_full:] = tail
+        return ks
+
+    ds, schema = _dataset(n_full + BATCH // 2, keys, seed=2)
+    tr = _trainer(schema, mesh)
+    out = tr.train_pass(ds)           # tail dropped by drop_last
+    assert out["routed_dropped"] == 0
+    train_capf = tr.cfg.capacity_factor
+    with warnings.catch_warnings():
+        warnings.simplefilter("error")
+        ev = tr.eval_pass(ds)         # scores the padded tail
+    assert ev["routed_dropped"] == 0
+    assert tr.cfg.capacity_factor == train_capf   # train step untouched
+    assert tr._eval_capacity >= train_capf
+
+
 def test_preplan_off_falls_back_to_adaptive():
     """With the flag off, the old behavior (lossy first pass + warn +
     doubling) remains — the backstop path stays exercised."""

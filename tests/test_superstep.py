@@ -1,0 +1,107 @@
+"""k-microbatch superstep (TrainerConfig.steps_per_dispatch): one
+lax.scan dispatch per k packed batches must be NUMERICALLY IDENTICAL to
+k sequential single-step dispatches — same math, same order; only the
+program-launch count changes. Tail groups (dataset length not a multiple
+of k) fall back to the single-step program mid-pass.
+"""
+
+import numpy as np
+
+import jax
+
+from paddlebox_tpu.data import DataFeedSchema
+from paddlebox_tpu.data.dataset import SlotDataset
+from paddlebox_tpu.data.slot_record import SlotRecordBatch
+from paddlebox_tpu.embedding import EmbeddingConfig, HostEmbeddingStore
+from paddlebox_tpu.models import DeepFMModel
+from paddlebox_tpu.parallel import make_mesh
+from paddlebox_tpu.train import Trainer, TrainerConfig
+
+NUM_SLOTS, EMB_DIM, BATCH = 4, 4, 16
+
+
+def _dataset(n_ex, seed=0):
+    schema = DataFeedSchema.ctr(num_sparse=NUM_SLOTS, num_float=1,
+                                batch_size=BATCH, max_len=1)
+    rng = np.random.default_rng(seed)
+    offs = np.arange(n_ex + 1, dtype=np.int64)
+    ds = SlotDataset(schema)
+    ds.records = SlotRecordBatch(
+        schema=schema, num=n_ex,
+        sparse_values=[(rng.integers(1, 400, size=n_ex).astype(np.int64)
+                        | (np.int64(s + 1) << np.int64(40)))
+                       for s in range(NUM_SLOTS)],
+        sparse_offsets=[offs.copy() for _ in range(NUM_SLOTS)],
+        float_values=[(rng.random(n_ex) < 0.3).astype(np.float32),
+                      rng.normal(size=n_ex).astype(np.float32)],
+        ins_id=np.zeros(n_ex, dtype=np.uint64),
+        search_id=np.zeros(n_ex, dtype=np.uint64),
+        rank=np.zeros(n_ex, dtype=np.int32),
+        cmatch=np.zeros(n_ex, dtype=np.int32))
+    return ds, schema
+
+
+def _train(n_dev, steps_per_dispatch, n_batches=6):
+    ds, schema = _dataset(n_batches * BATCH)
+    store = HostEmbeddingStore(EmbeddingConfig(dim=EMB_DIM,
+                                               learning_rate=0.05))
+    mesh = make_mesh(n_dev)
+    tr = Trainer(DeepFMModel(num_slots=NUM_SLOTS, emb_dim=EMB_DIM,
+                             dense_dim=1, hidden=(8,)),
+                 store, schema, mesh,
+                 TrainerConfig(global_batch_size=BATCH,
+                               steps_per_dispatch=steps_per_dispatch))
+    out = tr.train_pass(ds)
+    table = np.asarray(tr.feed_mgr.current_ws.table) \
+        if hasattr(tr.feed_mgr, "current_ws") else None
+    params = jax.tree.map(np.asarray, tr.params)
+    return out, params, tr, store
+
+
+def test_superstep_matches_single_step_trajectory():
+    """6 batches at k=4 -> one stacked superstep + 2 tail singles; the
+    loss list, final dense params, and persisted store rows must match
+    the k=1 run (scan of the same body in the same order)."""
+    out1, params1, tr1, store1 = _train(8, 1)
+    out4, params4, tr4, store4 = _train(8, 4)
+    assert tr4._superstep_fn is not None
+    assert tr1._superstep_fn is None
+    assert out1["steps"] == out4["steps"] == 6
+    np.testing.assert_allclose(out1["loss_mean"], out4["loss_mean"],
+                               rtol=1e-6)
+    np.testing.assert_allclose(out1["loss_first"], out4["loss_first"],
+                               rtol=1e-6)
+    np.testing.assert_allclose(out1["loss_last"], out4["loss_last"],
+                               rtol=1e-6)
+    jax.tree.map(lambda a, b: np.testing.assert_allclose(a, b, rtol=1e-5,
+                                                         atol=1e-7),
+                 params1, params4)
+    # persisted sparse rows identical
+    keys = np.sort(np.unique(np.concatenate(
+        [np.asarray(v) for v in
+         _dataset(6 * BATCH)[0].records.sparse_values]))).astype(np.uint64)
+    r1 = store1.peek_rows(keys)
+    r4 = store4.peek_rows(keys)
+    np.testing.assert_allclose(r1, r4, rtol=1e-5, atol=1e-7)
+
+
+def test_superstep_single_chip():
+    out1, *_ = _train(1, 1, n_batches=4)
+    out4, *_ = _train(1, 4, n_batches=4)
+    np.testing.assert_allclose(out1["loss_mean"], out4["loss_mean"],
+                               rtol=1e-6)
+    assert out1["auc"] == out4["auc"]
+
+
+def test_superstep_disabled_for_other_modes():
+    ds, schema = _dataset(2 * BATCH)
+    store = HostEmbeddingStore(EmbeddingConfig(dim=EMB_DIM))
+    tr = Trainer(DeepFMModel(num_slots=NUM_SLOTS, emb_dim=EMB_DIM,
+                             dense_dim=1, hidden=(8,)),
+                 store, schema, make_mesh(8),
+                 TrainerConfig(global_batch_size=BATCH,
+                               dense_sync_mode="kstep",
+                               steps_per_dispatch=4))
+    assert tr._superstep_fn is None
+    out = tr.train_pass(ds)
+    assert np.isfinite(out["loss_mean"])

@@ -191,9 +191,17 @@ def test_capacity_drops_surface_and_adapt(mesh8):
                  store, schema, mesh8,
                  TrainerConfig(global_batch_size=64, capacity_factor=1.0))
     before = stat_get("trainer.routed_dropped")
+    # the proactive preplan (test_capacity_preplan.py) would size the
+    # capacity first and make this pass lossless; this test certifies
+    # the adaptive BACKSTOP, so force the lossy path
+    old_preplan = flags.routed_capacity_preplan
+    flags.routed_capacity_preplan = False
     with warnings.catch_warnings(record=True) as wlist:
         warnings.simplefilter("always")
-        out = tr.train_pass(ds)
+        try:
+            out = tr.train_pass(ds)
+        finally:
+            flags.routed_capacity_preplan = old_preplan
     assert out["routed_dropped"] > 0
     assert stat_get("trainer.routed_dropped") > before
     assert any("all_to_all capacity" in str(w.message) for w in wlist)
@@ -214,12 +222,15 @@ def test_capacity_drop_fatal_flag(mesh8):
                  store, schema, mesh8,
                  TrainerConfig(global_batch_size=64, capacity_factor=1.0))
     old = flags.routed_drop_fatal
+    old_preplan = flags.routed_capacity_preplan
     flags.routed_drop_fatal = True
+    flags.routed_capacity_preplan = False   # certify the fatal backstop
     try:
         with pytest.raises(RuntimeError, match="all_to_all capacity"):
             tr.train_pass(ds)
     finally:
         flags.routed_drop_fatal = old
+        flags.routed_capacity_preplan = old_preplan
 
 
 def test_train_pass_preloads_next_working_set(mesh8):
