@@ -57,6 +57,11 @@ class AucRunner:
         candidate pool (RecordReplace without the replace-back dance)."""
         pool = self._pools[slot_name]
         ds = copy.copy(dataset)
+        # the shallow copy carries the trainer's capacity-preplan memo,
+        # but this copy's RESAMPLED slot routes differently — it must
+        # re-scan, not inherit the baseline's capacity
+        if hasattr(ds, "_pbtpu_preplan_need"):
+            del ds._pbtpu_preplan_need
         rec = copy.copy(dataset.records)
         rec.sparse_values = list(rec.sparse_values)
         names = [s.name for s in dataset.schema.sparse_slots]

@@ -954,9 +954,12 @@ class Trainer:
         # length would go stale — the adaptive-doubling backstop in
         # _check_dropped still catches that.
         # drop_last is part of the key: a train-pass scan (tail dropped)
-        # must not satisfy an eval pass that scores the padded tail
-        memo_key = (dataset.num_examples, ws.padded_rows, drop_last)
-        memo = getattr(dataset, "_pbtpu_preplan_need", None)
+        # must not satisfy an eval pass that scores the padded tail.
+        # Duck-typed: a dataset without num_examples just rescans.
+        n_ex = getattr(dataset, "num_examples", None)
+        memo_key = (n_ex, ws.padded_rows, drop_last)
+        memo = (getattr(dataset, "_pbtpu_preplan_need", None)
+                if n_ex is not None else None)
         if memo is not None and memo[0] == memo_key:
             capf = memo[1]
         else:
@@ -985,10 +988,11 @@ class Trainer:
             # bound is safe for both paths
             need = max_c * n_dev / n_local
             capf = min(float(n_dev), max(1.0, -(-need * 4 // 1) / 4))
-            try:
-                dataset._pbtpu_preplan_need = (memo_key, capf)
-            except AttributeError:
-                pass                      # slots-restricted dataset type
+            if n_ex is not None:
+                try:
+                    dataset._pbtpu_preplan_need = (memo_key, capf)
+                except AttributeError:
+                    pass                  # slots-restricted dataset type
         from paddlebox_tpu.utils.profiler import stat_add
         if for_eval:
             # a skewed EVAL dataset must never inflate the train step's
