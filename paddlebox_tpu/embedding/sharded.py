@@ -120,13 +120,24 @@ def push(table: jnp.ndarray, idx: jnp.ndarray, grads: jnp.ndarray,
         return pallas_kernels.binned_push(
             table, idx, grads, shows, clks, cfg,
             n_split=config_flags.binned_push_splits, plan=plan)
-    payload = jnp.concatenate(
-        [grads, shows[:, None], clks[:, None],
-         jnp.ones((n, 1), grads.dtype)], axis=1)
     gw = cfg.grad_width
     n_rows = quant.table_rows(table)
-    acc = jnp.zeros((n_rows, gw + 3), payload.dtype)
-    acc = acc.at[idx].add(payload, mode="drop")
+    if (config_flags.binned_push
+            and pallas_kernels.binned_acc_supported(cfg, n_rows)):
+        # quantized tables reuse the scatter-free merge: the kernel's
+        # acc contract is storage-agnostic, and the in-step scatter it
+        # replaces measured ~13ms of the 20.8ms int16 step (dim 8,
+        # batch 8192, one v5e — same win as the f32 path)
+        acc = pallas_kernels.binned_merge_acc(
+            idx, grads, shows, clks, cfg, n_rows,
+            n_split=config_flags.binned_push_splits, plan=plan,
+            vma=getattr(jax.typeof(table.fp), "vma", frozenset()))
+    else:
+        payload = jnp.concatenate(
+            [grads, shows[:, None], clks[:, None],
+             jnp.ones((n, 1), grads.dtype)], axis=1)
+        acc = jnp.zeros((n_rows, gw + 3), payload.dtype)
+        acc = acc.at[idx].add(payload, mode="drop")
     # Untouched rows keep their exact bits (stateful optimizers like adam
     # would otherwise decay momentum on every row; a quantized row must not
     # requantize — round twice — unless it really changed). The null row

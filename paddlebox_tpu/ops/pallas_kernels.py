@@ -389,6 +389,33 @@ def binned_push_geometry(cfg: EmbeddingConfig, n_rows: int):
 _geom_fallback_logged: set = set()
 
 
+def binned_acc_supported(cfg: EmbeddingConfig, n_rows: int) -> bool:
+    """Whether binned_merge_acc's geometry engages for this (cfg, rows)
+    on the current backend — the storage-agnostic half of
+    binned_push_supported (quantized tables check this directly; their
+    planes aren't a plain f32 array but the merge acc doesn't care).
+    The single engage predicate: binned_push_geometry already folds in
+    the G=1 scatter preference."""
+    if jax.default_backend() != "tpu":
+        return False
+    if binned_push_geometry(cfg, n_rows) is None:
+        # a geometry miss on an eligible narrow table is a perf loss
+        # that must be visible, not silent (ADVICE r2) — same policy as
+        # the f32 gate. G=1 misses are deliberate and unwarned.
+        geom = _bp_geometry(cfg, n_rows)
+        if geom is None:
+            key = (n_rows, cfg.grad_width)
+            if key not in _geom_fallback_logged:
+                _geom_fallback_logged.add(key)
+                import warnings
+                warnings.warn(
+                    f"binned_push geometry unavailable for table rows="
+                    f"{n_rows} grad_width={cfg.grad_width}; "
+                    f"falling back to the XLA scatter path")
+        return False
+    return True
+
+
 def binned_push_supported(table, cfg: EmbeddingConfig) -> bool:
     """Engages on real-TPU f32 tables where the kernel MEASURES faster
     than the XLA scatter: narrow payloads (G >= 2 lane groups, dim <=
@@ -405,24 +432,7 @@ def binned_push_supported(table, cfg: EmbeddingConfig) -> bool:
     measured round over round."""
     if not isinstance(table, jnp.ndarray) or table.dtype != jnp.float32:
         return False
-    if jax.default_backend() != "tpu":
-        return False
-    geom = _bp_geometry(cfg, table.shape[0])
-    if geom is None or geom[2] == 1:
-        if geom is None:
-            # a geometry miss on a narrow table (odd row count) is a
-            # perf loss that must be visible, not silent (ADVICE r2);
-            # the G=1 scatter choice is deliberate and not warned
-            key = (table.shape[0], cfg.grad_width)
-            if key not in _geom_fallback_logged:
-                _geom_fallback_logged.add(key)
-                import warnings
-                warnings.warn(
-                    f"binned_push geometry unavailable for table rows="
-                    f"{table.shape[0]} grad_width={cfg.grad_width}; "
-                    f"falling back to the XLA scatter path")
-        return False
-    return True
+    return binned_acc_supported(cfg, table.shape[0])
 
 
 def binned_push(table: jnp.ndarray, idx: jnp.ndarray, grads: jnp.ndarray,
@@ -448,15 +458,37 @@ def binned_push(table: jnp.ndarray, idx: jnp.ndarray, grads: jnp.ndarray,
     interpret=True runs the Pallas interpreter (CPU test path).
     """
     n_rows = table.shape[0]
+    vma = getattr(jax.typeof(table), "vma", frozenset())
+    acc = binned_merge_acc(idx, grads, shows, clks, cfg, n_rows,
+                           n_split=n_split, plan=plan,
+                           interpret=interpret, vma=vma)
+    gw = cfg.grad_width
+    new_rows = apply_updates(table, acc[:, :gw], acc[:, gw],
+                             acc[:, gw + 1], cfg)
+    touched = acc[:, gw + 2] > 0
+    return jnp.where(touched[:, None], new_rows, table)
+
+
+def binned_merge_acc(idx: jnp.ndarray, grads: jnp.ndarray,
+                     shows: jnp.ndarray, clks: jnp.ndarray,
+                     cfg: EmbeddingConfig, n_rows: int, n_split: int = 3,
+                     plan=None, interpret: bool = False,
+                     vma=None) -> jnp.ndarray:
+    """The kernel's merge half alone: the (n_rows, grad_width+3) per-row
+    accumulator [summed grads, show, clk, touch_count] — identical
+    contract to the XLA scatter-add acc, so storage variants (quantized
+    tables dequant->update->requant around it) reuse the scatter-free
+    merge without the kernel knowing their row encoding."""
     geom = _bp_geometry(cfg, n_rows)
-    assert geom is not None, "caller must check binned_push_supported"
+    assert geom is not None, "caller must check binned geometry support"
     P, PP, G, SB = geom
     NB = n_rows // SB
     TILE = _bp_tile(SB, G)
     packed, rstart, end = _bp_pack(idx, grads, shows, clks, geom, TILE,
                                    n_rows, plan)
     W = packed.shape[1]
-    vma = getattr(jax.typeof(table), "vma", frozenset())
+    if vma is None:
+        vma = getattr(jax.typeof(grads), "vma", frozenset())
     RB = SB // G
     AW = _bp_acc_width(G, PP)
     kernel = functools.partial(_binned_acc_kernel, PP=PP,
@@ -473,12 +505,6 @@ def binned_push(table: jnp.ndarray, idx: jnp.ndarray, grads: jnp.ndarray,
                             pltpu.SemaphoreType.DMA((2,))]),
         interpret=interpret,
     )(rstart, end, packed)
-    # untangle the grouped layout (fuses into the update pass) and apply
-    # the optimizer as ONE full-width XLA pass over the table
-    acc = acc_g[:, :G * PP].reshape(NB, RB, G, PP).transpose(
+    # untangle the grouped layout (fuses into the consumer's update pass)
+    return acc_g[:, :G * PP].reshape(NB, RB, G, PP).transpose(
         0, 2, 1, 3).reshape(n_rows, PP)[:, :P]
-    gw = cfg.grad_width
-    new_rows = apply_updates(table, acc[:, :gw], acc[:, gw],
-                             acc[:, gw + 1], cfg)
-    touched = acc[:, gw + 2] > 0
-    return jnp.where(touched[:, None], new_rows, table)

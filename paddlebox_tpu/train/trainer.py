@@ -216,11 +216,11 @@ class Trainer:
                 "dense-sync mode only")
         # Host-side binned-push plan (native counting sort in the pack
         # pipeline) replaces the on-device argsort of the scatter-free
-        # push — single-shard TPU f32 tables only (post-all_to_all tokens
-        # have no host plan). Read at trace time like the other kernels.
+        # push — single-shard TPU tables only (post-all_to_all tokens
+        # have no host plan); quantized storage rides the same merge acc
+        # and uses the plan too. Read at trace time like the kernels.
         self._use_plan = (
             self.n_shards == 1 and config_flags.binned_push
-            and self.store.cfg.storage == "f32"
             and jax.default_backend() == "tpu")
         # eval capacity can grow past the train factor (skewed eval-only
         # datasets) without ever touching the train step's compilation

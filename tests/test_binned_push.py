@@ -171,6 +171,29 @@ def test_parity_wide_dims(dim):
     np.testing.assert_allclose(got, want, atol=2e-5, rtol=1e-4)
 
 
+def test_merge_acc_matches_scatter_acc():
+    """binned_merge_acc's contract is the scatter-add accumulator
+    exactly (quantized tables build their dequant->update->requant pass
+    on top of it): same sums, same touch counts, out-of-range dropped."""
+    cfg = EmbeddingConfig(dim=8, optimizer="adagrad")
+    _, idx, grads, shows, clks = _case(cfg, seed=21, tok=1500)
+    idx = jnp.asarray(np.where(np.arange(1500) % 7 == 0, N,
+                               np.asarray(idx)).astype(np.int32))
+    payload = np.concatenate(
+        [np.asarray(grads), np.asarray(shows)[:, None],
+         np.asarray(clks)[:, None], np.ones((1500, 1), np.float32)],
+        axis=1)
+    want = np.zeros((N, cfg.grad_width + 3), np.float32)
+    ii = np.asarray(idx)
+    keep = ii < N
+    np.add.at(want, ii[keep], payload[keep])
+    got = np.asarray(pk.binned_merge_acc(idx, grads, shows, clks, cfg, N,
+                                         interpret=True))
+    np.testing.assert_allclose(got, want, atol=2e-5, rtol=1e-4)
+    # touch counts are exact integers
+    np.testing.assert_array_equal(got[:, -1], want[:, -1])
+
+
 def test_parity_wide_with_host_plan():
     cfg = EmbeddingConfig(dim=64, optimizer="sgd", learning_rate=0.1)
     table, idx, grads, shows, clks = _case(cfg, seed=13, tok=800)
