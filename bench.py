@@ -275,10 +275,19 @@ def device_step_bench(small: bool, mode: str = "allreduce",
                        and audit["implied_hbm_frac"] <= 1.0)
     else:
         audit["ok"] = True  # unknown hardware (CPU smoke): no peak table
+    from paddlebox_tpu.ops import pallas_kernels as _pk
     detail = {
         "device_kind": kind,
         "storage": storage,
         "dense_sync_mode": mode,
+        # which merge engine the step compiled with (the per-width
+        # crossover rule — binned_push_supported docstring). The kernel
+        # engages per SHARD, so the per-shard row count decides.
+        "push_engine": ("binned_kernel"
+                        if (config_flags.binned_push
+                            and _pk.binned_acc_supported(
+                                emb_cfg, ws.rows_per_shard))
+                        else "xla_scatter"),
         "steps_per_dispatch": ksd,
         "devices": n_dev,
         "global_batch": batch,
@@ -603,6 +612,7 @@ def _enrich(small: bool, detail: dict, ctx: dict) -> None:
                 matrix[mname] = {
                     "examples_per_sec_per_chip": round(m_eps, 1),
                     "step_seconds": m_detail["audit"]["step_seconds"],
+                    "push_engine": m_detail["push_engine"],
                 }
             except Exception as e:   # a matrix point must not kill the run
                 matrix[mname] = {"error": repr(e)}

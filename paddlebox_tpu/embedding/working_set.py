@@ -280,11 +280,12 @@ class PassWorkingSet:
         # per shard on rps rows, so the alignment target is rps, not the
         # global row count) — big tables get big-block divisibility,
         # small ones keep the cheap 4096 alignment; the waste is zero
-        # rows that are never indexed
+        # rows that are never indexed. Quantized storage rides the same
+        # merge accumulator (binned_merge_acc), so it gets the same
+        # alignment — _bp_lanes is the shared source of truth.
         if rps >= 4096:
             from paddlebox_tpu.ops.pallas_kernels import bp_row_alignment
-            align = (bp_row_alignment(cfg, rps)
-                     if cfg.storage == "f32" else 4096)
+            align = bp_row_alignment(cfg, rps)
             rps = -(-rps // align) * align
         n_pad = rps * n_shards
         host_table = np.zeros((n_pad, cfg.row_width), dtype=np.float32)

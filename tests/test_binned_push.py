@@ -194,6 +194,46 @@ def test_merge_acc_matches_scatter_acc():
     np.testing.assert_array_equal(got[:, -1], want[:, -1])
 
 
+def test_quant_push_binned_wiring(monkeypatch):
+    """The quantized push's binned branch end-to-end (gate, vma/plan
+    plumbing, requant over the kernel acc) against the quant scatter
+    path — backend-gated off on CPU, so force the gate and run the
+    kernel in interpret mode."""
+    from paddlebox_tpu.config import flags
+    from paddlebox_tpu.embedding import quant, sharded
+
+    cfg = EmbeddingConfig(dim=8, optimizer="adagrad", learning_rate=0.05,
+                          storage="int16")
+    rng = np.random.default_rng(31)
+    tok = 600
+    idx = jnp.asarray(rng.integers(0, N, size=tok).astype(np.int32))
+    grads = jnp.asarray(rng.normal(size=(tok, cfg.grad_width))
+                        .astype(np.float32) * 0.01)
+    shows = jnp.ones(tok, jnp.float32)
+    clks = jnp.zeros(tok, jnp.float32)
+    host = (rng.normal(size=(N, cfg.row_width)) * 0.01).astype(np.float32)
+    want_tbl = sharded.push(quant.device_table(host.copy(), cfg, None),
+                            idx, grads, shows, clks, cfg)
+
+    monkeypatch.setattr(pk, "binned_acc_supported", lambda c, n: True)
+    orig_acc = pk.binned_merge_acc
+    monkeypatch.setattr(
+        pk, "binned_merge_acc",
+        lambda *a, **k: orig_acc(*a, **{**k, "interpret": True}))
+    old = flags.binned_push
+    flags.binned_push = True
+    try:
+        got_tbl = sharded.push(quant.device_table(host.copy(), cfg, None),
+                               idx, grads, shows, clks, cfg)
+    finally:
+        flags.binned_push = old
+    want = quant.decode_rows_np(np.asarray(want_tbl.fp),
+                                np.asarray(want_tbl.qx), cfg)
+    got = quant.decode_rows_np(np.asarray(got_tbl.fp),
+                               np.asarray(got_tbl.qx), cfg)
+    np.testing.assert_allclose(got, want, atol=2e-5, rtol=1e-4)
+
+
 def test_parity_wide_with_host_plan():
     cfg = EmbeddingConfig(dim=64, optimizer="sgd", learning_rate=0.1)
     table, idx, grads, shows, clks = _case(cfg, seed=13, tok=800)
