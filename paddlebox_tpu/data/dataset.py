@@ -40,7 +40,7 @@ class SlotDataset:
         self.pipe_command: str | None = None
         self.parser_plugin: ParserPlugin | None = None
         self.with_ins_id = False
-        self.records: SlotRecordBatch | None = None
+        self.records = None
         self.date: int | None = None
         self._preload: concurrent.futures.Future | None = None
         self._pool = None
@@ -49,6 +49,19 @@ class SlotDataset:
         self._lock = threading.Lock()
         # per-device slices set by prepare_train
         self._shards: list[SlotRecordBatch] = []
+
+    # every rebind of the record batch bumps a version counter so pass-
+    # level caches keyed on dataset content (Trainer._preplan_capacity's
+    # capacity memo) invalidate when records are swapped behind an
+    # unchanged num_examples (ADVICE r4; auc_runner ablation rebinds)
+    @property
+    def records(self) -> SlotRecordBatch | None:
+        return self._records
+
+    @records.setter
+    def records(self, value: SlotRecordBatch | None) -> None:
+        self._records = value
+        self._records_version = getattr(self, "_records_version", 0) + 1
 
     # ---- configuration (BoxPSDataset python API, dataset.py:1081-1191) ----
 
@@ -134,8 +147,10 @@ class SlotDataset:
         rng = np.random.default_rng(seed)
         rec = self.records
         sparse_names = [s.name for s in self.schema.sparse_slots]
-        for name in slot_names:
-            s = sparse_names.index(name)
+        # resolve every name BEFORE mutating: an unknown slot must not
+        # leave records half-shuffled with no version bump below
+        slot_idx = [sparse_names.index(name) for name in slot_names]
+        for s in slot_idx:
             vals, offs = rec.sparse_values[s], rec.sparse_offsets[s]
             lens = offs[1:] - offs[:-1]
             # permute whole per-example value LISTS across examples (the
@@ -154,6 +169,9 @@ class SlotDataset:
                 np.repeat(new_offs[:-1], new_lens)
             rec.sparse_values[s] = vals[src_start + local]
             rec.sparse_offsets[s] = new_offs
+        # in-place mutation changes per-example routing: pass-level caches
+        # keyed on content (capacity-preplan memo) must invalidate
+        self._records_version = getattr(self, "_records_version", 0) + 1
 
     def merge_by_ins_id(self, merge_size: int = 0) -> int:
         """Merge examples sharing an ins_id into one (MergeByInsId,

@@ -58,10 +58,9 @@ class AucRunner:
         pool = self._pools[slot_name]
         ds = copy.copy(dataset)
         # the shallow copy carries the trainer's capacity-preplan memo,
-        # but this copy's RESAMPLED slot routes differently — it must
-        # re-scan, not inherit the baseline's capacity
-        if hasattr(ds, "_pbtpu_preplan_need"):
-            del ds._pbtpu_preplan_need
+        # but this copy's RESAMPLED slot routes differently — the
+        # ds.records rebind below bumps _records_version, so the carried
+        # memo's key can no longer match and the copy re-scans
         rec = copy.copy(dataset.records)
         rec.sparse_values = list(rec.sparse_values)
         names = [s.name for s in dataset.schema.sparse_slots]

@@ -174,6 +174,11 @@ static int npy_parse(const uint8_t *data, uint64_t size, NpyArray *a) {
         } else cur++;
     }
     if (a->ndim == 0) a->ndim = 1;          /* scalar-ish: () treated (1,) */
+    if (a->ndim > 2) {
+        /* dims past index 1 would silently overwrite shape[1] above and
+         * the extent check below would then validate the wrong count */
+        fprintf(stderr, "npy ndim %d unsupported\n", a->ndim); return -1;
+    }
     /* the declared extent must fit the entry: a crafted shape like
      * (1e9,) over a few-KB member would otherwise send every later
      * reader (key_find binary search, plane pointers) far past the
@@ -191,12 +196,16 @@ static int npy_parse(const uint8_t *data, uint64_t size, NpyArray *a) {
         || (uint64_t)a->shape[1] > (1ull << 40)) {
         fprintf(stderr, "bad npy dtype/shape\n"); return -1;
     }
-    uint64_t need = (uint64_t)a->shape[0] * (uint64_t)a->shape[1]
-                    * (uint64_t)itemsize;
-    if (need > size - hoff - hlen) {
-        fprintf(stderr, "npy shape exceeds entry: need %llu have %llu\n",
-                (unsigned long long)need,
-                (unsigned long long)(size - hoff - hlen));
+    /* overflow-safe extent check: shape[0]*shape[1]*itemsize can wrap
+     * uint64 at the 2^40 per-dim cap (e.g. (2^40, 2^40) -> need == 0),
+     * so compare by division instead of multiplying */
+    uint64_t avail = size - hoff - hlen;
+    uint64_t rows = (uint64_t)a->shape[0], cols = (uint64_t)a->shape[1];
+    if (rows != 0 && cols != 0
+        && cols > avail / (uint64_t)itemsize / rows) {
+        fprintf(stderr, "npy shape exceeds entry: %llux%llux%ld have %llu\n",
+                (unsigned long long)rows, (unsigned long long)cols,
+                itemsize, (unsigned long long)avail);
         return -1;
     }
     a->data = data + (major == 1 ? 10 : 12) + hlen;
@@ -284,10 +293,17 @@ int main(int argc, char **argv) {
     for (; n_layers < 16; n_layers++) {
         char nm[64];
         snprintf(nm, sizeof nm, "mlp/%d/w", n_layers);
-        if (npz_get(&dense_z, nm, &W[n_layers])) break;
+        int rc = npz_get(&dense_z, nm, &W[n_layers]);
+        if (rc > 0) break;              /* not found = end of layers */
+        if (rc < 0) {
+            /* a CORRUPT entry must refuse, not truncate the MLP and
+             * silently score with fewer layers */
+            fprintf(stderr, "dense.npz: bad %s\n", nm); return 1;
+        }
         snprintf(nm, sizeof nm, "mlp/%d/b", n_layers);
         if (npz_get(&dense_z, nm, &Bb[n_layers])) {
-            fprintf(stderr, "dense.npz: missing %s\n", nm); return 1;
+            fprintf(stderr, "dense.npz: missing or bad %s\n", nm);
+            return 1;
         }
     }
     if (n_layers == 0) { fprintf(stderr, "dense.npz: no mlp layers\n");

@@ -694,6 +694,7 @@ class Trainer:
                     yield pb, self._stage_device(host_tuple)
                 return
             stk_sh = mesh_lib.stacked_batch_sharding(self.mesh)
+            n_sh = self.n_shards
             buf: list = []
             for item in raw:
                 buf.append(item)
@@ -701,6 +702,19 @@ class Trainer:
                     stacked = tuple(
                         np.stack(cols)
                         for cols in zip(*(ht for _, ht in buf)))
+                    # the extras protocol requires batch-leading arrays
+                    # (the step's shard_map in_specs shard dim 0); a 0-d
+                    # or per-batch-scalar extra would stack to (k,) and
+                    # fail deep inside the scan trace — fail loudly here
+                    # instead, naming the protocol
+                    for a in stacked:
+                        if a.ndim < 2 or a.shape[1] % n_sh:
+                            raise ValueError(
+                                "steps_per_dispatch>1 requires every "
+                                "host-batch leaf (incl. model "
+                                "batch_extras) to be batch-leading with "
+                                f"a mesh-divisible axis 0; got stacked "
+                                f"shape {a.shape} on a {n_sh}-way mesh")
                     yield ([pb for pb, _ in buf],
                            jax.device_put(stacked, stk_sh), True)
                     buf = []
@@ -955,9 +969,16 @@ class Trainer:
         # _check_dropped still catches that.
         # drop_last is part of the key: a train-pass scan (tail dropped)
         # must not satisfy an eval pass that scores the padded tail.
+        # the dataset's records version is too: records swapped in place
+        # behind an unchanged num_examples (the auc_runner rebinds
+        # ds.records per ablation) change routing, and the "lossy first
+        # pass impossible" guarantee must survive that. The ws itself
+        # needs no stamp — row assignment is by sorted-key rank, so an
+        # unchanged dataset always translates identically.
         # Duck-typed: a dataset without num_examples just rescans.
         n_ex = getattr(dataset, "num_examples", None)
-        memo_key = (n_ex, ws.padded_rows, drop_last)
+        memo_key = (n_ex, ws.padded_rows, drop_last,
+                    getattr(dataset, "_records_version", None))
         memo = (getattr(dataset, "_pbtpu_preplan_need", None)
                 if n_ex is not None else None)
         if memo is not None and memo[0] == memo_key:

@@ -35,7 +35,13 @@ def save_pytree(tree: Any, fname: str, compress: bool = True) -> str:
     fixed offset) so non-Python clients can mmap the arrays directly —
     the serving export uses this (native/serving_score.c)."""
     leaves = jax.tree_util.tree_flatten_with_path(tree)[0]
-    arrays = {_path_str(path): np.asarray(leaf) for path, leaf in leaves}
+    # force C order: XLA may hand back an F-contiguous view of its chosen
+    # device layout, and np.save would then write fortran_order=True —
+    # which the mmap-based C serving client (serving_score.c) rejects.
+    # (order="C", not ascontiguousarray: the latter promotes 0-d leaves
+    # like adam's count to (1,), breaking load_pytree's shape check)
+    arrays = {_path_str(path): np.asarray(leaf, order="C")
+              for path, leaf in leaves}
     os.makedirs(os.path.dirname(fname) or ".", exist_ok=True)
     (np.savez_compressed if compress else np.savez)(fname, **arrays)
     return fname
