@@ -93,6 +93,20 @@ class Flags:
     # Opt-in for lookup-dominated workloads: "auto" = 64 (or 128 for
     # wide rows); 0 = logical width; N = explicit width >= row_width.
     table_pad_width: Any = 0                # (new)
+    # Host-plan dedup pre-merge (the reference's DedupKeysAndFillIdx +
+    # PushMergeCopy pairing, box_wrapper_impl.h:103): the pack thread's
+    # counting sort additionally emits unique-row segment bounds, and
+    # the device segment-sums per-token payloads onto one lane per
+    # unique row BEFORE the merge engine runs — each duplicate crosses
+    # the engine once. "auto" = geometries where the in-step A/B
+    # measured a win (see sharded.push); "on"/"off" force. Trace-time,
+    # single-shard TPU tables only (like the plan itself).
+    push_dedup_premerge: str = "auto"       # (new)
+    # Merge-engine override for A/B runs: "auto" picks per width
+    # (binned kernel at G>=2 lane groups, XLA scatter at G=1 — the
+    # measured crossover, binned_push_supported); "kernel"/"scatter"
+    # force one engine everywhere the geometry allows.
+    push_engine: str = "auto"               # (new)
 
     # --- trainer (trainer_desc.proto:100-108, flags.cc:591-597) ---
     param_sync_step: int = 1                # BoxPSWorkerParameter.sync_dense_step

@@ -87,6 +87,64 @@ def lookup(table: jnp.ndarray, idx: jnp.ndarray,
     return gate_pull(pulled, cfg).reshape((*idx.shape, cfg.pull_width))
 
 
+def plan_premerge(idx: jnp.ndarray, grads: jnp.ndarray,
+                  shows: jnp.ndarray, clks: jnp.ndarray, plan):
+    """Device half of the host dedup plan: segment-sum per-token payloads
+    onto one lane per unique row (the merge half of the reference's
+    DedupKeysAndFillIdx + PushMergeCopy pairing, box_wrapper_impl.h:103,
+    box_wrapper.cu:630-830).
+
+    The host counting sort (native pbtpu_dedup_plan) already grouped
+    tokens by row, so the sum is a cumsum over the sorted payload
+    differenced at the (sorted, ascending) segment ends — no argsort, no
+    per-duplicate scatter. Pad lanes carry zero-width segments and
+    ascending out-of-range row ids, so downstream engines drop them and
+    the scatter engine may legally promise sorted+unique indices.
+
+    Returns (uniq_idx, merged_grads, merged_shows, merged_clks,
+    kernel_plan) — kernel_plan is (None, rstart, end) unique-lane DMA
+    windows (order=None: already sorted), or None when the plan carries
+    no kernel windows (scatter-engine widths)."""
+    order, rstart, endb, uniq, segend = plan
+    pay = jnp.concatenate([grads, shows[:, None], clks[:, None]], axis=1)
+    s_pay = jnp.take(pay, order, axis=0)
+    cs = jnp.concatenate(
+        [jnp.zeros((1, pay.shape[1]), pay.dtype),
+         jnp.cumsum(s_pay, axis=0)], axis=0)
+    starts = jnp.concatenate(
+        [jnp.zeros((1,), segend.dtype), segend[:-1]])
+    # boundary gathers ride the sorted-indices fast path (segend/starts
+    # ascend by construction)
+    dnums = lax.GatherDimensionNumbers(
+        offset_dims=(1,), collapsed_slice_dims=(0,), start_index_map=(0,))
+    slice_sizes = (1, cs.shape[1])
+    hi = lax.gather(cs, segend[:, None], dnums, slice_sizes,
+                    indices_are_sorted=True, mode="clip")
+    lo = lax.gather(cs, starts[:, None], dnums, slice_sizes,
+                    indices_are_sorted=True, mode="clip")
+    m = hi - lo
+    gw = grads.shape[1]
+    kplan = (None, rstart, endb) if rstart.shape[0] else None
+    return uniq, m[:, :gw], m[:, gw], m[:, gw + 1], kplan
+
+
+def _normalize_plan(plan):
+    """(plan3_or_None, premerge5_or_None) from a caller plan tuple.
+
+    Plans arrive as 3-tuples (order, rstart, end — the kernel grouping),
+    or 5-tuples (+ uniq, segend — the dedup pre-merge); zero-length
+    leading arrays mean the corresponding half is absent (the jit static
+    branch)."""
+    if plan is None:
+        return None, None
+    if len(plan) == 3:
+        return (plan if plan[0].shape[0] else None), None
+    order, rstart, endb, uniq, segend = plan
+    if uniq.shape[0]:
+        return None, plan
+    return ((order, rstart, endb) if order.shape[0] else None), None
+
+
 def push(table: jnp.ndarray, idx: jnp.ndarray, grads: jnp.ndarray,
          shows: jnp.ndarray, clks: jnp.ndarray,
          cfg: EmbeddingConfig, plan=None) -> jnp.ndarray:
@@ -110,6 +168,15 @@ def push(table: jnp.ndarray, idx: jnp.ndarray, grads: jnp.ndarray,
     very large working sets pick a sharded mesh (each shard scans only its
     rows).
     """
+    kplan, dplan = _normalize_plan(plan)
+    premerged = False
+    if dplan is not None:
+        # host dedup plan: segment-sum duplicates onto unique lanes
+        # first, so whichever engine runs below sees each touched row
+        # once (852k multi-hot tokens -> ~330k unique lanes)
+        idx, grads, shows, clks, kplan = plan_premerge(
+            idx, grads, shows, clks, dplan)
+        premerged = True
     n = idx.shape[0]
     if (config_flags.binned_push and not quant.is_quant(table)
             and pallas_kernels.binned_push_supported(table, cfg)):
@@ -119,7 +186,7 @@ def push(table: jnp.ndarray, idx: jnp.ndarray, grads: jnp.ndarray,
         # measures faster (binned_push_supported docstring)
         return pallas_kernels.binned_push(
             table, idx, grads, shows, clks, cfg,
-            n_split=config_flags.binned_push_splits, plan=plan)
+            n_split=config_flags.binned_push_splits, plan=kplan)
     gw = cfg.grad_width
     n_rows = quant.table_rows(table)
     if (config_flags.binned_push
@@ -130,19 +197,33 @@ def push(table: jnp.ndarray, idx: jnp.ndarray, grads: jnp.ndarray,
         # batch 8192, one v5e — same win as the f32 path)
         acc = pallas_kernels.binned_merge_acc(
             idx, grads, shows, clks, cfg, n_rows,
-            n_split=config_flags.binned_push_splits, plan=plan,
+            n_split=config_flags.binned_push_splits, plan=kplan,
             vma=getattr(jax.typeof(table.fp), "vma", frozenset()))
     else:
         payload = jnp.concatenate(
             [grads, shows[:, None], clks[:, None],
              jnp.ones((n, 1), grads.dtype)], axis=1)
         acc = jnp.zeros((n_rows, gw + 3), payload.dtype)
-        acc = acc.at[idx].add(payload, mode="drop")
+        # pre-merged lanes are ascending and distinct by construction
+        # (pads use ascending out-of-range ids), so the scatter may
+        # promise sorted+unique — the hints XLA needs to skip its
+        # conflict-safe serial path
+        acc = acc.at[idx].add(payload, mode="drop",
+                              indices_are_sorted=premerged,
+                              unique_indices=premerged)
     # Untouched rows keep their exact bits (stateful optimizers like adam
     # would otherwise decay momentum on every row; a quantized row must not
     # requantize — round twice — unless it really changed). The null row
     # only ever receives zero grads/increments (callers mask padding), and
     # a fresh zero row is a fixed point of every optimizer — it stays zero.
+    if (not quant.is_quant(table) and acc.shape[1] >= 64
+            and jax.default_backend() == "tpu"):
+        # wide accumulators: XLA's fused update+where degrades ~3x when
+        # the slice fusion consumes a computed acc (in-composition A/B
+        # on one v5e, dim 64, 213k tokens: 15.7ms vs 5.9ms with the
+        # single-custom-call merge_update; narrow accs show the
+        # opposite — dim 8: 2.8ms vs 4.7ms — and keep the XLA fusion)
+        return pallas_kernels.merge_update(table, acc, cfg)
     touched = acc[:, gw + 2] > 0
     if quant.is_quant(table):
         # dequant -> exact f32 update -> requant, one fused elementwise

@@ -79,11 +79,12 @@ def device_width(cfg: EmbeddingConfig) -> int:
     if not pad or cfg.storage != "f32":
         return rw
     if pad == "auto":
-        if rw <= 64:
-            return 64
-        if rw <= 128:
-            return 128
-        return rw
+        # width-aware: only the pathological gather zone pads (v5e
+        # 852k-row sweep: 14..63-lane gathers run 3-8x slower per row —
+        # 24.0ms at 38 lanes vs 5.1ms gathering 64-wide and slicing;
+        # 13-lane and >=64-lane sources are already on the fast path,
+        # and round 2 measured the dim-8 full step SLOWER padded)
+        return 64 if 16 <= rw < 64 else rw
     return max(rw, int(pad))
 
 

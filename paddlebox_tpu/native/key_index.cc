@@ -231,4 +231,75 @@ void pbtpu_block_plan(const int32_t* idx, int64_t n, int32_t super_block,
   }
 }
 
+// ---------------------------------------------------------------------
+// Dedup plan: counting sort by FULL row id + unique-row segment bounds —
+// the host half of the reference's DedupKeysAndFillIdx + PushMergeCopy
+// pairing (box_wrapper_impl.h:103, box_wrapper.cu:630-830). The device
+// pre-merge then segment-sums each unique row's payloads over the
+// already-grouped token order (no argsort, no per-duplicate scatter) and
+// both merge engines see ONE lane per unique row.
+//   idx      : (n,) int32 row ids; anything outside [0, n_rows) sorts
+//              into a sentinel bucket at the end (device drops it)
+//   order    : (n,) out — token positions sorted ascending by row id
+//   uniq     : (n,) out — ascending unique row ids; tail padded with
+//              n_rows + i (distinct AND ascending, so the scatter's
+//              unique/sorted promises hold; all >= n_rows -> dropped)
+//   segend   : (n,) out — exclusive end of unique i's token run in the
+//              sorted order; pads repeat n_valid (zero-width segments)
+//   rstart   : (n_blocks,) out — 8-aligned unique-LANE window starts
+//              per table super-block (binned kernel DMA windows)
+//   end      : (n_blocks,) out — exclusive unique-lane window ends
+// Returns the number of unique valid rows.
+int64_t pbtpu_dedup_plan(const int32_t* idx, int64_t n, int64_t n_rows,
+                         int32_t super_block, int64_t n_blocks,
+                         int32_t* order, int32_t* uniq, int32_t* segend,
+                         int32_t* rstart, int32_t* end) {
+  // counts over rows + one sentinel bucket for out-of-range ids
+  std::vector<int32_t> counts(static_cast<size_t>(n_rows) + 1, 0);
+  for (int64_t i = 0; i < n; ++i) {
+    int64_t r = idx[i];
+    if (r < 0 || r >= n_rows) r = n_rows;
+    ++counts[r];
+  }
+  // prefix over rows: token start offsets (reused as insert cursors),
+  // unique list, segment ends, and per-block unique-lane windows
+  if (n_blocks <= 0 || super_block <= 0) return -1;  // wrapper contract
+  std::vector<int64_t> cursor(static_cast<size_t>(n_rows) + 1, 0);
+  int64_t run = 0, u = 0, blk = -1;
+  for (int64_t r = 0; r < n_rows; ++r) {
+    cursor[r] = run;
+    if (counts[r] > 0) {
+      int64_t b = r / super_block;
+      if (b >= n_blocks) b = n_blocks - 1;
+      while (blk < b) {  // open blocks [blk+1, b]: start at lane u
+        ++blk;
+        rstart[blk] = static_cast<int32_t>((u / 8) * 8);
+        end[blk] = static_cast<int32_t>(u);
+      }
+      run += counts[r];
+      uniq[u] = static_cast<int32_t>(r);
+      segend[u] = static_cast<int32_t>(run);
+      end[blk] = static_cast<int32_t>(u + 1);
+      ++u;
+    }
+  }
+  while (blk + 1 < n_blocks) {  // trailing empty blocks
+    ++blk;
+    rstart[blk] = static_cast<int32_t>((u / 8) * 8);
+    end[blk] = static_cast<int32_t>(u);
+  }
+  const int64_t n_valid = run;
+  cursor[n_rows] = run;  // sentinel tokens go after every valid row
+  for (int64_t j = u; j < n; ++j) {  // pad lanes: distinct, ascending,
+    uniq[j] = static_cast<int32_t>(n_rows + (j - u));  // out of range
+    segend[j] = static_cast<int32_t>(n_valid);
+  }
+  for (int64_t i = 0; i < n; ++i) {
+    int64_t r = idx[i];
+    if (r < 0 || r >= n_rows) r = n_rows;
+    order[cursor[r]++] = static_cast<int32_t>(i);
+  }
+  return u;
+}
+
 }  // extern "C"

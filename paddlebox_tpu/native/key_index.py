@@ -36,6 +36,10 @@ def _configure(lib: ctypes.CDLL) -> None:
     lib.pbtpu_block_plan.restype = None
     lib.pbtpu_block_plan.argtypes = [i32p, c.c_int64, c.c_int32, c.c_int64,
                                      i32p, i32p, i32p]
+    lib.pbtpu_dedup_plan.restype = c.c_int64
+    lib.pbtpu_dedup_plan.argtypes = [i32p, c.c_int64, c.c_int64, c.c_int32,
+                                     c.c_int64, i32p, i32p, i32p, i32p,
+                                     i32p]
 
 
 def get_lib() -> ctypes.CDLL | None:
@@ -67,6 +71,52 @@ def block_plan(idx: np.ndarray, super_block: int, n_blocks: int
     ends = np.cumsum(counts)
     starts = ends - counts
     return (order, ((starts // 8) * 8).astype(np.int32),
+            ends.astype(np.int32))
+
+
+def dedup_plan(idx: np.ndarray, n_rows: int, super_block: int,
+               n_blocks: int) -> tuple[np.ndarray, ...]:
+    """Full-row counting sort + unique-row segment bounds (the host half
+    of the reference's DedupKeysAndFillIdx/PushMergeCopy pairing; see
+    key_index.cc pbtpu_dedup_plan for the array contracts).
+
+    Returns (order (n,), uniq (n,), segend (n,), rstart (n_blocks,),
+    end (n_blocks,)) int32. `uniq` pads with ascending out-of-range ids
+    and `segend` pads with zero-width segments, so the device pre-merge
+    needs no dynamic shapes. Native when available; numpy otherwise.
+    """
+    idx = np.ascontiguousarray(idx, dtype=np.int32)
+    n = len(idx)
+    assert n_blocks >= 1 and super_block >= 1
+    lib = get_lib()
+    if lib is not None:
+        order = np.empty(n, np.int32)
+        uniq = np.empty(n, np.int32)
+        segend = np.empty(n, np.int32)
+        rstart = np.empty(n_blocks, np.int32)
+        end = np.empty(n_blocks, np.int32)
+        lib.pbtpu_dedup_plan(idx, n, n_rows, super_block, n_blocks,
+                             order, uniq, segend, rstart, end)
+        return order, uniq, segend, rstart, end
+    r = np.where((idx < 0) | (idx >= n_rows), n_rows, idx)
+    order = np.argsort(r, kind="stable").astype(np.int32)
+    sr = r[order]
+    n_valid = int(np.searchsorted(sr, n_rows))
+    uniq_rows, first = np.unique(sr[:n_valid], return_index=True)
+    u = len(uniq_rows)
+    uniq = np.empty(n, np.int32)
+    uniq[:u] = uniq_rows
+    uniq[u:] = n_rows + np.arange(n - u, dtype=np.int32)
+    segend = np.full(n, n_valid, np.int32)
+    segend[:max(0, u - 1)] = first[1:]
+    # unique-lane windows per super-block (8-aligned starts, like
+    # block_plan; stale lanes below the aligned start are masked by the
+    # kernel's local-range check)
+    b = np.minimum(uniq_rows // super_block, n_blocks - 1)
+    counts = np.bincount(b, minlength=n_blocks)
+    ends = np.cumsum(counts)
+    return (order, uniq, segend,
+            (((ends - counts) // 8) * 8).astype(np.int32),
             ends.astype(np.int32))
 
 

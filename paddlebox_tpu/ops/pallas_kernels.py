@@ -338,14 +338,22 @@ def _bp_pack(idx, grads, shows, clks, geom, TILE: int, n_rows: int,
              plan=None):
     """Build the kernel's packed operand: tokens grouped by super-block,
     each row ``[payload_f32 (PP lanes) | id_hi | id_lo]`` padded to a
-    multiple of 8 lanes. Split out so bench.py's stage attribution can
-    time the prep separately from the kernel."""
+    multiple of 8 lanes (then to whole 128-lane tiles for the DMA).
+    Split out so bench.py's stage attribution can time the prep
+    separately from the kernel.
+
+    The token gather (``[order]``) runs at the FULL padded width: v5e
+    row gathers from 14..63-lane sources are 3-8x slower per row than
+    from >=64-lane ones (852k-token sweep: 23.2ms at 40 lanes vs 3.6ms
+    at 128), so the payload is padded/id-tagged BEFORE the reorder —
+    one extra elementwise pass, ~6x off the multi-hot pack cost."""
     P, PP, G, SB = geom
     NB = n_rows // SB
     tok = idx.shape[0]
-    payload = jnp.concatenate(
-        [grads, shows[:, None], clks[:, None],
-         jnp.ones((tok, 1), jnp.float32)], axis=1)
+    # Mosaic DMA slices must be 128-lane aligned (memref tiling (1,128));
+    # narrow payloads pad up to one lane tile, wide ones to the next
+    W = -(-(PP + 2) // 128) * 128
+    order = rstart = end = None
     if plan is None:
         order = jnp.argsort(idx)
         s_idx = idx[order]
@@ -356,21 +364,38 @@ def _bp_pack(idx, grads, shows, clks, geom, TILE: int, n_rows: int,
         end = bounds[1:]
     else:
         order, rstart, end = plan
-        s_idx = idx[order]
-    s_pay = payload[order]
-    # pad so the last tile's DMA stays in bounds; pad tokens carry row id
-    # n_rows, which every block's local-range mask rejects
-    s_idx = jnp.concatenate(
-        [s_idx, jnp.full((TILE,), n_rows, jnp.int32)])
-    s_pay = jnp.concatenate([s_pay, jnp.zeros((TILE, P), jnp.float32)])
-    s_pay = jnp.pad(s_pay, ((0, 0), (0, PP - P)))
-    hi = (s_idx // 4096).astype(jnp.float32)
-    lo = (s_idx % 4096).astype(jnp.float32)
-    packed = jnp.concatenate([s_pay, hi[:, None], lo[:, None]], axis=1)
-    # Mosaic DMA slices must be 128-lane aligned (memref tiling (1,128));
-    # narrow payloads pad up to one lane tile, wide ones to the next
-    W = -(-(PP + 2) // 128) * 128
-    packed = jnp.pad(packed, ((0, 0), (0, W - (PP + 2))))
+    # id digits: two exact integer-valued floats — f32 bit patterns of
+    # small ints are denormals and would flush; see kernel comment
+    hi = (idx // 4096).astype(jnp.float32)
+    lo = (idx % 4096).astype(jnp.float32)
+    if P < 16 and order is not None:
+        # narrow payloads gather fast at their logical width (v5e:
+        # 12-13-lane row gathers ~5-10ns/row) — reorder first, pad after
+        payload = jnp.concatenate(
+            [grads, shows[:, None], clks[:, None],
+             jnp.ones((tok, 1), jnp.float32)], axis=1)
+        s_pay = jnp.take(payload, order, axis=0)
+        packed = jnp.concatenate(
+            [s_pay, jnp.zeros((tok, PP - P), jnp.float32),
+             jnp.take(hi, order)[:, None], jnp.take(lo, order)[:, None],
+             jnp.zeros((tok, W - PP - 2), jnp.float32)], axis=1)
+    else:
+        # 16..63-lane gathers are pathological (3-8x/row) — pack to the
+        # full 128-lane-tile width FIRST, then one fast wide gather
+        pay_full = jnp.concatenate(
+            [grads, shows[:, None], clks[:, None],
+             jnp.ones((tok, 1), jnp.float32),
+             jnp.zeros((tok, PP - P), jnp.float32),
+             hi[:, None], lo[:, None],
+             jnp.zeros((tok, W - PP - 2), jnp.float32)], axis=1)
+        packed = (pay_full if order is None        # pre-merged: sorted
+                  else jnp.take(pay_full, order, axis=0))
+    # pad so the last tile's DMA stays in bounds; pad tokens carry row
+    # id n_rows, which every block's local-range mask rejects
+    pad_block = jnp.zeros((TILE, W), jnp.float32)
+    pad_block = pad_block.at[:, PP].set(float(n_rows // 4096))
+    pad_block = pad_block.at[:, PP + 1].set(float(n_rows % 4096))
+    packed = jnp.concatenate([packed, pad_block], axis=0)
     return packed, rstart, end
 
 
@@ -378,12 +403,29 @@ def binned_push_geometry(cfg: EmbeddingConfig, n_rows: int):
     """(super_block, n_blocks) for host-side plan building, or None when
     the dispatch keeps the scatter (no geometry, or wide rows where the
     scatter measures faster — see binned_push_supported) and a plan
-    would be wasted host work + H2D."""
+    would be wasted host work + H2D.
+
+    flags.push_engine overrides the per-width dispatch for A/B runs:
+    "kernel" keeps the kernel at G=1, "scatter" disables it everywhere.
+    """
     geom = _bp_geometry(cfg, n_rows)
-    if geom is None or geom[2] == 1:
+    if geom is None:
+        return None
+    from paddlebox_tpu.config import flags as config_flags
+    eng = config_flags.push_engine
+    if eng == "scatter" or (geom[2] == 1 and eng != "kernel"):
         return None
     _, _, _, SB = geom
     return SB, n_rows // SB
+
+
+def lane_groups(cfg: EmbeddingConfig, n_rows: int):
+    """G (payload row-groups per 128 dot lanes) for this geometry, or
+    None when no kernel geometry exists. G == 1 identifies the wide-row
+    widths whose dispatch keeps the XLA scatter (the dedup pre-merge's
+    "wide" criterion keys off this)."""
+    geom = _bp_geometry(cfg, n_rows)
+    return None if geom is None else geom[2]
 
 
 _geom_fallback_logged: set = set()

@@ -42,8 +42,12 @@ from paddlebox_tpu.utils.timer import StageTimers
 
 # arity of the binned-push host plan inside a staged batch tuple:
 # (idx, mask, dense, labels, *plan[PLAN_ARITY], *extras) — _pack_host,
-# _host_plan, and eval_pass's extras slice all key off this
-PLAN_ARITY = 3
+# _host_plan, and eval_pass's extras slice all key off this.
+# plan = (order, rstart, end, uniq, segend): the first three are the
+# kernel's token/block grouping, the last two the dedup pre-merge's
+# unique-row segment bounds (sharded.plan_premerge). Zero-length
+# arrays = that half is absent (the jit static branch).
+PLAN_ARITY = 5
 
 
 @dataclasses.dataclass
@@ -309,9 +313,10 @@ class Trainer:
         dedup = config_flags.pullpush_dedup_keys and self.n_shards > 1
 
         def core(tshard, idx_l, mask_l, dense_l, labels_l, params,
-                 order, rstart, endb, *extras_l):
-            # zero-length order == "no host plan" (static shape branch)
-            plan = (order, rstart, endb) if order.shape[0] else None
+                 order, rstart, endb, uniq, segb, *extras_l):
+            # zero-length arrays == "no host plan" (static shape branch)
+            plan = ((order, rstart, endb, uniq, segb)
+                    if order.shape[0] or uniq.shape[0] else None)
             B_l = idx_l.shape[0]
             flat_idx = idx_l.reshape(-1)
             if "lookup" in ablate:
@@ -382,12 +387,12 @@ class Trainer:
             # local dense update inside shard_map; params carry a leading
             # shard axis (each device trains its own copy between syncs)
             def body(tshard, idx_l, mask_l, dense_l, labels_l, p_st, o_st,
-                     order, rstart, endb):
+                     order, rstart, endb, uniq, segb):
                 p = jax.tree.map(lambda a: a[0], p_st)
                 o = jax.tree.map(lambda a: a[0], o_st)
                 new_shard, gp, loss, preds, drop_g = core(
                     tshard, idx_l, mask_l, dense_l, labels_l, p,
-                    order, rstart, endb)
+                    order, rstart, endb, uniq, segb)
                 updates, new_o = tx.update(gp, o, p)
                 new_p = optax.apply_updates(p, updates)
                 loss_g = lax.pmean(loss, axes)
@@ -396,16 +401,18 @@ class Trainer:
                         drop_g)
 
             def step(table, params, opt_state, idx, mask, dense, labels,
-                     order=_NO_PLAN, rstart=_NO_PLAN, endb=_NO_PLAN):
+                     order=_NO_PLAN, rstart=_NO_PLAN, endb=_NO_PLAN,
+                     uniq=_NO_PLAN, segb=_NO_PLAN):
                 return jax.shard_map(
                     body, mesh=self.mesh,
                     in_specs=(batch_spec, batch_spec, batch_spec, batch_spec,
                               batch_spec, batch_spec, batch_spec, batch_spec,
-                              batch_spec, batch_spec),
+                              batch_spec, batch_spec, batch_spec,
+                              batch_spec),
                     out_specs=(batch_spec, batch_spec, batch_spec, P(),
                                batch_spec, P()),
                 )(table, idx, mask, dense, labels, params, opt_state,
-                  order, rstart, endb)
+                  order, rstart, endb, uniq, segb)
 
             return jax.jit(step, donate_argnums=(0, 1, 2),
                            out_shardings=(tbl_sh, self._stacked_sh,
@@ -418,24 +425,25 @@ class Trainer:
             from jax.flatten_util import ravel_pytree
 
             def body(tshard, idx_l, mask_l, dense_l, labels_l, params,
-                     order, rstart, endb):
+                     order, rstart, endb, uniq, segb):
                 new_shard, gp, loss, preds, drop_g = core(
                     tshard, idx_l, mask_l, dense_l, labels_l, params,
-                    order, rstart, endb)
+                    order, rstart, endb, uniq, segb)
                 gp = _mean_replicated_grad(gp, axes)
                 loss_g = lax.pmean(loss, axes)
                 return new_shard, gp, loss_g, preds, drop_g
 
             def step(table, params, idx, mask, dense, labels,
-                     order=_NO_PLAN, rstart=_NO_PLAN, endb=_NO_PLAN):
+                     order=_NO_PLAN, rstart=_NO_PLAN, endb=_NO_PLAN,
+                     uniq=_NO_PLAN, segb=_NO_PLAN):
                 new_table, gp, loss, preds, drop_g = jax.shard_map(
                     body, mesh=self.mesh,
                     in_specs=(batch_spec, batch_spec, batch_spec, batch_spec,
                               batch_spec, P(), batch_spec, batch_spec,
-                              batch_spec),
+                              batch_spec, batch_spec, batch_spec),
                     out_specs=(batch_spec, P(), P(), batch_spec, P()),
                 )(table, idx, mask, dense, labels, params,
-                  order, rstart, endb)
+                  order, rstart, endb, uniq, segb)
                 gp_flat = ravel_pytree(gp)[0]
                 return new_table, gp_flat, loss, preds, drop_g
 
@@ -445,24 +453,25 @@ class Trainer:
         n_extras = self._n_extras
 
         def body(tshard, idx_l, mask_l, dense_l, labels_l, params,
-                 order, rstart, endb, *extras_l):
+                 order, rstart, endb, uniq, segb, *extras_l):
             new_shard, gp, loss, preds, drop_g = core(
                 tshard, idx_l, mask_l, dense_l, labels_l, params,
-                order, rstart, endb, *extras_l)
+                order, rstart, endb, uniq, segb, *extras_l)
             gp = _mean_replicated_grad(gp, axes)
             loss_g = lax.pmean(loss, axes)
             return new_shard, gp, loss_g, preds, drop_g
 
         def run_body(table, params, opt_state, idx, mask, dense, labels,
-                     order, rstart, endb, *extras):
+                     order, rstart, endb, uniq, segb, *extras):
             new_table, gp, loss, preds, drop_g = jax.shard_map(
                 body, mesh=self.mesh,
                 in_specs=(batch_spec, batch_spec, batch_spec, batch_spec,
                           batch_spec, P(), batch_spec, batch_spec,
-                          batch_spec) + (batch_spec,) * n_extras,
+                          batch_spec, batch_spec, batch_spec)
+                + (batch_spec,) * n_extras,
                 out_specs=(batch_spec, P(), P(), batch_spec, P()),
             )(table, idx, mask, dense, labels, params,
-              order, rstart, endb, *extras)
+              order, rstart, endb, uniq, segb, *extras)
             updates, new_opt = tx.update(gp, opt_state, params)
             new_params = optax.apply_updates(params, updates)
             return new_table, new_params, new_opt, loss, preds, drop_g
@@ -473,11 +482,12 @@ class Trainer:
             def step_flat(table, *args):
                 dstate = args[:n_dense]
                 (idx, mask, dense, labels, order, rstart,
-                 endb, *extras) = args[n_dense:]
+                 endb, uniq, segb, *extras) = args[n_dense:]
                 params, opt_state = unpack_fn(dstate)
                 new_table, new_params, new_opt, loss, preds, drop_g = \
                     run_body(table, params, opt_state, idx, mask, dense,
-                             labels, order, rstart, endb, *extras)
+                             labels, order, rstart, endb, uniq, segb,
+                             *extras)
                 return (new_table, *pack_fn(new_params, new_opt), loss,
                         preds, drop_g)
 
@@ -511,9 +521,11 @@ class Trainer:
                            + (repl, bat_sh, repl))
 
         def step(table, params, opt_state, idx, mask, dense, labels,
-                 order=_NO_PLAN, rstart=_NO_PLAN, endb=_NO_PLAN, *extras):
+                 order=_NO_PLAN, rstart=_NO_PLAN, endb=_NO_PLAN,
+                 uniq=_NO_PLAN, segb=_NO_PLAN, *extras):
             return run_body(table, params, opt_state, idx, mask, dense,
-                            labels, order, rstart, endb, *extras)
+                            labels, order, rstart, endb, uniq, segb,
+                            *extras)
 
         # Donation aliases the (large) table and the dense state in place;
         # pinned out_shardings make output signatures identical to the inputs
@@ -727,20 +739,50 @@ class Trainer:
             raw.close()
 
     def _host_plan(self, ws: PassWorkingSet, idx: np.ndarray):
-        """Binned-push token grouping, on the host pack pipeline
-        (pallas_kernels.binned_push's `plan`). Zero-length arrays mean
-        "no plan" — the step's static-shape branch then keeps the
+        """Binned-push token grouping + optional dedup pre-merge bounds,
+        on the host pack pipeline (pallas_kernels.binned_push's `plan` /
+        sharded.plan_premerge). Zero-length arrays mean "that half is
+        absent" — the step's static-shape branch then keeps the
         on-device grouping (or the XLA scatter path off-TPU)."""
-        empty = (np.zeros(0, np.int32),) * PLAN_ARITY
+        Z = np.zeros(0, np.int32)
+        empty = (Z,) * PLAN_ARITY
         if not self._use_plan:
             return empty
         from paddlebox_tpu.ops import pallas_kernels
         geom = pallas_kernels.binned_push_geometry(
             self.store.cfg, ws.padded_rows)
-        if geom is None:
-            return empty
-        from paddlebox_tpu.native.key_index import block_plan
-        return block_plan(idx.reshape(-1), geom[0], geom[1])
+        if not self._dedup_premerge(ws):
+            if geom is None:
+                return empty
+            from paddlebox_tpu.native.key_index import block_plan
+            o, r, e = block_plan(idx.reshape(-1), geom[0], geom[1])
+            return (o, r, e, Z, Z)
+        from paddlebox_tpu.native.key_index import dedup_plan
+        # scatter-engine widths carry no kernel windows; the counting
+        # sort still needs a block granularity — one whole-table block
+        SB, NB = geom if geom is not None else (ws.padded_rows, 1)
+        o, u, s, r, e = dedup_plan(idx.reshape(-1), ws.padded_rows,
+                                   SB, NB)
+        return (o, r, e, u, s) if geom is not None else (o, Z, Z, u, s)
+
+    def _dedup_premerge(self, ws: PassWorkingSet) -> bool:
+        """Whether the host plan carries dedup pre-merge bounds
+        (flags.push_dedup_premerge). "auto" = the geometries where the
+        round-5 in-step A/B on one v5e measured a win: multi-hot
+        batches (duplicate-heavy: 852k tokens -> ~330k unique at the
+        bench's multihot4 point) and wide scatter-engine rows (G=1,
+        where the per-token scatter is the bound). Single-hot
+        narrow-row batches measured neutral-to-slower (the premerge's
+        cumsum + boundary gathers cost more than the kernel saves at
+        ~1.2x duplication)."""
+        dd = config_flags.push_dedup_premerge
+        if dd != "auto":
+            return dd == "on"
+        from paddlebox_tpu.ops import pallas_kernels
+        multi_hot = self.layout.total_len > self.layout.num_slots
+        wide = pallas_kernels.lane_groups(
+            self.store.cfg, ws.padded_rows) == 1
+        return multi_hot or wide
 
     def train_pass(self, dataset, metrics: Any = None,
                    preload_keys: np.ndarray | None = None

@@ -13,7 +13,8 @@ test. This is the OpTest pattern of the reference
 step.
 
 Only the benchmark configuration is modeled: embed_w_num=1, no
-expand/gating thresholds, max_len=1 uniform slot layout, adagrad sparse
+expand/gating thresholds, uniform max_len slot layout (sum-pool over L
+tokens per slot; L=1 is the single-hot identity), adagrad sparse
 optimizer, adam dense optimizer, f32 or int16/int8 device storage.
 """
 
@@ -65,8 +66,10 @@ class GoldenDeepFM:
 
     def __init__(self, table, init_params, num_slots, emb_dim, dense_dim,
                  hidden, lr_sparse=0.05, initial_g2sum=3.0,
-                 dense_lr=1e-3, storage="f32", dense_opt="adam"):
+                 dense_lr=1e-3, storage="f32", dense_opt="adam",
+                 max_len=1):
         self.S, self.E, self.D = num_slots, emb_dim, dense_dim
+        self.L = max_len                        # tokens per slot (seqpool)
         self.row_width = table.shape[1]
         self.pull_width = 3 + emb_dim           # show, clk, w, embedx
         self.gw = 1 + emb_dim                   # d_w, d_embedx
@@ -109,15 +112,18 @@ class GoldenDeepFM:
 
     # -- one train step --------------------------------------------------
     def step(self, idx, mask, dense, labels):
-        """idx (B, S) int32 working-set rows; mask (B, S) bool; dense
+        """idx (B, S*L) int32 working-set rows; mask (B, S*L) bool; dense
         (B, D) f32; labels (B,) f32. Returns the step loss; mutates
         table/params in place exactly once, like Trainer._step_fn."""
-        B, S, E = idx.shape[0], self.S, self.E
+        B, S, E, L = idx.shape[0], self.S, self.E, self.L
         maskf = mask.astype(np.float32)
         pulled = self.table[idx.reshape(-1), :self.pull_width].reshape(
-            B, S, self.pull_width)
-        x = pulled * maskf[..., None]           # masked tokens contribute 0
-        # CVM join transform (L=1: pooling is identity)
+            B, S * L, self.pull_width)
+        tok = pulled * maskf[..., None]         # masked tokens contribute 0
+        # sum-pool L tokens per slot (ops/seqpool_cvm._pool reshape-sum;
+        # identity at L=1), then the CVM join transform on the POOLED
+        # show/clk
+        x = tok.reshape(B, S, L, self.pull_width).sum(axis=2)
         show, clk = x[..., 0], x[..., 1]
         log_show = np.log(show + 1.0)
         log_ctr = np.log(clk + 1.0) - log_show
@@ -168,16 +174,20 @@ class GoldenDeepFM:
         d_v = d_feats[..., 3:] + g[:, None, None] * (sum_v[:, None, :] - v)
         d_w = d_feats[..., 2]
         # show/clk grads are DROPPED by the push (CVM counters train
-        # nothing) — only (w, embedx) columns leave the model
-        sgrad = np.concatenate([d_w[..., None], d_v], axis=-1)
-        sgrad = (sgrad * maskf[..., None]).reshape(B * S, self.gw)
+        # nothing) — only (w, embedx) columns leave the model. Sum-pool
+        # backward: every token of a slot receives the slot's grad
+        # (masked tokens zero).
+        sgrad = np.concatenate([d_w[..., None], d_v], axis=-1)  # (B,S,gw)
+        sgrad = np.repeat(sgrad[:, :, None, :], L, axis=2).reshape(
+            B, S * L, self.gw)
+        sgrad = (sgrad * maskf[..., None]).reshape(B * S * L, self.gw)
 
         # ---- sparse push: scatter-merge + in-table adagrad ----
         show_inc = maskf.reshape(-1)
         clk_inc = (maskf * labels[:, None]).reshape(-1)
         payload = np.concatenate(
             [sgrad, show_inc[:, None], clk_inc[:, None],
-             np.ones((B * S, 1), np.float32)], axis=1)
+             np.ones((B * S * L, 1), np.float32)], axis=1)
         acc = np.zeros((len(self.table), self.gw + 3), np.float32)
         np.add.at(acc, idx.reshape(-1), payload)
         gw = self.gw

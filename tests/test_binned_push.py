@@ -149,6 +149,115 @@ def test_geometry_non_pow2_lane_groups():
     assert PP == 24 and G == 4 and SB % G == 0
 
 
+# ---------------------------------------------------------------------------
+# host dedup plan + device pre-merge (DedupKeysAndFillIdx + PushMergeCopy,
+# box_wrapper_impl.h:103 / box_wrapper.cu:630-830)
+# ---------------------------------------------------------------------------
+
+from paddlebox_tpu.native.key_index import dedup_plan  # noqa: E402
+
+
+def _dedup_5plan(idx_np, n_rows, cfg):
+    geom = pk.binned_push_geometry(cfg, n_rows)
+    SB, NB = geom if geom is not None else (n_rows, 1)
+    o, u, s, r, e = dedup_plan(idx_np, n_rows, SB, NB)
+    Z = np.zeros(0, np.int32)
+    if geom is None:
+        r, e = Z, Z
+    return tuple(jnp.asarray(a) for a in (o, r, e, u, s))
+
+
+def test_dedup_plan_properties():
+    """Plan invariants both backends must hold: sorted grouping, exact
+    segment runs, ascending distinct pad lanes, zero-width pad
+    segments, out-of-range ids in the sentinel tail."""
+    rng = np.random.default_rng(5)
+    n_rows = 4096
+    idx = rng.integers(-3, n_rows + 7, size=9000).astype(np.int32)
+    order, uniq, segend, rstart, end = dedup_plan(idx, n_rows, 512, 8)
+    r = np.where((idx < 0) | (idx >= n_rows), n_rows, idx)
+    sr = r[order]
+    assert np.array_equal(np.sort(order), np.arange(len(idx)))
+    assert (np.diff(sr) >= 0).all()
+    starts = np.concatenate([[0], segend[:-1]])
+    u = int((uniq < n_rows).sum())
+    for i in range(0, u, max(1, u // 37)):      # sampled segment check
+        assert (sr[starts[i]:segend[i]] == uniq[i]).all()
+    assert (np.diff(uniq.astype(np.int64)) > 0).all()
+    assert (segend[u:] == starts[u:]).all()
+    # unique-lane block windows cover exactly the in-block lanes
+    for b in range(8):
+        lanes = uniq[rstart[b]:end[b]]
+        in_blk = lanes[(lanes >= 0) & (lanes < n_rows)]
+        assert ((in_blk // 512) <= b).all()
+        assert (uniq[:u] // 512 == b).sum() == \
+            ((in_blk // 512) == b).sum()
+
+
+@pytest.mark.parametrize("dim", [4, 64])
+def test_premerge_parity_scatter_engine(dim):
+    """push() with a 5-plan (pre-merge + sorted-unique scatter) must
+    match the plain per-token scatter path — summation order differs
+    (cumsum-diff), so tolerances, not bitwise."""
+    cfg = EmbeddingConfig(dim=dim, optimizer="adagrad", learning_rate=0.05)
+    n_rows = 4096
+    table, idx, grads, shows, clks = _case(cfg, seed=11, n_rows=n_rows,
+                                           tok=5000, skew=True)
+    want = _xla_push(table, idx, grads, shows, clks, cfg)
+    plan = _dedup_5plan(np.asarray(idx), n_rows, cfg)
+    old = flags.binned_push
+    flags.binned_push = False        # CPU: force the scatter engine
+    try:
+        got = np.asarray(jax.jit(
+            lambda *a: sharded.push(*a, cfg, plan=plan))(
+                table, idx, grads, shows, clks))
+    finally:
+        flags.binned_push = old
+    np.testing.assert_allclose(got, want, atol=5e-5, rtol=5e-4)
+
+
+def test_premerge_parity_kernel_engine():
+    """Pre-merged unique lanes through the binned kernel (interpret
+    mode) must match the per-token scatter reference."""
+    cfg = EmbeddingConfig(dim=8, optimizer="adagrad", learning_rate=0.05)
+    table, idx, grads, shows, clks = _case(cfg, seed=13, skew=True)
+    want = _xla_push(table, idx, grads, shows, clks, cfg)
+    SB, NB = pk.binned_push_geometry(cfg, N)
+    o, u, s, r, e = dedup_plan(np.asarray(idx), N, SB, NB)
+    plan5 = tuple(jnp.asarray(a) for a in (o, r, e, u, s))
+    uniq, mg, ms, mc, kplan = sharded.plan_premerge(
+        idx, grads, shows, clks, plan5)
+    got = np.asarray(pk.binned_push(table, uniq, mg, ms, mc, cfg,
+                                    plan=kplan, interpret=True))
+    np.testing.assert_allclose(got, want, atol=5e-5, rtol=5e-4)
+
+
+def test_premerge_counts_and_drops():
+    """Pre-merged show/clk sums equal per-row token sums; out-of-range
+    and pad lanes contribute nothing."""
+    cfg = EmbeddingConfig(dim=4, optimizer="sgd", learning_rate=1.0)
+    rng = np.random.default_rng(17)
+    n_rows, tok = 512, 3000
+    idx_np = rng.integers(0, n_rows + 40, size=tok).astype(np.int32)
+    idx = jnp.asarray(idx_np)
+    grads = jnp.asarray(rng.normal(size=(tok, cfg.grad_width))
+                        .astype(np.float32))
+    shows = jnp.asarray(np.ones(tok, np.float32))
+    clks = jnp.asarray((rng.random(tok) < 0.4).astype(np.float32))
+    plan = _dedup_5plan(idx_np, n_rows, cfg)
+    uniq, mg, ms, mc, _ = jax.jit(sharded.plan_premerge)(
+        idx, grads, shows, clks, plan)
+    uniq, ms, mc = map(np.asarray, (uniq, ms, mc))
+    valid = idx_np < n_rows
+    want_shows = np.bincount(idx_np[valid], minlength=n_rows)
+    u = int((uniq < n_rows).sum())
+    got_shows = np.zeros(n_rows)
+    got_shows[uniq[:u]] = ms[:u]
+    np.testing.assert_allclose(got_shows, want_shows, atol=1e-4)
+    assert np.abs(ms[u:]).max(initial=0) == 0
+    assert np.abs(np.asarray(mg)[u:]).max(initial=0) == 0
+
+
 def test_parity_dim16_pow2_groups():
     cfg = EmbeddingConfig(dim=16, optimizer="adagrad", learning_rate=0.05)
     table, idx, grads, shows, clks = _case(cfg, seed=5)

@@ -31,7 +31,7 @@ BATCH, STEPS, N_KEYS = 32, 60, 300
 
 
 def _run_pair(storage, mode="allreduce", n_dev=1, golden_lr_mult=1.0,
-              sync_step=7):
+              sync_step=7, emb_dim=EMB_DIM, max_len=1):
     """Train STEPS batches through the real Trainer step in the given
     dense-sync mode / shard count AND through the NumPy twin; return the
     loss trajectories + final states.
@@ -52,13 +52,13 @@ def _run_pair(storage, mode="allreduce", n_dev=1, golden_lr_mult=1.0,
     """
     from paddlebox_tpu.parallel import mesh as mesh_lib
 
-    cfg = EmbeddingConfig(dim=EMB_DIM, optimizer="adagrad",
+    cfg = EmbeddingConfig(dim=emb_dim, optimizer="adagrad",
                           learning_rate=0.05, storage=storage)
     store = HostEmbeddingStore(cfg)
     schema = DataFeedSchema.ctr(num_sparse=NUM_SLOTS, num_float=DENSE_DIM,
-                                batch_size=BATCH, max_len=1)
+                                batch_size=BATCH, max_len=max_len)
     mesh = make_mesh(n_dev)
-    tr = Trainer(DeepFMModel(num_slots=NUM_SLOTS, emb_dim=EMB_DIM,
+    tr = Trainer(DeepFMModel(num_slots=NUM_SLOTS, emb_dim=emb_dim,
                              dense_dim=DENSE_DIM, hidden=HIDDEN),
                  store, schema, mesh,
                  TrainerConfig(global_batch_size=BATCH,
@@ -75,7 +75,7 @@ def _run_pair(storage, mode="allreduce", n_dev=1, golden_lr_mult=1.0,
     # independent init cross-check: the golden recomputes the
     # deterministic splitmix row init from the documented formula
     gold_rows = splitmix_init_rows(ws.sorted_keys, cfg.row_width,
-                                   3, 3 + EMB_DIM, cfg.initial_range)
+                                   3, 3 + emb_dim, cfg.initial_range)
     n_pad = ws.padded_rows
     gold_table = np.zeros((n_pad, cfg.row_width), np.float32)
     gold_table[1:1 + len(keys)] = gold_rows
@@ -87,8 +87,8 @@ def _run_pair(storage, mode="allreduce", n_dev=1, golden_lr_mult=1.0,
         # kstep keeps per-shard dense copies (stack_for_shards leading
         # axis); the golden models one logical copy
         init_params = jax.tree.map(lambda a: a[0], init_params)
-    gold = GoldenDeepFM(gold_table, init_params, NUM_SLOTS, EMB_DIM,
-                        DENSE_DIM, HIDDEN,
+    gold = GoldenDeepFM(gold_table, init_params, NUM_SLOTS, emb_dim,
+                        DENSE_DIM, HIDDEN, max_len=max_len,
                         lr_sparse=cfg.learning_rate * golden_lr_mult,
                         initial_g2sum=cfg.initial_g2sum,
                         dense_lr=tr.cfg.dense_lr, storage=storage,
@@ -104,8 +104,9 @@ def _run_pair(storage, mode="allreduce", n_dev=1, golden_lr_mult=1.0,
         tr.dense_table.start()
     fw_losses, gold_losses = [], []
     for step in range(STEPS):
-        raw = rng.choice(keys, size=(BATCH, NUM_SLOTS))
-        mask = rng.random((BATCH, NUM_SLOTS)) < 0.9   # some padding
+        T = NUM_SLOTS * max_len
+        raw = rng.choice(keys, size=(BATCH, T))
+        mask = rng.random((BATCH, T)) < 0.9       # some padding
         idx = ws.translate(raw, mask)
         if n_dev == 1:
             # independent translate cross-check: searchsorted + 1
@@ -116,7 +117,7 @@ def _run_pair(storage, mode="allreduce", n_dev=1, golden_lr_mult=1.0,
         labels = (rng.random(BATCH) < 0.3).astype(np.float32)
         batch = tuple(jax.device_put(a, sh) for a in
                       (idx, mask, dense, labels)) + \
-            (tr.NO_PLAN, tr.NO_PLAN, tr.NO_PLAN)
+            (tr.NO_PLAN,) * 5
         if mode == "async":
             p = jax.device_put(tr._unravel(tr.dense_table.pull()), repl)
             table, gp_flat, loss, _, dropped = tr._step_fn(
@@ -190,6 +191,28 @@ def test_trajectory_parity_mesh8_routed():
     np.testing.assert_allclose(fw, gold, rtol=5e-4, atol=5e-5)
     fw_table = np.asarray(table)[:, :g.table.shape[1]]
     np.testing.assert_allclose(fw_table, g.table, rtol=2e-3, atol=5e-5)
+
+
+def test_trajectory_parity_multihot4():
+    """Multi-hot golden (VERDICT r4 weak #5): max_len=4 through the
+    seqpool sum + pad masking — the pooling forward AND its broadcast
+    backward (every token receives the slot grad) against the NumPy
+    twin. The single-hot golden never touches this path."""
+    fw, gold, table, params, g = _run_pair("f32", max_len=4)
+    np.testing.assert_allclose(fw, gold, rtol=3e-4, atol=3e-5)
+    fw_table = np.asarray(table)[:, :g.table.shape[1]]
+    np.testing.assert_allclose(fw_table, g.table, rtol=2e-3, atol=3e-5)
+
+
+def test_trajectory_parity_dim64_scatter():
+    """Wide-dim golden (VERDICT r4 weak #5): dim 64 runs the
+    scatter-engine push (G=1 — no binned kernel) and, on TPU, the
+    merge_update consumer; the dim-4 golden never exercises the wide
+    row layout or that dispatch."""
+    fw, gold, table, params, g = _run_pair("f32", emb_dim=64)
+    np.testing.assert_allclose(fw, gold, rtol=3e-4, atol=3e-5)
+    fw_table = np.asarray(table)[:, :g.table.shape[1]]
+    np.testing.assert_allclose(fw_table, g.table, rtol=2e-3, atol=3e-5)
 
 
 def test_detects_systematic_error():

@@ -167,7 +167,7 @@ def test_trainer_on_remote_store(cluster):
              rng.normal(size=(16, 1)).astype(np.float32),
              (rng.random(16) < 0.5).astype(np.float32))]
     out = tr._step_fn(table, *dstate, *args,
-                      tr.NO_PLAN, tr.NO_PLAN, tr.NO_PLAN)
+                      *(tr.NO_PLAN,) * 5)
     table, _, loss, _, dropped = tr.split_step_out(out)
     assert np.isfinite(float(loss))
     assert int(dropped) == 0

@@ -28,7 +28,7 @@ def pad_on():
     flags.table_pad_width = old
 
 
-def _mk(dim=8, n_keys=100):
+def _mk(dim=32, n_keys=100):  # rw 38: inside the auto pad zone [16, 64)
     cfg = EmbeddingConfig(dim=dim, optimizer="adagrad", learning_rate=0.1)
     store = HostEmbeddingStore(cfg)
     rng = np.random.default_rng(0)
@@ -40,9 +40,15 @@ def test_device_width_rules():
     old = flags.table_pad_width
     try:
         flags.table_pad_width = "auto"
-        assert device_width(EmbeddingConfig(dim=8)) == 64
-        assert device_width(EmbeddingConfig(dim=50)) == 64   # rw 55
-        assert device_width(EmbeddingConfig(dim=100)) == 128  # rw 105
+        # width-aware: only the pathological 16..63-lane gather zone
+        # pads (round-5 v5e sweep); 13-lane and >=64-lane sources are
+        # already fast and keep their logical width
+        assert device_width(EmbeddingConfig(dim=8)) == \
+            EmbeddingConfig(dim=8).row_width                  # rw 13
+        assert device_width(EmbeddingConfig(dim=32)) == 64    # rw 38
+        assert device_width(EmbeddingConfig(dim=50)) == 64    # rw 55
+        assert device_width(EmbeddingConfig(dim=100)) == \
+            EmbeddingConfig(dim=100).row_width                # rw 105
         wide = EmbeddingConfig(dim=160)                       # rw > 128
         assert device_width(wide) == wide.row_width
         assert device_width(EmbeddingConfig(dim=8, storage="int8")) == \
