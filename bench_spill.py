@@ -41,12 +41,19 @@ def rss_mb() -> float:
     return float("nan")
 
 
-def drop_file_cache(store) -> None:
+def drop_file_cache(store) -> bool:
     """Flush dirty memmap pages, then evict the mapping's resident pages
     (madvise MADV_DONTNEED — fadvise cannot evict pages a live mapping
     references) so RSS shows the HARD resident floor (index + cache),
-    not reclaimable file-backed cache."""
+    not reclaimable file-backed cache.
+
+    Returns whether the madvise eviction succeeded — a failed eviction
+    leaves the file's pages resident and would silently report an
+    INFLATED "hard floor" RSS as if the drop worked, so callers record
+    the outcome next to every RSS-after-drop number."""
     import ctypes
+    import errno
+    import mmap as mmap_mod
     store._rows.flush()
     mm = store._rows
     libc = ctypes.CDLL(None, use_errno=True)
@@ -54,12 +61,20 @@ def drop_file_cache(store) -> None:
     page = os.sysconf("SC_PAGESIZE")
     base = addr - (addr % page)
     length = mm.nbytes + (addr - base)
-    libc.madvise(ctypes.c_void_p(base), ctypes.c_size_t(length), 4)
+    rc = libc.madvise(ctypes.c_void_p(base), ctypes.c_size_t(length),
+                      mmap_mod.MADV_DONTNEED)
+    ok = rc == 0
+    if not ok:
+        err = ctypes.get_errno()
+        print(f"# madvise(MADV_DONTNEED) failed: "
+              f"{errno.errorcode.get(err, err)}", file=sys.stderr,
+              flush=True)
     fd = os.open(store._rows_path, os.O_RDONLY)
     try:
         os.posix_fadvise(fd, 0, 0, os.POSIX_FADV_DONTNEED)
     finally:
         os.close(fd)
+    return ok
 
 
 def main() -> None:
@@ -114,7 +129,7 @@ def main() -> None:
                                replace=False)
             sel = np.concatenate([keep, fresh])
         keys = key_window(np.unique(sel))
-        drop_file_cache(store)              # cold spill tier per pass
+        drop_ok = drop_file_cache(store)    # cold spill tier per pass
         h0, m0 = store.cache_hits, store.cache_misses
         t0 = time.perf_counter()
         rows = store.lookup_or_init(keys)
@@ -133,10 +148,11 @@ def main() -> None:
             "writeback_mb_per_s": round(mb / wb_s, 1),
             "cache_hits": int(store.cache_hits - h0),
             "cache_misses": int(store.cache_misses - m0),
+            "pre_pass_cache_drop_ok": bool(drop_ok),
         })
     out["passes"] = passes
     out["rss_after_passes_mb"] = round(rss_mb(), 1)
-    drop_file_cache(store)
+    out["final_cache_drop_ok"] = bool(drop_file_cache(store))
     out["rss_after_cache_drop_mb"] = round(rss_mb(), 1)
     out["hard_floor_note"] = (
         "resident floor = key index (~16B/key) + RAM row cache + numpy "

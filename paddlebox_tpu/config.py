@@ -102,6 +102,16 @@ class Flags:
     # measured a win (see sharded.push); "on"/"off" force. Trace-time,
     # single-shard TPU tables only (like the plan itself).
     push_dedup_premerge: str = "auto"       # (new)
+    # Fused gather-pool pull: multi-hot/wide layouts gather table rows
+    # and sum-pool them per (example, slot) INSIDE the pull
+    # (pallas_kernels.gather_pool), so the (B*T, pull_width) token
+    # matrix never materializes through the model; the pooled cotangent
+    # expands back per token straight into the dedup premerge + binned
+    # push (sharded.pooled_grad_tokens). "auto" = the trainer heuristic
+    # (multi-hot or total_dim >= 64, single-shard mesh, pooled-pull-
+    # capable model, uniform slot layout); "on"/"off" force. Read at
+    # Trainer construction (trace time), like binned_push.
+    fused_gather_pool: str = "auto"         # (new)
     # Merge-engine override for A/B runs: "auto" picks per width
     # (binned kernel at G>=2 lane groups, XLA scatter at G=1 — the
     # measured crossover, binned_push_supported); "kernel"/"scatter"

@@ -31,6 +31,7 @@ from __future__ import annotations
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 from jax import lax
 
 from paddlebox_tpu.config import flags as config_flags
@@ -87,6 +88,80 @@ def lookup(table: jnp.ndarray, idx: jnp.ndarray,
     return gate_pull(pulled, cfg).reshape((*idx.shape, cfg.pull_width))
 
 
+# ---------------------------------------------------------------------------
+# fused gather-pool pull (the multi-hot/wide-dim fast path)
+# ---------------------------------------------------------------------------
+
+def fused_pull_supported(cfg: EmbeddingConfig) -> bool:
+    """Semantics preconditions of the fused gather-pool pull, independent
+    of geometry: the pooled path skips gate_pull (create-threshold
+    presence masks act per ROW and the pooled cotangent expansion would
+    need the per-row gate to route grads), so it must not engage where
+    gating matters. Storage is NOT checked here — the jnp reference
+    inside fused_pull_pool handles quantized tables; only the kernel is
+    f32-only (gather_pool_supported)."""
+    return (cfg.mf_create_threshold == 0
+            and cfg.expand_create_threshold == 0)
+
+
+def fused_pull_pool(table, idx: jnp.ndarray, cfg: EmbeddingConfig,
+                    num_slots: int, slot_len: int) -> jnp.ndarray:
+    """(B, S*L) translated indices → (B, S, pull_width) sum-pooled rows.
+
+    The fused form of lookup + per-slot sum pool for the uniform slot
+    layout: on real TPU with a supported geometry the Pallas gather-pool
+    kernel gathers rows from the HBM table and pools them in VMEM — the
+    (B*T, pull_width) pulled matrix never materializes. Elsewhere (CPU
+    test meshes, quantized storage, unsupported geometry) the identical
+    jnp math runs through lookup + reshape-sum. Masked tokens must
+    already be nulled to NULL_INDEX (translate does), and the null row
+    is all-zero by the working-set contract, so padding contributes
+    zeros without a mask operand. The backward pass is NOT defined here:
+    trainers take grads against the pooled output and expand them per
+    token with pooled_grad_tokens (into the dedup premerge + binned
+    push), and the standalone op form lives in
+    ops.seqpool_cvm.fused_gather_seqpool_cvm."""
+    from paddlebox_tpu.ops import pallas_kernels
+    B = idx.shape[0]
+    if (not quant.is_quant(table)
+            and pallas_kernels.gather_pool_supported(
+                cfg, B, num_slots, slot_len, table.shape[1])):
+        return pallas_kernels.gather_pool(table, idx, cfg, num_slots,
+                                          slot_len)
+    pulled = lookup(table, idx.reshape(-1), cfg)
+    return pulled.reshape(B, num_slots, slot_len,
+                          cfg.pull_width).sum(axis=2)
+
+
+def pooled_grad_tokens(gpooled: jnp.ndarray, mask: jnp.ndarray,
+                       segment_ids, num_slots: int) -> jnp.ndarray:
+    """Per-token sparse grads from the pooled cotangent.
+
+    Pooling is a per-segment sum, so each token's pull cotangent is its
+    (example, slot) pooled row: gpooled (B, S, pull_width) → (B*T,
+    grad_width) rows ``gpooled[b, seg[t], 2:] * mask[b, t]`` (show/clk
+    cotangents dropped like the unfused path's ``gpull[..., 2:]``). The
+    (B*S, ·) source is ~slot_len times smaller than the token matrix and
+    XLA fuses this gather into its consumer (the premerge cumsum /
+    binned-push pack), so the fused path's backward never stores a
+    (B, T, pull_width) array either. The mask multiply keeps null-row
+    grads zero (push's contract for NULL_INDEX)."""
+    B, S, P = gpooled.shape
+    seg = jnp.asarray(np.asarray(segment_ids), jnp.int32)
+    bs = (jnp.arange(B, dtype=jnp.int32)[:, None] * S
+          + seg[None, :]).reshape(-1)
+    tok = jnp.take(gpooled.reshape(B * S, P)[:, 2:], bs, axis=0)
+    return tok * mask.reshape(-1).astype(tok.dtype)[:, None]
+
+
+# Cumsum restart granularity of the premerge segment sums: bounds the
+# f32 prefix magnitude each segment difference cancels against to one
+# block's payload sum instead of the whole token stream's (ADVICE r5:
+# at ~852k tokens the full-length prefix makes grad error scale with
+# the PREFIX magnitude, not the segment's).
+_CS_BLOCK = 4096
+
+
 def plan_premerge(idx: jnp.ndarray, grads: jnp.ndarray,
                   shows: jnp.ndarray, clks: jnp.ndarray, plan):
     """Device half of the host dedup plan: segment-sum per-token payloads
@@ -95,11 +170,17 @@ def plan_premerge(idx: jnp.ndarray, grads: jnp.ndarray,
     box_wrapper.cu:630-830).
 
     The host counting sort (native pbtpu_dedup_plan) already grouped
-    tokens by row, so the sum is a cumsum over the sorted payload
+    tokens by row, so the sum is a prefix sum over the sorted payload
     differenced at the (sorted, ascending) segment ends — no argsort, no
-    per-duplicate scatter. Pad lanes carry zero-width segments and
-    ascending out-of-range row ids, so downstream engines drop them and
-    the scatter engine may legally promise sorted+unique indices.
+    per-duplicate scatter. The prefix sum RESTARTS every _CS_BLOCK
+    tokens (block-local cumsum + per-block exclusive bases): the
+    block-base terms cancel exactly for segments inside one block
+    (identical gathered values), so a segment's rounding error scales
+    with its block's payload magnitude, not the full stream's — signed
+    grads at 852k tokens would otherwise cancel against an unbounded
+    prefix. Pad lanes carry zero-width segments and ascending
+    out-of-range row ids, so downstream engines drop them and the
+    scatter engine may legally promise sorted+unique indices.
 
     Returns (uniq_idx, merged_grads, merged_shows, merged_clks,
     kernel_plan) — kernel_plan is (None, rstart, end) unique-lane DMA
@@ -108,21 +189,46 @@ def plan_premerge(idx: jnp.ndarray, grads: jnp.ndarray,
     order, rstart, endb, uniq, segend = plan
     pay = jnp.concatenate([grads, shows[:, None], clks[:, None]], axis=1)
     s_pay = jnp.take(pay, order, axis=0)
-    cs = jnp.concatenate(
-        [jnp.zeros((1, pay.shape[1]), pay.dtype),
-         jnp.cumsum(s_pay, axis=0)], axis=0)
+    n, Wp = s_pay.shape
+    C = _CS_BLOCK
+    nc = max(1, -(-n // C))
+    pad = nc * C - n
+    if pad:
+        s_pay = jnp.concatenate(
+            [s_pay, jnp.zeros((pad, Wp), s_pay.dtype)], axis=0)
+    blocks = s_pay.reshape(nc, C, Wp)
+    # lcs0[c, j] = sum of block c's first j tokens; base[c] = sum of all
+    # tokens before block c. prefix(p) = base[p // C] + lcs0[p // C, p % C]
+    lcs0 = jnp.concatenate(
+        [jnp.zeros((nc, 1, Wp), s_pay.dtype), jnp.cumsum(blocks, axis=1)],
+        axis=1)
+    base = jnp.concatenate(
+        [jnp.zeros((1, Wp), s_pay.dtype),
+         jnp.cumsum(lcs0[:, -1, :], axis=0)], axis=0)[:-1]
+    flat_lcs = lcs0.reshape(nc * (C + 1), Wp)
     starts = jnp.concatenate(
         [jnp.zeros((1,), segend.dtype), segend[:-1]])
     # boundary gathers ride the sorted-indices fast path (segend/starts
-    # ascend by construction)
+    # ascend by construction, and // and % preserve that order)
     dnums = lax.GatherDimensionNumbers(
         offset_dims=(1,), collapsed_slice_dims=(0,), start_index_map=(0,))
-    slice_sizes = (1, cs.shape[1])
-    hi = lax.gather(cs, segend[:, None], dnums, slice_sizes,
-                    indices_are_sorted=True, mode="clip")
-    lo = lax.gather(cs, starts[:, None], dnums, slice_sizes,
-                    indices_are_sorted=True, mode="clip")
-    m = hi - lo
+
+    def prefix_parts(p):
+        # p == nc*C (the stream end) flattens past lcs0 and clips to the
+        # equivalent (nc-1, C) cell, its base index to nc-1 — exactly the
+        # stream total; interior block boundaries read (c, 0) = base[c].
+        c = p // C
+        li = c * (C + 1) + lax.rem(p, C)
+        b = lax.gather(base, c[:, None], dnums, (1, Wp),
+                       indices_are_sorted=True, mode="clip")
+        loc = lax.gather(flat_lcs, li[:, None], dnums, (1, Wp),
+                         indices_are_sorted=True, mode="clip")
+        return b, loc
+    b_hi, l_hi = prefix_parts(segend)
+    b_lo, l_lo = prefix_parts(starts)
+    # local differences first: same-block segments see their bases cancel
+    # exactly in (b_hi - b_lo)
+    m = (l_hi - l_lo) + (b_hi - b_lo)
     gw = grads.shape[1]
     kplan = (None, rstart, endb) if rstart.shape[0] else None
     return uniq, m[:, :gw], m[:, gw], m[:, gw + 1], kplan

@@ -82,9 +82,12 @@ def device_width(cfg: EmbeddingConfig) -> int:
         # width-aware: only the pathological gather zone pads (v5e
         # 852k-row sweep: 14..63-lane gathers run 3-8x slower per row —
         # 24.0ms at 38 lanes vs 5.1ms gathering 64-wide and slicing;
-        # 13-lane and >=64-lane sources are already on the fast path,
-        # and round 2 measured the dim-8 full step SLOWER padded)
-        return 64 if 16 <= rw < 64 else rw
+        # <=13-lane and >=64-lane sources are already on the fast path,
+        # and round 2 measured the dim-8 full step SLOWER padded). The
+        # zone starts at 14, where the sweep's slowdown begins — not 16
+        # (ADVICE r5: widths 14-15, e.g. dim 9-10, were stranded on the
+        # slow path).
+        return 64 if 14 <= rw < 64 else rw
     return max(rw, int(pad))
 
 

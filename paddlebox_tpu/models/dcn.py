@@ -17,6 +17,9 @@ from paddlebox_tpu.ops import fused_seqpool_cvm
 
 class DCNv2Model:
     name = "dcn_v2"
+    # pulled is consumed only through fused_seqpool_cvm*, so the
+    # trainer may substitute the fused gather-pool pull (PooledSlots)
+    pooled_pull_ok = True
 
     def __init__(self, num_slots: int, emb_dim: int, dense_dim: int = 0,
                  hidden: tuple[int, ...] = (256, 128),

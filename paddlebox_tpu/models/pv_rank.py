@@ -37,6 +37,9 @@ from paddlebox_tpu.ops.rank_attention import build_rank_offset, rank_attention
 
 class PVRankModel:
     name = "pv_rank"
+    # pulled is consumed only through fused_seqpool_cvm*, so the
+    # trainer may substitute the fused gather-pool pull (PooledSlots)
+    pooled_pull_ok = True
     num_extras = 1      # rank_offset — staged by the trainer per batch
 
     def __init__(self, num_slots: int, emb_dim: int, dense_dim: int = 0,

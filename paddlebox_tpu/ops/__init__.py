@@ -1,4 +1,6 @@
-from paddlebox_tpu.ops.seqpool_cvm import (fused_seqpool_cvm,  # noqa: F401
+from paddlebox_tpu.ops.seqpool_cvm import (PooledSlots,  # noqa: F401
+                                           fused_gather_seqpool_cvm,
+                                           fused_seqpool_cvm,
                                            fused_seqpool_cvm_with_conv,
                                            fused_seqpool_cvm_with_pcoc)
 from paddlebox_tpu.ops.cvm import cvm, cvm_inverse  # noqa: F401

@@ -1,6 +1,12 @@
 """Pallas TPU kernels for the embedding-table hot path.
 
-Two generations of kernels live here:
+Three kernel families live here:
+
+- ``gather_pool`` (the fused pull for multi-hot/wide layouts): gathers
+  rows from the HBM device table and sum-pools them per (example, slot)
+  segment in VMEM, so the (tokens, pull_width) pulled matrix never
+  materializes — the pull-side dual of ``binned_push`` (see its section
+  comment for the rationale and measurements).
 
 - ``binned_push`` (the production path, flags.binned_push): replaces the
   XLA token scatter-add with block-binned one-hot MXU matmuls that build
@@ -509,6 +515,212 @@ def binned_push(table: jnp.ndarray, idx: jnp.ndarray, grads: jnp.ndarray,
                              acc[:, gw + 1], cfg)
     touched = acc[:, gw + 2] > 0
     return jnp.where(touched[:, None], new_rows, table)
+
+
+# ---------------------------------------------------------------------------
+# Fused gather-pool: the pull-side dual of binned_push.
+#
+# Multi-hot slots are bottlenecked by the (tokens, pull_width) pulled
+# matrix the unfused path materializes between the table gather and the
+# per-slot sum pool (the reference fuses exactly this in its
+# fused_seqpool_cvm* CUDA kernels): at the bench's mh4d32 point the step
+# moves 852k x 35 f32 rows to HBM, pools them, then moves the same-shape
+# gradient back — 37.7k examples/s/chip vs the 645k one-hot headline
+# (BENCH_r05). This kernel gathers rows from the (HBM-resident) device
+# table with per-row async copies and sum-pools them per (example, slot)
+# segment while they sit in VMEM, emitting only the pooled
+# (B, num_slots, pull_width) output — the per-token matrix never exists
+# in HBM. The per-token filters of the reference kernel family
+# (need_filter show/clk thresholds — scalar or per-slot —
+# embed_threshold, quant_ratio) apply to the gathered rows in VMEM
+# before pooling, same math as seqpool_cvm._filter_and_quant.
+#
+# Layout: tokens of one batch tile land in the gathered scratch at row
+# ``l * BB*S + b*S + s`` (pool-position-major), so the pool is L
+# contiguous block adds — no strided reads, no scatter. Masked tokens
+# are pre-mapped to row NULL_INDEX (all zeros by the working-set
+# contract), so padding contributes zeros without a mask operand.
+#
+# The backward pass does not run in here: the pooled cotangent is
+# (B, S, P) — already ~L times smaller than the token matrix — and
+# sharded.pooled_grad_tokens expands it per token straight into the
+# dedup pre-merge + binned_push pipeline (see PARITY.md "Fused
+# gather-pool pull").
+#
+# On CPU the kernel runs under the Pallas interpreter for the parity
+# tests; production CPU paths (and any unsupported geometry) take the
+# jnp reference in sharded.fused_pull_pool.
+# ---------------------------------------------------------------------------
+
+_GP_VMEM_BUDGET = 4 << 20   # gathered-rows scratch cap (bytes)
+_GP_MAX_WIDTH = 512         # table row lanes past this: fall back
+_GP_SEMS = 8                # in-flight row DMAs
+
+
+def gather_pool_geometry(B: int, S: int, L: int, table_width: int):
+    """Batch-tile size BB for the gather-pool kernel, or None when the
+    (batch, slots, slot_len, width) combination doesn't fit its layout
+    needs. BB is the largest power of two <= 64 dividing B whose
+    gathered scratch (L * BB * S rows at the table's padded lane width)
+    fits the VMEM budget — bigger tiles amortize the grid prologue,
+    smaller ones keep wide rows resident."""
+    if B <= 0 or S <= 0 or L <= 0 or table_width > _GP_MAX_WIDTH:
+        return None
+    lanes = -(-table_width // 128) * 128
+    BB = 64
+    while BB > 1 and (B % BB or L * BB * S * lanes * 4 > _GP_VMEM_BUDGET):
+        BB //= 2
+    if B % BB or L * BB * S * lanes * 4 > _GP_VMEM_BUDGET:
+        return None
+    return BB
+
+
+def gather_pool_supported(cfg: EmbeddingConfig, B: int, S: int, L: int,
+                          table_width: int) -> bool:
+    """Whether the fused gather-pool kernel engages for this geometry on
+    the current backend. Real-TPU f32 tables only: quantized storage
+    gathers two planes (the jnp reference handles it), and the pull
+    gating masks (mf/expand create thresholds) are applied by lookup —
+    the kernel skips both, so it must not engage where they matter.
+    CPU callers get the jnp reference in sharded.fused_pull_pool; tests
+    drive the kernel directly in interpret mode."""
+    if jax.default_backend() != "tpu":
+        return False
+    if cfg.storage != "f32":
+        return False
+    if cfg.mf_create_threshold > 0 or cfg.expand_create_threshold > 0:
+        return False
+    return gather_pool_geometry(B, S, L, table_width) is not None
+
+
+def _gather_pool_kernel(idx_ref, thr_ref, table_ref, out_ref, gathered, sem,
+                        *, BB: int, S: int, L: int, T: int, P: int,
+                        n_rows: int, n_sem: int, need_filter: bool,
+                        show_coeff: float, clk_coeff: float,
+                        embed_threshold: float, quant_ratio: int,
+                        cvm_offset: int):
+    """One batch tile: DMA-gather BB*T table rows into the
+    pool-position-major scratch, then pool with L contiguous block adds.
+
+    idx_ref : (BB*T,) int32 in SMEM — this tile's (already translated,
+              mask-nulled) row ids; the DMA source address for each row.
+    thr_ref : (BB*S, 1) f32 — per-(example, slot) need_filter threshold
+              (the per-slot diff-thres variant tiled over the tile's
+              examples; zeros when need_filter is off).
+    The row DMAs run n_sem deep: copy t+n_sem is issued as soon as copy
+    t completes (same-slot semaphore reuse forces that order anyway).
+    """
+    n = BB * T
+    BBS = BB * S
+
+    def copy(t):
+        row = jnp.minimum(idx_ref[t], n_rows - 1)
+        b = t // T
+        within = t - b * T
+        s = within // L
+        l = within - s * L
+        dest = l * BBS + b * S + s
+        return pltpu.make_async_copy(
+            table_ref.at[pl.ds(row, 1), :],
+            gathered.at[pl.ds(dest, 1), :],
+            sem.at[lax.rem(t, n_sem)])
+
+    for k in range(n_sem):
+        copy(k).start()
+
+    def body(t, _):
+        copy(t).wait()
+
+        @pl.when(t + n_sem < n)
+        def _prefetch():
+            copy(t + n_sem).start()
+
+        return 0
+
+    lax.fori_loop(0, n, body, 0)
+
+    acc = None
+    for l in range(L):
+        x = gathered[l * BBS:(l + 1) * BBS, :]
+        keep = None
+        if need_filter:
+            show, clk = x[:, 0:1], x[:, 1:2]
+            keep = ((show - clk) * show_coeff + clk * clk_coeff
+                    >= thr_ref[...])
+        if embed_threshold > 0.0:
+            show, w = x[:, 0:1], x[:, cvm_offset:cvm_offset + 1]
+            drop = ((show > embed_threshold)
+                    & (jnp.abs(w) < embed_threshold))
+            keep = ~drop if keep is None else keep & ~drop
+        if quant_ratio > 0:
+            # quantize embedx lanes only (lanes past P are sliced away
+            # below; quantizing them along for the ride is harmless)
+            lane = lax.broadcasted_iota(jnp.int32, x.shape, 1)
+            q = jnp.round(x * quant_ratio) / quant_ratio
+            x = jnp.where(lane >= cvm_offset + 1, q, x)
+        if keep is not None:
+            x = x * keep.astype(x.dtype)
+        acc = x if acc is None else acc + x
+    out_ref[...] = acc[:, :P]
+
+
+def gather_pool(table: jnp.ndarray, idx: jnp.ndarray, cfg: EmbeddingConfig,
+                num_slots: int, slot_len: int, *,
+                need_filter: bool = False, show_coeff: float = 0.2,
+                clk_coeff: float = 1.0, threshold=0.96,
+                embed_threshold: float = 0.0, quant_ratio: int = 0,
+                cvm_offset: int = 2,
+                interpret: bool | None = None) -> jnp.ndarray:
+    """Fused gather + per-(example, slot) sum pool over the device table.
+
+    table : (n_rows, W) f32 device table (W >= cfg.pull_width; pad/opt
+            columns past pull_width are gathered and discarded). Row
+            NULL_INDEX must be the all-zero row — masked/padding tokens
+            point there and contribute zeros (callers null idx by mask).
+    idx   : (B, S*L) int32 translated indices, slot-major uniform layout
+            (token (b, s, l) at column s*L + l — SparseLayout with equal
+            max_len per slot).
+    threshold may be a scalar or a per-slot (S,) vector (the diff-thres
+    variant). Returns (B, S, pull_width) pooled rows; the CVM transform
+    applies downstream on this small output (seqpool_cvm.PooledSlots).
+    """
+    B, T = idx.shape
+    S, L = num_slots, slot_len
+    assert T == S * L, (T, S, L)
+    n_rows, W = table.shape
+    BB = gather_pool_geometry(B, S, L, W)
+    assert BB is not None, "caller must check gather_pool geometry support"
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    P = cfg.pull_width
+    thr = jnp.asarray(threshold, jnp.float32)
+    if thr.ndim == 0:
+        thr = jnp.broadcast_to(thr, (S,))
+    thr_col = jnp.tile(thr, (BB,))[:, None]
+    BBS = BB * S
+    n_sem = min(_GP_SEMS, BB * T)
+    kernel = functools.partial(
+        _gather_pool_kernel, BB=BB, S=S, L=L, T=T, P=P, n_rows=n_rows,
+        n_sem=n_sem, need_filter=bool(need_filter),
+        show_coeff=float(show_coeff), clk_coeff=float(clk_coeff),
+        embed_threshold=float(embed_threshold),
+        quant_ratio=int(quant_ratio), cvm_offset=int(cvm_offset))
+    out = pl.pallas_call(
+        kernel,
+        out_shape=jax.ShapeDtypeStruct((B * S, P), jnp.float32),
+        grid=(B // BB,),
+        in_specs=[
+            pl.BlockSpec((BB * T,), lambda i: (i,),
+                         memory_space=pltpu.SMEM),
+            pl.BlockSpec((BBS, 1), lambda i: (0, 0)),
+            pl.BlockSpec(memory_space=pl.ANY),
+        ],
+        out_specs=pl.BlockSpec((BBS, P), lambda i: (i, 0)),
+        scratch_shapes=[pltpu.VMEM((L * BBS, W), jnp.float32),
+                        pltpu.SemaphoreType.DMA((n_sem,))],
+        interpret=interpret,
+    )(idx.reshape(-1).astype(jnp.int32), thr_col, table)
+    return out.reshape(B, S, P)
 
 
 def binned_merge_acc(idx: jnp.ndarray, grads: jnp.ndarray,

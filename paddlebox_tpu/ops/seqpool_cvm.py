@@ -18,12 +18,55 @@ The reference fuses all slots into one kernel by hand; here the whole thing
 is a handful of jnp ops over the flat (B, T) token layout — one masked
 multiply, one segment-sum scatter, one log transform — which XLA fuses into
 the surrounding matmuls (SURVEY.md §7 design stance).
+
+Two fused entry points ride on top of that reference math:
+
+- ``PooledSlots`` — a marker wrapper for input that is ALREADY pooled per
+  (example, slot), produced by the fused gather-pool pull
+  (``sharded.fused_pull_pool`` / ``pallas_kernels.gather_pool``). The
+  ``fused_seqpool_cvm*`` functions accept it in place of the per-token
+  ``pulled`` array and apply only the post-pool CVM transform — models
+  stay unchanged while the (B, T, P) token matrix never materializes.
+- ``fused_gather_seqpool_cvm`` — the standalone one-call form over the
+  device table with a custom VJP that merges the pooled cotangent per
+  unique row (dedup) before scattering into the table cotangent, so
+  neither the pulled matrix nor its gradient is ever built per token.
 """
 
 from __future__ import annotations
 
+import dataclasses
+import functools
+from typing import Any
+
+import jax
 import jax.numpy as jnp
 import numpy as np
+
+
+@dataclasses.dataclass
+class PooledSlots:
+    """(B, S, P) per-slot sums that are ALREADY pooled.
+
+    Produced by the fused gather-pool pull; ``fused_seqpool_cvm*`` skip
+    the per-token filter/pool stages for this input and apply only the
+    post-pool CVM transform. Per-token filters/quant cannot run on
+    pooled sums — the fused kernel applies them pre-pool (gather_pool
+    kwargs), so the model-facing call must leave them at defaults.
+    """
+    pooled: Any  # jnp.ndarray (B, num_slots, pull_width)
+
+    @property
+    def shape(self):
+        return self.pooled.shape
+
+
+def _check_pooled_kwargs(need_filter, embed_threshold, quant_ratio):
+    if need_filter or embed_threshold > 0.0 or quant_ratio > 0:
+        raise ValueError(
+            "per-token filters/quant cannot apply to a PooledSlots input; "
+            "pass them to the fused gather-pool pull "
+            "(pallas_kernels.gather_pool) instead")
 
 
 def _filter_and_quant(pulled, mask, seg_np, cvm_offset, need_filter,
@@ -97,13 +140,22 @@ def fused_seqpool_cvm(
     P = pull width: [show, clk, embed_w, embedx...]. segment_ids (T,) maps
     token columns to slots (SparseLayout.segment_ids). Returns (B, S*out_dim)
     if flatten else (B, S, out_dim), out_dim = P if use_cvm else P-cvm_offset.
+
+    `pulled` may be a PooledSlots wrapper (the fused gather-pool pull):
+    the per-token filter/pool stages are then already done and only the
+    post-pool CVM transform applies here.
     """
-    B, T, P = pulled.shape
-    seg_np = np.asarray(segment_ids, dtype=np.int64)
-    x = _filter_and_quant(pulled, mask, seg_np, cvm_offset, need_filter,
-                          show_coeff, clk_coeff, threshold, embed_threshold,
-                          quant_ratio)
-    pooled = _pool(x, seg_np, num_slots)
+    if isinstance(pulled, PooledSlots):
+        _check_pooled_kwargs(need_filter, embed_threshold, quant_ratio)
+        pooled = pulled.pooled
+        B = pooled.shape[0]
+    else:
+        B, T, P = pulled.shape
+        seg_np = np.asarray(segment_ids, dtype=np.int64)
+        x = _filter_and_quant(pulled, mask, seg_np, cvm_offset, need_filter,
+                              show_coeff, clk_coeff, threshold,
+                              embed_threshold, quant_ratio)
+        pooled = _pool(x, seg_np, num_slots)
     if use_cvm:
         log_show = jnp.log(pooled[..., 0:1] + 1.0)
         log_ctr = jnp.log(pooled[..., 1:2] + 1.0) - log_show
@@ -147,22 +199,28 @@ def fused_seqpool_cvm_with_pcoc(
 
     Update phase drops all max_cvm_offset leading columns.
     """
-    B, T, E = pulled.shape
     pclk_num = cvm_offset - 4
     if pclk_num < 0:
         raise ValueError("cvm_offset must be >= 4 (show/clk/show2/clk2)")
-    seg_np = np.asarray(segment_ids, dtype=np.int64)
-    keep = mask
-    if need_filter:
-        show, clk = pulled[..., 0], pulled[..., 1]
-        keep = keep & ((show - clk) * show_coeff + clk * clk_coeff
-                       >= threshold)
-    x = pulled
-    if quant_ratio > 0:
-        q = jnp.round(x[..., max_cvm_offset:] * quant_ratio) / quant_ratio
-        x = jnp.concatenate([x[..., :max_cvm_offset], q], axis=-1)
-    x = x * keep[..., None]
-    pooled = _pool(x, seg_np, num_slots)       # (B, S, E)
+    if isinstance(pulled, PooledSlots):
+        _check_pooled_kwargs(need_filter, 0.0, quant_ratio)
+        B = pulled.shape[0]
+        pooled = pulled.pooled                 # (B, S, E)
+    else:
+        B, T, E = pulled.shape
+        seg_np = np.asarray(segment_ids, dtype=np.int64)
+        keep = mask
+        if need_filter:
+            show, clk = pulled[..., 0], pulled[..., 1]
+            keep = keep & ((show - clk) * show_coeff + clk * clk_coeff
+                           >= threshold)
+        x = pulled
+        if quant_ratio > 0:
+            q = (jnp.round(x[..., max_cvm_offset:] * quant_ratio)
+                 / quant_ratio)
+            x = jnp.concatenate([x[..., :max_cvm_offset], q], axis=-1)
+        x = x * keep[..., None]
+        pooled = _pool(x, seg_np, num_slots)   # (B, S, E)
     if not use_cvm:
         out = pooled[..., max_cvm_offset:]
     else:
@@ -202,11 +260,15 @@ def fused_seqpool_cvm_with_conv(
     Filters/quantization run at the conv layout's column offsets.
     """
     CVM_OFFSET = 3  # embed_w column in the conv layout
-    seg_np = np.asarray(segment_ids, dtype=np.int64)
-    x = _filter_and_quant(pulled, mask, seg_np, CVM_OFFSET, need_filter,
-                          show_coeff, clk_coeff, threshold, embed_threshold,
-                          quant_ratio)
-    pooled = _pool(x, seg_np, num_slots)
+    if isinstance(pulled, PooledSlots):
+        _check_pooled_kwargs(need_filter, embed_threshold, quant_ratio)
+        pooled = pulled.pooled
+    else:
+        seg_np = np.asarray(segment_ids, dtype=np.int64)
+        x = _filter_and_quant(pulled, mask, seg_np, CVM_OFFSET, need_filter,
+                              show_coeff, clk_coeff, threshold,
+                              embed_threshold, quant_ratio)
+        pooled = _pool(x, seg_np, num_slots)
     if use_cvm:
         log_show = jnp.log(pooled[..., 0:1] + 1.0)
         log_ctr = jnp.log(pooled[..., 1:2] + 1.0) - log_show
@@ -219,3 +281,177 @@ def fused_seqpool_cvm_with_conv(
     if flatten:
         out = out.reshape(out.shape[0], -1)
     return out
+
+
+# ---------------------------------------------------------------------------
+# fused gather-pool form: pull + filter + pool in one op over the device
+# table, with a custom VJP that merges the pooled cotangent per unique
+# row before touching the table — neither the (B, T, P) pulled matrix
+# nor its gradient is ever built per token. Training steps use the
+# trainer's split form instead (grad taken against the pooled output,
+# expanded by sharded.pooled_grad_tokens straight into the binned push);
+# this one-call op is the standalone form for tests and feature
+# extraction, and the reference the parity suite differentiates through.
+# ---------------------------------------------------------------------------
+
+
+@dataclasses.dataclass(frozen=True)
+class _GPStatic:
+    """Hashable static config for the gather-pool custom VJP."""
+    cfg: Any                 # EmbeddingConfig (frozen dataclass)
+    S: int
+    L: int
+    need_filter: bool
+    show_coeff: float
+    clk_coeff: float
+    embed_threshold: float
+    quant_ratio: int
+    cvm_offset: int
+    interpret: Any           # True = Pallas interpreter, None = backend pick
+
+
+def _gp_uniform_seg(S: int, L: int) -> np.ndarray:
+    return np.repeat(np.arange(S, dtype=np.int64), L)
+
+
+def _gp_forward(table, idx0, thr, st: _GPStatic):
+    """Pooled (B, S, P) rows: the Pallas kernel where its geometry holds
+    (real TPU, or interpret=True for the CPU parity tests), else the
+    identical jnp math via the unfused building blocks."""
+    from paddlebox_tpu.ops import pallas_kernels as pk
+    B, T = idx0.shape
+    W = table.shape[1]
+    use_kernel = (pk.gather_pool_geometry(B, st.S, st.L, W) is not None
+                  and (st.interpret is True
+                       or (st.interpret is None
+                           and jax.default_backend() == "tpu")))
+    if use_kernel:
+        return pk.gather_pool(
+            table, idx0, st.cfg, st.S, st.L, need_filter=st.need_filter,
+            show_coeff=st.show_coeff, clk_coeff=st.clk_coeff, threshold=thr,
+            embed_threshold=st.embed_threshold, quant_ratio=st.quant_ratio,
+            cvm_offset=st.cvm_offset, interpret=st.interpret)
+    P = st.cfg.pull_width
+    seg = _gp_uniform_seg(st.S, st.L)
+    pulled = jnp.take(table, idx0.reshape(-1), axis=0)[:, :P].reshape(
+        B, T, P)
+    x = _filter_and_quant(pulled, jnp.ones((B, T), bool), seg,
+                          st.cvm_offset, st.need_filter, st.show_coeff,
+                          st.clk_coeff, thr, st.embed_threshold,
+                          st.quant_ratio)
+    return _pool(x, seg, st.S)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4,))
+def _gather_pool_vjp(table, idx0, mask, thr, st: _GPStatic):
+    return _gp_forward(table, idx0, thr, st)
+
+
+def _gather_pool_vjp_fwd(table, idx0, mask, thr, st: _GPStatic):
+    return _gp_forward(table, idx0, thr, st), (table, idx0, mask, thr)
+
+
+def _gather_pool_vjp_bwd(st: _GPStatic, res, d_pooled):
+    """Pooling is linear, so each token's cotangent is its (example,
+    slot) pooled row times the per-token keep factor; duplicates merge
+    per unique row (dedup_tokens — the 852k-token → ~330k-unique case)
+    before the one scatter into the table cotangent. Quantization is
+    straight-through (the reference CUDA grad op distributes gradients
+    without re-applying the rounding; jnp.round's a.e.-zero derivative
+    would silently kill embedx grads)."""
+    from paddlebox_tpu.embedding.sharded import dedup_tokens
+    table, idx0, mask, thr = res
+    B, T = idx0.shape
+    S, P = st.S, st.cfg.pull_width
+    seg = _gp_uniform_seg(S, st.L)
+    bs = (jnp.arange(B, dtype=jnp.int32)[:, None]
+          * S + jnp.asarray(seg, jnp.int32)[None, :]).reshape(-1)
+    d_tok = jnp.take(d_pooled.reshape(B * S, P), bs, axis=0)
+    keep = mask.reshape(-1)
+    if st.need_filter or st.embed_threshold > 0.0:
+        rows = jnp.take(table, idx0.reshape(-1), axis=0)
+        show, clk = rows[:, 0], rows[:, 1]
+        if st.need_filter:
+            t = jnp.asarray(thr, jnp.float32)
+            t_tok = t[jnp.asarray(seg)] if t.ndim == 1 else t
+            t_flat = jnp.broadcast_to(t_tok, (B, T)).reshape(-1)
+            keep = keep & ((show - clk) * st.show_coeff
+                           + clk * st.clk_coeff >= t_flat)
+        if st.embed_threshold > 0.0:
+            w = rows[:, st.cvm_offset]
+            keep = keep & ~((show > st.embed_threshold)
+                            & (jnp.abs(w) < st.embed_threshold))
+    d_tok = d_tok * keep.astype(d_tok.dtype)[:, None]
+    uniq, inverse = dedup_tokens(idx0.reshape(-1))
+    merged = jnp.zeros((uniq.shape[0], P),
+                       d_tok.dtype).at[inverse].add(d_tok)
+    pad = jnp.zeros((merged.shape[0], table.shape[1] - P), merged.dtype)
+    d_table = jnp.zeros_like(table).at[uniq].add(
+        jnp.concatenate([merged, pad], axis=1))
+    f0 = jax.dtypes.float0
+    return (d_table, np.zeros(idx0.shape, f0), np.zeros(mask.shape, f0),
+            jnp.zeros_like(thr))
+
+
+_gather_pool_vjp.defvjp(_gather_pool_vjp_fwd, _gather_pool_vjp_bwd)
+
+
+def fused_gather_seqpool_cvm(
+    table: jnp.ndarray,
+    idx: jnp.ndarray,
+    mask: jnp.ndarray,
+    segment_ids: np.ndarray | jnp.ndarray,
+    num_slots: int,
+    cfg,
+    use_cvm: bool = True,
+    cvm_offset: int = 2,
+    need_filter: bool = False,
+    show_coeff: float = 0.2,
+    clk_coeff: float = 1.0,
+    threshold=0.96,
+    embed_threshold: float = 0.0,
+    quant_ratio: int = 0,
+    flatten: bool = True,
+    interpret: bool | None = None,
+) -> jnp.ndarray:
+    """table (n_rows, W) × idx/mask (B, T) → pooled+CVM features, fused.
+
+    Same contract as ``fused_seqpool_cvm(lookup(table, idx), mask, ...)``
+    for f32 tables whose row NULL_INDEX is the all-zero row, but the
+    per-token pulled matrix never materializes: the forward gathers and
+    pools inside one Pallas kernel (or the equivalent jnp reference off
+    the kernel's geometry), and the custom VJP merges the pooled
+    cotangent per unique row before the single table scatter. Requires
+    the uniform slot layout (equal max_len per slot). cfg is the table's
+    EmbeddingConfig (pull_width source of truth).
+    """
+    if cfg.mf_create_threshold > 0 or cfg.expand_create_threshold > 0:
+        # both the kernel and the jnp path here gather raw rows —
+        # lookup()'s gate_pull presence masks would be silently skipped
+        raise ValueError(
+            "fused_gather_seqpool_cvm skips gate_pull; create-threshold "
+            "configs (mf/expand_create_threshold > 0) must use the "
+            "unfused lookup + fused_seqpool_cvm path")
+    seg_np = np.asarray(segment_ids, dtype=np.int64)
+    S = num_slots
+    if S <= 0 or idx.shape[1] % S:
+        raise ValueError(f"token axis {idx.shape[1]} must be a multiple "
+                         f"of num_slots {S}")
+    L = idx.shape[1] // S
+    if not np.array_equal(seg_np, _gp_uniform_seg(S, L)):
+        raise ValueError(
+            "fused gather-pool requires the uniform slot layout "
+            "(equal max_len per slot); use the unfused path")
+    mask_a = jnp.asarray(mask)
+    idx0 = jnp.where(mask_a, jnp.asarray(idx), 0).astype(jnp.int32)
+    st = _GPStatic(cfg=cfg, S=S, L=L, need_filter=bool(need_filter),
+                   show_coeff=float(show_coeff),
+                   clk_coeff=float(clk_coeff),
+                   embed_threshold=float(embed_threshold),
+                   quant_ratio=int(quant_ratio),
+                   cvm_offset=int(cvm_offset), interpret=interpret)
+    thr = jnp.asarray(threshold, jnp.float32)
+    pooled = _gather_pool_vjp(table, idx0, mask_a, thr, st)
+    return fused_seqpool_cvm(PooledSlots(pooled), mask, segment_ids,
+                             num_slots, use_cvm=use_cvm,
+                             cvm_offset=cvm_offset, flatten=flatten)

@@ -34,6 +34,7 @@ from paddlebox_tpu.embedding import (EmbeddingConfig, HostEmbeddingStore,
                                      PassWorkingSet, sharded)
 from paddlebox_tpu.embedding.feed_pass import FeedPassManager
 from paddlebox_tpu.metrics import auc as auc_lib
+from paddlebox_tpu.ops.seqpool_cvm import PooledSlots
 from paddlebox_tpu.parallel import dense_sync
 from paddlebox_tpu.train import optimizers
 from paddlebox_tpu.parallel import mesh as mesh_lib
@@ -218,6 +219,11 @@ class Trainer:
             raise NotImplementedError(
                 "models with batch_extras support the allreduce "
                 "dense-sync mode only")
+        # Pull engine: multi-hot/wide-dim layouts pool the pulled rows
+        # per (example, slot) INSIDE the pull (fused gather-pool) so the
+        # (B*T, pull_width) token matrix never crosses the model; the
+        # heuristic is trace-time static, like the push engine.
+        self.pull_engine = self._select_pull_engine()
         # Host-side binned-push plan (native counting sort in the pack
         # pipeline) replaces the on-device argsort of the scatter-free
         # push — single-shard TPU tables only (post-all_to_all tokens
@@ -311,6 +317,8 @@ class Trainer:
         # far more than a single-chip step — so it only engages on
         # multi-shard meshes where ICI volume is what it buys down.
         dedup = config_flags.pullpush_dedup_keys and self.n_shards > 1
+        fused_pull = self.pull_engine == "fused_gather_pool"
+        L_hot = T // num_slots if fused_pull else 0
 
         def core(tshard, idx_l, mask_l, dense_l, labels_l, params,
                  order, rstart, endb, uniq, segb, *extras_l):
@@ -319,6 +327,56 @@ class Trainer:
                     if order.shape[0] or uniq.shape[0] else None)
             B_l = idx_l.shape[0]
             flat_idx = idx_l.reshape(-1)
+            if fused_pull:
+                # fused gather-pool pull (single-shard by the heuristic):
+                # rows pool per (example, slot) inside the pull and the
+                # model consumes the (B, S, P) sums via PooledSlots — the
+                # (B*T, P) token matrix exists in neither direction
+                # (backward expands the pooled cotangent per token
+                # straight into the premerge/binned push).
+                if "lookup" in ablate:
+                    pooled = lax.optimization_barrier(
+                        jnp.zeros((B_l, num_slots, emb_cfg.pull_width),
+                                  jnp.float32) + labels_l[0] * 0)
+                else:
+                    pooled = sharded.fused_pull_pool(
+                        tshard, idx_l, emb_cfg, num_slots, L_hot)
+                dropped = jnp.zeros((), jnp.int32)
+
+                def loss_fn(p, pooled_in):
+                    logits = model.apply(p, PooledSlots(pooled_in), mask_l,
+                                         dense_l, seg, num_slots,
+                                         *extras_l)
+                    loss = jnp.mean(
+                        optax.sigmoid_binary_cross_entropy(logits,
+                                                           labels_l))
+                    return loss, jax.nn.sigmoid(logits)
+
+                if "fwdbwd" in ablate:
+                    loss = jnp.sum(pooled) * 1e-8
+                    preds = jnp.zeros((B_l,), jnp.float32)
+                    gp = jax.tree.map(jnp.zeros_like, params)
+                    sgrad = lax.optimization_barrier(
+                        jnp.zeros((B_l * T, emb_cfg.grad_width),
+                                  jnp.float32) + loss * 0)
+                else:
+                    grad_fn = jax.value_and_grad(loss_fn, argnums=(0, 1),
+                                                 has_aux=True)
+                    (loss, preds), (gp, gpooled) = grad_fn(params, pooled)
+                    sgrad = sharded.pooled_grad_tokens(gpooled, mask_l,
+                                                       seg, num_slots)
+                    if cfg.scale_sparse_grad_by_global_mean:
+                        sgrad = sgrad / D
+                if "push" in ablate:
+                    new_shard = tshard
+                else:
+                    show_inc = mask_l.reshape(-1).astype(jnp.float32)
+                    clk_inc = (mask_l.astype(jnp.float32)
+                               * labels_l[:, None]).reshape(-1)
+                    new_shard = sharded.routed_push(
+                        tshard, flat_idx, sgrad, show_inc, clk_inc,
+                        emb_cfg, axes, capf, dedup=dedup, plan=plan)
+                return new_shard, gp, loss, preds, lax.psum(dropped, axes)
             if "lookup" in ablate:
                 pulled = lax.optimization_barrier(
                     jnp.zeros((B_l * T, emb_cfg.pull_width), jnp.float32)
@@ -571,9 +629,18 @@ class Trainer:
 
         num_slots = self.layout.num_slots
         n_extras = self._n_extras
+        fused_pull = self.pull_engine == "fused_gather_pool"
+        L_hot = T // num_slots if fused_pull else 0
 
         def body(tshard, idx_l, mask_l, dense_l, params, *extras_l):
             B_l = idx_l.shape[0]
+            if fused_pull:
+                pooled = sharded.fused_pull_pool(tshard, idx_l, emb_cfg,
+                                                 num_slots, L_hot)
+                logits = model.apply(params, PooledSlots(pooled), mask_l,
+                                     dense_l, seg, num_slots, *extras_l)
+                return (jax.nn.sigmoid(logits),
+                        lax.psum(jnp.zeros((), jnp.int32), axes))
             pulled, dropped = sharded.routed_lookup(
                 tshard, idx_l.reshape(-1), emb_cfg, axes, capf,
                 dedup=dedup, return_dropped=True)
@@ -764,6 +831,51 @@ class Trainer:
         o, u, s, r, e = dedup_plan(idx.reshape(-1), ws.padded_rows,
                                    SB, NB)
         return (o, r, e, u, s) if geom is not None else (o, Z, Z, u, s)
+
+    def _select_pull_engine(self) -> str:
+        """Which pull engine the step programs compile with (trace-time
+        static, recorded per bench matrix point like push_engine).
+
+        "fused_gather_pool" — rows pool per (example, slot) inside the
+        pull (sharded.fused_pull_pool; Pallas gather_pool on real TPU)
+        and the model consumes the (B, S, P) sums via PooledSlots; the
+        pooled cotangent expands per token into the dedup premerge +
+        binned push. flags.fused_gather_pool "auto" selects it where the
+        (tokens, P) matrix is the measured envelope gap: multi-hot
+        layouts (BENCH_r05 mh4d32 37.7k ex/s vs the 645k one-hot
+        headline) and wide rows (d128 252k) — single-shard meshes only
+        (the routed path re-expands tokens for the all_to_all anyway),
+        uniform slot layout, pooled-pull-capable models (pulled consumed
+        only through fused_seqpool_cvm*), and no create-threshold pull
+        gating (fused_pull_supported).
+
+        "gather_seqpool" — the unfused lookup + in-model seqpool path.
+        """
+        fg = config_flags.fused_gather_pool
+        if fg == "off":
+            return "gather_seqpool"
+        lay = self.layout
+        cfg = self.store.cfg
+        uniform = (lay.num_slots > 0
+                   and len(lay.slot_lens)
+                   and np.all(lay.slot_lens == lay.slot_lens[0]))
+        compatible = (uniform and self.n_shards == 1
+                      and getattr(self.model, "pooled_pull_ok", False)
+                      and sharded.fused_pull_supported(cfg))
+        if not compatible:
+            if fg == "on":
+                raise ValueError(
+                    "flags.fused_gather_pool='on' needs a single-shard "
+                    "mesh, a uniform slot layout, a pooled-pull-capable "
+                    "model (pooled_pull_ok), and no create-threshold "
+                    "pull gating")
+            return "gather_seqpool"
+        if fg == "on":
+            return "fused_gather_pool"
+        multi_hot = lay.total_len > lay.num_slots
+        wide = cfg.total_dim >= 64
+        return ("fused_gather_pool" if (multi_hot or wide)
+                else "gather_seqpool")
 
     def _dedup_premerge(self, ws: PassWorkingSet) -> bool:
         """Whether the host plan carries dedup pre-merge bounds

@@ -259,43 +259,87 @@ def attribute_step(trainer, ws, staged, step_seconds: float,
     }
 
     # --- isolated stage times (secondary; shows cross-stage overlap) ---
+    # fused-pull trainers measure the stages the fused step actually
+    # runs: gather-pool pull, pooled model fwd/bwd, and the pooled-
+    # cotangent expansion inside the push window — so the mh4d32/d128
+    # matrix attributions name the fused stages, not the unfused ones.
     table, params = ws.table, trainer.params
-
-    def lookup_fn(fidx, tbl):
-        return sharded.lookup(tbl, fidx, emb_cfg).reshape(
-            B, T, emb_cfg.pull_width)
-
-    isolated = {"lookup": timed_repeat(lookup_fn, (flat_idx, table), k=k)}
-
     import optax
+    from paddlebox_tpu.ops.seqpool_cvm import PooledSlots
     model = trainer.model
     seg = trainer.layout.segment_ids
     num_slots = trainer.layout.num_slots
-    pulled0 = jax.jit(lookup_fn)(flat_idx, table)
-
-    def fwdbwd(pulled, p):
-        def loss_fn(pp, pin):
-            logits = model.apply(pp, pin, mask, dense, seg, num_slots)
-            return jnp.mean(
-                optax.sigmoid_binary_cross_entropy(logits, labels))
-        _, (gp, gpull) = jax.value_and_grad(loss_fn, argnums=(0, 1))(
-            p, pulled)
-        return gpull
-
-    isolated["dense_fwd_bwd"] = timed_repeat(fwdbwd, (pulled0, params),
-                                             k=k)
-    gpull0 = jax.jit(fwdbwd)(pulled0, params)
-    sgrad0 = jax.jit(lambda g: g[..., 2:].reshape(-1, emb_cfg.grad_width)
-                     )(gpull0)
+    fused_pull = (getattr(trainer, "pull_engine", "gather_seqpool")
+                  == "fused_gather_pool")
+    mask_dev = jnp.asarray(np.asarray(mask))
     shows0 = jnp.asarray(np.asarray(mask).reshape(-1).astype(np.float32))
     clks0 = jnp.zeros_like(shows0)
     plan_t = tuple(plan) if plan and plan[0].shape[0] else None
 
-    def push_fn(sg, tbl):
-        return sharded.push(tbl, flat_idx, sg, shows0, clks0, emb_cfg,
-                            plan=plan_t)
+    if fused_pull:
+        L_hot = T // num_slots
+        idx_dev = jnp.asarray(np.asarray(idx))
 
-    isolated["sparse_push"] = timed_repeat(push_fn, (sgrad0, table), k=k)
+        def lookup_fn(fidx2, tbl):
+            return sharded.fused_pull_pool(tbl, fidx2, emb_cfg,
+                                           num_slots, L_hot)
+
+        isolated = {"lookup": timed_repeat(lookup_fn, (idx_dev, table),
+                                           k=k)}
+        pooled0 = jax.jit(lookup_fn)(idx_dev, table)
+
+        def fwdbwd(pooled, p):
+            def loss_fn(pp, pin):
+                logits = model.apply(pp, PooledSlots(pin), mask, dense,
+                                     seg, num_slots)
+                return jnp.mean(
+                    optax.sigmoid_binary_cross_entropy(logits, labels))
+            _, (gp, gpooled) = jax.value_and_grad(
+                loss_fn, argnums=(0, 1))(p, pooled)
+            return gpooled
+
+        isolated["dense_fwd_bwd"] = timed_repeat(fwdbwd,
+                                                 (pooled0, params), k=k)
+        gpooled0 = jax.jit(fwdbwd)(pooled0, params)
+
+        def push_fn(gpool, tbl):
+            sg = sharded.pooled_grad_tokens(gpool, mask_dev, seg,
+                                            num_slots)
+            return sharded.push(tbl, flat_idx, sg, shows0, clks0,
+                                emb_cfg, plan=plan_t)
+
+        isolated["sparse_push"] = timed_repeat(push_fn, (gpooled0, table),
+                                               k=k)
+    else:
+        def lookup_fn(fidx, tbl):
+            return sharded.lookup(tbl, fidx, emb_cfg).reshape(
+                B, T, emb_cfg.pull_width)
+
+        isolated = {"lookup": timed_repeat(lookup_fn, (flat_idx, table),
+                                           k=k)}
+        pulled0 = jax.jit(lookup_fn)(flat_idx, table)
+
+        def fwdbwd(pulled, p):
+            def loss_fn(pp, pin):
+                logits = model.apply(pp, pin, mask, dense, seg, num_slots)
+                return jnp.mean(
+                    optax.sigmoid_binary_cross_entropy(logits, labels))
+            _, (gp, gpull) = jax.value_and_grad(loss_fn, argnums=(0, 1))(
+                p, pulled)
+            return gpull
+
+        isolated["dense_fwd_bwd"] = timed_repeat(fwdbwd, (pulled0, params),
+                                                 k=k)
+        gpull0 = jax.jit(fwdbwd)(pulled0, params)
+        sgrad0 = jax.jit(
+            lambda g: g[..., 2:].reshape(-1, emb_cfg.grad_width))(gpull0)
+
+        def push_fn(sg, tbl):
+            return sharded.push(tbl, flat_idx, sg, shows0, clks0, emb_cfg,
+                                plan=plan_t)
+
+        isolated["sparse_push"] = timed_repeat(push_fn, (sgrad0, table),
+                                               k=k)
 
     attributed = float(sum(stages.values()))
     single = times[0]

@@ -40,11 +40,14 @@ def test_device_width_rules():
     old = flags.table_pad_width
     try:
         flags.table_pad_width = "auto"
-        # width-aware: only the pathological 16..63-lane gather zone
-        # pads (round-5 v5e sweep); 13-lane and >=64-lane sources are
-        # already fast and keep their logical width
+        # width-aware: only the pathological 14..63-lane gather zone
+        # pads (round-5 v5e sweep — the slowdown starts at 14, ADVICE
+        # r5); <=13-lane and >=64-lane sources are already fast and
+        # keep their logical width
         assert device_width(EmbeddingConfig(dim=8)) == \
             EmbeddingConfig(dim=8).row_width                  # rw 13
+        assert device_width(EmbeddingConfig(dim=9)) == 64     # rw 14
+        assert device_width(EmbeddingConfig(dim=10)) == 64    # rw 15
         assert device_width(EmbeddingConfig(dim=32)) == 64    # rw 38
         assert device_width(EmbeddingConfig(dim=50)) == 64    # rw 55
         assert device_width(EmbeddingConfig(dim=100)) == \
