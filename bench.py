@@ -72,6 +72,100 @@ def _peaks(device_kind: str):
     return None
 
 
+# ---------------------------------------------------------------------------
+# Round-over-round regression gate (the discipline round 5 lacked: a 1.87x
+# headline regression shipped inside a green artifact). BENCH_BEST.json
+# holds the best RECORDED value per metric per matrix point; every number
+# this run produces is compared against it, every point gets an explicit
+# ok/REGRESS line in the artifact AND the compact tail, and an unwaived
+# >threshold regression fails audit_ok + the process exit code.
+# ---------------------------------------------------------------------------
+
+GATE_THRESHOLD = 0.10
+
+
+def load_bench_best() -> dict | None:
+    """BENCH_BEST.json next to this file (PBTPU_BENCH_BEST overrides —
+    tests inject synthetic bests through it). None when absent."""
+    path = os.environ.get(
+        "PBTPU_BENCH_BEST",
+        os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                     "BENCH_BEST.json"))
+    try:
+        with open(path) as f:
+            return json.load(f)
+    except (OSError, ValueError):
+        return None
+
+
+def collect_gate_metrics(eps_chip: float, detail: dict) -> dict:
+    """Flatten this run's recorded numbers into the gate's metric
+    namespace (higher is better for every one of them)."""
+    m = {"headline_eps": eps_chip}
+    for name, point in (detail.get("matrix") or {}).items():
+        if isinstance(point, dict) and \
+                "examples_per_sec_per_chip" in point:
+            m[f"matrix.{name}"] = point["examples_per_sec_per_chip"]
+    e2e = detail.get("e2e")
+    if isinstance(e2e, dict) and "examples_per_sec_per_chip" in e2e:
+        m["e2e_eps"] = e2e["examples_per_sec_per_chip"]
+    host = detail.get("host")
+    if isinstance(host, dict) and \
+            isinstance(host.get("derived_max_feed_eps_per_chip"),
+                       (int, float)):
+        m["host.derived_max_feed_eps"] = \
+            host["derived_max_feed_eps_per_chip"]
+    return m
+
+
+def apply_regression_gate(current: dict, best: dict | None,
+                          device_kind: str) -> dict:
+    """Compare `current` metrics against the recorded bests.
+
+    Returns the gate record for the artifact: per-metric
+    ``ok(+x%)`` / ``REGRESS(-x%)`` / ``REGRESS(-x%) waived: note`` lines,
+    and ``ok`` False iff any metric regressed more than the threshold
+    WITHOUT an explicit waiver note. Skips (ok) when no best file exists
+    or it was recorded on different hardware — a CPU dryrun must not
+    "regress" against chip numbers."""
+    if not best:
+        return {"ok": True, "skipped": "no BENCH_BEST.json recorded"}
+    want_kind = best.get("device_kind")
+    if want_kind is not None and want_kind != device_kind:
+        return {"ok": True,
+                "skipped": f"BENCH_BEST records {want_kind!r}, this run "
+                           f"is on {device_kind!r} — not comparable"}
+    thresh = float(best.get("threshold", GATE_THRESHOLD))
+    waivers = best.get("waivers", {}) or {}
+    lines: dict = {}
+    ok = True
+    regressed = []
+    for name, best_v in (best.get("metrics") or {}).items():
+        cur = current.get(name)
+        if cur is None:
+            lines[name] = "missing (not measured this run)"
+            continue
+        rel = cur / best_v - 1.0
+        if rel < -thresh:
+            if name in waivers:
+                lines[name] = (f"REGRESS({rel:+.0%}) waived: "
+                               f"{waivers[name]}")
+            else:
+                lines[name] = f"REGRESS({rel:+.0%})"
+                regressed.append(name)
+                ok = False
+        else:
+            lines[name] = f"ok({rel:+.0%})"
+    for name in current:
+        if name not in lines:
+            lines[name] = "new (no recorded best)"
+    return {"ok": ok, "threshold": thresh, "lines": lines,
+            "regressed": regressed,
+            "note": "values compared against the best RECORDED value per "
+                    "metric (BENCH_BEST.json); an unwaived regression "
+                    "past the threshold fails audit_ok and the exit code"}
+
+
 def _mark(msg, t0=[None]):
     if t0[0] is None:
         t0[0] = time.time()
@@ -108,7 +202,7 @@ def device_step_bench(small: bool, mode: str = "allreduce",
                       batch_per_dev: int | None = None,
                       n_split: int | None = None,
                       emb_dim: int = 8, max_len: int = 1,
-                      return_ctx: bool = False):
+                      return_ctx: bool = False, tiny: bool = False):
     import jax
     from paddlebox_tpu.config import flags as config_flags
     from paddlebox_tpu.data import DataFeedSchema
@@ -125,9 +219,13 @@ def device_step_bench(small: bool, mode: str = "allreduce",
                                        else n_split)
     devices = jax.devices()
     n_dev = len(devices)
-    num_slots, dense_dim, hidden = 26, 13, (400, 400, 400)
+    # tiny = --dryrun geometry: small enough that the full bench pipeline
+    # (trainer, attribution, floor, gate) runs in seconds on one CPU —
+    # the code paths are the product, the numbers are not
+    num_slots, dense_dim, hidden = ((4, 3, (32,)) if tiny
+                                    else (26, 13, (400, 400, 400)))
     if batch_per_dev is None:
-        batch_per_dev = 256 if small else 8192
+        batch_per_dev = 64 if tiny else (256 if small else 8192)
     batch = batch_per_dev * n_dev
     schema = DataFeedSchema.ctr(num_sparse=num_slots, num_float=dense_dim,
                                 batch_size=batch, max_len=max_len)
@@ -144,7 +242,7 @@ def device_step_bench(small: bool, mode: str = "allreduce",
                  TrainerConfig(global_batch_size=batch, auc_buckets=1 << 16,
                                dense_sync_mode=mode))
     rng = np.random.default_rng(0)
-    n_keys = 1 << (14 if small else 19)
+    n_keys = 1 << (9 if tiny else (14 if small else 19))
     keys = rng.choice(1 << 50, n_keys, replace=False).astype(np.uint64)
     _mark("keys ready")
     ws = PassWorkingSet.begin_pass(store, keys, mesh)
@@ -214,6 +312,14 @@ def device_step_bench(small: bool, mode: str = "allreduce",
                 table, params, opt, loss, preds, drop = tr._step_fn(
                     table, params, opt, *b)
                 params, opt = tr._sync_fn(params, opt)
+            elif tr.push_overlap:
+                # deferred push pipeline (flags.push_overlap): loss-path
+                # program + apply program back to back, train_pass's
+                # dataflow — the headline measures the mode training runs
+                out = tr._defer_step_fn(table, *dstate, *b)
+                dstate, ops, loss, _, _ = tr.split_defer_out(out)
+                table = tr._apply_fn(table, b[0], b[1], b[3],
+                                     *b[4:9], *ops)
             else:
                 out = tr._step_fn(table, *dstate, *b)
                 table, dstate, loss, _, _ = tr.split_step_out(out)
@@ -276,6 +382,18 @@ def device_step_bench(small: bool, mode: str = "allreduce",
     else:
         audit["ok"] = True  # unknown hardware (CPU smoke): no peak table
     from paddlebox_tpu.ops import pallas_kernels as _pk
+    from paddlebox_tpu.utils.step_probe import push_floor_analysis
+    # sparse-push floor: analytic per-stage bounds for THIS point's
+    # geometry; the closure statement is finalized once the attribution
+    # measures the real push stage (_enrich) — regressions then alarm
+    # against the push's own physics, not just the chip peaks. PER-SHARD
+    # geometry: the kernel/engine dispatch keys on rows_per_shard and
+    # each shard pushes its local tokens, so the floor must model the
+    # pass one chip actually performs (global rows would overstate the
+    # update bytes n_shards-fold and could even flip the engine)
+    push_floor = push_floor_analysis(
+        emb_cfg, ws.rows_per_shard, batch * T // n_dev,
+        n_split=config_flags.binned_push_splits, peaks=peaks)
     detail = {
         "device_kind": kind,
         "storage": storage,
@@ -292,6 +410,15 @@ def device_step_bench(small: bool, mode: str = "allreduce",
         # fused gather-pool for multi-hot/wide layouts — the mh4d32 and
         # d128 envelope points — unfused lookup+seqpool elsewhere)
         "pull_engine": tr.pull_engine,
+        # which _bp_pack width-class path the push compiled with (None =
+        # scatter engine, no pack; premerged points compile no reorder
+        # at all) — the per-point record whose absence let the round-5
+        # pack rewrite regress the headline unnoticed
+        "pack_engine": _pk.pack_engine(
+            emb_cfg, ws.rows_per_shard,
+            premerged=tr._use_plan and tr._dedup_premerge(ws)),
+        # deferred-push pipeline state (flags.push_overlap)
+        "push_overlap": "on" if tr.push_overlap else "off",
         "steps_per_dispatch": ksd,
         "devices": n_dev,
         "global_batch": batch,
@@ -301,6 +428,7 @@ def device_step_bench(small: bool, mode: str = "allreduce",
         "working_set_keys": n_keys,
         "loss_final": loss_v,
         "audit": audit,
+        "push_floor": push_floor,
     }
     if return_ctx:
         # live handles for a later attribution pass (main runs it under
@@ -312,7 +440,8 @@ def device_step_bench(small: bool, mode: str = "allreduce",
     return eps_chip, detail
 
 
-def _attribute_with_retry(tr, ws, staged0, step_seconds, small):
+def _attribute_with_retry(tr, ws, staged0, step_seconds, small,
+                          tiny=False):
     """Stage attribution (log_for_profile's cal-split analogue,
     boxps_worker.cc:746-759) with ONE retry — BENCH_r03 was killed by a
     transient tunnel error here (VERDICT r3 missing #2). Transient and
@@ -327,8 +456,9 @@ def _attribute_with_retry(tr, ws, staged0, step_seconds, small):
     for attempt in (0, 1):
         try:
             res = attribute_step(tr, ws, staged0, step_seconds,
-                                 k=4 if small else 24,
-                                 n_loop=10 if small else 100)
+                                 k=2 if tiny else (4 if small else 24),
+                                 n_loop=3 if tiny else
+                                 (10 if small else 100))
             _mark(f"stage attribution done (coverage "
                   f"{res['coverage']:.0%})")
             return res
@@ -645,9 +775,9 @@ def host_bench(small: bool) -> dict:
     out["derived_note"] = (
         f"one pack thread on this host sustains batch={batch} every "
         f"{per_batch*1e3:.1f}ms = {batch/per_batch:,.0f} ex/s of "
-        "translate+plan; headline device step consumes "
-        "~1.2M ex/s/chip, so one core feeds one chip with margin "
-        f"{batch/per_batch/1.2e6:.1f}x")
+        "translate+plan; compare against THIS artifact's recorded "
+        "headline eps (feed_margin_vs_headline) — no hardcoded "
+        "device-step constants here")
 
     # --- superstep A/B (VERDICT r4 weak #4): steps_per_dispatch exists
     # for DISPATCH-BOUND hosts; the tunneled TPU measured it neutral
@@ -687,8 +817,71 @@ def host_bench(small: bool) -> dict:
     return out
 
 
+def dryrun_main() -> int:
+    """Fast CPU smoke of the bench's regression-gate, stage-attribution,
+    and push-floor code paths (tier-1: exercised on every PR instead of
+    only on-chip). Tiny geometry — the numbers are meaningless, the
+    MACHINERY is the product: the attribution must produce a stage
+    account, the floor must close (or abstain with a reason), and the
+    gate must (a) skip bests recorded on foreign hardware, (b) TRIP on
+    an injected synthetic >10% regression, (c) honor an explicit waiver
+    note, (d) pass at parity. Prints ONE JSON line; exit 0 iff all four
+    behaved."""
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    from paddlebox_tpu.utils.step_probe import finalize_push_floor
+
+    checks: dict = {}
+    eps, detail, ctx = device_step_bench(True, n_steps=2, n_windows=1,
+                                         tiny=True, return_ctx=True)
+    attr = _attribute_with_retry(ctx["tr"], ctx["ws"], ctx["staged0"],
+                                 ctx["step_seconds"], True, tiny=True)
+    detail["stage_attribution"] = attr
+    checks["attribution_ok"] = bool(attr.get("stages"))
+    if "push_floor" in detail:
+        finalize_push_floor(detail["push_floor"],
+                            (attr.get("stages") or {}).get("sparse_push"))
+    checks["floor_ok"] = "closed" in (detail.get("push_floor") or {})
+    ctx.clear()
+    metrics = collect_gate_metrics(eps, detail)
+    kind = detail.get("device_kind", "")
+    committed = load_bench_best()
+    g0 = apply_regression_gate(metrics, committed, kind)
+    checks["gate_skips_foreign_hardware"] = (committed is None
+                                            or bool(g0.get("skipped")))
+    synth = {"device_kind": None,
+             "metrics": {"headline_eps": eps * 2.0}}
+    g1 = apply_regression_gate(metrics, synth, kind)
+    checks["gate_trips_on_regression"] = not g1["ok"]
+    g2 = apply_regression_gate(
+        metrics, dict(synth, waivers={"headline_eps":
+                                      "synthetic dryrun waiver"}), kind)
+    checks["waiver_untrips"] = g2["ok"]
+    g3 = apply_regression_gate(
+        metrics, {"device_kind": None,
+                  "metrics": {"headline_eps": eps}}, kind)
+    checks["gate_ok_at_parity"] = g3["ok"]
+    ok = all(checks.values())
+    print(json.dumps({
+        "metric": "bench_dryrun", "ok": ok, "checks": checks,
+        "value": round(eps, 1),
+        "pack_engine": detail.get("pack_engine"),
+        "push_engine": detail.get("push_engine"),
+        "push_overlap": detail.get("push_overlap"),
+        "push_floor_closed": (detail.get("push_floor") or {}
+                              ).get("closed"),
+        "overlap_ab": attr.get("overlap_ab"),
+        "stages": attr.get("stages"),
+        "gate_example_lines": g1.get("lines"),
+    }), flush=True)
+    return 0 if ok else 2
+
+
 def main() -> None:
     import jax
+
+    if "--dryrun" in sys.argv:
+        raise SystemExit(dryrun_main())
 
     if "--host" in sys.argv:
         # host-section subprocess entry (see _enrich): CPU backend,
@@ -731,11 +924,28 @@ def main() -> None:
     # the run was interrupted.
     pending = None
     try:
-        _enrich(small, detail, ctx)
+        _enrich(small, detail, ctx, eps_chip)
     except BaseException as e:
         detail["bench_error"] = repr(e)
         if not isinstance(e, Exception):
             pending = e
+
+    # round-over-round regression gate: every recorded number vs the best
+    # recorded value for this hardware (BENCH_BEST.json); an unwaived
+    # >10% regression fails audit_ok — the alarm round 5 did not have.
+    # Guarded like _enrich: a hand-edited BENCH_BEST.json with a zero /
+    # quoted / malformed value must not hold the artifact hostage
+    # (the one JSON line below prints NO MATTER WHAT).
+    try:
+        gate = apply_regression_gate(
+            collect_gate_metrics(eps_chip, detail), load_bench_best(),
+            detail.get("device_kind", ""))
+    except Exception as e:
+        gate = {"ok": False, "regressed": [],
+                "error": f"gate failed on BENCH_BEST.json: {e!r}",
+                "lines": {}}
+    detail["regression_gate"] = gate
+    detail["audit"]["ok"] = detail["audit"]["ok"] and gate["ok"]
 
     print(json.dumps({
         "metric": "deepfm_device_step_examples_per_sec_per_chip",
@@ -760,6 +970,18 @@ def main() -> None:
               for k, v in detail.get("matrix", {}).items()
               if isinstance(v, dict)
               and "examples_per_sec_per_chip" in v}
+    # compact gate tail: one token per regressed metric (ok runs print
+    # "ok"); the tail line alone must carry the verdict
+    if gate.get("error"):
+        gate_short = f"error({gate['error'][:80]})"
+    elif gate.get("skipped"):
+        gate_short = f"skipped({gate['skipped'][:60]})"
+    elif gate["ok"]:
+        gate_short = "ok"
+    else:
+        gate_short = "REGRESS:" + ",".join(
+            f"{n}({gate['lines'][n].split('(')[1].rstrip(')')})"
+            for n in gate.get("regressed", []))
     summary = {
         "metric": "deepfm_device_step_examples_per_sec_per_chip",
         "value": round(eps_chip, 1),
@@ -767,8 +989,11 @@ def main() -> None:
         "vs_baseline": round(eps_chip / TARGET_PER_CHIP, 4),
         "step_ms": round(detail["audit"]["step_seconds"] * 1e3, 2),
         "audit_ok": detail["audit"]["ok"],
+        "gate": gate_short,
         "push_engine": detail.get("push_engine"),
         "pull_engine": detail.get("pull_engine"),
+        "pack_engine": detail.get("pack_engine"),
+        "push_overlap": detail.get("push_overlap"),
         "matrix_eps": mshort,
         "e2e_eps": (detail.get("e2e", {}).get(
             "examples_per_sec_per_chip")
@@ -781,6 +1006,11 @@ def main() -> None:
     print(json.dumps(summary), flush=True)
     if pending is not None:
         raise pending
+    if not gate["ok"]:
+        print("REGRESSION GATE FAIL: " + (gate.get("error") or "; ".join(
+            f"{n} {gate['lines'][n]}" for n in gate.get("regressed", []))),
+            file=sys.stderr)
+        raise SystemExit(2)
     if not detail["audit"]["ok"]:
         print("AUDIT FAIL: implied MFU/HBM exceeds hardware peaks — the "
               "measurement window is broken; do not trust the number",
@@ -788,15 +1018,22 @@ def main() -> None:
         raise SystemExit(2)
 
 
-def _enrich(small: bool, detail: dict, ctx: dict) -> None:
+def _enrich(small: bool, detail: dict, ctx: dict,
+            eps_chip: float | None = None) -> None:
     """Attribution + matrix + e2e datapoints, mutating `detail` in place
     so partial progress survives any failure (main prints whatever
     landed)."""
+    from paddlebox_tpu.utils.step_probe import finalize_push_floor
     if ctx["mode"] == "allreduce" and ctx["n_dev"] == 1 \
             and os.environ.get("PBTPU_BENCH_ATTR", "1") != "0":
         detail["stage_attribution"] = _attribute_with_retry(
             ctx["tr"], ctx["ws"], ctx["staged0"], ctx["step_seconds"],
             small)
+        if "push_floor" in detail:
+            finalize_push_floor(
+                detail["push_floor"],
+                detail["stage_attribution"].get("stages", {})
+                .get("sparse_push"))
     # release the headline run's device buffers before the matrix
     # re-allocates its own table + staged batches
     ctx.clear()
@@ -853,6 +1090,9 @@ def _enrich(small: bool, detail: dict, ctx: dict) -> None:
                     "step_seconds": m_audit["step_seconds"],
                     "push_engine": m_detail["push_engine"],
                     "pull_engine": m_detail["pull_engine"],
+                    "pack_engine": m_detail["pack_engine"],
+                    "push_overlap": m_detail["push_overlap"],
+                    "push_floor": m_detail.get("push_floor"),
                     # per-point self-audit (VERDICT r4 weak #1): the
                     # headline's founding rule — a number without a
                     # FLOPs/bytes audit is not trusted — applied to
@@ -872,6 +1112,11 @@ def _enrich(small: bool, detail: dict, ctx: dict) -> None:
                         _attribute_with_retry(
                             m_ctx["tr"], m_ctx["ws"], m_ctx["staged0"],
                             m_ctx["step_seconds"], small)
+                    if matrix[mname].get("push_floor"):
+                        finalize_push_floor(
+                            matrix[mname]["push_floor"],
+                            matrix[mname]["stage_attribution"]
+                            .get("stages", {}).get("sparse_push"))
                     m_ctx.clear()
                 if kw.get("mode") == "async":
                     # BoxPSAsynDenseTable pulls+pushes the full flat
@@ -903,6 +1148,13 @@ def _enrich(small: bool, detail: dict, ctx: dict) -> None:
             if r.returncode == 0:
                 detail["host"] = json.loads(r.stdout.strip().
                                             splitlines()[-1])
+                cap = detail["host"].get("derived_max_feed_eps_per_chip")
+                if eps_chip and isinstance(cap, (int, float)):
+                    # the margin cites THIS run's measured headline, not
+                    # a hardcoded constant (reconciled: the r5 artifact
+                    # said "~1.2M" while recording 645k)
+                    detail["host"]["feed_margin_vs_headline"] = round(
+                        cap / eps_chip, 2)
             else:
                 detail["host"] = {"error": r.stderr[-500:]}
         except Exception as e:

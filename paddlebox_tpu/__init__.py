@@ -19,5 +19,6 @@ Pallas kernels; libbox_ps becomes `paddlebox_tpu.embedding`.
 
 __version__ = "0.1.0"
 
+from paddlebox_tpu import jax_compat as jax_compat  # noqa: F401  (shims first)
 from paddlebox_tpu import config as config  # noqa: F401
 from paddlebox_tpu.config import flags as flags  # noqa: F401

@@ -117,6 +117,30 @@ class Flags:
     # measured crossover, binned_push_supported); "kernel"/"scatter"
     # force one engine everywhere the geometry allows.
     push_engine: str = "auto"               # (new)
+    # Deferred sparse-push apply (the reference hides push latency behind
+    # the next pass's work — boxps_worker per-card push timers overlap
+    # pass boundaries): the jitted step returns the packed push operands
+    # (dedup plan + premerged grads/shows/clks) instead of applying them
+    # inline, and the trainer dispatches the binned scatter-update for
+    # step N as its OWN program while step N+1's pack/plan-H2D runs.
+    # Bounded staleness of one step, enforced (PushOperandStager refuses
+    # a second pending apply); flushed at pass boundaries and before
+    # eval/save. Bit-identical to the inline push: the apply is always
+    # data-sequenced before the next step consumes the table. "auto" =
+    # on where dense sync permits (allreduce, steps_per_dispatch == 1 —
+    # mirroring AsyncDenseTable's dispatch-decoupling semantics);
+    # "on"/"off" force. Read at Trainer construction (trace time).
+    push_overlap: str = "auto"              # (new)
+    # _bp_pack width-class engine override for A/B runs: "auto" selects
+    # per payload width (narrow < 14 lanes reorders at logical width and
+    # pads after; gather-zone 14..63 pads to 64 lanes BEFORE the reorder
+    # — the v5e 14..63-lane row-gather cliff, 3-8x slower per row; wide
+    # >= 64 packs at the full DMA width first). "narrow"/"gather_zone"/
+    # "wide" force one path everywhere its layout allows — the
+    # in-composed-step A/B knob whose absence let the round-5 _bp_pack
+    # rewrite regress the headline 1.87x unnoticed. Recorded per bench
+    # matrix point as pack_engine.
+    pack_engine: str = "auto"               # (new)
 
     # --- trainer (trainer_desc.proto:100-108, flags.cc:591-597) ---
     param_sync_step: int = 1                # BoxPSWorkerParameter.sync_dense_step

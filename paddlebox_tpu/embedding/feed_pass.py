@@ -130,6 +130,11 @@ class FeedPassManager:
 
         self._hook = hook
         store.register_flush_hook(hook)
+        # pre-flush hooks: run before this manager's own flush moves row
+        # values D2H — the trainer registers its deferred-push flush here
+        # (push_overlap) so a pending table apply lands before the rows
+        # it would change are persisted. WeakMethod like the store hook.
+        self._pre_flush: list = []
         # observability (also mirrored into the global StatRegistry)
         self.last_h2d_bytes = 0
         self.last_d2h_bytes = 0
@@ -316,6 +321,10 @@ class FeedPassManager:
         table buffer every step, so a mid-pass gather could read a dead
         buffer. Save/export/shrink belong between passes (the reference
         has the same discipline — EndPass precedes SaveDelta)."""
+        for ref in list(self._pre_flush):
+            fn = ref()
+            if fn is not None:
+                fn()
         ws = self._current
         if (ws is None or ws.table is None or self._unsynced is None
                 or not self._unsynced.any()):
@@ -413,6 +422,11 @@ class FeedPassManager:
         self.last_end_seconds = time.perf_counter() - t0
         stat_set("feed_pass.last_dirty_rows", int(ws.touched.sum()))
         return 0
+
+    def register_pre_flush(self, method) -> None:
+        """Register a bound method to run at the START of flush(), before
+        any row value moves D2H (weakly held, like the store hook)."""
+        self._pre_flush.append(weakref.WeakMethod(method))
 
     def pass_opened(self) -> None:
         """Trainer hook: the table is now being donated step-to-step;

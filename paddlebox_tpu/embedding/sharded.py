@@ -251,9 +251,42 @@ def _normalize_plan(plan):
     return ((order, rstart, endb) if order.shape[0] else None), None
 
 
+def deferred_push_operands(idx: jnp.ndarray, grads: jnp.ndarray,
+                           shows: jnp.ndarray, clks: jnp.ndarray, plan
+                           ) -> tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    """Packed push operands for a DEFERRED table apply (flags.push_overlap).
+
+    The jitted step calls this in place of the push so the scatter-update
+    leaves the loss-producing program entirely; the trainer's apply
+    program consumes the result one step later. Uniform arity (g0, g1,
+    g2) so the step's output signature is static across plan variants:
+
+    - dedup-plan batches premerge IN-STEP (plan_premerge segment-sums
+      per-token payloads onto unique lanes) → (merged_grads,
+      merged_shows, merged_clks); the apply replays only the engine on
+      the staged unique lanes.
+    - otherwise → (per-token grads, empty, empty); the apply recomputes
+      show/clk increments from the staged mask/labels (bit-identical:
+      same arrays, same ops) and runs the full push.
+
+    The premerge stays in the step deliberately: it consumes the sparse
+    cotangent right where backward produces it (off the loss path — loss
+    and preds do not depend on it), and the apply's operand shrinks to
+    one lane per unique row."""
+    if plan is not None and plan[3].shape[0]:
+        _, mg, ms, mc, _ = plan_premerge(idx, grads, shows, clks, plan)
+        return mg, ms, mc
+    # zero-length placeholders SLICED from grads (not fresh constants):
+    # they inherit the varying-manual-axes type, so the step's batch-spec
+    # out_specs hold under strict vma checking
+    empty = grads[:0, 0]
+    return grads, empty, empty
+
+
 def push(table: jnp.ndarray, idx: jnp.ndarray, grads: jnp.ndarray,
          shows: jnp.ndarray, clks: jnp.ndarray,
-         cfg: EmbeddingConfig, plan=None) -> jnp.ndarray:
+         cfg: EmbeddingConfig, plan=None,
+         premerged: bool = False) -> jnp.ndarray:
     """Merge-and-update: apply summed grads + show/clk increments in-table.
 
     idx   : (n,) int32 row indices (duplicates fine; 0 = null, must carry
@@ -261,6 +294,10 @@ def push(table: jnp.ndarray, idx: jnp.ndarray, grads: jnp.ndarray,
             routed path uses that for empty all-to-all lanes)
     grads : (n, grad_width) d_w, d_embedx per token
     shows, clks : (n,) counter increments per token
+    premerged : idx/grads/shows/clks are already unique lanes (ascending,
+            pads out-of-range — plan_premerge's output, e.g. replayed by
+            a deferred apply); `plan` is then the kernel-window 3-tuple
+            (order_or_None, rstart, end) or None, not a caller plan.
     Returns the updated table.
 
     Implementation note (TPU): duplicates are merged with ONE fused
@@ -274,8 +311,10 @@ def push(table: jnp.ndarray, idx: jnp.ndarray, grads: jnp.ndarray,
     very large working sets pick a sharded mesh (each shard scans only its
     rows).
     """
-    kplan, dplan = _normalize_plan(plan)
-    premerged = False
+    if premerged:
+        kplan, dplan = plan, None
+    else:
+        kplan, dplan = _normalize_plan(plan)
     if dplan is not None:
         # host dedup plan: segment-sum duplicates onto unique lanes
         # first, so whichever engine runs below sees each touched row

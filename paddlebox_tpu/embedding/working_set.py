@@ -228,6 +228,66 @@ def fetch_rows(table: jax.Array, row_idx: np.ndarray,
     return rows[:k], rows.nbytes
 
 
+class PushOperandStager:
+    """Double-buffered staging for the deferred sparse-push pipeline
+    (flags.push_overlap).
+
+    Two slots rotate: the PENDING slot holds step N's packed push
+    operands (staged batch refs + the step's premerged grads/shows/clks)
+    until the trainer dispatches the apply program; the RETIRED slot
+    keeps step N-1's operands referenced for one more rotation, while
+    their apply kernel may still be in flight and step N+1's plan-H2D is
+    being dispatched — so the device buffers both overlap windows read
+    stay pinned without any per-step host sync.
+
+    The pending slot is also the pipeline's staleness bound: a second
+    ``put`` before the pending apply was taken means the table would lag
+    by MORE than one unapplied step, and raises instead of queueing —
+    the trainer must dispatch the apply for step N before step N+1's
+    operands land.
+    """
+
+    __slots__ = ("_pending", "_retired", "puts", "applies")
+
+    def __init__(self):
+        self._pending = None
+        self._retired = None
+        self.puts = 0
+        self.applies = 0
+
+    def put(self, item) -> None:
+        if self._pending is not None:
+            raise RuntimeError(
+                "deferred push staleness bound exceeded: a second step's "
+                "operands were queued while one apply is still pending — "
+                "dispatch the pending apply first (one-step bound)")
+        self._pending = item
+        self.puts += 1
+
+    def take(self):
+        """Pop the pending operands (None if none). The popped item moves
+        to the retired slot — its buffers stay referenced for one more
+        rotation while the apply that consumes them is in flight."""
+        item, self._pending = self._pending, None
+        if item is not None:
+            self._retired = item
+            self.applies += 1
+        return item
+
+    def pending(self) -> int:
+        return int(self._pending is not None)
+
+    def live(self) -> int:
+        """Slots currently pinning device buffers (<= 2 by construction
+        — the leak check the deferred pipeline's tests assert on)."""
+        return (int(self._pending is not None)
+                + int(self._retired is not None))
+
+    def clear(self) -> None:
+        self._pending = None
+        self._retired = None
+
+
 class PassWorkingSet:
     def __init__(self, cfg: EmbeddingConfig, sorted_keys: np.ndarray,
                  table: jax.Array, rows_per_shard: int, n_shards: int):

@@ -1,0 +1,100 @@
+"""Compatibility shims for older JAX releases (0.4.x).
+
+The framework is written against the current JAX surface (``jax.shard_map``,
+``jax.typeof``, ``lax.axis_size``, varying-manual-axes metadata on
+``ShapeDtypeStruct``). Some deployment images pin jax 0.4.x, where those
+names live elsewhere or do not exist; importing this module (done once from
+``paddlebox_tpu/__init__``) installs equivalent aliases so the SAME package
+code runs on both:
+
+- ``jax.shard_map``      -> ``jax.experimental.shard_map.shard_map``
+  (kwarg-compatible for the subset used here: f, mesh, in_specs, out_specs).
+- ``jax.typeof``         -> ``jax.core.get_aval`` (callers only getattr
+  ``.vma`` with a default, so a plain aval suffices).
+- ``lax.axis_size``      -> ``jax.core.axis_frame`` (which on 0.4.x returns
+  the static mapped-axis size directly).
+- ``shape_struct(...)``  -> ``jax.ShapeDtypeStruct`` accepting a ``vma``
+  kwarg on every version (dropped where unsupported) — Pallas ``out_shape``
+  builders call this instead of the class.
+
+No behavior changes on a current JAX: every shim is installed only when the
+canonical name is missing, and ``shape_struct`` forwards ``vma`` verbatim
+when the class accepts it.
+"""
+
+from __future__ import annotations
+
+import jax
+
+# True when this process runs a pre-vma JAX (0.4.x shard_map). Besides the
+# missing names, ONE semantic differs: differentiating wrt a REPLICATED
+# (in_spec P()) argument INSIDE a shard_map body yields the device-local
+# cotangent — the vma-typed autodiff of current JAX inserts the psum that
+# keeps replicated values replication-invariant; 0.4.x does not. Code that
+# relies on the psummed convention (Trainer._mean_replicated_grad) checks
+# this flag and inserts the psum explicitly, so dense grads stay the
+# global mean on both versions (pinned by the mesh-8 golden trajectory).
+LEGACY_SHARD_MAP = not hasattr(jax, "shard_map")
+
+_SDS_HAS_VMA: bool | None = None
+
+
+def shape_struct(shape, dtype, vma=None):
+    """jax.ShapeDtypeStruct with the vma kwarg dropped on old JAX."""
+    global _SDS_HAS_VMA
+    if vma is None:
+        return jax.ShapeDtypeStruct(shape, dtype)
+    if _SDS_HAS_VMA is None:
+        try:
+            jax.ShapeDtypeStruct((), jax.numpy.float32, vma=frozenset())
+            _SDS_HAS_VMA = True
+        except TypeError:
+            _SDS_HAS_VMA = False
+    if _SDS_HAS_VMA:
+        return jax.ShapeDtypeStruct(shape, dtype, vma=vma)
+    return jax.ShapeDtypeStruct(shape, dtype)
+
+
+def _install() -> None:
+    if not hasattr(jax, "shard_map"):
+        from jax.experimental.shard_map import shard_map as _shard_map
+
+        def shard_map(f, *, mesh, in_specs, out_specs, **kwargs):
+            kwargs.pop("check_vma", None)  # new-API spelling of check_rep
+            # 0.4.x's static replication checker predates the vma system
+            # this code is written against and rejects valid programs
+            # (e.g. psummed cotangents of replicated inputs); the modern
+            # checker validates these, so disable the old one.
+            kwargs.setdefault("check_rep", False)
+            return _shard_map(f, mesh=mesh, in_specs=in_specs,
+                              out_specs=out_specs, **kwargs)
+
+        jax.shard_map = shard_map
+
+    if not hasattr(jax, "typeof"):
+        from jax.core import get_aval
+
+        jax.typeof = get_aval
+
+    from jax import lax
+
+    if not hasattr(lax, "axis_size"):
+        from jax.core import axis_frame
+
+        def axis_size(axis_name):
+            return axis_frame(axis_name)
+
+        lax.axis_size = axis_size
+
+    if not hasattr(lax, "pcast"):
+        # pcast only adjusts the varying-manual-axes TYPE of a value; on
+        # a pre-vma jax there is no such type (and check_rep is off), so
+        # the data-identity is the faithful lowering
+        def pcast(x, axis_name, *, to=None):
+            del axis_name, to
+            return x
+
+        lax.pcast = pcast
+
+
+_install()

@@ -46,6 +46,7 @@ from jax.experimental.pallas import tpu as pltpu
 
 from paddlebox_tpu.embedding.config import EmbeddingConfig
 from paddlebox_tpu.embedding.optim import apply_updates
+from paddlebox_tpu.jax_compat import shape_struct
 
 
 def use_pallas() -> bool:
@@ -107,7 +108,7 @@ def merge_update(table: jnp.ndarray, acc: jnp.ndarray, cfg: EmbeddingConfig,
         return jnp.where((acc[:, gw + 2] > 0)[:, None], new_rows, table)
     return pl.pallas_call(
         functools.partial(_merge_update_kernel, cfg=cfg),
-        out_shape=jax.ShapeDtypeStruct((n, w), table.dtype, vma=vma),
+        out_shape=shape_struct((n, w), table.dtype, vma=vma),
         grid=grid,
         in_specs=[
             pl.BlockSpec((block_rows, w), lambda i: (i, 0)),
@@ -340,6 +341,136 @@ def _binned_acc_kernel(rstart_ref, end_ref, packed_ref, acc_ref,
     lax.fori_loop(0, n_t, body, 0)
 
 
+# ---------------------------------------------------------------------------
+# _bp_pack width-class engines.
+#
+# The pack's one expensive op is the token reorder (``[order]`` row
+# gather), and the v5e row-gather sweep is sharply non-monotone in source
+# width: <=13-lane sources gather at ~5-10ns/row (fast narrow path),
+# 14..63-lane sources fall off a cliff (3-8x slower per row — 23.2ms at
+# 40 lanes vs 3.6ms at 128 over 852k tokens), and >=64-lane sources are
+# back on the fast path. One pack layout therefore cannot serve every
+# payload width: the round-5 _bp_pack rewrite moved the dim-8 headline's
+# 12-lane payload onto the pad-first layout and silently halved headline
+# throughput (VERDICT r5 — reverting that one function restored 1.87x).
+# The engines below make the choice EXPLICIT, per width class, overridable
+# for in-composed-step A/Bs (flags.pack_engine) and recorded per bench
+# matrix point (pack_engine()) so a wrong choice alarms instead of
+# shipping:
+#
+#   narrow      (P < 14)       reorder at the logical payload width, pad
+#                              after — the fast-narrow-gather path.
+#   gather_zone (14 <= P < 64) pad to 64 lanes BEFORE the reorder (the
+#                              smallest fast-path width), zero-extend to
+#                              the DMA width after — half the gather
+#                              bytes of the 128-lane layout.
+#   wide        (P >= 64)      pack at the full 128-lane-tile DMA width
+#                              first, one wide gather.
+# ---------------------------------------------------------------------------
+
+PACK_ENGINES = ("narrow", "gather_zone", "wide")
+
+
+def pack_width_class(P: int) -> str:
+    """Width class of a P-lane push payload (the v5e gather-sweep zones;
+    the 14-lane zone start matches device_width's pad rule)."""
+    if P < 14:
+        return "narrow"
+    if P < 64:
+        return "gather_zone"
+    return "wide"
+
+
+def _resolve_pack_engine(P: int, premerged: bool) -> str:
+    """THE pack-engine resolver — both the compiled path (_bp_pack) and
+    the per-point bench record (pack_engine) call this one function, so
+    the record can never name a code path the program does not contain
+    (the round-5 unattributable-regression failure mode). Raises on a
+    typo'd forced engine: the flag exists for trustworthy A/Bs."""
+    if premerged:
+        # premerged lanes arrive sorted (order=None): no reorder
+        # compiles regardless of width class or override
+        return "premerged_no_reorder"
+    from paddlebox_tpu.config import flags as config_flags
+    eng = config_flags.pack_engine
+    if eng in PACK_ENGINES:
+        return eng
+    if eng != "auto":
+        raise ValueError(f"pack_engine={eng!r} (want 'auto' or one of "
+                         f"{PACK_ENGINES})")
+    return pack_width_class(P)
+
+
+def pack_engine(cfg: EmbeddingConfig, n_rows: int,
+                premerged: bool = False) -> str | None:
+    """Which _bp_pack code path the binned push compiles with for this
+    (cfg, rows) — "narrow" | "gather_zone" | "wide", or None when the
+    binned kernel does not engage (scatter-engine dispatch has no pack).
+    flags.pack_engine overrides for A/B runs. Recorded per bench matrix
+    point, so every engine choice stays measured round over round.
+
+    premerged: the dedup premerge feeds the pack already-sorted lanes
+    (order=None), so NO reorder compiles regardless of width class —
+    reported as "premerged_no_reorder" so the per-point record names the
+    code path the program actually contains, not the one the width alone
+    would pick."""
+    if binned_push_geometry(cfg, n_rows) is None:
+        return None
+    return _resolve_pack_engine(cfg.grad_width + 3, premerged)
+
+
+def _pack_narrow(grads, shows, clks, hi, lo, order, tok, P, PP, W):
+    # reorder at the logical payload width (fast <14-lane gathers), pad
+    # to the DMA width after — one extra elementwise pass over the
+    # already-sorted payload
+    payload = jnp.concatenate(
+        [grads, shows[:, None], clks[:, None],
+         jnp.ones((tok, 1), jnp.float32)], axis=1)
+    s_pay = jnp.take(payload, order, axis=0)
+    return jnp.concatenate(
+        [s_pay, jnp.zeros((tok, PP - P), jnp.float32),
+         jnp.take(hi, order)[:, None], jnp.take(lo, order)[:, None],
+         jnp.zeros((tok, W - PP - 2), jnp.float32)], axis=1)
+
+
+def _pack_gather_zone(grads, shows, clks, hi, lo, order, tok, P, PP, W):
+    # 14..63-lane gathers are the pathological zone — pad to 64 lanes
+    # (the smallest fast-path source width) BEFORE the reorder, then
+    # zero-extend to the DMA width; the gather moves half the bytes of
+    # the 128-lane-first layout
+    G64 = 64 if PP + 2 <= 64 else W
+    pay64 = jnp.concatenate(
+        [grads, shows[:, None], clks[:, None],
+         jnp.ones((tok, 1), jnp.float32),
+         jnp.zeros((tok, PP - P), jnp.float32),
+         hi[:, None], lo[:, None],
+         jnp.zeros((tok, G64 - PP - 2), jnp.float32)], axis=1)
+    s64 = jnp.take(pay64, order, axis=0)
+    if G64 == W:
+        return s64
+    return jnp.concatenate(
+        [s64, jnp.zeros((tok, W - G64), jnp.float32)], axis=1)
+
+
+def _pack_wide(grads, shows, clks, hi, lo, order, tok, P, PP, W):
+    # >=64-lane payloads are already on the fast gather path — pack at
+    # the full DMA width first, one wide gather (order=None skips the
+    # gather entirely: pre-merged lanes arrive sorted)
+    pay_full = jnp.concatenate(
+        [grads, shows[:, None], clks[:, None],
+         jnp.ones((tok, 1), jnp.float32),
+         jnp.zeros((tok, PP - P), jnp.float32),
+         hi[:, None], lo[:, None],
+         jnp.zeros((tok, W - PP - 2), jnp.float32)], axis=1)
+    if order is None:
+        return pay_full
+    return jnp.take(pay_full, order, axis=0)
+
+
+_PACK_BUILDERS = {"narrow": _pack_narrow, "gather_zone": _pack_gather_zone,
+                  "wide": _pack_wide}
+
+
 def _bp_pack(idx, grads, shows, clks, geom, TILE: int, n_rows: int,
              plan=None):
     """Build the kernel's packed operand: tokens grouped by super-block,
@@ -348,11 +479,12 @@ def _bp_pack(idx, grads, shows, clks, geom, TILE: int, n_rows: int,
     Split out so bench.py's stage attribution can time the prep
     separately from the kernel.
 
-    The token gather (``[order]``) runs at the FULL padded width: v5e
-    row gathers from 14..63-lane sources are 3-8x slower per row than
-    from >=64-lane ones (852k-token sweep: 23.2ms at 40 lanes vs 3.6ms
-    at 128), so the payload is padded/id-tagged BEFORE the reorder —
-    one extra elementwise pass, ~6x off the multi-hot pack cost."""
+    The token reorder is dispatched per payload width class (see the
+    section comment above): narrow payloads gather at logical width and
+    pad after; gather-zone widths pad to 64 lanes first; wide payloads
+    pack at the full DMA width. All three produce the identical packed
+    array — only the gather's source width differs — so forcing one via
+    flags.pack_engine is always legal (the A/B knob)."""
     P, PP, G, SB = geom
     NB = n_rows // SB
     tok = idx.shape[0]
@@ -374,28 +506,11 @@ def _bp_pack(idx, grads, shows, clks, geom, TILE: int, n_rows: int,
     # small ints are denormals and would flush; see kernel comment
     hi = (idx // 4096).astype(jnp.float32)
     lo = (idx % 4096).astype(jnp.float32)
-    if P < 16 and order is not None:
-        # narrow payloads gather fast at their logical width (v5e:
-        # 12-13-lane row gathers ~5-10ns/row) — reorder first, pad after
-        payload = jnp.concatenate(
-            [grads, shows[:, None], clks[:, None],
-             jnp.ones((tok, 1), jnp.float32)], axis=1)
-        s_pay = jnp.take(payload, order, axis=0)
-        packed = jnp.concatenate(
-            [s_pay, jnp.zeros((tok, PP - P), jnp.float32),
-             jnp.take(hi, order)[:, None], jnp.take(lo, order)[:, None],
-             jnp.zeros((tok, W - PP - 2), jnp.float32)], axis=1)
-    else:
-        # 16..63-lane gathers are pathological (3-8x/row) — pack to the
-        # full 128-lane-tile width FIRST, then one fast wide gather
-        pay_full = jnp.concatenate(
-            [grads, shows[:, None], clks[:, None],
-             jnp.ones((tok, 1), jnp.float32),
-             jnp.zeros((tok, PP - P), jnp.float32),
-             hi[:, None], lo[:, None],
-             jnp.zeros((tok, W - PP - 2), jnp.float32)], axis=1)
-        packed = (pay_full if order is None        # pre-merged: sorted
-                  else jnp.take(pay_full, order, axis=0))
+    eng = _resolve_pack_engine(P, premerged=order is None)
+    # premerged_no_reorder builds the full-width operand with no gather
+    # (the wide builder's order=None path)
+    builder = _PACK_BUILDERS.get(eng, _pack_wide)
+    packed = builder(grads, shows, clks, hi, lo, order, tok, P, PP, W)
     # pad so the last tile's DMA stays in bounds; pad tokens carry row
     # id n_rows, which every block's local-range mask rejects
     pad_block = jnp.zeros((TILE, W), jnp.float32)
@@ -749,8 +864,7 @@ def binned_merge_acc(idx: jnp.ndarray, grads: jnp.ndarray,
                                G=G, SB=SB, n_split=n_split, TILE=TILE)
     acc_g = pl.pallas_call(
         kernel,
-        out_shape=jax.ShapeDtypeStruct((NB * RB, AW), jnp.float32,
-                                       vma=vma),
+        out_shape=shape_struct((NB * RB, AW), jnp.float32, vma=vma),
         grid_spec=pltpu.PrefetchScalarGridSpec(
             num_scalar_prefetch=2, grid=(NB,),
             in_specs=[pl.BlockSpec(memory_space=pl.ANY)],

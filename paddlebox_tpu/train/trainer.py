@@ -33,6 +33,7 @@ from paddlebox_tpu.data.slot_record import PackedBatch, SparseLayout
 from paddlebox_tpu.embedding import (EmbeddingConfig, HostEmbeddingStore,
                                      PassWorkingSet, sharded)
 from paddlebox_tpu.embedding.feed_pass import FeedPassManager
+from paddlebox_tpu.embedding.working_set import PushOperandStager
 from paddlebox_tpu.metrics import auc as auc_lib
 from paddlebox_tpu.ops.seqpool_cvm import PooledSlots
 from paddlebox_tpu.parallel import dense_sync
@@ -102,6 +103,12 @@ def _mean_replicated_grad(gp, axes):
     the already-replicated value — and silently scale the effective LR by
     the mesh size). Dividing by the axis size yields the true global mean.
     """
+    from paddlebox_tpu import jax_compat
+    if jax_compat.LEGACY_SHARD_MAP:
+        # pre-vma shard_map: in-body autodiff leaves replicated-input
+        # cotangents device-local — insert the psum the modern typed
+        # autodiff performs implicitly (see jax_compat.LEGACY_SHARD_MAP)
+        gp = jax.tree.map(lambda g: lax.psum(g, axes), gp)
     d = 1
     for a in axes:
         d = d * lax.axis_size(a)
@@ -236,6 +243,19 @@ class Trainer:
         # datasets) without ever touching the train step's compilation
         self._eval_capacity = self.cfg.capacity_factor
         self._superstep_fn: Callable | None = None
+        # Deferred sparse-push pipeline (flags.push_overlap): the step
+        # returns packed push operands off the loss-producing path; the
+        # apply program for step N dispatches while step N+1's pack and
+        # plan-H2D run. Operands ride a double-buffered stager (bounded
+        # staleness: ONE unapplied step, enforced there); flushed at
+        # pass boundaries and before eval/save (feed-manager pre-flush
+        # hook). Bit-identical to the inline push — the apply is always
+        # sequenced before the next step consumes the table.
+        self.push_overlap = self._select_push_overlap()
+        self._push_stager = PushOperandStager()
+        self.push_applies = 0       # deferred applies dispatched (tests)
+        self._overlap_ws = None
+        self.feed_mgr.register_pre_flush(self.flush_push)
         self._rebuild_steps()
         self._auc_fn = jax.jit(auc_lib.auc_update)
         self._auc_masked_fn = jax.jit(
@@ -289,7 +309,7 @@ class Trainer:
         return labels, dense
 
     # ------------------------------------------------------------------
-    def _fwd_bwd_push(self, ablate: tuple = ()):
+    def _fwd_bwd_push(self, ablate: tuple = (), defer: bool = False):
         """Shared shard_map core: routed pull → fwd/bwd → routed push.
 
         Returns a fn(tshard, idx_l, mask_l, dense_l, labels_l, params_local)
@@ -300,7 +320,13 @@ class Trainer:
         attribution (step_probe.attribute_step): the marginal device cost
         of a stage is full-step time minus the ablated step's time, the
         only measurement that accounts for XLA's cross-stage overlap.
-        Never set in training."""
+        Never set in training.
+
+        defer: the push stage returns its packed operands
+        (sharded.deferred_push_operands — premerged in-step when the host
+        plan carries dedup bounds) INSTEAD of applying them; the first
+        element of the core's return is then the uniform-arity operand
+        triple, not the updated shard (flags.push_overlap)."""
         cfg = self.cfg
         emb_cfg = self.store.cfg
         axes = tuple(self.mesh.axis_names)
@@ -319,6 +345,25 @@ class Trainer:
         dedup = config_flags.pullpush_dedup_keys and self.n_shards > 1
         fused_pull = self.pull_engine == "fused_gather_pool"
         L_hot = T // num_slots if fused_pull else 0
+
+        def push_tail(tshard, flat_idx, sgrad, mask_l, labels_l, plan):
+            """Push stage tail: deferred operands, ablated no-op, or the
+            inline routed merge-update. Deferred: the apply program
+            replays the same inputs one step later (Trainer._apply_fn)."""
+            if defer:
+                show_inc = mask_l.reshape(-1).astype(jnp.float32)
+                clk_inc = (mask_l.astype(jnp.float32)
+                           * labels_l[:, None]).reshape(-1)
+                return sharded.deferred_push_operands(
+                    flat_idx, sgrad, show_inc, clk_inc, plan)
+            if "push" in ablate:
+                return tshard
+            show_inc = mask_l.reshape(-1).astype(jnp.float32)
+            clk_inc = (mask_l.astype(jnp.float32)
+                       * labels_l[:, None]).reshape(-1)
+            return sharded.routed_push(tshard, flat_idx, sgrad, show_inc,
+                                       clk_inc, emb_cfg, axes, capf,
+                                       dedup=dedup, plan=plan)
 
         def core(tshard, idx_l, mask_l, dense_l, labels_l, params,
                  order, rstart, endb, uniq, segb, *extras_l):
@@ -367,15 +412,8 @@ class Trainer:
                                                        seg, num_slots)
                     if cfg.scale_sparse_grad_by_global_mean:
                         sgrad = sgrad / D
-                if "push" in ablate:
-                    new_shard = tshard
-                else:
-                    show_inc = mask_l.reshape(-1).astype(jnp.float32)
-                    clk_inc = (mask_l.astype(jnp.float32)
-                               * labels_l[:, None]).reshape(-1)
-                    new_shard = sharded.routed_push(
-                        tshard, flat_idx, sgrad, show_inc, clk_inc,
-                        emb_cfg, axes, capf, dedup=dedup, plan=plan)
+                new_shard = push_tail(tshard, flat_idx, sgrad, mask_l,
+                                      labels_l, plan)
                 return new_shard, gp, loss, preds, lax.psum(dropped, axes)
             if "lookup" in ablate:
                 pulled = lax.optimization_barrier(
@@ -411,16 +449,8 @@ class Trainer:
                 sgrad = gpull[..., 2:].reshape(B_l * T, emb_cfg.grad_width)
                 if cfg.scale_sparse_grad_by_global_mean:
                     sgrad = sgrad / D
-            if "push" in ablate:
-                new_shard = tshard
-            else:
-                show_inc = mask_l.reshape(-1).astype(jnp.float32)
-                clk_inc = (mask_l.astype(jnp.float32)
-                           * labels_l[:, None]).reshape(-1)
-                new_shard = sharded.routed_push(tshard, flat_idx, sgrad,
-                                                show_inc, clk_inc, emb_cfg,
-                                                axes, capf, dedup=dedup,
-                                                plan=plan)
+            new_shard = push_tail(tshard, flat_idx, sgrad, mask_l,
+                                  labels_l, plan)
             # capacity-drop monitor: global count of tokens the fixed-size
             # all_to_all lanes could not carry this step (push routes the
             # same tokens at the same capacity, so one count covers both)
@@ -429,12 +459,17 @@ class Trainer:
 
         return core
 
-    def _build_train_step(self, ablate: tuple = (),
-                          scan_steps: int = 1) -> Callable:
+    def _build_train_step(self, ablate: tuple = (), scan_steps: int = 1,
+                          defer: bool = False) -> Callable:
         cfg = self.cfg
         axes = tuple(self.mesh.axis_names)
         tx = self.tx
-        core = self._fwd_bwd_push(ablate)
+        if defer:
+            # deferred push (flags.push_overlap): allreduce single-step
+            # programs only, and ablation instruments the INLINE step
+            assert not ablate and scan_steps == 1 \
+                and cfg.dense_sync_mode == "allreduce"
+        core = self._fwd_bwd_push(ablate, defer=defer)
         batch_spec = P(axes)
         repl = mesh_lib.replicated_sharding(self.mesh)
         tbl_sh = mesh_lib.table_sharding(self.mesh)
@@ -509,30 +544,36 @@ class Trainer:
                            out_shardings=(tbl_sh, repl, repl, bat_sh, repl))
 
         n_extras = self._n_extras
+        # head of the step output: the updated table (inline push) or the
+        # uniform-arity deferred push operand triple (flags.push_overlap)
+        n_head = 3 if defer else 1
 
         def body(tshard, idx_l, mask_l, dense_l, labels_l, params,
                  order, rstart, endb, uniq, segb, *extras_l):
-            new_shard, gp, loss, preds, drop_g = core(
+            head, gp, loss, preds, drop_g = core(
                 tshard, idx_l, mask_l, dense_l, labels_l, params,
                 order, rstart, endb, uniq, segb, *extras_l)
             gp = _mean_replicated_grad(gp, axes)
             loss_g = lax.pmean(loss, axes)
-            return new_shard, gp, loss_g, preds, drop_g
+            head = head if defer else (head,)
+            return (*head, gp, loss_g, preds, drop_g)
 
         def run_body(table, params, opt_state, idx, mask, dense, labels,
                      order, rstart, endb, uniq, segb, *extras):
-            new_table, gp, loss, preds, drop_g = jax.shard_map(
+            out = jax.shard_map(
                 body, mesh=self.mesh,
                 in_specs=(batch_spec, batch_spec, batch_spec, batch_spec,
                           batch_spec, P(), batch_spec, batch_spec,
                           batch_spec, batch_spec, batch_spec)
                 + (batch_spec,) * n_extras,
-                out_specs=(batch_spec, P(), P(), batch_spec, P()),
+                out_specs=(batch_spec,) * n_head
+                + (P(), P(), batch_spec, P()),
             )(table, idx, mask, dense, labels, params,
               order, rstart, endb, uniq, segb, *extras)
+            head, (gp, loss, preds, drop_g) = out[:n_head], out[n_head:]
             updates, new_opt = tx.update(gp, opt_state, params)
             new_params = optax.apply_updates(params, updates)
-            return new_table, new_params, new_opt, loss, preds, drop_g
+            return head, new_params, new_opt, loss, preds, drop_g
 
         if self._dense_packer is not None:
             pack_fn, unpack_fn, n_dense = self._dense_packer
@@ -542,12 +583,24 @@ class Trainer:
                 (idx, mask, dense, labels, order, rstart,
                  endb, uniq, segb, *extras) = args[n_dense:]
                 params, opt_state = unpack_fn(dstate)
-                new_table, new_params, new_opt, loss, preds, drop_g = \
+                head, new_params, new_opt, loss, preds, drop_g = \
                     run_body(table, params, opt_state, idx, mask, dense,
                              labels, order, rstart, endb, uniq, segb,
                              *extras)
-                return (new_table, *pack_fn(new_params, new_opt), loss,
+                if defer:
+                    # (*dstate, g0, g1, g2, loss, preds, dropped): the
+                    # table is read, never written — the apply program
+                    # owns the update (split with split_defer_out)
+                    return (*pack_fn(new_params, new_opt), *head, loss,
+                            preds, drop_g)
+                return (head[0], *pack_fn(new_params, new_opt), loss,
                         preds, drop_g)
+
+            if defer:
+                return jax.jit(
+                    step_flat, donate_argnums=tuple(range(1, 1 + n_dense)),
+                    out_shardings=(repl,) * n_dense + (bat_sh,) * 3
+                    + (repl, bat_sh, repl))
 
             if scan_steps > 1:
                 # k-microbatch superstep: ONE dispatch runs k sequential
@@ -581,16 +634,102 @@ class Trainer:
         def step(table, params, opt_state, idx, mask, dense, labels,
                  order=_NO_PLAN, rstart=_NO_PLAN, endb=_NO_PLAN,
                  uniq=_NO_PLAN, segb=_NO_PLAN, *extras):
-            return run_body(table, params, opt_state, idx, mask, dense,
-                            labels, order, rstart, endb, uniq, segb,
-                            *extras)
+            head, new_params, new_opt, loss, preds, drop_g = run_body(
+                table, params, opt_state, idx, mask, dense, labels,
+                order, rstart, endb, uniq, segb, *extras)
+            if defer:
+                return (new_params, new_opt, *head, loss, preds, drop_g)
+            return (head[0], new_params, new_opt, loss, preds, drop_g)
 
+        if defer:
+            return jax.jit(step, donate_argnums=(1, 2),
+                           out_shardings=(repl, repl) + (bat_sh,) * 3
+                           + (repl, bat_sh, repl))
         # Donation aliases the (large) table and the dense state in place;
         # pinned out_shardings make output signatures identical to the inputs
         # so the train_pass feedback loop never retraces.
         return jax.jit(step, donate_argnums=(0, 1, 2),
                        out_shardings=(tbl_sh, repl, repl, repl, bat_sh,
                                       repl))
+
+    def _build_apply_fn(self) -> Callable:
+        """The deferred table-apply program (flags.push_overlap): consumes
+        the previous step's staged batch operands + the step's packed push
+        operands and runs EXACTLY the merge-update the inline step would
+        have — same functions, same inputs, so the result is bit-identical;
+        only the program boundary moved. Donates the table; dispatched by
+        the trainer while the next batch's pack/plan-H2D proceeds, and
+        always sequenced before the next step consumes its output."""
+        cfg = self.cfg
+        emb_cfg = self.store.cfg
+        axes = tuple(self.mesh.axis_names)
+        capf = cfg.capacity_factor
+        dedup = config_flags.pullpush_dedup_keys and self.n_shards > 1
+        batch_spec = P(axes)
+        tbl_sh = mesh_lib.table_sharding(self.mesh)
+
+        def body(tshard, idx_l, mask_l, labels_l, order, rstart, endb,
+                 uniq, segb, g0, g1, g2):
+            if uniq.shape[0] and g1.shape[0]:
+                # the step already premerged onto the plan's unique lanes
+                # (deferred_push_operands); replay only the engine
+                kplan = ((None, rstart, endb) if rstart.shape[0]
+                         else None)
+                return sharded.push(tshard, uniq, g0, g1, g2, emb_cfg,
+                                    plan=kplan, premerged=True)
+            flat_idx = idx_l.reshape(-1)
+            show_inc = mask_l.reshape(-1).astype(jnp.float32)
+            clk_inc = (mask_l.astype(jnp.float32)
+                       * labels_l[:, None]).reshape(-1)
+            plan = ((order, rstart, endb, uniq, segb)
+                    if order.shape[0] or uniq.shape[0] else None)
+            return sharded.routed_push(tshard, flat_idx, g0, show_inc,
+                                       clk_inc, emb_cfg, axes, capf,
+                                       dedup=dedup, plan=plan)
+
+        def apply(table, idx, mask, labels, order, rstart, endb, uniq,
+                  segb, g0, g1, g2):
+            return jax.shard_map(
+                body, mesh=self.mesh,
+                in_specs=(batch_spec,) * 12,
+                out_specs=batch_spec,
+            )(table, idx, mask, labels, order, rstart, endb, uniq, segb,
+              g0, g1, g2)
+
+        return jax.jit(apply, donate_argnums=(0,), out_shardings=tbl_sh)
+
+    def _select_push_overlap(self) -> bool:
+        """Whether training runs the deferred sparse-push pipeline
+        (flags.push_overlap, read at construction — trace-time static,
+        like the engine heuristics). "auto" = on where dense sync
+        permits: the allreduce single-step program (kstep trains
+        per-shard dense copies inside the step, async already decouples
+        dense through the host table, and the k-microbatch superstep
+        carries the table through a scan — all three need the inline
+        apply). Mirrors AsyncDenseTable's dispatch-decoupling semantics
+        on the sparse side with a hard one-step staleness bound."""
+        po = config_flags.push_overlap
+        if po not in ("auto", "on", "off"):
+            raise ValueError(f"push_overlap={po!r}")
+        if po == "off":
+            return False
+        ok = (self.cfg.dense_sync_mode == "allreduce"
+              and self.cfg.steps_per_dispatch == 1)
+        if po == "on" and not ok:
+            raise ValueError(
+                "flags.push_overlap='on' needs the allreduce dense-sync "
+                "mode with steps_per_dispatch=1 (the deferred apply is "
+                "sequenced between single-step programs)")
+        return ok
+
+    def split_defer_out(self, out: tuple):
+        """Deferred step output tuple → (dense_state, push_ops, loss,
+        preds, dropped). The deferred step returns (*dense_state, g0, g1,
+        g2, loss, preds, dropped) — no table; the apply program owns the
+        update. Callers must slice through THIS helper (dense_state
+        length varies with the flat-transport mode)."""
+        nd = self._n_dense_args
+        return (out[:nd], out[nd:nd + 3], out[-3], out[-2], out[-1])
 
     def _build_param_sync(self) -> Callable:
         """K-step parameter averaging (SyncParam, boxps_worker.cc:481-521).
@@ -852,6 +991,11 @@ class Trainer:
         "gather_seqpool" — the unfused lookup + in-model seqpool path.
         """
         fg = config_flags.fused_gather_pool
+        if fg not in ("auto", "on", "off"):
+            # a typo'd forced engine must fail loudly, not silently
+            # measure the auto heuristic (same guard as pack_engine/
+            # push_overlap/push_engine)
+            raise ValueError(f"fused_gather_pool={fg!r}")
         if fg == "off":
             return "gather_seqpool"
         lay = self.layout
@@ -913,6 +1057,7 @@ class Trainer:
         cfg = self.cfg
         ws = self.feed_mgr.begin_pass(dataset.unique_keys())
         self.feed_mgr.pass_opened()
+        self._overlap_ws = ws if self.push_overlap else None
         if preload_keys is not None:
             self.preload_pass(preload_keys)
         self._preplan_capacity(dataset, ws)
@@ -968,6 +1113,29 @@ class Trainer:
                         table, gp_flat, loss, preds, dropped = self._step_fn(
                             table, params, idx, mask, dense, labels, *plan)
                         self.dense_table.push(np.asarray(gp_flat))
+                    elif self.push_overlap:
+                        # deferred push pipeline: dispatch step N-1's
+                        # pending table apply FIRST (the next step's pull
+                        # must consume the applied table — that data
+                        # dependence is what keeps overlap-on bit-
+                        # identical), then the loss-path program, then
+                        # queue this step's packed operands; their apply
+                        # runs while batch N+1's pack/plan-H2D proceeds
+                        table = self._dispatch_pending_apply(table)
+                        dst = (dstate if dstate is not None
+                               else (params, opt_state))
+                        out = self._defer_step_fn(table, *dst, idx, mask,
+                                                  dense, labels, *plan)
+                        (dst, push_ops, loss, preds,
+                         dropped) = self.split_defer_out(out)
+                        if dstate is not None:
+                            dstate = dst
+                        else:
+                            params, opt_state = dst
+                        self._push_stager.put(
+                            (idx, mask, labels,
+                             tuple(plan[:PLAN_ARITY]), push_ops))
+                        pass_step += 1
                     elif dstate is not None:
                         out = self._step_fn(table, *dstate, idx, mask,
                                             dense, labels, *plan)
@@ -1052,6 +1220,11 @@ class Trainer:
             # even when a batch raised (the pass/day crash-recovery flow
             # catches and resumes from checkpoint — the Trainer must stay
             # usable).
+            if self.push_overlap:
+                # pass-boundary flush: the last step's table apply is
+                # still pending (bounded staleness of one) — land it
+                # before anything reads or persists the table
+                table = self._dispatch_pending_apply(table)
             ws.table = table
             self.feed_mgr.pass_closed()
             if mode == "async":
@@ -1086,6 +1259,10 @@ class Trainer:
             # Superstep entries are (k,) vectors; flatten to per-step.
             losses = [float(x) for l in dev_losses
                       for x in np.asarray(l).reshape(-1)]
+        # every dispatched apply has drained; release the stager's
+        # retired-slot buffer refs (the pipeline's leak invariant:
+        # live() == 0 between passes)
+        self._push_stager.clear()
         out = auc_acc.compute()
         out["loss_first"] = losses[0] if losses else float("nan")
         out["loss_last"] = losses[-1] if losses else float("nan")
@@ -1185,9 +1362,16 @@ class Trainer:
 
     def _rebuild_steps(self) -> None:
         """(Re)build the compiled step programs from the current config:
-        the single step, the k-microbatch superstep (allreduce + flat
-        dense transport only), and the eval step."""
+        the single step, the deferred step + apply pair (push_overlap),
+        the k-microbatch superstep (allreduce + flat dense transport
+        only), and the eval step. _step_fn is ALWAYS the inline step —
+        external callers and the stage attribution instrument it; the
+        training loop uses the deferred pair when push_overlap is on."""
         self._step_fn = self._build_train_step()
+        self._defer_step_fn = (self._build_train_step(defer=True)
+                               if self.push_overlap else None)
+        self._apply_fn = (self._build_apply_fn()
+                          if self.push_overlap else None)
         k = self.cfg.steps_per_dispatch
         self._superstep_fn = (
             self._build_train_step(scan_steps=k)
@@ -1289,9 +1473,43 @@ class Trainer:
         """Join the background feed pass (BoxHelper::WaitFeedPassDone)."""
         self.feed_mgr.wait_feed_pass_done()
 
+    def _dispatch_pending_apply(self, table):
+        """Dispatch the pending deferred table apply (if any) against
+        `table` and return the applied table. The caller owns sequencing:
+        this must run before anything consumes the post-apply state."""
+        item = self._push_stager.take()
+        if item is None:
+            return table
+        idx, mask, labels, plan, ops = item
+        table = self._apply_fn(table, idx, mask, labels, *plan, *ops)
+        self.push_applies += 1
+        return table
+
+    def flush_push(self) -> int:
+        """Apply any pending deferred sparse-push update to the live
+        working set (flags.push_overlap). Runs automatically at pass
+        boundaries, before eval passes, and ahead of sparse flushes
+        (store save/export/shrink reach it through the feed manager's
+        pre-flush hooks). Returns the number of applies dispatched
+        (0 or 1 — staleness is bounded at one step)."""
+        if not self._push_stager.pending():
+            return 0
+        ws = self._overlap_ws
+        if ws is None:
+            return 0
+        if self.feed_mgr._in_pass:
+            raise RuntimeError(
+                "flush_push while a training pass is open — the loop "
+                "owns the table mid-pass; finish the pass first")
+        ws.table = self._dispatch_pending_apply(ws.table)
+        return 1
+
     def flush_sparse(self) -> int:
         """Force lazily-retained device rows back to the host store (runs
-        automatically before store save/export/shrink via flush hooks)."""
+        automatically before store save/export/shrink via flush hooks).
+        Deferred push applies (push_overlap) land first — row values must
+        be final before they move D2H."""
+        self.flush_push()
         return self.feed_mgr.flush()
 
     def eval_params(self):
@@ -1347,6 +1565,9 @@ class Trainer:
     def eval_pass(self, dataset) -> dict[str, float]:
         """Test-mode pass: no pushes, no dense updates, and the store is
         neither grown nor dirtied by unseen keys (SetTestMode)."""
+        # flush-before-eval ordering (push_overlap): predictions must see
+        # every trained row value; a pending deferred apply lands first
+        self.flush_push()
         bs = self.cfg.global_batch_size
         ws = self.feed_mgr.begin_pass(dataset.unique_keys(), test_mode=True)
         self._preplan_capacity(dataset, ws, drop_last=False,

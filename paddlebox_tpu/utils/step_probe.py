@@ -187,6 +187,39 @@ def _run_step_loop(trainer, fn, staged, n: int, holder: list) -> float:
     return best / n
 
 
+def _run_defer_loop(trainer, staged, n: int, holder: list,
+                    with_apply: bool) -> float:
+    """Bench-identical loop over the DEFERRED step program (push_overlap):
+    the loss-path program alone (with_apply=False — the table is read,
+    never updated; fine for timing) or the real pipeline pair (deferred
+    step + apply dispatched back to back, the training loop's dataflow).
+    holder carries [table, dense_state] like _run_step_loop."""
+    idx, mask, dense, labels = staged[:4]
+    plan = staged[4:9]
+
+    def step():
+        out = trainer._defer_step_fn(holder[0], *holder[1], *staged)
+        dstate, ops, loss, preds, drop = trainer.split_defer_out(out)
+        holder[1] = dstate
+        if with_apply:
+            holder[0] = trainer._apply_fn(holder[0], idx, mask, labels,
+                                          *plan, *ops)
+        return loss
+
+    for _ in range(2):
+        loss = step()
+    _sync(loss)
+    best = None
+    for _ in range(3):
+        t0 = time.perf_counter()
+        for _ in range(n):
+            loss = step()
+        _sync(loss)
+        w = time.perf_counter() - t0
+        best = w if best is None else min(best, w)
+    return best / n
+
+
 def attribute_step(trainer, ws, staged, step_seconds: float,
                    k: int = 24, n_loop: int = 100) -> dict:
     """Stage breakdown of one train step, as device seconds.
@@ -257,6 +290,35 @@ def attribute_step(trainer, ws, staged, step_seconds: float,
         "glue_residual": times[3] - floor,
         "dispatch_floor": floor,
     }
+
+    # --- deferred-push pipeline A/B (flags.push_overlap): the inline
+    # single step vs the real deferred pair (loss-path program + apply
+    # program, dispatched back to back like train_pass) and the
+    # loss-path program alone — the in-composed-step measurement that
+    # keeps the overlap engine choice decision-grade per matrix point.
+    overlap_ab = None
+    if getattr(trainer, "push_overlap", False) \
+            and trainer._defer_step_fn is not None:
+        holder = [ws.table, trainer.pack_dense()]
+        try:
+            t_pair = _run_defer_loop(trainer, staged, n_loop, holder,
+                                     with_apply=True)
+            t_loss = _run_defer_loop(trainer, staged, n_loop, holder,
+                                     with_apply=False)
+        finally:
+            if _all_alive(holder):
+                ws.table = holder[0]
+                trainer.params, trainer.opt_state = trainer.unpack_dense(
+                    holder[1])
+        overlap_ab = {
+            "inline_single_step": round(times[0], 6),
+            "deferred_step_plus_apply": round(t_pair, 6),
+            "deferred_loss_path_step": round(t_loss, 6),
+            "note": "pair = both programs dispatched back to back (the "
+                    "training loop's dataflow); loss_path = the "
+                    "deferred step alone — what the AUC/D2H consumer "
+                    "waits on when the apply overlaps the next pack",
+        }
 
     # --- isolated stage times (secondary; shows cross-stage overlap) ---
     # fused-pull trainers measure the stages the fused step actually
@@ -346,11 +408,17 @@ def attribute_step(trainer, ws, staged, step_seconds: float,
     return {
         "stages": {n: round(s, 6) for n, s in stages.items()},
         "isolated": {n: round(s, 6) for n, s in isolated.items()},
+        "push_overlap": ("on" if getattr(trainer, "push_overlap", False)
+                         else "off"),
+        "overlap_ab": overlap_ab,
         "attributed_seconds": round(attributed, 6),
         "single_step_seconds": round(single, 6),
         "headline_step_seconds": round(step_seconds, 6),
         "unattributed_seconds": round(single - attributed, 6),
         "coverage": round(attributed / single, 3) if single else 0.0,
+        "method_overlap": "overlap_ab (when push_overlap is on) A/Bs the "
+                  "inline step against the deferred step+apply pair in "
+                  "the real programs",
         "method": "stages = telescoping cumulative ablation of the "
                   "SINGLE-step program (full -> -push -> -push-lookup "
                   "-> -push-lookup-fwdbwd -> no-op floor, bench-"
@@ -362,3 +430,130 @@ def attribute_step(trainer, ws, staged, step_seconds: float,
                   "each stage repeated in one jit (over-counts XLA "
                   "overlap); device_get-terminated windows",
     }
+
+
+# ---------------------------------------------------------------------------
+# Sparse-push floor analysis: what the push SHOULD cost on this hardware.
+#
+# The stage attribution says what the push DOES cost; this derives the
+# analytic floor of each push sub-stage so a regression alarms against a
+# floor, not just against the chip's headline peaks (an 11ms push can pass
+# an MFU audit while sitting 10x above its own physics). Stages mirror the
+# binned-push pipeline: plan-H2D (host plan staging — rides the pack
+# pipeline, NOT on the step's critical path), kernel DMA (packed-operand
+# build + the kernel's tile streams), one-hot dots (the MXU merge), and
+# the fused table update (one bandwidth pass over the table). Scatter-
+# engine widths (no kernel geometry) get the scatter's bandwidth model.
+# ---------------------------------------------------------------------------
+
+def push_floor_analysis(emb_cfg, n_rows: int, tokens: int,
+                        n_split: int = 2, peaks=None,
+                        measured_push: float | None = None,
+                        slack: float = 3.0) -> dict:
+    """Per-stage analytic bounds of one sparse push + closure statement.
+
+    peaks : (peak_bf16_flops, peak_hbm_bytes) or None (unknown hardware —
+            bounds are reported as bytes/FLOPs only, closure abstains).
+    measured_push : the attribution's sparse_push seconds, if available.
+    closed : True when the measured push sits within `slack` x the floor;
+            otherwise a reason string naming the gap — the alarm line.
+    """
+    from paddlebox_tpu.ops import pallas_kernels as pk
+
+    geom = pk._bp_geometry(emb_cfg, n_rows)
+    # backend-aware: must name the engine the step actually compiles with
+    # (bench detail's push_engine) — CPU smoke runs the scatter
+    engine = ("binned_kernel"
+              if pk.binned_acc_supported(emb_cfg, n_rows)
+              else "xla_scatter")
+    gw = emb_cfg.grad_width
+    rw = emb_cfg.row_width
+    stages: dict = {}
+    # plan staging: order + block windows (+ dedup lanes at worst)
+    plan_bytes = tokens * 4 * 3 + 1024
+    stages["plan_h2d"] = {
+        "bytes": plan_bytes,
+        "bound_seconds": None,
+        "note": "host plan staged by the pack pipeline, overlapped with "
+                "device compute — off the step's critical path; counted "
+                "for completeness, excluded from the floor",
+    }
+    peak_f, peak_b = peaks if peaks is not None else (None, None)
+
+    def _bw(name, nbytes, note):
+        stages[name] = {
+            "bytes": int(nbytes),
+            "bound_seconds": (round(nbytes / peak_b, 6)
+                              if peak_b else None),
+            "note": note,
+        }
+
+    if engine == "binned_kernel" and geom is not None:
+        P, PP, G, SB = geom
+        W = -(-(PP + 2) // 128) * 128
+        TILE = pk._bp_tile(SB, G)
+        RB = SB // G
+        AW = pk._bp_acc_width(G, PP)
+        tok_pad = tokens + TILE
+        _bw("kernel_dma",
+            tok_pad * W * 4 * 2          # packed build write + DMA read
+            + (n_rows // SB) * RB * AW * 4,   # grouped acc write
+            "packed-operand build + double-buffered tile DMA + acc write")
+        dot_flops = 2.0 * n_split * tokens * RB * AW
+        stages["onehot_dots"] = {
+            "flops": dot_flops,
+            "bound_seconds": (round(dot_flops / peak_f, 6)
+                              if peak_f else None),
+            "note": f"{n_split}-plane one-hot MXU merge, RB={RB} AW={AW}",
+        }
+        _bw("fused_update",
+            n_rows * (rw * 4 * 2 + PP * 4),
+            "one full-width XLA pass: table read+write + acc read")
+    else:
+        _bw("kernel_dma",
+            tokens * (gw + 3) * 4 * 2,
+            "scatter payload write + read (no kernel geometry: "
+            "XLA scatter engine)")
+        stages["onehot_dots"] = {
+            "flops": 0.0, "bound_seconds": 0.0 if peak_b else None,
+            "note": "scatter engine — no MXU merge"}
+        _bw("fused_update",
+            n_rows * (rw * 4 * 2 + (gw + 3) * 4 * 2),
+            "scatter-add accumulate + fused update pass over the table")
+
+    bounded = [s["bound_seconds"] for name, s in stages.items()
+               if name != "plan_h2d"]
+    floor = (round(sum(b for b in bounded if b is not None), 6)
+             if any(b is not None for b in bounded) else None)
+    out = {
+        "engine": engine,
+        "tokens": tokens,
+        "table_rows": n_rows,
+        "stages": stages,
+        "floor_seconds": floor,
+        "measured_push_seconds": (round(measured_push, 6)
+                                  if measured_push is not None else None),
+    }
+    finalize_push_floor(out, measured_push, slack)
+    return out
+
+
+def finalize_push_floor(floor: dict, measured_push: float | None,
+                        slack: float = 3.0) -> None:
+    """(Re)close a push_floor_analysis result once the attribution has
+    measured the real push stage — mutates `floor` in place (the bench
+    computes the floor before attribution runs and finalizes after)."""
+    f = floor.get("floor_seconds")
+    if measured_push is not None:
+        floor["measured_push_seconds"] = round(measured_push, 6)
+    if f is None:
+        floor["closed"] = "no peak table for this hardware (CPU smoke?)"
+    elif measured_push is None:
+        floor["closed"] = "no measured push stage (attribution absent)"
+    elif measured_push <= slack * max(f, 1e-9):
+        floor["closed"] = True
+    else:
+        floor["closed"] = (
+            f"measured {measured_push*1e3:.2f}ms > {slack:.0f}x floor "
+            f"{f*1e3:.2f}ms — push is off its physics; check the "
+            f"pack engine and plan staging before trusting the step")

@@ -1,0 +1,114 @@
+"""Bench regression gate (BENCH_BEST.json) + the --dryrun tier-1 smoke.
+
+Round 5 shipped a reproducible 1.87x headline regression inside a green
+artifact. The gate makes that class of failure impossible: every recorded
+number is compared against the best recorded value per metric, a >10%
+unwaived regression fails audit_ok and the exit code, and the CPU dryrun
+exercises the gate + stage-attribution + push-floor code paths on every
+PR instead of only on-chip.
+"""
+
+import importlib.util
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+BENCH_PY = os.path.join(REPO, "bench.py")
+
+
+@pytest.fixture(scope="module")
+def bench():
+    spec = importlib.util.spec_from_file_location("_bench_mod", BENCH_PY)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_gate_trips_on_unwaived_regression(bench):
+    best = {"device_kind": None, "threshold": 0.10,
+            "metrics": {"headline_eps": 1000.0, "matrix.a": 500.0}}
+    g = bench.apply_regression_gate(
+        {"headline_eps": 850.0, "matrix.a": 510.0}, best, "cpu")
+    assert not g["ok"]
+    assert g["regressed"] == ["headline_eps"]
+    assert g["lines"]["headline_eps"].startswith("REGRESS(")
+    assert g["lines"]["matrix.a"].startswith("ok(")
+
+
+def test_gate_honors_waiver_note(bench):
+    best = {"device_kind": None,
+            "metrics": {"headline_eps": 1000.0},
+            "waivers": {"headline_eps": "known tunnel variance"}}
+    g = bench.apply_regression_gate({"headline_eps": 500.0}, best, "cpu")
+    assert g["ok"]
+    assert "waived: known tunnel variance" in g["lines"]["headline_eps"]
+
+
+def test_gate_within_threshold_passes(bench):
+    best = {"device_kind": None, "metrics": {"headline_eps": 1000.0}}
+    g = bench.apply_regression_gate({"headline_eps": 905.0}, best, "cpu")
+    assert g["ok"]
+
+
+def test_gate_skips_foreign_hardware_and_missing_best(bench):
+    best = {"device_kind": "TPU v5 lite",
+            "metrics": {"headline_eps": 1000.0}}
+    g = bench.apply_regression_gate({"headline_eps": 1.0}, best, "cpu")
+    assert g["ok"] and "skipped" in g
+    assert bench.apply_regression_gate({}, None, "cpu")["ok"]
+
+
+def test_gate_reports_missing_and_new_metrics(bench):
+    best = {"device_kind": None, "metrics": {"gone_metric": 10.0}}
+    g = bench.apply_regression_gate({"new_metric": 5.0}, best, "cpu")
+    assert g["ok"]
+    assert "missing" in g["lines"]["gone_metric"]
+    assert "new" in g["lines"]["new_metric"]
+
+
+def test_collect_gate_metrics_namespace(bench):
+    detail = {
+        "matrix": {"kstep_f32": {"examples_per_sec_per_chip": 7.0},
+                   "broken": {"error": "boom"}},
+        "e2e": {"examples_per_sec_per_chip": 3.0},
+        "host": {"derived_max_feed_eps_per_chip": 9.0},
+    }
+    m = bench.collect_gate_metrics(11.0, detail)
+    assert m == {"headline_eps": 11.0, "matrix.kstep_f32": 7.0,
+                 "e2e_eps": 3.0, "host.derived_max_feed_eps": 9.0}
+
+
+def test_committed_bench_best_is_wellformed():
+    with open(os.path.join(REPO, "BENCH_BEST.json")) as f:
+        best = json.load(f)
+    assert best["device_kind"] == "TPU v5 lite"
+    assert 0 < best["threshold"] <= 0.5
+    assert best["metrics"]["headline_eps"] > 1e6, \
+        "the recorded best headline predates the round-5 regression"
+    for name, note in best.get("waivers", {}).items():
+        assert name in best["metrics"] and len(note) > 10
+
+
+def test_bench_dryrun_smoke():
+    """`bench.py --dryrun` (tier-1): the gate + attribution + floor code
+    paths run on CPU at tiny geometry; the gate must trip on an injected
+    synthetic regression and the process must exit 0 with every check
+    green."""
+    env = dict(os.environ, JAX_PLATFORMS="cpu", XLA_FLAGS="")
+    r = subprocess.run([sys.executable, BENCH_PY, "--dryrun"],
+                       capture_output=True, text=True, env=env,
+                       timeout=560, cwd=REPO)
+    assert r.returncode == 0, r.stderr[-2000:]
+    out = json.loads(r.stdout.strip().splitlines()[-1])
+    assert out["metric"] == "bench_dryrun" and out["ok"]
+    assert out["checks"]["gate_trips_on_regression"]
+    assert out["checks"]["waiver_untrips"]
+    assert out["checks"]["attribution_ok"]
+    assert out["checks"]["floor_ok"]
+    assert out["push_overlap"] == "on"
+    assert "stages" in out and "sparse_push" in out["stages"]
+    assert out["gate_example_lines"]["headline_eps"].startswith("REGRESS")

@@ -128,14 +128,20 @@ def test_fused_op_empty_slots_and_all_pad():
     np.testing.assert_array_equal(np.asarray(got0), 0.0)
 
 
-@pytest.mark.parametrize("need_filter", [False, True])
-def test_fused_op_grad_parity(need_filter):
+@pytest.mark.parametrize("need_filter,embed_threshold",
+                         [(False, 0.0), (True, 0.0),
+                          (False, 0.3), (True, 0.3)])
+def test_fused_op_grad_parity(need_filter, embed_threshold):
     """Grad parity through the custom VJP vs the unfused autodiff
     reference — including the duplicate-heavy merge (every token drawn
-    from 8 rows, so the VJP's dedup path actually folds duplicates)."""
+    from 8 rows, so the VJP's dedup path actually folds duplicates) and
+    the embed_threshold drop mask (the VJP re-derives the forward's keep
+    predicate from the raw rows; a predicate drift between the copies
+    must fail here, not corrupt training silently)."""
     cfg, table, idx, mask, seg = _mk(B=6, S=3, L=4, n=64, seed=4)
     idx = (idx % 8 + 1).astype(np.int32)          # heavy duplication
-    kw = dict(need_filter=need_filter, threshold=0.5)
+    kw = dict(need_filter=need_filter, threshold=0.5,
+              embed_threshold=embed_threshold)
     w = jnp.asarray(np.random.default_rng(5).normal(
         size=(6, 3 * cfg.pull_width)).astype(np.float32))
 
