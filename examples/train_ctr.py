@@ -3,8 +3,10 @@ framework, end to end:
 
   slot-text files → SlotDataset (load + shuffle) → day loop of passes
   (BoxPS lifecycle, join/update phase flip, per-pass AUC + cmatch metrics)
-  → base/delta checkpoints with donefiles (FleetUtil) → crash recovery →
-  serving export (Predictor scores the eval slice).
+  → crash-safe per-pass snapshots (PassCheckpointer: atomic manifested
+  base/delta chain) + day-end base models with donefiles (FleetUtil) →
+  crash recovery via both paths → serving export (Predictor scores the
+  eval slice).
 
 Runs hardware-free on the 8-virtual-device CPU mesh:
 
@@ -72,9 +74,16 @@ def main() -> int:
     store = HostEmbeddingStore(EmbeddingConfig(dim=emb_dim,
                                                optimizer="adagrad",
                                                learning_rate=0.1))
+    from paddlebox_tpu.utils.pass_ckpt import PassCheckpointer
+
     box = BoxPS(store)
     box.init_metric("auc", method="plain")
     fleet = FleetUtil(out_root)
+    # crash-safe pass snapshots: atomic manifested base/delta chain +
+    # dense/optimizer/metric planes + cursor; resume() falls back past a
+    # torn newest snapshot by checksum
+    ckpt = PassCheckpointer(os.path.join(work, "snapshots"),
+                            keep_last_n=3, base_every=2)
     mesh = make_mesh(min(8, len(jax.devices())))
     model = DeepFMModel(num_slots=num_slots, emb_dim=emb_dim, dense_dim=2,
                         hidden=(64, 32))
@@ -93,11 +102,14 @@ def main() -> int:
             ds.load_into_memory(global_shuffle=False)
             box.begin_pass()
             stats = tr.train_pass(ds, metrics=box.metrics)
-            info = box.end_pass()
-            # single delta writer: save_delta clears the dirty mask, so a
-            # second save of the same pass would always be empty
-            fleet.save_delta_model(store, tr.eval_params(), day,
-                                   box.pass_id)
+            # single delta writer per store: save_delta consumes the
+            # dirty mask, so per-pass persistence belongs to ONE owner —
+            # here the crash-safe checkpointer. (Stacking
+            # fleet.save_delta_model on top would write EMPTY fleet
+            # deltas; the day-end fleet base below is a full snapshot
+            # and stays exact regardless.)
+            info = box.end_pass(checkpointer=ckpt, trainer=tr)
+            last_snapshot_keys = len(store)
             msg = box.get_metric_msg("auc")
             print(f"day {day} pass {box.pass_id}: "
                   f"auc={stats['auc']:.3f} "
@@ -111,11 +123,30 @@ def main() -> int:
         fleet.save_model(store, tr.eval_params(), day)
         print(f"day {day}: shrink evicted {evicted}, base model saved")
 
-    # ---- crash recovery: rebuild from the newest donefiles ----
+    # ---- crash recovery path 1: rebuild from the newest donefiles ----
     store2, dense2, rec_day = fleet.load_model(tr.eval_params())
     print(f"recovered day {rec_day}: {len(store2)} keys "
           f"(live {len(store)})")
     assert len(store2) == len(store)
+
+    # ---- crash recovery path 2: resume-from-pass (PassCheckpointer) ----
+    # A preempted worker restarts, resumes every plane from the newest
+    # verified snapshot, and re-enters the pass loop at the cursor.
+    store3 = HostEmbeddingStore(EmbeddingConfig(dim=emb_dim,
+                                                optimizer="adagrad",
+                                                learning_rate=0.1))
+    box3 = BoxPS(store3)
+    box3.init_metric("auc", method="plain")
+    tr3 = Trainer(model, store3, schema, mesh,
+                  TrainerConfig(global_batch_size=128, dense_lr=3e-3,
+                                auc_buckets=1 << 12), seed=123)
+    cursor = tr3.resume(ckpt, box=box3)
+    print(f"resumed at cursor {cursor}: {len(store3)} keys, "
+          f"next pass {box3.pass_id + 1}")
+    assert cursor["pass_id"] == box.pass_id
+    # the snapshot is pass-granular: it captures the table as of the last
+    # end_pass, i.e. BEFORE the day-end shrink that followed it
+    assert len(store3) == last_snapshot_keys
 
     # ---- serving ----
     export = os.path.join(work, "export")

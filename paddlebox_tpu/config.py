@@ -152,6 +152,23 @@ class Flags:
     fix_dayid: bool = False                 # FLAGS_fix_dayid
     auc_runner_mode: bool = False           # FLAGS_padbox_auc_runner_mode
 
+    # --- crash-safe checkpoints (new — utils/pass_ckpt.py) ---
+    # Pass snapshots retained by PassCheckpointer; >= 2 so a torn newest
+    # snapshot always has a verified predecessor to fall back to.
+    ckpt_keep_last_n: int = 3               # (new)
+    # A fresh sparse base chain every N passes (bounds delta-replay length
+    # at resume and lets retention reclaim old chain dirs); deltas between.
+    ckpt_base_every: int = 8                # (new)
+    # CommandFS shell-out resilience: bounded retry with exponential
+    # backoff on put/get/ls/rm + idempotent mkdir -p (transient
+    # HDFS/object-store failures are the norm, not the exception), and a
+    # per-command timeout (0 = none). Retry deliberately excludes append
+    # (a retried partial append could double-write a donefile line) and
+    # cat (streaming).
+    fs_retry_attempts: int = 3              # (new)
+    fs_retry_backoff_s: float = 0.2         # (new) doubles per attempt
+    fs_command_timeout_s: float = 0.0       # (new) 0 disables
+
     # --- numerics / TPU (new) ---
     compute_dtype: str = "float32"          # bf16 for matmul-heavy towers
     embedding_dtype: str = "float32"
@@ -175,6 +192,8 @@ class Flags:
                 raw = os.environ[env_key]
                 if field.type in ("int", int):
                     f.set(field.name, int(raw))
+                elif field.type in ("float", float):
+                    f.set(field.name, float(raw))
                 elif field.type in ("bool", bool):
                     f.set(field.name, raw.lower() in ("1", "true", "yes"))
                 else:

@@ -338,6 +338,8 @@ class FeedPassManager:
             # retained — stale device rows must not overwrite it
             self._unsynced[:] = False
             return 0
+        from paddlebox_tpu.utils import faultpoint
+        faultpoint.hit("feed_pass.flush.pre")
         k = ws.num_keys
         row_ids = np.flatnonzero(self._unsynced[1:1 + k]) + 1
         rows, nbytes = fetch_rows(ws.table, row_ids, self.store.cfg)

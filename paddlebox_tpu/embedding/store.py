@@ -18,6 +18,14 @@ array. Checkpointing is numpy-native:
 - ``load``       — base + ordered deltas.
 - ``shrink``     — drop cold rows by show-count threshold with decay
   (ShrinkTable semantics).
+
+Crash safety: every member lands via write-tmp → fsync → ``os.replace``
+(utils/checkpoint.atomic_file), and each save commits a ``MANIFEST.json``
+LAST recording the chain (base + ordered deltas), per-member size + CRC32,
+``save_seq`` and each delta's chain parent. ``load``/``restore`` verify
+the replayed prefix against the manifest and raise
+``CheckpointCorruptError`` naming the first torn member — a truncated
+mid-chain delta fails loudly instead of silently resurrecting stale rows.
 """
 
 from __future__ import annotations
@@ -31,6 +39,13 @@ import numpy as np
 
 from paddlebox_tpu.embedding.config import EmbeddingConfig
 from paddlebox_tpu.native.key_index import KeyIndex
+from paddlebox_tpu.utils import checkpoint as ckpt_lib
+from paddlebox_tpu.utils import faultpoint
+from paddlebox_tpu.utils.checkpoint import CheckpointCorruptError
+
+
+def _delta_name(seq: int) -> str:
+    return f"delta-{seq:05d}.npz"
 
 
 class HostEmbeddingStore:
@@ -49,6 +64,12 @@ class HostEmbeddingStore:
         self._tombstones: set[int] = set()  # evicted since last save
         self._lock = threading.Lock()
         self._save_seq = 0
+        # monotonic count of saves (base or delta, any directory): every
+        # save consumes the dirty mask + tombstones, so a checkpointing
+        # consumer must know whether ANY other save ran since its last
+        # one — save_seq alone can't tell (a foreign save_base resets it
+        # to 0, aliasing with "nothing happened" after an own base)
+        self._save_count = 0
         # bumped whenever rows change OUTSIDE the pass pull/push cycle
         # (shrink/remove/delta replay) — consumers holding device-resident
         # copies of rows (FeedPassManager) use it to invalidate reuse
@@ -63,6 +84,17 @@ class HostEmbeddingStore:
     @property
     def mutation_count(self) -> int:
         return self._mutations
+
+    @property
+    def save_seq(self) -> int:
+        """Delta chain position of the last save (0 = at a base)."""
+        return self._save_seq
+
+    @property
+    def save_count(self) -> int:
+        """Monotonic number of save_base/save_delta calls on this store
+        object (all directories) — the dirty-mask consumption counter."""
+        return self._save_count
 
     # ---- row-storage hooks (overridden by the disk spill tier) ----
 
@@ -267,32 +299,67 @@ class HostEmbeddingStore:
             vals = self._rows[:self._n, :self.cfg.pull_width].copy()
         return keys, vals
 
-    def save_base(self, path: str) -> str:
+    def save_base(self, path: str, pass_id: int | None = None) -> str:
+        """Full-snapshot save. Atomic-durable: base.npz lands via
+        tmp+fsync+replace, then meta, then the MANIFEST commit — no
+        reader ever sees a torn file under a final name.
+
+        Crash-fallback caveat: re-saving a base INTO A DIRECTORY THAT
+        ALREADY HOLDS ONE replaces the old base.npz before the reset
+        manifest commits, so a kill inside that window leaves a directory
+        whose manifest describes the previous chain but whose base bytes
+        are new (load() then fails verification loudly — detected, but
+        with nothing local to fall back to). Writers that need
+        fall-back-past-a-torn-base semantics must rotate to a fresh
+        directory per base, which is exactly what PassCheckpointer's
+        chain-NNNN rotation and FleetUtil's per-day base dirs do."""
         self._run_flush_hooks()
         os.makedirs(path, exist_ok=True)
         with self._lock:
             fname = os.path.join(path, "base.npz")
-            np.savez_compressed(fname, keys=self._keys[:self._n],
-                                rows=self._rows[:self._n])
+            with ckpt_lib.atomic_file(
+                    fname,
+                    fault_point="store.save_base.pre_replace") as tmp:
+                with open(tmp, "wb") as f:
+                    np.savez_compressed(f, keys=self._keys[:self._n],
+                                        rows=self._rows[:self._n])
+            self._save_seq = 0
+            self._save_count += 1
             self._write_meta(path)
+            self._write_chain_manifest(path, reset=True, pass_id=pass_id)
             self._dirty[:] = False
             self._tombstones.clear()
-            self._save_seq = 0
         return fname
 
-    def save_delta(self, path: str) -> str:
+    def save_delta(self, path: str, pass_id: int | None = None) -> str:
+        """Incremental save (rows dirtied + keys tombstoned since the last
+        save). Same atomic discipline as save_base; the chain manifest is
+        committed LAST, so a crash between the delta file and the manifest
+        leaves the chain describing the previous save_seq and the stale
+        delta file unreachable (it is overwritten by the re-run)."""
         self._run_flush_hooks()
         os.makedirs(path, exist_ok=True)
         with self._lock:
-            self._save_seq += 1
+            # the sequence number commits only after the delta file lands:
+            # a failed write must not burn a seq and leave a permanent
+            # mid-chain gap that later (successful) saves build past
+            seq = self._save_seq + 1
             idx = np.flatnonzero(self._dirty[:self._n])
             keys = self._keys[idx]
-            fname = os.path.join(path, f"delta-{self._save_seq:05d}.npz")
-            removed = np.fromiter(self._tombstones, dtype=np.uint64,
+            fname = os.path.join(path, _delta_name(seq))
+            removed = np.fromiter(sorted(self._tombstones), dtype=np.uint64,
                                   count=len(self._tombstones))
-            np.savez_compressed(fname, keys=keys, rows=self._rows[idx],
-                                removed=removed)
+            with ckpt_lib.atomic_file(
+                    fname,
+                    fault_point="store.save_delta.pre_replace") as tmp:
+                with open(tmp, "wb") as f:
+                    np.savez_compressed(f, keys=keys, rows=self._rows[idx],
+                                        removed=removed)
+            self._save_seq = seq
+            self._save_count += 1
             self._write_meta(path)
+            faultpoint.hit("store.save_delta.pre_manifest")
+            self._write_chain_manifest(path, pass_id=pass_id)
             self._dirty[:] = False
             self._tombstones.clear()
         return fname
@@ -301,38 +368,163 @@ class HostEmbeddingStore:
         meta = dataclasses.asdict(self.cfg)
         meta["save_seq"] = self._save_seq
         meta["num_keys"] = self._n
-        with open(os.path.join(path, "meta.json"), "w") as f:
-            json.dump(meta, f, indent=1)
+        with ckpt_lib.atomic_file(os.path.join(path, "meta.json")) as tmp:
+            with open(tmp, "w") as f:
+                json.dump(meta, f, indent=1)
+
+    def _write_chain_manifest(self, path: str, reset: bool = False,
+                              pass_id: int | None = None) -> None:
+        """Commit MANIFEST.json describing the live chain: base + ordered
+        deltas up to ``self._save_seq``, per-member size + CRC32, and each
+        delta's chain parent. ``reset=True`` (save_base) starts a fresh
+        chain at just the base. Prior members' entries are reused from the
+        previous manifest when present (only the file just written is
+        re-hashed); a legacy pre-manifest directory gets its surviving
+        members hashed once."""
+        prev = None if reset else ckpt_lib.read_manifest(path)
+        prev_files = (prev or {}).get("files", {})
+        # logical chain: base then delta-1..delta-seq. Members absent from
+        # THIS directory are allowed (fleet-style self-contained delta
+        # dirs carry one delta of a chain whose earlier links live
+        # elsewhere); load() enforces completeness for the prefix it
+        # actually replays.
+        logical = ["base.npz"] + [_delta_name(i)
+                                  for i in range(1, self._save_seq + 1)]
+        chain, files = [], {}
+        for i, name in enumerate(logical):
+            full = os.path.join(path, name)
+            if not os.path.exists(full):
+                continue
+            fresh = (name == "base.npz" if reset
+                     else name == _delta_name(self._save_seq))
+            ent = (dict(prev_files[name])
+                   if not fresh and name in prev_files
+                   else ckpt_lib.file_entry(full))
+            ent["parent"] = logical[i - 1] if i else None
+            files[name] = ent
+            chain.append(name)
+        files["meta.json"] = ckpt_lib.file_entry(
+            os.path.join(path, "meta.json"))
+        ckpt_lib.write_manifest(path, files, save_seq=self._save_seq,
+                                chain=chain, num_keys=self._n,
+                                pass_id=pass_id)
 
     def apply_delta_file(self, fname: str) -> None:
         """Replay one delta-*.npz (written by save_delta, possibly into a
         different directory) on top of the current state — lets a resume path
         reconstruct `base + ordered deltas` when deltas were checkpointed
         into self-contained per-pass directories."""
-        z = np.load(fname)
-        self._ingest(z["keys"], z["rows"])
-        if "removed" in z and len(z["removed"]):
-            self._remove(z["removed"])
+        try:
+            ctx = np.load(fname)
+        except Exception as e:           # BadZipFile / truncation / OSError
+            raise CheckpointCorruptError(fname, str(e))
+        with ctx as z:
+            try:
+                keys, rows = z["keys"], z["rows"]
+                removed = z["removed"] if "removed" in z else None
+            except Exception as e:
+                raise CheckpointCorruptError(
+                    fname, f"member unreadable ({e})")
+        self._ingest(keys, rows)
+        if removed is not None and len(removed):
+            self._remove(removed)
+
+    @staticmethod
+    def _verify_chain(path: str, seq: int) -> None:
+        """Check base + delta-1..delta-seq against the directory MANIFEST
+        (size + CRC32 per member). No manifest (legacy/pre-crash-safety
+        dir) verifies nothing; a manifest that does not cover the needed
+        prefix — or a member that fails its checksum — raises
+        CheckpointCorruptError with the chain position, the reason, and
+        the fallback hint the resume path acts on."""
+        manifest = ckpt_lib.read_manifest(path)
+        if manifest is None:
+            return
+        need = ["base.npz"] + [_delta_name(i) for i in range(1, seq + 1)]
+        covered = manifest.get("files", {})
+        for i, name in enumerate(need):
+            if name not in covered:
+                raise CheckpointCorruptError(
+                    os.path.join(path, name),
+                    f"chain member #{i} ({name}) not covered by the "
+                    f"manifest (manifest save_seq="
+                    f"{manifest.get('save_seq')}, wanted replay up to "
+                    f"{seq}) — fall back to an earlier snapshot")
+        try:
+            ckpt_lib.verify_manifest(path, manifest, only=need)
+        except CheckpointCorruptError as e:
+            raise CheckpointCorruptError(
+                e.fname,
+                f"chain member failed verification at position "
+                f"{need.index(os.path.basename(e.fname))} of "
+                f"base+{seq} deltas: {e} — fall back to an earlier "
+                f"snapshot") from e
+
+    def restore(self, path: str, upto_seq: int | None = None,
+                verify: bool = True) -> "HostEmbeddingStore":
+        """In-place resume-from-chain: reset this store and replay
+        ``base + deltas[:seq]`` from ``path``.
+
+        ``upto_seq`` pins the replay horizon (a pass snapshot records the
+        save_seq it was committed at; deltas written after it — by the
+        run that crashed — are ignored and later overwritten). Without
+        it, the horizon comes from the chain MANIFEST when one exists —
+        the manifest is the save's commit record, so a crash between a
+        delta/meta write and the manifest commit correctly resumes at the
+        previous save — falling back to meta.json for legacy dirs.
+        ``verify=True`` checks the replayed prefix against the chain
+        MANIFEST first. In-place restore keeps registered flush hooks and
+        bumps ``mutation_count``, so a FeedPassManager holding device-
+        resident rows invalidates its reuse automatically."""
+        if upto_seq is None:
+            manifest = ckpt_lib.read_manifest(path)
+            if manifest is not None and "save_seq" in manifest:
+                seq = int(manifest["save_seq"])
+            else:
+                with open(os.path.join(path, "meta.json")) as f:
+                    seq = int(json.load(f)["save_seq"])
+        else:
+            seq = int(upto_seq)
+        if verify:
+            self._verify_chain(path, seq)
+        with self._lock:
+            self._mutations += 1
+            self._index = KeyIndex(max(1024, len(self._keys)))
+            self._n = 0
+            self._dirty[:] = False
+            self._tombstones.clear()
+            self._rows_compacted()       # row ids all changed (reset)
+        base = os.path.join(path, "base.npz")
+        try:
+            ctx = np.load(base)
+        except Exception as e:
+            raise CheckpointCorruptError(base, str(e))
+        with ctx as z:
+            self._ingest(z["keys"], z["rows"])
+        for i in range(1, seq + 1):
+            fname = os.path.join(path, _delta_name(i))
+            if not os.path.exists(fname):
+                raise CheckpointCorruptError(
+                    fname, f"mid-chain delta #{i} of {seq} missing — the "
+                           f"chain cannot be replayed; fall back to an "
+                           f"earlier snapshot")
+            self.apply_delta_file(fname)
+        self._save_seq = seq
+        # replayed state == on-disk state; nothing is pending for a delta
+        self._dirty[:self._n] = False
+        return self
 
     @classmethod
-    def load(cls, path: str, cfg: EmbeddingConfig | None = None
-             ) -> "HostEmbeddingStore":
+    def load(cls, path: str, cfg: EmbeddingConfig | None = None,
+             upto_seq: int | None = None,
+             verify: bool = True) -> "HostEmbeddingStore":
         with open(os.path.join(path, "meta.json")) as f:
             meta = json.load(f)
         if cfg is None:
             fields = {f.name for f in dataclasses.fields(EmbeddingConfig)}
             cfg = EmbeddingConfig(**{k: v for k, v in meta.items()
                                      if k in fields})
-        store = cls(cfg)
-        base = np.load(os.path.join(path, "base.npz"))
-        store._ingest(base["keys"], base["rows"])
-        deltas = sorted(f for f in os.listdir(path) if f.startswith("delta-"))
-        for d in deltas[:meta["save_seq"]]:
-            store.apply_delta_file(os.path.join(path, d))
-        store._save_seq = meta["save_seq"]
-        # replayed state == on-disk state; nothing is pending for a delta
-        store._dirty[:store._n] = False
-        return store
+        return cls(cfg).restore(path, upto_seq=upto_seq, verify=verify)
 
     def _remove(self, keys: np.ndarray) -> None:
         with self._lock:

@@ -60,18 +60,30 @@ class BoxPS:
         self._pass_t0 = time.time()
 
     def end_pass(self, need_save_delta: bool = False,
-                 delta_path: str | None = None) -> dict[str, Any]:
+                 delta_path: str | None = None,
+                 checkpointer=None, trainer=None) -> dict[str, Any]:
         """Close the pass; optionally snapshot the delta plane
-        (BoxPSDataset.end_pass(need_save_delta), dataset.py:1124)."""
+        (BoxPSDataset.end_pass(need_save_delta), dataset.py:1124).
+
+        With ``checkpointer`` (a PassCheckpointer) + ``trainer``, commits
+        the full crash-safe pass snapshot instead: dense + optimizer +
+        sparse base-or-delta + metrics + cursor, atomically manifested —
+        the need_save_delta flow upgraded to a resumable one."""
         if not self.in_pass:
             raise RuntimeError("end_pass without begin_pass")
         self.in_pass = False
         out: dict[str, Any] = {"pass_id": self.pass_id,
                                "seconds": time.time() - self._pass_t0}
+        if checkpointer is not None:
+            if trainer is None:
+                raise ValueError("end_pass(checkpointer=...) needs trainer")
+            out["snapshot"] = checkpointer.save(trainer, box=self,
+                                                metrics=self.metrics)
         if need_save_delta:
             if delta_path is None:
                 raise ValueError("need_save_delta requires delta_path")
-            out["delta_file"] = self.store.save_delta(delta_path)
+            out["delta_file"] = self.store.save_delta(
+                delta_path, pass_id=self.pass_id)
         return out
 
     def flip_phase(self) -> None:

@@ -1480,6 +1480,8 @@ class Trainer:
         item = self._push_stager.take()
         if item is None:
             return table
+        from paddlebox_tpu.utils import faultpoint
+        faultpoint.hit("trainer.push_apply.pre")
         idx, mask, labels, plan, ops = item
         table = self._apply_fn(table, idx, mask, labels, *plan, *ops)
         self.push_applies += 1
@@ -1561,6 +1563,31 @@ class Trainer:
         self.params = jax.device_put(params, repl)
         if opt_state is not None:
             self.opt_state = jax.device_put(opt_state, repl)
+
+    def save_checkpoint(self, checkpointer, box=None, metrics=None,
+                        pass_id: int | None = None) -> str:
+        """Snapshot the complete post-pass state (dense + optimizer +
+        sparse base/delta + metrics + cursor) through a
+        :class:`~paddlebox_tpu.utils.pass_ckpt.PassCheckpointer`. Flushes
+        the device tier (pending deferred push + lazily-retained rows)
+        first, so the snapshot is self-contained."""
+        return checkpointer.save(self, box=box, metrics=metrics,
+                                 pass_id=pass_id)
+
+    def resume(self, checkpointer, box=None, metrics=None) -> dict | None:
+        """Crash recovery: restore every plane from the newest snapshot
+        whose manifest chain verifies (base + ordered deltas checksum-
+        clean, tombstone-consistent replay via ``store.restore``), falling
+        back past a torn/truncated newest snapshot automatically.
+
+        Restores the sparse store in place (device-resident rows are
+        invalidated via the store's mutation counter), the dense
+        params/optimizer state mode-aware (``restore_dense``), the metric
+        registry + phase bit, and the pass/step cursor. Returns the cursor
+        dict ({pass_id, global_step, date, phase}) — the driver re-enters
+        its pass loop at ``cursor["pass_id"] + 1`` — or None when there is
+        nothing to resume (fresh start)."""
+        return checkpointer.resume(self, box=box, metrics=metrics)
 
     def eval_pass(self, dataset) -> dict[str, float]:
         """Test-mode pass: no pushes, no dense updates, and the store is

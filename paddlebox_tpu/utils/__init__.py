@@ -5,3 +5,6 @@ from paddlebox_tpu.utils.profiler import (RecordEvent, STATS,  # noqa: F401
                                           export_chrome_trace,
                                           find_nonfinite, stat_add, stat_get)
 from paddlebox_tpu.utils.timer import StageTimers  # noqa: F401
+from paddlebox_tpu.utils.checkpoint import (  # noqa: F401
+    CheckpointCorruptError)
+from paddlebox_tpu.utils.pass_ckpt import PassCheckpointer  # noqa: F401

@@ -1,20 +1,182 @@
-"""Dense-parameter checkpointing — pytree ↔ npz.
+"""Dense-parameter checkpointing — pytree ↔ npz — and the atomic-write /
+manifest primitives every snapshot writer in the system goes through.
 
 The reference persists dense params by copying the thread-0 scope back to the
 root scope at trainer Finalize (boxps_trainer.cc:123-131) and then calling
 ``fluid.io.save_persistables``. Here the dense state is a JAX pytree
 (params + optimizer state); we serialize it keyed by tree path so load is
 order-independent and shape-checked.
+
+Crash-safety contract (the pass/day training loop restarts from these
+files after preemption — SURVEY.md §5 "Failure detection"):
+
+- Writers go write-tmp → fsync → ``os.replace`` (:func:`atomic_file`), so a
+  file is either the complete previous version or the complete new version
+  under its final name — never a truncation.
+- Snapshot directories carry a ``MANIFEST.json`` (:func:`write_manifest`)
+  listing every member with size + CRC32; :func:`verify_manifest` re-hashes
+  and raises :class:`CheckpointCorruptError` naming the first bad member,
+  so a torn snapshot is *diagnosed*, not silently half-loaded.
 """
 
 from __future__ import annotations
 
+import json
 import os
+import zipfile
+import zlib
+from contextlib import contextmanager
 from typing import Any
 
 import jax
 import numpy as np
 
+from paddlebox_tpu.utils import faultpoint
+
+MANIFEST_NAME = "MANIFEST.json"
+
+
+class CheckpointCorruptError(RuntimeError):
+    """A checkpoint member is truncated/corrupt (bad zip, bad CRC, bad
+    size, missing file). Carries the offending path in ``fname``."""
+
+    def __init__(self, fname: str, detail: str):
+        super().__init__(f"checkpoint {fname!r} is corrupt or truncated: "
+                         f"{detail}")
+        self.fname = fname
+
+
+# ---------------------------------------------------------------------------
+# atomic durable writes
+# ---------------------------------------------------------------------------
+
+@contextmanager
+def atomic_file(path: str, fault_point: str | None = None):
+    """Yield a temp path in ``path``'s directory; on clean exit fsync it and
+    ``os.replace`` onto ``path`` (then fsync the directory so the rename
+    itself is durable). On exception the temp file is removed and ``path``
+    is untouched — a crashed writer can never leave a partial file under
+    the final name.
+
+    ``fault_point``: optional faultpoint name hit between the durable tmp
+    write and the rename — the window the atomicity claim is about.
+    """
+    d = os.path.dirname(os.path.abspath(path))
+    os.makedirs(d, exist_ok=True)
+    tmp = f"{path}.tmp.{os.getpid()}"
+    try:
+        yield tmp
+        with open(tmp, "rb+") as f:
+            f.flush()
+            os.fsync(f.fileno())
+        if fault_point is not None:
+            faultpoint.hit(fault_point)
+        os.replace(tmp, path)
+        _fsync_dir(d)
+    except BaseException:
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+        raise
+
+
+def _fsync_dir(d: str) -> None:
+    try:
+        fd = os.open(d, os.O_RDONLY)
+    except OSError:          # platform without directory fds
+        return
+    try:
+        os.fsync(fd)
+    except OSError:
+        pass
+    finally:
+        os.close(fd)
+
+
+def crc32_file(path: str, chunk: int = 1 << 20) -> int:
+    crc = 0
+    with open(path, "rb") as f:
+        while True:
+            b = f.read(chunk)
+            if not b:
+                return crc & 0xFFFFFFFF
+            crc = zlib.crc32(b, crc)
+
+
+def file_entry(path: str) -> dict[str, int]:
+    """Manifest entry for one on-disk member: {bytes, crc32}."""
+    return {"bytes": os.path.getsize(path), "crc32": crc32_file(path)}
+
+
+# ---------------------------------------------------------------------------
+# manifests
+# ---------------------------------------------------------------------------
+
+def write_manifest(dirpath: str, files: dict[str, dict],
+                   fault_point: str | None = None, **meta: Any) -> str:
+    """Atomically commit ``MANIFEST.json`` for a snapshot directory.
+
+    ``files`` maps member-relative-path → ``file_entry`` dict. Extra
+    keyword metadata (pass_id, save_seq, chain parent, …) is stored
+    alongside. The manifest lands LAST, atomically — its presence is the
+    snapshot's commit record; a snapshot without one never existed.
+    """
+    out = os.path.join(dirpath, MANIFEST_NAME)
+    doc = dict(meta)
+    doc["files"] = files
+    with atomic_file(out, fault_point=fault_point) as tmp:
+        with open(tmp, "w") as f:
+            json.dump(doc, f, indent=1, sort_keys=True)
+    return out
+
+
+def read_manifest(dirpath: str) -> dict | None:
+    p = os.path.join(dirpath, MANIFEST_NAME)
+    if not os.path.exists(p):
+        return None
+    try:
+        with open(p) as f:
+            return json.load(f)
+    except (json.JSONDecodeError, OSError) as e:
+        raise CheckpointCorruptError(p, f"unreadable manifest ({e})")
+
+
+def verify_manifest(dirpath: str, manifest: dict | None = None,
+                    only: list[str] | None = None) -> dict:
+    """Re-hash the members listed in ``dirpath``'s manifest; raise
+    :class:`CheckpointCorruptError` on the first missing/short/mismatched
+    member, naming it. Returns the (parsed) manifest. ``only`` restricts
+    verification to a subset of members (e.g. the delta chain prefix a
+    resume actually replays)."""
+    m = manifest if manifest is not None else read_manifest(dirpath)
+    if m is None:
+        raise CheckpointCorruptError(
+            os.path.join(dirpath, MANIFEST_NAME),
+            "missing manifest (snapshot was never committed)")
+    names = only if only is not None else list(m.get("files", {}))
+    for name in names:
+        ent = m["files"].get(name)
+        p = os.path.join(dirpath, name)
+        if ent is None:
+            raise CheckpointCorruptError(p, "member absent from manifest")
+        if not os.path.exists(p):
+            raise CheckpointCorruptError(p, "member file missing on disk")
+        size = os.path.getsize(p)
+        if size != ent["bytes"]:
+            raise CheckpointCorruptError(
+                p, f"size {size} != manifest {ent['bytes']} "
+                   f"(truncated or torn write)")
+        crc = crc32_file(p)
+        if crc != ent["crc32"]:
+            raise CheckpointCorruptError(
+                p, f"crc32 {crc:#010x} != manifest {ent['crc32']:#010x}")
+    return m
+
+
+# ---------------------------------------------------------------------------
+# dense pytree ↔ npz
+# ---------------------------------------------------------------------------
 
 def _path_str(path) -> str:
     parts = []
@@ -33,7 +195,12 @@ def _path_str(path) -> str:
 def save_pytree(tree: Any, fname: str, compress: bool = True) -> str:
     """compress=False writes STORED zip members (plain .npy bytes at a
     fixed offset) so non-Python clients can mmap the arrays directly —
-    the serving export uses this (native/serving_score.c)."""
+    the serving export uses this (native/serving_score.c).
+
+    The write is atomic-durable: bytes go to a same-directory temp file,
+    fsync, then ``os.replace`` — a reader (or a resume after SIGKILL mid-
+    write) sees the previous complete file or the new complete file,
+    never a truncation under the final name."""
     leaves = jax.tree_util.tree_flatten_with_path(tree)[0]
     # force C order: XLA may hand back an F-contiguous view of its chosen
     # device layout, and np.save would then write fortran_order=True —
@@ -42,25 +209,45 @@ def save_pytree(tree: Any, fname: str, compress: bool = True) -> str:
     # like adam's count to (1,), breaking load_pytree's shape check)
     arrays = {_path_str(path): np.asarray(leaf, order="C")
               for path, leaf in leaves}
-    os.makedirs(os.path.dirname(fname) or ".", exist_ok=True)
-    (np.savez_compressed if compress else np.savez)(fname, **arrays)
+    with atomic_file(fname, fault_point="ckpt.dense.pre_replace") as tmp:
+        # write through an open handle: np.savez would append ".npz" to a
+        # bare path, breaking the tmp → final rename pairing
+        with open(tmp, "wb") as f:
+            (np.savez_compressed if compress else np.savez)(f, **arrays)
     return fname
 
 
 def load_pytree(template: Any, fname: str) -> Any:
-    """Load into the structure of `template` (shapes must match)."""
-    z = np.load(fname)
-    leaves, treedef = jax.tree_util.tree_flatten_with_path(template)
-    out = []
-    for path, leaf in leaves:
-        key = _path_str(path)
-        if key not in z:
-            raise KeyError(f"checkpoint {fname} missing leaf {key!r}")
-        arr = z[key]
-        want = np.shape(leaf)
-        if tuple(arr.shape) != tuple(want):
-            raise ValueError(
-                f"leaf {key!r}: checkpoint shape {arr.shape} != {want}")
-        out.append(arr)
+    """Load into the structure of `template` (shapes must match).
+
+    The npz handle is closed on every path (context manager), and a
+    truncated/corrupt archive surfaces as :class:`CheckpointCorruptError`
+    naming the file — the resume path keys its fallback on that."""
+    try:
+        ctx = np.load(fname)
+    except (zipfile.BadZipFile, EOFError, ValueError) as e:
+        raise CheckpointCorruptError(fname, str(e))
+    except OSError as e:
+        if not os.path.exists(fname):
+            raise
+        raise CheckpointCorruptError(fname, str(e))
+    with ctx as z:
+        leaves, treedef = jax.tree_util.tree_flatten_with_path(template)
+        out = []
+        for path, leaf in leaves:
+            key = _path_str(path)
+            if key not in z:
+                raise KeyError(f"checkpoint {fname} missing leaf {key!r}")
+            try:
+                arr = z[key]
+            except (zipfile.BadZipFile, EOFError, zlib.error,
+                    ValueError) as e:
+                raise CheckpointCorruptError(
+                    fname, f"member {key!r} unreadable ({e})")
+            want = np.shape(leaf)
+            if tuple(arr.shape) != tuple(want):
+                raise ValueError(
+                    f"leaf {key!r}: checkpoint shape {arr.shape} != {want}")
+            out.append(arr)
     return jax.tree_util.tree_unflatten(
         treedef, [jax.numpy.asarray(a) for a in out])

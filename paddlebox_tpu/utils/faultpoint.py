@@ -1,0 +1,133 @@
+"""Named fault-injection points for the crash-safe checkpoint subsystem.
+
+The reference survives worker preemption by pass-granularity restart
+(SURVEY.md §5 "Failure detection": load the newest base + replay delta
+donefiles). Proving our atomic-manifest/resume path actually delivers that
+needs a way to die at *specific* instructions — mid dense write, between a
+delta file and its manifest commit, inside the feed-pass flush — not
+wherever a SIGKILL happens to land. This registry is that harness.
+
+Every interesting crash window in the save/flush/apply paths calls
+:func:`hit` with a registered name. Disarmed (the default), a hit is one
+global ``is None`` check — nothing to measure. Armed — via :func:`arm` in
+process, or the environment for subprocess tests::
+
+    PBTPU_FAULTPOINT=store.save_delta.pre_manifest   # point name
+    PBTPU_FAULTPOINT_ACTION=kill                     # kill | ioerror
+    PBTPU_FAULTPOINT_AFTER=2                         # fire on the 3rd hit
+
+— the named point either hard-kills the process (``os._exit(137)``, the
+closest in-process stand-in for SIGKILL/preemption: no atexit handlers, no
+finally blocks, buffers lost) or raises :class:`FaultInjected` (an OSError,
+for exercising IO-error retry/cleanup paths without losing the process).
+
+``POINTS`` is the closed registry; tests parametrize over it so a new
+crash window cannot be added without the kill→resume matrix covering it.
+``hit()`` refuses unregistered names for the same reason.
+"""
+
+from __future__ import annotations
+
+import os
+
+# The closed set of registered crash windows. Keep in sync with the
+# kill→resume matrix in tests/test_crash_safety.py (it parametrizes over
+# this tuple) and the PARITY.md table.
+POINTS: tuple[str, ...] = (
+    # utils/checkpoint.save_pytree: dense tmp file fully written + fsynced,
+    # os.replace not yet executed — the final name must still hold the
+    # previous snapshot (or nothing).
+    "ckpt.dense.pre_replace",
+    # embedding/store.save_base: base.npz tmp written, before the replace.
+    "store.save_base.pre_replace",
+    # embedding/store.save_delta: delta-*.npz tmp written, before replace.
+    "store.save_delta.pre_replace",
+    # embedding/store.save_delta: delta file landed, manifest commit not
+    # yet — the chain manifest must still describe the previous save.
+    "store.save_delta.pre_manifest",
+    # embedding/feed_pass.flush: unsynced device rows are about to move
+    # D2H into the host store (the materialization that precedes every
+    # save) — dying here must leave the previous snapshot untouched.
+    "feed_pass.flush.pre",
+    # train/trainer._dispatch_pending_apply: a deferred sparse-push apply
+    # (flags.push_overlap) is about to dispatch mid-pass.
+    "trainer.push_apply.pre",
+    # utils/pass_ckpt.save: all planes written, snapshot MANIFEST.json not
+    # yet committed — the snapshot must be invisible to resume.
+    "pass_ckpt.pre_manifest",
+    # utils/pass_ckpt.save: manifest committed — resume must land on THIS
+    # snapshot.
+    "pass_ckpt.post_manifest",
+)
+
+
+class FaultInjected(OSError):
+    """Raised by an armed ``ioerror`` fault point."""
+
+
+class _Armed:
+    __slots__ = ("name", "action", "after", "hits")
+
+    def __init__(self, name: str, action: str, after: int):
+        self.name = name
+        self.action = action
+        self.after = after
+        self.hits = 0
+
+
+_armed: _Armed | None = None
+# per-point hit counters, kept even when disarmed is re-armed (observability
+# for tests asserting a point is actually on the executed path)
+_counts: dict[str, int] = {}
+
+
+def arm(name: str, action: str = "kill", after: int = 0) -> None:
+    """Arm one fault point. ``action``: ``kill`` (os._exit(137)) or
+    ``ioerror`` (raise FaultInjected). ``after``: fire on hit #after+1."""
+    global _armed
+    if name not in POINTS:
+        raise KeyError(f"unknown fault point {name!r}; registered: {POINTS}")
+    if action not in ("kill", "ioerror"):
+        raise ValueError(f"fault action {action!r} (want kill|ioerror)")
+    _armed = _Armed(name, action, int(after))
+
+
+def disarm() -> None:
+    global _armed
+    _armed = None
+
+
+def hit_count(name: str) -> int:
+    return _counts.get(name, 0)
+
+
+def hit(name: str) -> None:
+    """Mark a registered crash window. No-op unless armed on this name."""
+    a = _armed
+    if a is None:
+        return
+    if name not in POINTS:
+        raise KeyError(f"unregistered fault point {name!r}")
+    _counts[name] = _counts.get(name, 0) + 1
+    if name != a.name:
+        return
+    a.hits += 1
+    if a.hits <= a.after:
+        return
+    if a.action == "kill":
+        # stderr marker first: the harness asserts the kill came from the
+        # armed point, not an incidental crash
+        os.write(2, f"FAULTPOINT KILL {name}\n".encode())
+        os._exit(137)
+    raise FaultInjected(f"fault point {name} (injected)")
+
+
+def _arm_from_env() -> None:
+    name = os.environ.get("PBTPU_FAULTPOINT", "")
+    if not name:
+        return
+    arm(name, os.environ.get("PBTPU_FAULTPOINT_ACTION", "kill"),
+        int(os.environ.get("PBTPU_FAULTPOINT_AFTER", "0")))
+
+
+_arm_from_env()

@@ -116,7 +116,24 @@ class CommandFS(FileSystem):
     into the subprocess environment (credentials — the fs_user/fs_passwd of
     InitAfsAPI travel here, never through the conversation of a command
     line that ``ps`` could show, when the CLI supports env auth).
+
+    Resilience: ``put``/``get``/``ls``/``rm`` (and idempotent ``mkdir -p``)
+    retry with exponential backoff (``retries`` total attempts, first
+    sleep ``retry_backoff`` seconds, doubling), and every non-streaming
+    command is bounded by ``timeout`` seconds (None or 0 = unbounded; a
+    hung client counts as a failed attempt). A failed ``get`` attempt's
+    partial local dst is removed before the retry (the default hadoop
+    ``-get`` refuses to overwrite, so a leftover half-download would turn
+    every retry into 'File exists'). Exhaustion raises with the attempt
+    count and the last stderr. ``append`` is deliberately NOT retried — a
+    partial append that reported failure could double-write a donefile
+    line — and ``test``'s exists/absent exit codes are both successes, so
+    it never retries a legitimate "absent". Defaults come from
+    flags.fs_retry_attempts / fs_retry_backoff_s / fs_command_timeout_s
+    at call time.
     """
+
+    _RETRY_OPS = ("put", "get", "ls", "rm", "mkdir")
 
     def __init__(self, cat: str = "hadoop fs -cat {path}",
                  ls: str = "hadoop fs -ls {path}",
@@ -126,11 +143,31 @@ class CommandFS(FileSystem):
                  test: str = "hadoop fs -test -e {path}",
                  rm: str = "hadoop fs -rm -r -f {path}",
                  append: str | None = None,
-                 env: dict | None = None):
+                 env: dict | None = None,
+                 retries: int | None = None,
+                 retry_backoff: float | None = None,
+                 timeout: float | None = None):
         self._cmds = {"cat": cat, "ls": ls, "put": put, "get": get,
                       "mkdir": mkdir, "test": test, "rm": rm,
                       "append": append}
         self._env = dict(os.environ, **(env or {}))
+        self._retries = retries
+        self._retry_backoff = retry_backoff
+        self._timeout = timeout
+
+    def _retry_policy(self, op: str) -> tuple[int, float, float | None]:
+        """(attempts, first_backoff_seconds, timeout_seconds_or_None)."""
+        from paddlebox_tpu.config import flags
+        attempts = (self._retries if self._retries is not None
+                    else flags.fs_retry_attempts)
+        if op not in self._RETRY_OPS:
+            attempts = 1
+        backoff = (self._retry_backoff if self._retry_backoff is not None
+                   else flags.fs_retry_backoff_s)
+        # 0 means "no timeout" in both the ctor and the flag
+        timeout = (self._timeout if self._timeout is not None
+                   else flags.fs_command_timeout_s) or None
+        return max(1, int(attempts)), float(backoff), timeout
 
     def _argv(self, op: str, **kw) -> list[str]:
         tpl = self._cmds[op]
@@ -151,13 +188,52 @@ class CommandFS(FileSystem):
 
     def _run(self, op: str, ok_codes: tuple = (0,),
              **kw) -> subprocess.CompletedProcess:
-        proc = subprocess.run(self._argv(op, **kw), env=self._env,
-                              capture_output=True)
-        if proc.returncode not in ok_codes:
-            raise RuntimeError(
-                f"CommandFS {op} failed ({proc.returncode}): "
-                f"{proc.stderr.decode(errors='replace')[:500]}")
-        return proc
+        import time
+        attempts, backoff, timeout = self._retry_policy(op)
+        argv = self._argv(op, **kw)
+        # get-retry hygiene targets: only paths a failed attempt may have
+        # CREATED are ever cleaned up between attempts — a dst (or member
+        # inside a pre-existing dst directory) that existed before the
+        # first attempt is never touched
+        get_cleanup: list[str] = []
+        if op == "get" and attempts > 1 and "dst" in kw:
+            dst = kw["dst"]
+            if not os.path.exists(dst):
+                get_cleanup.append(dst)
+            elif os.path.isdir(dst) and "src" in kw:
+                member = os.path.join(
+                    dst, os.path.basename(kw["src"].rstrip("/")))
+                if not os.path.exists(member):
+                    get_cleanup.append(member)
+        last = "never ran"
+        for attempt in range(1, attempts + 1):
+            try:
+                proc = subprocess.run(argv, env=self._env,
+                                      capture_output=True, timeout=timeout)
+            except subprocess.TimeoutExpired:
+                last = f"timed out after {timeout}s"
+            else:
+                if proc.returncode in ok_codes:
+                    return proc
+                last = (f"exit {proc.returncode}: "
+                        f"{proc.stderr.decode(errors='replace')[:500]}")
+            if attempt < attempts:
+                for p in get_cleanup:
+                    # a dead/timed-out client may have left a partial
+                    # local download; `-get` without -f would then fail
+                    # every retry with 'File exists'
+                    try:
+                        if os.path.isdir(p):
+                            import shutil
+                            shutil.rmtree(p)
+                        elif os.path.exists(p):
+                            os.remove(p)
+                    except OSError:
+                        pass
+                time.sleep(backoff * (2 ** (attempt - 1)))
+        raise RuntimeError(
+            f"CommandFS {op} failed after {attempts} attempt"
+            f"{'s' if attempts != 1 else ''} ({last})")
 
     def open_read(self, path: str) -> IO[bytes]:
         # stderr spools to a temp file: a chatty CLI (hadoop log4j noise)

@@ -1,0 +1,309 @@
+"""Unified crash-safe pass snapshots + resume — the PassCheckpointer.
+
+The reference's production loop survives preemption at pass granularity:
+``SaveBase`` writes the day's batch model, ``end_pass(need_save_delta)``
+emits per-pass deltas, and a restarted worker loads the newest base +
+replays the delta donefiles (SURVEY.md §5; fleet_util.py:649-745). Our
+reproduction adds what the open-source glue leaves implicit: *atomic*
+snapshots with verified manifests, and a resume that restores every plane
+a pass touches —
+
+- dense params + optimizer state (utils/checkpoint.save_pytree, mode-aware
+  through ``Trainer.restore_dense`` — allreduce/kstep/async),
+- the sparse table as a base-or-delta chain (``store.save_base`` /
+  ``save_delta``; a fresh base every ``base_every`` passes bounds replay
+  length and lets retention reclaim old chains),
+- metric/AUC registry state and the join/update phase bit,
+- the pass/step cursor (``BoxPS.pass_id``, ``date``,
+  ``Trainer.global_step``),
+
+after first flushing the device tier (pending deferred push applies +
+lazily-retained rows — ``Trainer.flush_sparse``), so the snapshot is the
+complete post-pass state.
+
+Commit protocol: every member lands atomically (tmp → fsync → replace);
+the snapshot's ``MANIFEST.json`` — carrying the cursor, the chain
+reference with per-member CRC32s, and checksums of the snapshot's own
+files — is written LAST. A snapshot without a committed manifest never
+happened; one whose checksums no longer verify is diagnosed and skipped.
+``resume`` therefore walks snapshots newest-first and restores the first
+one that fully verifies, falling back past a torn/truncated newest
+snapshot automatically. ``keep_last_n`` prunes old snapshots (and any
+sparse chain directory no surviving snapshot references) after each
+successful save.
+"""
+
+from __future__ import annotations
+
+import os
+import re
+import shutil
+import warnings
+from typing import Any
+
+from paddlebox_tpu.config import flags as config_flags
+from paddlebox_tpu.utils import checkpoint as ckpt_lib
+from paddlebox_tpu.utils import faultpoint
+from paddlebox_tpu.utils.checkpoint import CheckpointCorruptError
+
+_PASS_RE = re.compile(r"^pass-(\d+)$")
+_CHAIN_RE = re.compile(r"^chain-(\d+)$")
+
+
+def _dense_tree(trainer) -> dict[str, Any]:
+    return {"params": trainer.params, "opt_state": trainer.opt_state}
+
+
+def _metric_tree(metrics) -> dict[str, Any]:
+    return {name: metrics.get_state(name) for name in metrics.names()}
+
+
+class PassCheckpointer:
+    """Owns one snapshot root. One instance per training job; the driver
+    calls :meth:`save` at every pass boundary (directly or through
+    ``BoxPS.end_pass``) and :meth:`resume` once at startup."""
+
+    def __init__(self, root: str, keep_last_n: int | None = None,
+                 base_every: int | None = None):
+        self.root = root
+        self.keep_last_n = (config_flags.ckpt_keep_last_n
+                            if keep_last_n is None else int(keep_last_n))
+        if self.keep_last_n < 2:
+            # fallback-past-a-torn-newest needs at least one predecessor
+            raise ValueError("keep_last_n must be >= 2 for crash safety")
+        self.base_every = (config_flags.ckpt_base_every
+                           if base_every is None else int(base_every))
+        os.makedirs(root, exist_ok=True)
+        self._chain_gen = 0
+        self._chain_dir: str | None = None
+        self._deltas_in_chain = 0
+        # store.save_count as of OUR last save/resume: any foreign
+        # save_base/save_delta in between (e.g. FleetUtil donefile models
+        # sharing the store) consumed the dirty mask + tombstones, so the
+        # next snapshot must be a full base — a delta into our chain
+        # would silently miss the rows/evictions the foreign save carried
+        # away. The MONOTONIC count is the guard (save_seq can't be: a
+        # foreign save_base resets it to 0, aliasing "nothing happened")
+        self._expect_count: int | None = None
+
+    # ---- paths -----------------------------------------------------------
+
+    def snap_dir(self, pass_id: int) -> str:
+        return os.path.join(self.root, f"pass-{pass_id:05d}")
+
+    def _chain_path(self, name: str) -> str:
+        return os.path.join(self.root, name)
+
+    def _list_snaps(self) -> list[tuple[int, str]]:
+        out = []
+        for n in os.listdir(self.root):
+            m = _PASS_RE.match(n)
+            if m and os.path.isdir(os.path.join(self.root, n)):
+                out.append((int(m.group(1)), os.path.join(self.root, n)))
+        return sorted(out)
+
+    # ---- save ------------------------------------------------------------
+
+    def save(self, trainer, box=None, metrics=None,
+             pass_id: int | None = None) -> str:
+        """Snapshot the complete post-pass state. Returns the snapshot dir.
+
+        Members land atomically in dependency order (sparse chain → dense
+        → metrics), manifest last — a kill anywhere before the manifest
+        commit leaves this snapshot invisible and the previous one intact.
+        """
+        if pass_id is None:
+            if box is None:
+                raise ValueError("save needs pass_id or a BoxPS")
+            pass_id = int(box.pass_id)
+        metrics = metrics if metrics is not None else (
+            box.metrics if box is not None else None)
+        # device tier → host store: pending deferred push apply lands,
+        # then unsynced resident rows move D2H (the stager/feed flush the
+        # snapshot's completeness depends on)
+        trainer.flush_sparse()
+
+        # sparse plane: rotate to a fresh base chain on the first save,
+        # every base_every-th pass after, and whenever another writer
+        # saved the store since our last snapshot (its delta consumed the
+        # dirty rows ours would need — only a full base is still exact)
+        rotate = (self._chain_dir is None
+                  or (self.base_every > 0
+                      and self._deltas_in_chain >= self.base_every - 1)
+                  or trainer.store.save_count != self._expect_count)
+        # chain bookkeeping commits only AFTER the store save succeeds: a
+        # transient failure (ENOSPC, injected IO error) must leave the
+        # checkpointer pointing at the last good chain state, not at a
+        # half-open baseless chain every later save would trip over
+        if rotate:
+            gen = self._chain_gen + 1
+            chain_name = f"chain-{gen:04d}"
+            trainer.store.save_base(self._chain_path(chain_name),
+                                    pass_id=pass_id)
+            self._chain_gen = gen
+            self._chain_dir = chain_name
+            self._deltas_in_chain = 0
+        else:
+            chain_name = self._chain_dir
+            trainer.store.save_delta(self._chain_path(chain_name),
+                                     pass_id=pass_id)
+            self._deltas_in_chain += 1
+        save_seq = trainer.store.save_seq
+        self._expect_count = trainer.store.save_count
+        chain_manifest = ckpt_lib.read_manifest(self._chain_path(chain_name))
+        chain_files = {
+            name: chain_manifest["files"][name]
+            for name in (["base.npz"]
+                         + [f"delta-{i:05d}.npz"
+                            for i in range(1, save_seq + 1)])}
+
+        snap = self.snap_dir(pass_id)
+        os.makedirs(snap, exist_ok=True)
+        files: dict[str, dict] = {}
+        dense_f = os.path.join(snap, "dense.npz")
+        ckpt_lib.save_pytree(_dense_tree(trainer), dense_f)
+        files["dense.npz"] = ckpt_lib.file_entry(dense_f)
+        if metrics is not None and metrics.names():
+            met_f = os.path.join(snap, "metrics.npz")
+            ckpt_lib.save_pytree(_metric_tree(metrics), met_f)
+            files["metrics.npz"] = ckpt_lib.file_entry(met_f)
+
+        cursor = {
+            "pass_id": int(pass_id),
+            "global_step": int(trainer.global_step),
+            "date": None if box is None else box.date,
+            "phase": None if metrics is None else int(metrics.phase),
+        }
+        faultpoint.hit("pass_ckpt.pre_manifest")
+        ckpt_lib.write_manifest(
+            snap, files, cursor=cursor, save_seq=save_seq,
+            chain_dir=chain_name, chain_files=chain_files,
+            parent_snapshot=(f"pass-{pass_id - 1:05d}"
+                             if pass_id > 1 else None))
+        faultpoint.hit("pass_ckpt.post_manifest")
+        self._prune()
+        return snap
+
+    # ---- discovery / verification ---------------------------------------
+
+    def _verify_snapshot(self, snap: str) -> dict:
+        """Full snapshot verification: manifest present, snapshot members
+        checksum clean, and the sparse chain prefix it references intact
+        — against the CRCs the snapshot itself recorded (the chain dir's
+        live manifest may already describe a newer save)."""
+        manifest = ckpt_lib.verify_manifest(snap)
+        try:
+            int(manifest["cursor"]["pass_id"])     # resume depends on it
+            int(manifest["cursor"]["global_step"])
+            chain_dir = self._chain_path(manifest["chain_dir"])
+            need = (["base.npz"]
+                    + [f"delta-{i:05d}.npz"
+                       for i in range(1, int(manifest["save_seq"]) + 1)])
+        except (KeyError, TypeError, ValueError) as e:
+            raise CheckpointCorruptError(
+                os.path.join(snap, ckpt_lib.MANIFEST_NAME),
+                f"snapshot manifest missing/invalid field ({e!r})")
+        chain_files = manifest.get("chain_files", {})
+        try:
+            # same missing/size/crc checks as any manifest, but against
+            # the CRCs the SNAPSHOT recorded
+            ckpt_lib.verify_manifest(chain_dir, {"files": chain_files},
+                                     only=need)
+        except CheckpointCorruptError as e:
+            name = os.path.basename(e.fname)
+            pos = need.index(name) if name in need else -1
+            raise CheckpointCorruptError(
+                e.fname,
+                f"chain member #{pos} of base+{len(need) - 1} deltas "
+                f"(as recorded by snapshot {os.path.basename(snap)}): "
+                f"{e}") from e
+        return manifest
+
+    def latest_valid(self) -> tuple[int, str, dict] | None:
+        """Newest snapshot that fully verifies, walking past torn ones
+        (with a warning naming the diagnosis). None = nothing to resume."""
+        for pass_id, snap in reversed(self._list_snaps()):
+            try:
+                return pass_id, snap, self._verify_snapshot(snap)
+            except CheckpointCorruptError as e:
+                warnings.warn(
+                    f"snapshot {snap} failed verification ({e}); falling "
+                    f"back to the previous one")
+        return None
+
+    # ---- resume ----------------------------------------------------------
+
+    def resume(self, trainer, box=None, metrics=None) -> dict | None:
+        """Restore every plane from the newest valid snapshot; return its
+        cursor dict ({pass_id, global_step, date, phase}), or None when no
+        valid snapshot exists (fresh start). The driver re-enters its pass
+        loop at ``cursor['pass_id'] + 1``."""
+        found = self.latest_valid()
+        if found is None:
+            return None
+        pass_id, snap, manifest = found
+        cursor = dict(manifest["cursor"])
+        chain_name = manifest["chain_dir"]
+        seq = int(manifest["save_seq"])
+
+        # sparse plane, in place: mutation_count bump invalidates any
+        # device-resident rows the feed manager still holds. Chain already
+        # verified against the snapshot's own CRCs above.
+        trainer.store.restore(self._chain_path(chain_name),
+                              upto_seq=seq, verify=False)
+
+        # dense plane (mode-aware: allreduce/kstep/async via restore_dense)
+        dense = ckpt_lib.load_pytree(
+            _dense_tree(trainer), os.path.join(snap, "dense.npz"))
+        trainer.restore_dense(dense["params"], dense["opt_state"])
+        trainer.global_step = int(cursor["global_step"])
+
+        metrics = metrics if metrics is not None else (
+            box.metrics if box is not None else None)
+        if metrics is not None and "metrics.npz" in manifest["files"]:
+            states = ckpt_lib.load_pytree(
+                _metric_tree(metrics), os.path.join(snap, "metrics.npz"))
+            for name, state in states.items():
+                metrics.set_state(name, state)
+            if cursor.get("phase") is not None:
+                metrics.phase = int(cursor["phase"])
+        if box is not None:
+            box.pass_id = int(cursor["pass_id"])
+            box.in_pass = False
+            if cursor.get("date") is not None:
+                box.date = int(cursor["date"])
+
+        # continue the chain where the snapshot left it: the next save
+        # deltas into the same chain dir (store._save_seq was set by
+        # restore; stale higher-numbered deltas from the crashed run get
+        # overwritten as the re-run reaches them)
+        self._chain_dir = chain_name
+        self._chain_gen = max(self._chain_gen,
+                              int(_CHAIN_RE.match(chain_name).group(1)))
+        self._deltas_in_chain = seq
+        # store.restore replayed the chain and left save_seq at `seq`; a
+        # foreign save between now and our next snapshot bumps save_count
+        # and forces the rotation
+        self._expect_count = trainer.store.save_count
+        return cursor
+
+    # ---- retention -------------------------------------------------------
+
+    def _prune(self) -> None:
+        """Drop snapshots beyond keep_last_n, then chain dirs no surviving
+        snapshot references. Never touches the open chain."""
+        snaps = self._list_snaps()
+        for _, snap in snaps[:-self.keep_last_n]:
+            shutil.rmtree(snap, ignore_errors=True)
+        referenced = {self._chain_dir}
+        for _, snap in self._list_snaps():
+            try:
+                m = ckpt_lib.read_manifest(snap)
+            except CheckpointCorruptError:
+                continue     # unusable snapshot; resume skips it too
+            if m is not None:
+                referenced.add(m.get("chain_dir"))
+        for n in os.listdir(self.root):
+            if _CHAIN_RE.match(n) and n not in referenced:
+                shutil.rmtree(os.path.join(self.root, n),
+                              ignore_errors=True)

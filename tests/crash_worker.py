@@ -1,0 +1,115 @@
+"""Subprocess driver for the kill→resume fault-injection matrix.
+
+Runs a small deterministic pass-loop training job with crash-safe
+checkpointing (PassCheckpointer), resuming from the snapshot root if one
+exists, and dumps the final dense/sparse/metric state to an npz for
+bitwise comparison. Fault points are armed purely through the environment
+(PBTPU_FAULTPOINT / _ACTION / _AFTER — see utils/faultpoint.py), so the
+same invocation serves as the golden run, the killed run, and the
+resuming re-run.
+
+Usage: python tests/crash_worker.py ROOT OUT_NPZ [--passes N]
+"""
+
+import argparse
+import os
+import sys
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO not in sys.path:
+    sys.path.insert(0, REPO)
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np  # noqa: E402
+
+from paddlebox_tpu.data import DataFeedSchema, SlotDataset  # noqa: E402
+from paddlebox_tpu.data.parser import parse_multislot_lines  # noqa: E402
+from paddlebox_tpu.embedding import (EmbeddingConfig,  # noqa: E402
+                                     HostEmbeddingStore)
+from paddlebox_tpu.fleet import BoxPS  # noqa: E402
+from paddlebox_tpu.models import DNNCTRModel  # noqa: E402
+from paddlebox_tpu.parallel import make_mesh  # noqa: E402
+from paddlebox_tpu.train import Trainer, TrainerConfig  # noqa: E402
+from paddlebox_tpu.utils.pass_ckpt import PassCheckpointer  # noqa: E402
+
+NUM_SLOTS = 3
+VOCAB = 40
+
+
+def synth(n=256, seed=11):
+    rng = np.random.default_rng(seed)
+    schema = DataFeedSchema.ctr(num_sparse=NUM_SLOTS, num_float=1,
+                                batch_size=64, max_len=2)
+    w = np.random.default_rng(5).normal(size=(NUM_SLOTS, VOCAB))
+    lines = []
+    for _ in range(n):
+        logits, parts, sl = 0.0, [], []
+        for s in range(NUM_SLOTS):
+            ids = rng.integers(0, VOCAB, size=2)
+            sl.append(ids)
+            logits += w[s, ids].sum()
+        p = 1 / (1 + np.exp(-logits))
+        parts.append(f"1 {float(rng.random() < p)}")
+        parts.append(f"1 {rng.normal():.3f}")
+        for s, ids in enumerate(sl):
+            parts.append(
+                f"2 {' '.join(str(int(i) + s * 1000003) for i in ids)}")
+        lines.append(" ".join(parts))
+    ds = SlotDataset(schema)
+    ds.records = parse_multislot_lines(lines, schema)
+    return ds, schema
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("root")
+    ap.add_argument("out")
+    ap.add_argument("--passes", type=int, default=3)
+    args = ap.parse_args()
+
+    ds, schema = synth()
+    store = HostEmbeddingStore(EmbeddingConfig(dim=4, learning_rate=0.05))
+    mesh = make_mesh(1)
+    tr = Trainer(DNNCTRModel(num_slots=NUM_SLOTS, emb_dim=4, dense_dim=1,
+                             hidden=(8,)),
+                 store, schema, mesh,
+                 TrainerConfig(global_batch_size=64, dense_lr=2e-3,
+                               auc_buckets=1 << 8),
+                 seed=7)
+    box = BoxPS(store)
+    box.set_date(20260801)
+    box.init_metric("job_auc", n_buckets=128)
+    ckpt = PassCheckpointer(args.root, keep_last_n=2, base_every=2)
+
+    cursor = tr.resume(ckpt, box=box)
+    start = (int(cursor["pass_id"]) if cursor is not None else 0) + 1
+    print(f"worker: resume cursor={cursor} -> starting at pass {start}",
+          flush=True)
+    for _ in range(start, args.passes + 1):
+        box.begin_pass()
+        tr.train_pass(ds, metrics=box.metrics)
+        box.end_pass(checkpointer=ckpt, trainer=tr)
+
+    # final-state dump for bitwise comparison
+    tr.flush_sparse()
+    keys = np.sort(np.asarray(ds.unique_keys(), dtype=np.uint64))
+    rows = store.get_rows(keys)
+    dense = {f"p{i}": np.asarray(leaf) for i, leaf in
+             enumerate(jax.tree_util.tree_leaves(
+                 {"params": tr.params, "opt": tr.opt_state}))}
+    met = box.metrics.get_state("job_auc")
+    np.savez(args.out, keys=keys, rows=rows,
+             global_step=np.int64(tr.global_step),
+             pass_id=np.int64(box.pass_id),
+             met_pos=np.asarray(met["pos"]),
+             met_neg=np.asarray(met["neg"]), **dense)
+    print("worker: done", flush=True)
+
+
+if __name__ == "__main__":
+    main()

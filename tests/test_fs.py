@@ -313,3 +313,170 @@ def test_argv_no_resubstitution_and_close_idempotent(tmp_path):
     with pytest.raises(RuntimeError, match="cat failed"):
         f.close()
     f.close()  # second close (e.g. with-block __exit__) must be a no-op
+
+
+# ---------------------------------------------------------------------------
+# bounded retry + backoff + per-command timeout (crash-safe PR satellite)
+# ---------------------------------------------------------------------------
+
+FLAKY_CLI = textwrap.dedent("""
+    import os, shutil, sys
+    # fail the first FLAKY_FAILS invocations (counter persisted on disk),
+    # then behave like `cp`
+    marker = os.environ["FLAKY_COUNTER"]
+    n = int(open(marker).read()) if os.path.exists(marker) else 0
+    open(marker, "w").write(str(n + 1))
+    if n < int(os.environ.get("FLAKY_FAILS", "2")):
+        sys.stderr.write("transient outage #%d\\n" % (n + 1))
+        sys.exit(5)
+    shutil.copy2(sys.argv[1], sys.argv[2])
+""")
+
+
+def _flaky_fs(tmp_path, fails, **kw):
+    cli = tmp_path / "flaky_cli.py"
+    cli.write_text(FLAKY_CLI)
+    counter = tmp_path / "counter"
+    base = f"{sys.executable} {cli}"
+    fs = fs_lib.CommandFS(
+        put=f"{base} {{src}} {{dst}}",
+        env={"FLAKY_COUNTER": str(counter), "FLAKY_FAILS": str(fails)},
+        retry_backoff=0.01, **kw)
+    return fs, counter
+
+
+def test_command_fs_retry_recovers_from_transient_failures(tmp_path):
+    fs, counter = _flaky_fs(tmp_path, fails=2, retries=3)
+    src = tmp_path / "src.txt"
+    src.write_text("payload")
+    dst = tmp_path / "dst.txt"
+    fs.put(str(src), str(dst))             # attempts 1,2 fail; 3 lands
+    assert dst.read_text() == "payload"
+    assert counter.read_text() == "3"
+
+
+def test_command_fs_retry_exhaustion_reports_attempts(tmp_path):
+    fs, counter = _flaky_fs(tmp_path, fails=99, retries=3)
+    src = tmp_path / "src.txt"
+    src.write_text("payload")
+    with pytest.raises(RuntimeError,
+                       match=r"put failed after 3 attempts") as ei:
+        fs.put(str(src), str(tmp_path / "dst.txt"))
+    assert counter.read_text() == "3"      # bounded: exactly 3 shell-outs
+    assert "transient outage" in str(ei.value)   # last stderr surfaced
+
+
+def test_command_fs_append_and_test_never_retry(tmp_path):
+    """append is excluded (a retried partial append could double-write a
+    donefile line); test's absent exit code is a success, not a retry."""
+    cli = tmp_path / "count_cli.py"
+    cli.write_text(textwrap.dedent("""
+        import os, sys
+        marker = os.environ["FLAKY_COUNTER"]
+        n = int(open(marker).read()) if os.path.exists(marker) else 0
+        open(marker, "w").write(str(n + 1))
+        sys.exit(5)
+    """))
+    counter = tmp_path / "counter"
+    base = f"{sys.executable} {cli}"
+    fs = fs_lib.CommandFS(
+        put=f"{base} {{src}} {{dst}}", append=f"{base} {{src}} {{dst}}",
+        test=f"{base} {{path}}",
+        env={"FLAKY_COUNTER": str(counter)},
+        retries=4, retry_backoff=0.01)
+    with pytest.raises(RuntimeError, match="append failed after 1 attempt"):
+        fs._run("append", src="a", dst="b")
+    assert counter.read_text() == "1"
+    counter.write_text("0")
+    with pytest.raises(RuntimeError, match="test failed after 1 attempt"):
+        fs._run("test", path="x")          # exit 5 is neither 0 nor 1
+    assert counter.read_text() == "1"
+
+
+def test_command_fs_timeout_counts_as_failed_attempt(tmp_path):
+    fs = fs_lib.CommandFS(put="sleep 30", retries=2, retry_backoff=0.01,
+                          timeout=0.2)
+    import time
+    t0 = time.time()
+    with pytest.raises(RuntimeError,
+                       match=r"put failed after 2 attempts.*timed out"):
+        fs.put("a", "b")
+    assert time.time() - t0 < 10.0
+
+
+def test_command_fs_get_retry_cleans_partial_download(tmp_path):
+    """A failed get attempt's partial local file must be removed before
+    the retry: hadoop's plain -get refuses to overwrite, so a leftover
+    half-download would turn every retry into 'File exists'."""
+    cli = tmp_path / "get_cli.py"
+    cli.write_text(textwrap.dedent("""
+        import os, shutil, sys
+        src, dst = sys.argv[1], sys.argv[2]
+        if os.path.exists(dst):
+            sys.stderr.write("get: %s: File exists\\n" % dst)
+            sys.exit(1)
+        marker = os.environ["FLAKY_COUNTER"]
+        n = int(open(marker).read()) if os.path.exists(marker) else 0
+        open(marker, "w").write(str(n + 1))
+        if n < 1:
+            open(dst, "w").write("PARTIAL")   # torn download, then die
+            sys.exit(5)
+        shutil.copy2(src, dst)
+    """))
+    counter = tmp_path / "counter"
+    src = tmp_path / "remote.txt"
+    src.write_text("full payload")
+    dst = tmp_path / "local.txt"
+    fs = fs_lib.CommandFS(
+        get=f"{sys.executable} {cli} {{src}} {{dst}}",
+        env={"FLAKY_COUNTER": str(counter)},
+        retries=3, retry_backoff=0.01)
+    fs.get(str(src), str(dst))
+    assert dst.read_text() == "full payload"
+
+
+def test_command_fs_ctor_timeout_zero_means_no_timeout(tmp_path):
+    """timeout=0 in the constructor must mean 'unbounded', matching the
+    fs_command_timeout_s flag convention — not an instant timeout."""
+    fs = fs_lib.CommandFS(put="cp {src} {dst}", retries=1, timeout=0)
+    src = tmp_path / "a.txt"
+    src.write_text("x")
+    fs.put(str(src), str(tmp_path / "b.txt"))
+    assert (tmp_path / "b.txt").read_text() == "x"
+
+
+def test_command_fs_get_retry_preserves_preexisting_dst(tmp_path):
+    """Retry cleanup may only remove what a failed attempt created: a dst
+    directory (and its unrelated contents) that existed before the first
+    attempt must survive retries; only the partial downloaded member is
+    removed."""
+    cli = tmp_path / "get_cli.py"
+    cli.write_text(textwrap.dedent("""
+        import os, shutil, sys
+        src, dst = sys.argv[1], sys.argv[2]
+        if os.path.isdir(dst):
+            dst = os.path.join(dst, os.path.basename(src.rstrip("/")))
+        if os.path.exists(dst):
+            sys.stderr.write("get: %s: File exists\\n" % dst)
+            sys.exit(1)
+        marker = os.environ["FLAKY_COUNTER"]
+        n = int(open(marker).read()) if os.path.exists(marker) else 0
+        open(marker, "w").write(str(n + 1))
+        if n < 1:
+            open(dst, "w").write("PARTIAL")
+            sys.exit(5)
+        shutil.copy2(src, dst)
+    """))
+    counter = tmp_path / "counter"
+    src = tmp_path / "remote.txt"
+    src.write_text("full payload")
+    dst_dir = tmp_path / "downloads"
+    dst_dir.mkdir()
+    (dst_dir / "unrelated.txt").write_text("precious")
+    fs = fs_lib.CommandFS(
+        get=f"{sys.executable} {cli} {{src}} {{dst}}",
+        env={"FLAKY_COUNTER": str(counter)},
+        retries=3, retry_backoff=0.01)
+    fs.get(str(src), str(dst_dir))
+    assert (dst_dir / "unrelated.txt").read_text() == "precious"
+    assert (dst_dir / "remote.txt").read_text() == "full payload"

@@ -95,8 +95,14 @@ def test_join_update_alternation_shares_table():
     for r in results:
         assert np.isfinite(r["loss_mean"])
     # the second join pass continues learning on a table the update pass
-    # trained in between — shared state, improving loss
-    assert results[2]["loss_mean"] < results[0]["loss_mean"]
+    # trained in between — shared state, improving ranking. Measured on
+    # AUC, not loss_mean: the join tower is exactly the one consuming the
+    # CVM show/clk counters, which jump from all-zero to populated after
+    # pass 1 — the second join pass sits at the peak of that covariate
+    # shift's miscalibration (loss 0.692→0.888 while AUC leaps
+    # 0.537→0.855; the third join pass drops to 0.681/0.992). See ROADMAP
+    # "pass-2 loss signature" root cause.
+    assert results[2]["auc"] > results[0]["auc"] + 0.1
     # the update pass really trained ITS program (params moved)...
     moved = any(
         not np.array_equal(np.asarray(a), np.asarray(b))

@@ -154,7 +154,11 @@ def test_trainer_e2e_quant_storage_close_to_f32():
             out["f32"][i]["loss_mean"], abs=5e-3)
         assert out["int16"][i]["auc"] == pytest.approx(
             out["f32"][i]["auc"], abs=0.02)
-    assert out["int16"][1]["loss_mean"] < out["int16"][0]["loss_mean"]
+    # learning sanity on AUC, not loss_mean: the pass-1→2 CVM counter
+    # jump (all-zero → populated; clk carries the label for these
+    # near-singleton keys) transiently raises log-loss while ranking
+    # improves — see ROADMAP "pass-2 loss signature" root cause.
+    assert out["int16"][1]["auc"] > out["int16"][0]["auc"] + 0.1
     # pass-2 boundary H2D for int16 is smaller than f32's
     assert out["int16"][2] < out["f32"][2]
 

@@ -164,5 +164,14 @@ def test_training_with_cache_under_half_of_keys(tmp_path):
             pytest.approx(results["spill"][i]["loss_mean"], abs=1e-7)
         assert results["ram"][i]["auc"] == \
             pytest.approx(results["spill"][i]["auc"], abs=1e-7)
-    # second pass learned (sanity that the comparison is not vacuous)
-    assert results["spill"][1]["loss_mean"] < results["spill"][0]["loss_mean"]
+    # second pass learned (sanity that the comparison is not vacuous).
+    # Measured on AUC, not loss_mean: pass-2 log-loss transiently RISES
+    # here by construction — the CVM show/clk counter features (clk
+    # accumulates the label itself, and these keys are near-singletons)
+    # jump from all-zero to populated between pass 1 and 2, and the dense
+    # tower is miscalibrated under that covariate shift exactly while
+    # ranking improves sharply (loss_mean 0.71→0.79 while AUC 0.48→0.69;
+    # by pass 3 loss drops decisively). Which side of a pass2<pass1 loss
+    # assert lands is jax-version numeric luck — see ROADMAP "pass-2 loss
+    # signature" root cause.
+    assert results["spill"][1]["auc"] > results["spill"][0]["auc"] + 0.1
