@@ -294,6 +294,8 @@ def device_step_bench(small: bool, mode: str = "allreduce",
         runs the trainer's default k-microbatch superstep (one dispatch
         per steps_per_dispatch batches, like train_pass)."""
         nonlocal params, opt, dstate
+        from paddlebox_tpu import monitor
+        monitor.counter_add("bench.device_steps", k)
         if mode == "allreduce" and staged_stacked is not None:
             assert k % ksd == 0, (k, ksd)
             for _ in range(k // ksd):
@@ -829,8 +831,12 @@ def dryrun_main() -> int:
     behaved."""
     import jax
     jax.config.update("jax_platforms", "cpu")
+    from paddlebox_tpu import monitor
     from paddlebox_tpu.utils.step_probe import finalize_push_floor
 
+    # telemetry rides the dryrun too: the artifact must embed the hub
+    # summary (counters + any flight records) — asserted as a check below
+    monitor.hub().enable(monitor.MemorySink())
     checks: dict = {}
     eps, detail, ctx = device_step_bench(True, n_steps=2, n_windows=1,
                                          tiny=True, return_ctx=True)
@@ -843,6 +849,11 @@ def dryrun_main() -> int:
                             (attr.get("stages") or {}).get("sparse_push"))
     checks["floor_ok"] = "closed" in (detail.get("push_floor") or {})
     ctx.clear()
+    detail["telemetry"] = monitor.hub().summary()
+    monitor.hub().disable()
+    checks["telemetry_embedded"] = (
+        isinstance(detail["telemetry"], dict)
+        and bool(detail["telemetry"].get("counters")))
     metrics = collect_gate_metrics(eps, detail)
     kind = detail.get("device_kind", "")
     committed = load_bench_best()
@@ -929,6 +940,15 @@ def main() -> None:
         detail["bench_error"] = repr(e)
         if not isinstance(e, Exception):
             pending = e
+
+    # telemetry summary rides every artifact (counters accumulated across
+    # the run + flight records from the e2e section's real passes) — the
+    # hub may be disabled; the cumulative registry still tells the story
+    try:
+        from paddlebox_tpu import monitor as _monitor
+        detail["telemetry"] = _monitor.hub().summary()
+    except Exception as e:
+        detail["telemetry"] = {"error": repr(e)}
 
     # round-over-round regression gate: every recorded number vs the best
     # recorded value for this hardware (BENCH_BEST.json); an unwaived

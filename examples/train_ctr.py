@@ -16,6 +16,15 @@ Runs hardware-free on the 8-virtual-device CPU mesh:
 On a TPU host, drop the env vars — the same script trains on the chips.
 This mirrors the reference's user workflow (dataset.set_date / begin_pass /
 train_from_dataset / end_pass / fleet_util.save_*_model — SURVEY.md §3.4).
+
+Observability (the telemetry-hub quickstart, README "Observability"):
+``PBTPU_TELEMETRY_DIR=/some/dir`` turns the hub's event stream on — a
+JSONL event file (``events.jsonl``: tagged events/spans + one flight
+record per pass), log_for_profile-parity pass lines on stdout, a
+Prometheus text exposition (``metrics.prom``), and a chrome trace
+(``trace.json``) with pass-boundary / checkpoint-commit markers.
+``--short`` trains one day instead of two (the tier-1 telemetry smoke
+runs this path).
 """
 
 from __future__ import annotations
@@ -56,6 +65,7 @@ def synth_files(root: str, schema, n_files: int = 4, lines: int = 512,
 
 def main() -> int:
     import jax
+    from paddlebox_tpu import monitor
     from paddlebox_tpu.data import DataFeedSchema, SlotDataset
     from paddlebox_tpu.embedding import EmbeddingConfig, HostEmbeddingStore
     from paddlebox_tpu.fleet import BoxPS, FleetUtil
@@ -63,6 +73,18 @@ def main() -> int:
     from paddlebox_tpu.models import DeepFMModel
     from paddlebox_tpu.parallel import make_mesh
     from paddlebox_tpu.train import Trainer, TrainerConfig
+    from paddlebox_tpu.utils import profiler
+
+    short = "--short" in sys.argv
+    telemetry_dir = os.environ.get("PBTPU_TELEMETRY_DIR")
+    if telemetry_dir:
+        # observability quickstart: JSONL event stream + parity stdout
+        # lines; host spans collected for the chrome trace exported below
+        os.makedirs(telemetry_dir, exist_ok=True)
+        monitor.hub().enable(
+            monitor.JsonlSink(os.path.join(telemetry_dir, "events.jsonl")),
+            monitor.ParityLogSink())
+        profiler.enable_profiler()
 
     work = tempfile.mkdtemp(prefix="pbtpu_example_")
     out_root = os.path.join(work, "output")
@@ -94,7 +116,7 @@ def main() -> int:
     ds = SlotDataset(schema)
     ds.set_filelist(files)
 
-    days = [20260729, 20260730]
+    days = [20260729] if short else [20260729, 20260730]
     passes_per_day = 2
     for day in days:
         box.set_date(day)
@@ -162,6 +184,20 @@ def main() -> int:
            if pos.any() and (~pos).any() else float("nan"))
     print(f"serving: scored {len(probs)} examples, AUC={auc:.3f}")
     assert auc > 0.6, "serving scores lost the training signal"
+
+    if telemetry_dir:
+        # flush the event stream, write the Prometheus exposition, and
+        # export the chrome trace (pass_begin/pass_end +
+        # checkpoint_commit instant markers — open it in Perfetto)
+        n_spans = profiler.export_chrome_trace(
+            os.path.join(telemetry_dir, "trace.json"))
+        with open(os.path.join(telemetry_dir, "metrics.prom"), "w") as f:
+            f.write(monitor.hub().prometheus_text())
+        flights = monitor.hub().flight_records()
+        monitor.hub().disable()
+        profiler.disable_profiler()
+        print(f"telemetry: {len(flights)} flight records, {n_spans} trace "
+              f"events -> {telemetry_dir}")
     print("example complete:", work)
     return 0
 

@@ -169,6 +169,15 @@ class Flags:
     fs_retry_backoff_s: float = 0.2         # (new) doubles per attempt
     fs_command_timeout_s: float = 0.0       # (new) 0 disables
 
+    # --- telemetry (new — monitor/ TelemetryHub + utils/profiler) ---
+    # RecordEvent span ring capacity: the profiler keeps at most this many
+    # spans, dropping oldest-first (profiler.dropped_spans counts); 0 =
+    # unbounded (the pre-hub behavior — a day-scale run grows forever).
+    profiler_max_events: int = 200_000      # (new)
+    # JsonlSink bounded queue: a slow/failed writer drops events (counted)
+    # instead of ever blocking the training thread.
+    telemetry_queue_size: int = 8192        # (new)
+
     # --- numerics / TPU (new) ---
     compute_dtype: str = "float32"          # bf16 for matmul-heavy towers
     embedding_dtype: str = "float32"

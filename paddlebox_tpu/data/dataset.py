@@ -26,7 +26,7 @@ from paddlebox_tpu.data.reader import ParserPlugin, read_file
 from paddlebox_tpu.data.schema import DataFeedSchema
 from paddlebox_tpu.data.slot_record import PackedBatch, SlotRecordBatch, batch_iterator
 from paddlebox_tpu.data.shuffle import LocalShuffler, RoutingMode, TcpShuffleService, route_records
-from paddlebox_tpu.utils.profiler import stat_add
+from paddlebox_tpu.monitor import counter_add as stat_add
 
 
 class SlotDataset:

@@ -30,7 +30,7 @@ import numpy as np
 from paddlebox_tpu.data.reader import ParserPlugin, read_file
 from paddlebox_tpu.data.schema import DataFeedSchema
 from paddlebox_tpu.data.slot_record import PackedBatch, SlotRecordBatch
-from paddlebox_tpu.utils.profiler import stat_add
+from paddlebox_tpu.monitor import counter_add as stat_add
 
 _STOP = object()
 

@@ -53,8 +53,11 @@ from paddlebox_tpu.embedding.store import HostEmbeddingStore
 from paddlebox_tpu.embedding.working_set import (PassWorkingSet, bucket_size,
                                                  fetch_rows, transfer_bytes,
                                                  _put_compressed)
+from paddlebox_tpu.monitor import context as mon_ctx
+from paddlebox_tpu.monitor import counter_add as stat_add
+from paddlebox_tpu.monitor import event as mon_event
+from paddlebox_tpu.monitor import gauge_set as stat_set
 from paddlebox_tpu.parallel import mesh as mesh_lib
-from paddlebox_tpu.utils.profiler import stat_add, stat_set
 
 
 @functools.lru_cache(maxsize=8)
@@ -178,8 +181,9 @@ class FeedPassManager:
             except BaseException as e:    # re-raised at the join
                 self._feed_error = e
 
-        self._thread = threading.Thread(target=run, daemon=True,
-                                        name="pbtpu-feed-pass")
+        # context-inheriting spawn: the staging events this thread emits
+        # are tagged with the pass that overlaps them
+        self._thread = mon_ctx.spawn(run, name="pbtpu-feed-pass")
         self._thread.start()
 
     def wait_feed_pass_done(self) -> None:
@@ -225,6 +229,11 @@ class FeedPassManager:
             fresh_dev = jax.device_put(staged, repl)
         else:
             fresh_dev = jnp.asarray(staged)
+        # emitted from the feed thread when staging ran via
+        # begin_feed_pass (background-thread events carry the pass tag)
+        mon_event("feed_pass_staged", n_fresh=int(n_fresh),
+                  n_keys=int(len(keys)),
+                  h2d_bytes=int(transfer_bytes(cfg, n_fresh_pad)))
         return _Staging(keys=keys, pos_prev=pos, fresh_dev=fresh_dev,
                         n_fresh=n_fresh,
                         h2d_bytes=transfer_bytes(cfg, n_fresh_pad),
@@ -348,6 +357,8 @@ class FeedPassManager:
         self.last_d2h_bytes += nbytes
         stat_add("feed_pass.d2h_bytes", nbytes)
         stat_add("feed_pass.flushed_rows", len(row_ids))
+        mon_event("feed_pass_flush", rows=int(len(row_ids)),
+                  d2h_bytes=int(nbytes))
         return nbytes
 
     def _take_staging(self, keys: np.ndarray,

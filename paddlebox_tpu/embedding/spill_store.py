@@ -85,6 +85,10 @@ class SpillEmbeddingStore(HostEmbeddingStore):
             self._cdata[ms] = rows
         self.cache_hits += int(hit.sum())
         self.cache_misses += int(miss.sum())
+        # spill-tier activity rolls into the per-pass flight record
+        from paddlebox_tpu.monitor import counter_add
+        counter_add("spill.cache_hits", int(hit.sum()))
+        counter_add("spill.cache_misses", int(miss.sum()))
         return out
 
     def _write_rows(self, idx: np.ndarray, rows: np.ndarray) -> None:

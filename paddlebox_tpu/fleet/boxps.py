@@ -21,6 +21,7 @@ from __future__ import annotations
 import time
 from typing import Any
 
+from paddlebox_tpu import monitor
 from paddlebox_tpu.embedding import HostEmbeddingStore
 from paddlebox_tpu.metrics.metric import MetricRegistry
 
@@ -58,6 +59,9 @@ class BoxPS:
         self.in_pass = True
         self.pass_id += 1
         self._pass_t0 = time.time()
+        # telemetry pass scope: everything until end_pass — trainer steps,
+        # worker threads, checkpoint commits — is tagged with this pass
+        monitor.hub().begin_pass(self.pass_id, phase=self.phase)
 
     def end_pass(self, need_save_delta: bool = False,
                  delta_path: str | None = None,
@@ -84,6 +88,9 @@ class BoxPS:
                 raise ValueError("need_save_delta requires delta_path")
             out["delta_file"] = self.store.save_delta(
                 delta_path, pass_id=self.pass_id)
+        # flight-record commit LAST: checkpoint/delta durations and bytes
+        # above land in this pass's stats_delta and event stream
+        out["flight_record"] = monitor.hub().end_pass(metrics=self.metrics)
         return out
 
     def flip_phase(self) -> None:
@@ -92,6 +99,8 @@ class BoxPS:
         (The reference's SetTestMode is covered by Trainer.eval_pass /
         PassWorkingSet(test_mode=True) — no separate box-level flag.)"""
         self.metrics.flip_phase()
+        monitor.context.set_phase(self.phase)
+        monitor.event("flip_phase", phase=self.phase)
 
     # ---- table hygiene ----
 

@@ -1,0 +1,104 @@
+"""Flight-record and event schema — the machine-readable contract.
+
+Everything the hub emits is one JSON object per line; dashboards, the
+bench regression gate, and the tier-1 smoke all key off these shapes, so
+the schema is code (validators returning error strings), not prose. The
+flight record is the per-pass unit the ROADMAP's regression discipline
+consumes: stage-time split, throughput, STATS deltas since pass start,
+and the metric-registry snapshot — the log_for_profile line, made
+parseable.
+"""
+
+from __future__ import annotations
+
+import json
+import numbers
+
+# keys every hub record carries (pass_id/step/phase may be null outside a
+# pass — but the KEYS are always present, so consumers never branch)
+EVENT_REQUIRED_KEYS = ("ts", "type", "name", "pass_id", "step", "phase",
+                       "thread")
+
+# flight-record fields beyond the event envelope, with required types
+FLIGHT_REQUIRED_FIELDS = {
+    "seconds": numbers.Real,
+    "steps": numbers.Integral,
+    "examples": numbers.Integral,
+    "examples_per_sec": numbers.Real,
+    "stage_seconds": dict,
+    "stats_delta": dict,
+    "metrics": dict,
+}
+
+
+def validate_event(rec: dict) -> list[str]:
+    """Schema errors for one hub record (empty list = valid)."""
+    errs = []
+    if not isinstance(rec, dict):
+        return [f"record is {type(rec).__name__}, not an object"]
+    for k in EVENT_REQUIRED_KEYS:
+        if k not in rec:
+            errs.append(f"missing key {k!r}")
+    if "ts" in rec and not isinstance(rec["ts"], numbers.Real):
+        errs.append("ts is not a number")
+    for k in ("pass_id", "step"):
+        v = rec.get(k)
+        if v is not None and not isinstance(v, numbers.Integral):
+            errs.append(f"{k} is neither null nor an integer")
+    return errs
+
+
+def validate_flight_record(rec: dict) -> list[str]:
+    """Schema errors for a flight record (includes the event envelope)."""
+    errs = validate_event(rec)
+    if rec.get("type") != "flight_record":
+        errs.append(f"type is {rec.get('type')!r}, not 'flight_record'")
+    if not isinstance(rec.get("pass_id"), numbers.Integral):
+        errs.append("flight record pass_id must be an integer")
+    for k, want in FLIGHT_REQUIRED_FIELDS.items():
+        if k not in rec:
+            errs.append(f"missing field {k!r}")
+        elif not isinstance(rec[k], want):
+            errs.append(f"{k} is {type(rec[k]).__name__}, want "
+                        f"{want.__name__}")
+    for k in ("stage_seconds", "stats_delta"):
+        for name, v in (rec.get(k) or {}).items():
+            if not isinstance(v, numbers.Real):
+                errs.append(f"{k}[{name!r}] is not a number")
+    return errs
+
+
+def validate_events_file(path: str) -> dict:
+    """Validate a JSONL event stream end to end.
+
+    Returns {"events": n, "flight_records": [...], "errors": [...],
+    "threads": set-as-list} — ``errors`` empty means every line parsed and
+    every record (flight records included) passed its schema."""
+    n = 0
+    flights: list[dict] = []
+    errors: list[str] = []
+    threads: set = set()
+    with open(path) as f:
+        for lineno, line in enumerate(f, 1):
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                rec = json.loads(line)
+            except json.JSONDecodeError as e:
+                errors.append(f"line {lineno}: unparseable JSON ({e})")
+                continue
+            n += 1
+            if rec.get("type") == "meta":
+                continue              # sink bookkeeping, not telemetry
+            errs = (validate_flight_record(rec)
+                    if rec.get("type") == "flight_record"
+                    else validate_event(rec))
+            for e in errs:
+                errors.append(f"line {lineno} ({rec.get('name')}): {e}")
+            if rec.get("type") == "flight_record":
+                flights.append(rec)
+            if rec.get("thread"):
+                threads.add(rec["thread"])
+    return {"events": n, "flight_records": flights, "errors": errors,
+            "threads": sorted(threads)}

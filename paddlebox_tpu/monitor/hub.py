@@ -1,0 +1,361 @@
+"""TelemetryHub — counters, gauges, timers, spans, and pass flight records
+behind ONE API with pluggable sinks.
+
+The reference ships the pieces separately: ``StatRegistry``/``STAT_ADD``
+globals (platform/monitor.h:76,129), ``log_for_profile``'s per-card stage
+lines (boxps_worker.cc:746-759), and chrome-trace timelines
+(device_tracer.cc:815). The hub unifies them and adds the property none of
+them had: every emission is tagged with the pass/step it belongs to
+(``monitor.context``), including emissions from background threads — the
+push-overlap apply, the DumpStream writer, feed-pass flushes, checkpoint
+commits.
+
+Cost model: the hub is DISABLED by default and the disabled path is one
+attribute check (asserted by a micro-test) — instrumentation stays in the
+code permanently, like ``STAT_ADD`` in the reference. Counters/gauges are
+always live (they are the pre-existing ``STATS`` registry); the *event
+stream* is what enabling turns on.
+
+Pass lifecycle: ``begin_pass`` snapshots the cumulative counters;
+``end_pass`` commits a **flight record** — stage-time split, examples/sec,
+STATS deltas since pass start, metric-registry snapshot — to every sink
+(the ParityLogSink renders it as the log_for_profile line) and keeps the
+last records in memory for artifact embeds (bench.py). ``BoxPS`` drives
+the lifecycle in the full workflow; a bare ``Trainer.train_pass`` opens
+its own pass scope when none is active, so standalone runs still produce
+flight records.
+"""
+
+from __future__ import annotations
+
+import collections
+import re
+import threading
+import time
+
+from paddlebox_tpu.monitor import context
+from paddlebox_tpu.monitor.registry import STATS
+from paddlebox_tpu.monitor.sinks import Sink  # noqa: F401  (re-export)
+
+_prof = None
+
+
+def _profiler():
+    """Lazy handle on utils.profiler (it imports us; we must not import it
+    at module level). First touched at runtime, never during import."""
+    global _prof
+    if _prof is None:
+        from paddlebox_tpu.utils import profiler as p
+        _prof = p
+    return _prof
+
+
+class _Span:
+    """Timed scope: chrome-trace span (when the profiler is on) + hub span
+    event (when the hub is on). Disabled cost: two module-global checks."""
+
+    __slots__ = ("_hub", "_name", "_fields", "_t0")
+
+    def __init__(self, hub, name, fields):
+        self._hub = hub
+        self._name = name
+        self._fields = fields
+
+    def __enter__(self):
+        if self._hub._enabled or _profiler()._enabled:
+            self._t0 = time.perf_counter()
+        else:
+            self._t0 = None
+        return self
+
+    def __exit__(self, *exc):
+        t0 = self._t0
+        if t0 is None:
+            return False
+        t1 = time.perf_counter()
+        prof = _profiler()
+        if prof._enabled:
+            prof.record_span(self._name, t0, t1)
+        h = self._hub
+        if h._enabled:
+            rec = h._record("span", self._name, self._fields)
+            rec["dur_s"] = t1 - t0
+            h._dispatch(rec)
+        return False
+
+    def __call__(self, fn):
+        def wrapped(*a, **kw):
+            with _Span(self._hub, self._name, self._fields):
+                return fn(*a, **kw)
+        wrapped.__name__ = getattr(fn, "__name__", self._name)
+        return wrapped
+
+
+class _OpenPass:
+    __slots__ = ("handle", "t0", "stats0", "owner", "stage_seconds",
+                 "steps", "examples", "train_seconds", "extra")
+
+    def __init__(self, handle, stats0, owner):
+        self.handle = handle
+        self.t0 = time.perf_counter()
+        self.stats0 = stats0
+        self.owner = owner
+        self.stage_seconds: dict[str, float] = {}
+        self.steps = 0
+        self.examples = 0
+        self.train_seconds = 0.0
+        self.extra: dict = {}
+
+
+class TelemetryHub:
+    """One per process (module singleton :func:`hub`); see module doc."""
+
+    FLIGHT_KEEP = 64              # in-memory ring for artifact embeds
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._sinks: tuple = ()
+        self._enabled = False
+        self._gauges: set[str] = set()
+        self._pass: _OpenPass | None = None
+        self._auto_pass_id = 0
+        self._flight_records: collections.deque = collections.deque(
+            maxlen=self.FLIGHT_KEEP)
+        self.sink_errors = 0
+
+    # ---- sinks / enablement ---------------------------------------------
+
+    @property
+    def enabled(self) -> bool:
+        return self._enabled
+
+    def enable(self, *sinks: Sink) -> None:
+        """Attach sinks and turn the event stream on. Idempotent; extra
+        calls add sinks."""
+        with self._lock:
+            self._sinks = self._sinks + tuple(sinks)
+            self._enabled = True
+
+    def disable(self) -> None:
+        """Turn the event stream off and close every sink (joins the JSONL
+        writer thread). Counters/gauges stay live."""
+        with self._lock:
+            sinks, self._sinks = self._sinks, ()
+            self._enabled = False
+        for s in sinks:
+            try:
+                s.flush()
+                s.close()
+            except Exception:
+                self.sink_errors += 1
+
+    def sinks(self) -> tuple:
+        return self._sinks
+
+    # ---- counters / gauges (always live — the STATS registry) -----------
+
+    def counter_add(self, name: str, value: float = 1.0) -> None:
+        STATS.add(name, value)
+
+    def gauge_set(self, name: str, value: float) -> None:
+        STATS.set(name, value)
+        self._gauges.add(name)
+
+    # ---- events / spans --------------------------------------------------
+
+    def _record(self, type_: str, name: str, fields: dict | None) -> dict:
+        c = context.current()
+        rec = {"ts": time.time(), "type": type_, "name": name,
+               "pass_id": c.pass_id, "step": c.step, "phase": c.phase,
+               "thread": threading.current_thread().name}
+        if fields:
+            rec["fields"] = fields
+        return rec
+
+    def event(self, name: str, type: str = "event", **fields) -> None:
+        """Emit one tagged event to the sinks. No-op when disabled."""
+        if not self._enabled:
+            return
+        self._dispatch(self._record(type, name, fields))
+
+    def span(self, name: str, **fields) -> _Span:
+        """Timed scope (context manager or decorator); see :class:`_Span`."""
+        return _Span(self, name, fields)
+
+    def _dispatch(self, rec: dict) -> None:
+        """Error-isolated fan-out: a sink that raises is counted and, after
+        3 failures, detached — telemetry never takes down training."""
+        for s in self._sinks:
+            try:
+                s.emit(rec)
+            except Exception:
+                self.sink_errors += 1
+                STATS.add("monitor.sink_errors", 1)
+                n = getattr(s, "_hub_errors", 0) + 1
+                try:
+                    s._hub_errors = n
+                except AttributeError:
+                    n = 3
+                if n >= 3:
+                    with self._lock:
+                        self._sinks = tuple(x for x in self._sinks
+                                            if x is not s)
+
+    # ---- pass lifecycle --------------------------------------------------
+
+    def begin_pass(self, pass_id: int, phase: int | None = None,
+                   owner: str = "box") -> None:
+        """Open the pass scope: set the propagated context, snapshot the
+        cumulative counters (per-pass deltas diff against this), mark the
+        chrome trace. Cheap enough to run unconditionally."""
+        if self._pass is not None:
+            # a stale scope (crashed pass without abort) must not leak its
+            # identity into the new pass
+            self.abort_pass(reason="implicit: begin_pass over an open pass")
+        handle = context.enter_pass(pass_id, phase)
+        self._pass = _OpenPass(handle, STATS.snapshot(), owner)
+        self._auto_pass_id = max(self._auto_pass_id, int(pass_id))
+        if self._enabled:
+            self.event("pass_begin", type="lifecycle", owner=owner)
+        _profiler().record_instant("pass_begin", {"pass_id": int(pass_id)})
+
+    def open_pass_auto(self) -> bool:
+        """Trainer-owned scope when no BoxPS lifecycle is driving: opens a
+        pass with an auto-incremented id and returns True iff this call
+        opened it (the caller then owns the matching end/abort)."""
+        if self._pass is not None:
+            return False
+        self._auto_pass_id += 1
+        self.begin_pass(self._auto_pass_id, owner="trainer")
+        return True
+
+    def record_train(self, stage_seconds: dict | None = None,
+                     steps: int = 0, examples: int = 0,
+                     seconds: float = 0.0, **extra) -> None:
+        """Trainer contribution to the open pass's flight record (stage
+        split, throughput inputs, loss/auc extras). Accumulates — phased
+        programs run several train_passes per pass."""
+        p = self._pass
+        if p is None:
+            return
+        for k, v in (stage_seconds or {}).items():
+            p.stage_seconds[k] = p.stage_seconds.get(k, 0.0) + float(v)
+        p.steps += int(steps)
+        p.examples += int(examples)
+        p.train_seconds += float(seconds)
+        p.extra.update({k: v for k, v in extra.items() if v is not None})
+
+    def end_pass(self, metrics=None, **extra) -> dict | None:
+        """Commit the pass flight record and close the scope. Returns the
+        record (always built — the bench embeds it even when no sink is
+        attached); emitted to sinks only when enabled."""
+        p = self._pass
+        if p is None:
+            return None
+        self._pass = None
+        c = context.current()
+        seconds = time.perf_counter() - p.t0
+        snap = STATS.snapshot()
+        delta = {k: round(v - p.stats0.get(k, 0.0), 6)
+                 for k, v in snap.items()
+                 if v != p.stats0.get(k, 0.0)}
+        msnap: dict[str, dict] = {}
+        if metrics is not None:
+            for name in metrics.names():
+                try:
+                    msnap[name] = {k: float(v) for k, v in
+                                   metrics.get_metric_msg(name).items()}
+                except Exception as e:     # a broken metric must not block
+                    msnap[name] = {"error": 1.0}
+                    self.counter_add("monitor.metric_snapshot_errors")
+                    del e
+        rec = self._record("flight_record", "pass", None)
+        rec.update({
+            "seconds": round(seconds, 6),
+            "train_seconds": round(p.train_seconds, 6),
+            "steps": p.steps,
+            "examples": p.examples,
+            "examples_per_sec": round(p.examples / seconds, 3)
+            if seconds > 0 else 0.0,
+            "stage_seconds": {k: round(v, 6)
+                              for k, v in p.stage_seconds.items()},
+            "stats_delta": delta,
+            "metrics": msnap,
+            "owner": p.owner,
+        })
+        merged = dict(p.extra)
+        merged.update(extra)
+        if merged:
+            rec["extra"] = {k: v for k, v in merged.items()}
+        self._flight_records.append(rec)
+        if self._enabled:
+            self._dispatch(rec)
+        _profiler().record_instant("pass_end", {"pass_id": c.pass_id})
+        context.exit_pass(p.handle)
+        return rec
+
+    def abort_pass(self, reason: str = "") -> None:
+        """Close the scope without a flight record (pass raised)."""
+        p = self._pass
+        if p is None:
+            return
+        self._pass = None
+        if self._enabled:
+            self.event("pass_aborted", type="lifecycle",
+                       reason=str(reason)[:200])
+        context.exit_pass(p.handle)
+
+    def flight_records(self) -> list[dict]:
+        return list(self._flight_records)
+
+    # ---- exposition / embed ----------------------------------------------
+
+    def prometheus_text(self, prefix: str = "pbtpu") -> str:
+        """Prometheus text exposition of the counter/gauge registry (names
+        sanitized to the metric charset; gauges are the names set through
+        :meth:`gauge_set`, everything else a counter)."""
+        snap = STATS.snapshot()
+        gauges = set(self._gauges)
+        out: list[str] = []
+        for k in sorted(snap):
+            n = prefix + "_" + re.sub(r"[^a-zA-Z0-9_:]", "_", k)
+            kind = "gauge" if k in gauges else "counter"
+            out.append(f"# TYPE {n} {kind}")
+            out.append(f"{n} {snap[k]:g}")
+        return "\n".join(out) + "\n"
+
+    def summary(self) -> dict:
+        """Compact snapshot for artifact embeds (bench.py detail)."""
+        dropped = sum(getattr(s, "dropped", 0) for s in self._sinks)
+        return {"enabled": self._enabled,
+                "counters": STATS.snapshot(),
+                "gauges": sorted(self._gauges),
+                "sink_errors": self.sink_errors,
+                "events_dropped": dropped,
+                "flight_records": list(self._flight_records)[-8:]}
+
+
+_HUB = TelemetryHub()
+
+
+def hub() -> TelemetryHub:
+    return _HUB
+
+
+# module-level conveniences (the instrumented call-site surface)
+
+def counter_add(name: str, value: float = 1.0) -> None:
+    STATS.add(name, value)
+
+
+def gauge_set(name: str, value: float) -> None:
+    _HUB.gauge_set(name, value)
+
+
+def event(name: str, type: str = "event", **fields) -> None:
+    if _HUB._enabled:                 # inline the fast path
+        _HUB._dispatch(_HUB._record(type, name, fields))
+
+
+def span(name: str, **fields) -> _Span:
+    return _Span(_HUB, name, fields)

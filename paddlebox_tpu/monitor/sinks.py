@@ -1,0 +1,169 @@
+"""Telemetry sinks — where hub events land.
+
+Mirrors the reference's three observability outputs: the dump channel's
+background writer threads (→ :class:`JsonlSink`), the per-card
+``log_for_profile`` stdout lines (→ :class:`ParityLogSink`), and the
+in-memory ``StatRegistry`` readers (→ :class:`MemorySink`, used by tests
+and the bench's artifact embed). Prometheus-style text exposition lives on
+the hub itself (:meth:`TelemetryHub.prometheus_text`) since it reads the
+counter registry, not the event stream.
+
+Sink contract: ``emit(record)`` must be cheap and MUST NOT block the
+training thread — the JSONL sink therefore writes from its own thread
+behind a bounded queue and *drops* (counting drops) rather than ever
+blocking; a sink that raises is error-isolated by the hub (disabled after
+repeated failures) so a full disk or a closed pipe can never kill a
+training run.
+"""
+
+from __future__ import annotations
+
+import collections
+import json
+import os
+import queue
+import sys
+import threading
+import time
+
+
+class Sink:
+    """Interface. ``emit`` receives one event dict (already tagged with
+    pass/step/phase/thread by the hub)."""
+
+    def emit(self, record: dict) -> None:
+        raise NotImplementedError
+
+    def flush(self) -> None:
+        pass
+
+    def close(self) -> None:
+        pass
+
+
+class MemorySink(Sink):
+    """Bounded in-memory ring of events — tests and artifact embeds."""
+
+    def __init__(self, cap: int = 4096):
+        self._ring: collections.deque = collections.deque(maxlen=cap)
+        self.dropped = 0
+
+    def emit(self, record: dict) -> None:
+        if len(self._ring) == self._ring.maxlen:
+            self.dropped += 1
+        self._ring.append(record)
+
+    @property
+    def records(self) -> list[dict]:
+        return list(self._ring)
+
+    def find(self, name: str) -> list[dict]:
+        return [r for r in self._ring if r.get("name") == name]
+
+
+class JsonlSink(Sink):
+    """Background-thread JSONL event stream (the dump-channel shape,
+    boxps_trainer.cc:96-108: producers enqueue, one writer thread owns the
+    file handle and the serialization cost).
+
+    Never blocks or raises into the emitting thread: a full queue drops
+    the event (``dropped`` counts them — the stream says so on close via a
+    final ``sink_dropped`` record), and a write failure latches ``error``
+    while the drain keeps consuming so producers never wedge. The file is
+    opened lazily on the writer thread, so a bad path is an ``error``, not
+    an exception at construction."""
+
+    def __init__(self, path: str, queue_size: int | None = None):
+        if queue_size is None:
+            from paddlebox_tpu.config import flags
+            queue_size = flags.telemetry_queue_size
+        self.path = path
+        self.dropped = 0
+        self.written = 0
+        self.error: BaseException | None = None
+        self._q: queue.Queue = queue.Queue(maxsize=max(16, queue_size))
+        self._thread = threading.Thread(
+            target=self._drain, daemon=True, name="pbtpu-telemetry-jsonl")
+        self._thread.start()
+
+    def emit(self, record: dict) -> None:
+        try:
+            self._q.put_nowait(record)
+        except queue.Full:
+            self.dropped += 1
+
+    def _drain(self) -> None:
+        f = None
+        try:
+            d = os.path.dirname(os.path.abspath(self.path))
+            os.makedirs(d, exist_ok=True)
+            f = open(self.path, "a")
+        except BaseException as e:
+            self.error = e
+        while True:
+            job = self._q.get()
+            if job is None:
+                break
+            if self.error is not None:
+                continue              # keep consuming; producers never block
+            try:
+                f.write(json.dumps(job, default=str) + "\n")
+                self.written += 1
+            except BaseException as e:
+                self.error = e
+        if f is not None and self.error is None:
+            try:
+                if self.dropped:
+                    f.write(json.dumps({
+                        "ts": time.time(), "type": "meta",
+                        "name": "sink_dropped", "pass_id": None,
+                        "step": None, "phase": None,
+                        "thread": threading.current_thread().name,
+                        "fields": {"dropped": self.dropped}}) + "\n")
+                f.flush()
+            except BaseException as e:
+                self.error = e
+        if f is not None:
+            try:
+                f.close()
+            except OSError:
+                pass
+
+    def flush(self) -> None:
+        # drain-to-empty best effort (bounded: the writer may be dead)
+        deadline = time.time() + 2.0
+        while not self._q.empty() and time.time() < deadline \
+                and self._thread.is_alive():
+            time.sleep(0.01)
+
+    def close(self) -> None:
+        """Stop the writer and close the file. Unlike DumpStream, a write
+        error does NOT raise here — telemetry must never take down the
+        training job it observes; inspect ``.error`` instead."""
+        if self._thread.is_alive():
+            self._q.put(None)
+            self._thread.join(timeout=10.0)
+
+
+class ParityLogSink(Sink):
+    """One ``log_for_profile``-parity line per flight record
+    (boxps_worker.cc:746-759 prints the per-card read/trans/cal/sync split
+    at pass end; this prints our stage split + throughput the same way).
+    Ignores everything but flight records."""
+
+    def __init__(self, stream=None):
+        self._stream = stream
+
+    def emit(self, record: dict) -> None:
+        if record.get("type") != "flight_record":
+            return
+        stages = record.get("stage_seconds") or {}
+        stage_txt = " ".join(f"{k}={stages[k]:.3f}s" for k in stages)
+        line = (f"[pbtpu] pass={record.get('pass_id')} "
+                f"phase={record.get('phase')} "
+                f"steps={record.get('steps')} "
+                f"examples={record.get('examples')} "
+                f"eps={record.get('examples_per_sec', 0.0):.1f} "
+                f"{stage_txt} "
+                f"total={record.get('seconds', 0.0):.3f}s")
+        print(line, file=self._stream or sys.stdout, flush=True)

@@ -18,6 +18,7 @@ from __future__ import annotations
 
 import dataclasses
 import threading
+import time
 from typing import Any, Callable
 
 import jax
@@ -39,8 +40,10 @@ from paddlebox_tpu.ops.seqpool_cvm import PooledSlots
 from paddlebox_tpu.parallel import dense_sync
 from paddlebox_tpu.train import optimizers
 from paddlebox_tpu.parallel import mesh as mesh_lib
-from paddlebox_tpu.utils.profiler import RecordEvent, DumpStream, dump_tree
-from paddlebox_tpu.utils.timer import StageTimers
+from paddlebox_tpu import monitor
+from paddlebox_tpu.monitor import context as mon_ctx
+from paddlebox_tpu.monitor.timers import StageTimers
+from paddlebox_tpu.utils.profiler import DumpStream, dump_tree, find_nonfinite
 
 # arity of the binned-push host plan inside a staged batch tuple:
 # (idx, mask, dense, labels, *plan[PLAN_ARITY], *extras) — _pack_host,
@@ -207,8 +210,12 @@ class Trainer:
                 init_params, self.opt_state)
         self._n_dense_args = (self._dense_packer[2]
                               if self._dense_packer else 2)
+        # "train"/"auc" scopes are covered by the train_step/auc_update
+        # spans — only the stages without one emit hub events themselves
         self.timers = StageTimers(["read", "translate", "train", "auc",
-                                   "drain"])
+                                   "drain"],
+                                  emit_stages={"read", "translate",
+                                               "drain"})
         # incremental + overlapped pass boundaries (BoxHelper FeedPass):
         # resident device rows are reused across passes, write-back is lazy.
         # Pass a shared manager when several trainers drive one table
@@ -816,14 +823,26 @@ class Trainer:
                     else (np.zeros(0, np.int32),) * PLAN_ARITY)
             extras = (self._extras_fn(pb, self.n_shards)
                       if self._extras_fn is not None else ())
+            # embedding-plane traffic counters (flight-record deltas):
+            # pull = tokens * pull_width rows out, push = grad + show/clk
+            # lanes back (approximate routed volume; exact per-engine
+            # numbers stay the bench's job)
+            ecfg = self.store.cfg
+            monitor.counter_add("trainer.tokens", idx.size)
+            monitor.counter_add("trainer.pull_bytes",
+                                idx.size * 4 * ecfg.pull_width)
+            if with_plan:
+                monitor.counter_add("trainer.push_bytes",
+                                    idx.size * 4 * (ecfg.grad_width + 2))
         return (idx, pb.mask, dense.astype(np.float32),
                 labels.astype(np.float32), *plan, *extras)
 
     def _stage_device(self, host_tuple: tuple):
         # ONE device_put for all arrays: each put is a host->device
         # round trip (very expensive on tunneled transports)
-        return jax.device_put(host_tuple,
-                              mesh_lib.batch_sharding(self.mesh))
+        with monitor.span("h2d_stage"):
+            return jax.device_put(host_tuple,
+                                  mesh_lib.batch_sharding(self.mesh))
 
     def _put_batch(self, ws: PassWorkingSet, pb: PackedBatch,
                    with_plan: bool = True):
@@ -864,6 +883,7 @@ class Trainer:
             cancel = threading.Event()
 
             def producer():
+                n_packed = 0
                 try:
                     for pb in batch_source():
                         if cancel.is_set():
@@ -873,12 +893,15 @@ class Trainer:
                         # see _pack_host)
                         q.put((pb, self._pack_host(ws, pb,
                                                    with_plan=with_plan)))
+                        n_packed += 1
+                    # emitted from THIS worker thread: inherits the pass/
+                    # step context (monitor.context.spawn below)
+                    monitor.event("pack_producer_done", batches=n_packed)
                     q.put(done)
                 except BaseException as e:  # re-raised on the main thread
                     q.put(("__pack_error__", e))
 
-            t = threading.Thread(target=producer, daemon=True,
-                                 name="pbtpu-pack")
+            t = mon_ctx.spawn(producer, name="pbtpu-pack")
             t.start()
             try:
                 while True:
@@ -969,6 +992,10 @@ class Trainer:
         SB, NB = geom if geom is not None else (ws.padded_rows, 1)
         o, u, s, r, e = dedup_plan(idx.reshape(-1), ws.padded_rows,
                                    SB, NB)
+        # per-pass dedup rate: unique lanes vs routed tokens (the
+        # Parallax-style per-slot skew signal rolls up from these)
+        monitor.counter_add("trainer.plan_tokens", idx.size)
+        monitor.counter_add("trainer.plan_unique_tokens", len(u))
         return (o, r, e, u, s) if geom is not None else (o, Z, Z, u, s)
 
     def _select_pull_engine(self) -> str:
@@ -1053,7 +1080,42 @@ class Trainer:
         feed thread WHILE this pass trains (the PreLoadIntoMemory +
         BeginFeedPass pairing, data_set.cc:1712 / box_wrapper.h:994) —
         the next ``train_pass`` consumes the staging at its boundary.
+
+        Telemetry: runs inside the hub's pass scope (opened here when no
+        BoxPS lifecycle already did) so every event/span — including ones
+        from the pack/feed/dump worker threads — carries pass_id/step;
+        contributes the stage-time split + throughput to the pass flight
+        record, committed at ``hub.end_pass`` (BoxPS.end_pass, or here for
+        a trainer-owned scope).
         """
+        hub = monitor.hub()
+        owned_pass = hub.open_pass_auto()
+        pass_t0 = time.perf_counter()
+        stage0 = self.timers.snapshot()
+        applies0 = self.push_applies
+        try:
+            out = self._train_pass_impl(dataset, metrics, preload_keys)
+        except BaseException as e:
+            if owned_pass:
+                hub.abort_pass(reason=repr(e))
+            raise
+        stage_delta = {k: self.timers.total.get(k, 0.0) - stage0.get(k, 0.0)
+                       for k in self.timers.total}
+        hub.record_train(
+            stage_seconds=stage_delta, steps=out["steps"],
+            examples=out["steps"] * self.cfg.global_batch_size,
+            seconds=time.perf_counter() - pass_t0,
+            loss_mean=out.get("loss_mean"), auc=out.get("auc"),
+            routed_dropped=out.get("routed_dropped"),
+            push_applies=(self.push_applies - applies0) or None,
+            pull_engine=self.pull_engine)
+        if owned_pass:
+            hub.end_pass(metrics=metrics)
+        return out
+
+    def _train_pass_impl(self, dataset, metrics: Any = None,
+                         preload_keys: np.ndarray | None = None
+                         ) -> dict[str, float]:
         cfg = self.cfg
         ws = self.feed_mgr.begin_pass(dataset.unique_keys())
         self.feed_mgr.pass_opened()
@@ -1099,9 +1161,10 @@ class Trainer:
                 else:
                     pbs, staged, stacked = [item[0]], item[1], False
                 pb = pbs[-1]
-                with RecordEvent("pack_batch"):
+                mon_ctx.set_step(self.global_step)
+                with monitor.span("pack_batch"):
                     idx, mask, dense, labels, *plan = staged
-                with self.timers("train"), RecordEvent("train_step"):
+                with self.timers("train"), monitor.span("train_step"):
                     if stacked:
                         out = self._superstep_fn(table, *dstate, *staged)
                         (table, dstate, loss, preds,
@@ -1156,7 +1219,7 @@ class Trainer:
                 # its input table, and a concurrent flush (store read/save
                 # from another thread) must never gather from a dead buffer
                 ws.table = table
-                with self.timers("auc"), RecordEvent("auc_update"):
+                with self.timers("auc"), monitor.span("auc_update"):
                     # the AUC histogram is order-invariant: a stacked
                     # (k, B) group updates in one flattened call
                     auc_acc.update(self._auc_fn, preds.reshape(-1),
@@ -1188,23 +1251,35 @@ class Trainer:
                     else:
                         dump_pending = (self.global_step, preds, labels,
                                         self._dump_extra_fields(pb))
-                if cfg.check_nan_inf:
+                if cfg.check_nan_inf or config_flags.check_nan_inf:
                     lv = np.asarray(loss)
                     if not np.isfinite(lv).all():
-                        # dump-all-scope before raising (nan_inf_utils trip
-                        # handler, boxps_worker.cc:575-580)
+                        # FLAGS_check_nan_inf trip (nan_inf_utils,
+                        # boxps_worker.cc:575-580): walk the step outputs
+                        # for the offending leaves, tell telemetry WHICH
+                        # paths went non-finite, dump the whole scope,
+                        # then raise
+                        # flat transport: the live params are inside
+                        # dstate, not the pass-start `params` binding
+                        live_params = (self.unpack_dense(dstate)[0]
+                                       if dstate is not None else params)
+                        scope = {"params": live_params, "loss": loss,
+                                 "preds": preds, "labels": labels}
+                        bad = find_nonfinite(scope)
+                        monitor.counter_add("trainer.nan_trips")
+                        monitor.event("nan_guard",
+                                      step=int(self.global_step),
+                                      paths=bad[:32], n_bad=len(bad))
+                        dumped = None
                         if cfg.nan_dump_dir:
-                            # flat transport: the live params are inside
-                            # dstate, not the pass-start `params` binding
-                            live_params = (self.unpack_dense(dstate)[0]
-                                           if dstate is not None else params)
-                            dump_tree(
+                            dumped = dump_tree(
                                 f"{cfg.nan_dump_dir}/nan_step"
-                                f"{self.global_step}",
-                                {"params": live_params, "loss": loss,
-                                 "preds": preds, "labels": labels})
+                                f"{self.global_step}", scope)
                         raise FloatingPointError(
-                            f"nan/inf loss at step {self.global_step}")
+                            f"nan/inf loss at step {self.global_step}; "
+                            f"non-finite leaves: {bad[:8]}"
+                            + (f" (scope dumped to {dumped})"
+                               if dumped else ""))
                 dev_losses.append(loss)
                 dev_dropped.append(dropped)
                 self.global_step += len(pbs)
@@ -1345,17 +1420,16 @@ class Trainer:
                     dataset._pbtpu_preplan_need = (memo_key, capf)
                 except AttributeError:
                     pass                  # slots-restricted dataset type
-        from paddlebox_tpu.utils.profiler import stat_add
         if for_eval:
             # a skewed EVAL dataset must never inflate the train step's
             # all_to_all padding or force a train recompile — only the
             # eval program grows
             if capf > self._eval_capacity:
-                stat_add("trainer.capacity_preplanned_eval", 1)
+                monitor.counter_add("trainer.capacity_preplanned_eval", 1)
                 self._eval_capacity = capf
                 self._eval_fn = self._build_eval_step()
         elif capf > self.cfg.capacity_factor:
-            stat_add("trainer.capacity_preplanned", 1)
+            monitor.counter_add("trainer.capacity_preplanned", 1)
             self.cfg.capacity_factor = capf
             self._eval_capacity = max(self._eval_capacity, capf)
             self._rebuild_steps()
@@ -1392,12 +1466,12 @@ class Trainer:
         capacity/program — skew in an eval-only dataset must never
         inflate the train step's padding or force a train recompile."""
         import warnings
-        from paddlebox_tpu.utils.profiler import stat_add
         # superstep entries are (k,) vectors, single steps scalars
         total = int(sum(int(np.asarray(d).sum()) for d in dev_dropped))
         if not total:
             return 0
-        stat_add("trainer.routed_dropped", total)
+        monitor.counter_add("trainer.routed_dropped", total)
+        monitor.event("routed_dropped", total=total, for_eval=for_eval)
         capf = (self._eval_capacity if for_eval
                 else self.cfg.capacity_factor)
         msg = (f"{total} tokens exceeded all_to_all capacity this "
@@ -1483,8 +1557,10 @@ class Trainer:
         from paddlebox_tpu.utils import faultpoint
         faultpoint.hit("trainer.push_apply.pre")
         idx, mask, labels, plan, ops = item
-        table = self._apply_fn(table, idx, mask, labels, *plan, *ops)
+        with monitor.span("push_apply"):
+            table = self._apply_fn(table, idx, mask, labels, *plan, *ops)
         self.push_applies += 1
+        monitor.counter_add("trainer.push_applies")
         return table
 
     def flush_push(self) -> int:
@@ -1625,4 +1701,6 @@ class Trainer:
         # but adaptation stays on the eval program only
         out["routed_dropped"] = self._check_dropped(dev_dropped,
                                                    for_eval=True)
+        monitor.event("eval_pass", auc=float(out.get("auc", float("nan"))),
+                      routed_dropped=out["routed_dropped"])
         return out

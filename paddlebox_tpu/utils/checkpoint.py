@@ -95,11 +95,19 @@ def _fsync_dir(d: str) -> None:
 
 
 def crc32_file(path: str, chunk: int = 1 << 20) -> int:
+    import time
+
+    from paddlebox_tpu.monitor import counter_add
+    t0 = time.perf_counter()
     crc = 0
     with open(path, "rb") as f:
         while True:
             b = f.read(chunk)
             if not b:
+                # checksum cost is part of the checkpoint budget the
+                # flight record accounts (save + verify both land here)
+                counter_add("ckpt.crc_seconds", time.perf_counter() - t0)
+                counter_add("ckpt.crc_files")
                 return crc & 0xFFFFFFFF
             crc = zlib.crc32(b, crc)
 

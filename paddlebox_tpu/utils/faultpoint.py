@@ -90,6 +90,13 @@ def arm(name: str, action: str = "kill", after: int = 0) -> None:
     if action not in ("kill", "ioerror"):
         raise ValueError(f"fault action {action!r} (want kill|ioerror)")
     _armed = _Armed(name, action, int(after))
+    try:
+        from paddlebox_tpu.monitor.hub import _HUB
+        _HUB.counter_add("faultpoint.armed")
+        _HUB.event("faultpoint_armed", point=name, action=action,
+                   after=int(after))
+    except Exception:
+        pass
 
 
 def disarm() -> None:
@@ -114,6 +121,16 @@ def hit(name: str) -> None:
     a.hits += 1
     if a.hits <= a.after:
         return
+    # telemetry before firing (the kill path loses in-flight sinks by
+    # design — that IS the crash being modeled; counters still register
+    # for the ioerror action and in the parent of subprocess tests)
+    try:
+        from paddlebox_tpu.monitor.hub import _HUB
+        _HUB.counter_add("faultpoint.trips")
+        _HUB.counter_add(f"faultpoint.trip.{name}")
+        _HUB.event("faultpoint_trip", point=name, action=a.action)
+    except Exception:
+        pass                       # observability must not mask the fault
     if a.action == "kill":
         # stderr marker first: the harness asserts the kill came from the
         # armed point, not an incidental crash

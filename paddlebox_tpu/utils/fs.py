@@ -189,6 +189,8 @@ class CommandFS(FileSystem):
     def _run(self, op: str, ok_codes: tuple = (0,),
              **kw) -> subprocess.CompletedProcess:
         import time
+
+        from paddlebox_tpu import monitor
         attempts, backoff, timeout = self._retry_policy(op)
         argv = self._argv(op, **kw)
         # get-retry hygiene targets: only paths a failed attempt may have
@@ -207,17 +209,24 @@ class CommandFS(FileSystem):
                     get_cleanup.append(member)
         last = "never ran"
         for attempt in range(1, attempts + 1):
+            monitor.counter_add(f"fs.{op}.attempts")
             try:
                 proc = subprocess.run(argv, env=self._env,
                                       capture_output=True, timeout=timeout)
             except subprocess.TimeoutExpired:
                 last = f"timed out after {timeout}s"
+                monitor.counter_add(f"fs.{op}.timeouts")
             else:
                 if proc.returncode in ok_codes:
+                    if attempt > 1:
+                        # a retry that eventually succeeded — the
+                        # flaky-storage signature the flight record keys on
+                        monitor.counter_add(f"fs.{op}.recovered")
                     return proc
                 last = (f"exit {proc.returncode}: "
                         f"{proc.stderr.decode(errors='replace')[:500]}")
             if attempt < attempts:
+                monitor.counter_add(f"fs.{op}.retries")
                 for p in get_cleanup:
                     # a dead/timed-out client may have left a partial
                     # local download; `-get` without -f would then fail
@@ -231,6 +240,9 @@ class CommandFS(FileSystem):
                     except OSError:
                         pass
                 time.sleep(backoff * (2 ** (attempt - 1)))
+        monitor.counter_add(f"fs.{op}.exhausted")
+        monitor.event("fs_exhausted", op=op, attempts=attempts,
+                      error=last[:300])
         raise RuntimeError(
             f"CommandFS {op} failed after {attempts} attempt"
             f"{'s' if attempts != 1 else ''} ({last})")

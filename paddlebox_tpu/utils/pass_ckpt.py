@@ -38,12 +38,15 @@ from __future__ import annotations
 import os
 import re
 import shutil
+import time
 import warnings
 from typing import Any
 
+from paddlebox_tpu import monitor
 from paddlebox_tpu.config import flags as config_flags
 from paddlebox_tpu.utils import checkpoint as ckpt_lib
 from paddlebox_tpu.utils import faultpoint
+from paddlebox_tpu.utils import profiler
 from paddlebox_tpu.utils.checkpoint import CheckpointCorruptError
 
 _PASS_RE = re.compile(r"^pass-(\d+)$")
@@ -112,6 +115,7 @@ class PassCheckpointer:
         → metrics), manifest last — a kill anywhere before the manifest
         commit leaves this snapshot invisible and the previous one intact.
         """
+        t_save0 = time.perf_counter()
         if pass_id is None:
             if box is None:
                 raise ValueError("save needs pass_id or a BoxPS")
@@ -181,6 +185,24 @@ class PassCheckpointer:
             parent_snapshot=(f"pass-{pass_id - 1:05d}"
                              if pass_id > 1 else None))
         faultpoint.hit("pass_ckpt.post_manifest")
+        # checkpoint lifecycle telemetry: duration + bytes per save, plus
+        # a chrome-trace instant so the timeline reads commit points
+        seconds = time.perf_counter() - t_save0
+        sparse_member = ("base.npz" if rotate
+                         else f"delta-{save_seq:05d}.npz")
+        nbytes = (sum(e["bytes"] for e in files.values())
+                  + chain_files[sparse_member]["bytes"])
+        monitor.counter_add("ckpt.saves")
+        monitor.counter_add("ckpt.save_seconds", seconds)
+        monitor.counter_add("ckpt.bytes", nbytes)
+        if rotate:
+            monitor.counter_add("ckpt.base_rotations")
+        monitor.event("checkpoint_save", type="lifecycle",
+                      snapshot=os.path.basename(snap), seconds=seconds,
+                      bytes=int(nbytes), rotated=bool(rotate),
+                      chain=chain_name, save_seq=int(save_seq))
+        profiler.record_instant("checkpoint_commit",
+                                {"snapshot": os.path.basename(snap)})
         self._prune()
         return snap
 
@@ -226,6 +248,12 @@ class PassCheckpointer:
             try:
                 return pass_id, snap, self._verify_snapshot(snap)
             except CheckpointCorruptError as e:
+                # flaky-storage observability: a torn snapshot shows up in
+                # the flight record / exposition, not only in this warning
+                monitor.counter_add("ckpt.torn_fallbacks")
+                monitor.event("checkpoint_torn_fallback", type="lifecycle",
+                              snapshot=os.path.basename(snap),
+                              error=str(e)[:300])
                 warnings.warn(
                     f"snapshot {snap} failed verification ({e}); falling "
                     f"back to the previous one")
@@ -238,6 +266,7 @@ class PassCheckpointer:
         cursor dict ({pass_id, global_step, date, phase}), or None when no
         valid snapshot exists (fresh start). The driver re-enters its pass
         loop at ``cursor['pass_id'] + 1``."""
+        t_res0 = time.perf_counter()
         found = self.latest_valid()
         if found is None:
             return None
@@ -285,6 +314,13 @@ class PassCheckpointer:
         # foreign save between now and our next snapshot bumps save_count
         # and forces the rotation
         self._expect_count = trainer.store.save_count
+        seconds = time.perf_counter() - t_res0
+        monitor.counter_add("ckpt.resumes")
+        monitor.counter_add("ckpt.resume_seconds", seconds)
+        monitor.event("checkpoint_resume", type="lifecycle",
+                      snapshot=os.path.basename(snap), seconds=seconds,
+                      resumed_pass=int(cursor["pass_id"]),
+                      chain=chain_name, save_seq=seq)
         return cursor
 
     # ---- retention -------------------------------------------------------

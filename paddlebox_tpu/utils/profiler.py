@@ -7,22 +7,31 @@ The reference's observability stack (SURVEY.md §5):
   trace). Here: :class:`RecordEvent` spans collected by a process-global
   profiler, exported with :func:`export_chrome_trace`; device-side traces
   delegate to ``jax.profiler`` (:func:`start_device_trace`), whose TensorBoard
-  dumps play the CUPTI role on TPU.
+  dumps play the CUPTI role on TPU. Spans are tagged with the current
+  pass/step (``monitor.context``) and the buffer is a bounded ring
+  (``flags.profiler_max_events``) with a dropped-span counter — a day-scale
+  run can leave the profiler on without growing without limit.
 - global stat counters — platform/monitor.h ``StatRegistry``/``STAT_ADD``
-  (monitor.h:76,129; data_feed uses them for feasign counts). Here:
-  :class:`StatRegistry` + module-level :func:`stat_add`/:func:`stat_get`.
+  (monitor.h:76,129). The registry now lives in
+  :mod:`paddlebox_tpu.monitor.registry` (the telemetry hub owns it);
+  ``StatRegistry``/``STATS``/``stat_add`` here are back-compat shims over
+  the same object — new code should use ``monitor.counter_add``.
 - nan/inf safety net — ``FLAGS_check_nan_inf`` + details/nan_inf_utils
   (CheckBatchNanOrInfRet dumps the whole scope on trip,
   boxps_worker.cc:575-580). Here: :func:`find_nonfinite` walks a pytree and
-  :func:`dump_tree` snapshots it to an .npz next to the raised error.
+  :func:`dump_tree` snapshots it to an .npz next to the raised error
+  (wired into the trainer via ``flags.check_nan_inf``).
 - per-batch field/param dump threads — DumpField/DumpParam
   (device_worker.cc; dump channel + threads boxps_trainer.cc:96-108, proto
   knobs trainer_desc.proto:39-45). Here: :class:`DumpStream`, a
-  background-thread line writer the trainer feeds per batch.
+  background-thread line writer the trainer feeds per batch; the writer
+  thread inherits the trainer's pass/step context so its telemetry is
+  tagged.
 """
 
 from __future__ import annotations
 
+import collections
 import json
 import os
 import queue
@@ -32,21 +41,27 @@ from typing import Any, Iterable
 
 import numpy as np
 
+from paddlebox_tpu.config import flags as _flags
+from paddlebox_tpu.monitor import context as _mon_ctx
+from paddlebox_tpu.monitor.registry import STATS, StatRegistry  # noqa: F401
+
 # ---------------------------------------------------------------------------
 # RecordEvent spans + chrome trace
 # ---------------------------------------------------------------------------
 
-_events: list[dict] = []
+_events: collections.deque = collections.deque()
 _events_lock = threading.Lock()
 _enabled = False
+_dropped = 0
 _t0 = time.perf_counter()
 
 
 def enable_profiler() -> None:
     """Start collecting RecordEvent spans (profiler.cc EnableProfiler)."""
-    global _enabled, _t0
+    global _enabled, _t0, _dropped
     with _events_lock:
         _events.clear()
+        _dropped = 0
         _t0 = time.perf_counter()
     _enabled = True
 
@@ -61,11 +76,84 @@ def profiler_events() -> list[dict]:
         return list(_events)
 
 
+def dropped_spans() -> int:
+    """Spans evicted from the ring since enable_profiler() (satellite of
+    the bounded buffer: a day-scale run drops oldest-first past
+    ``flags.profiler_max_events`` instead of growing unbounded)."""
+    return _dropped
+
+
+def _append_event(ev: dict) -> None:
+    global _dropped
+    cap = _flags.profiler_max_events
+    with _events_lock:
+        if cap and len(_events) >= cap:
+            _events.popleft()
+            _dropped += 1
+            STATS.add("profiler.dropped_spans", 1)
+        _events.append(ev)
+
+
+def _ctx_args(extra: dict | None = None) -> dict | None:
+    """pass/step tags for a chrome event (None outside a pass, no args key)."""
+    c = _mon_ctx.current()
+    if c.pass_id is None and not extra:
+        return None
+    args = {} if c.pass_id is None else {"pass_id": c.pass_id,
+                                         "step": c.step}
+    if extra:
+        args.update(extra)
+    return args
+
+
+def record_span(name: str, start: float, end: float,
+                args: dict | None = None) -> None:
+    """Record one complete span (perf_counter endpoints). The
+    ``start >= _t0`` guard drops spans that straddle an enable_profiler()
+    reset — they belong to neither trace."""
+    if not _enabled or start < _t0:
+        return
+    ev = {
+        "name": name,
+        "ph": "X",
+        "ts": (start - _t0) * 1e6,        # chrome trace is in µs
+        "dur": (end - start) * 1e6,
+        "pid": os.getpid(),
+        "tid": threading.get_ident() & 0xFFFFFFFF,
+    }
+    a = _ctx_args(args)
+    if a:
+        ev["args"] = a
+    _append_event(ev)
+
+
+def record_instant(name: str, args: dict | None = None) -> None:
+    """Record a chrome-trace instant marker (``ph: i``) — pass boundaries
+    and checkpoint commits use these so a Perfetto timeline reads in pass
+    units."""
+    if not _enabled:
+        return
+    ev = {
+        "name": name,
+        "ph": "i",
+        "s": "g",                          # global-scope instant line
+        "ts": (time.perf_counter() - _t0) * 1e6,
+        "pid": os.getpid(),
+        "tid": threading.get_ident() & 0xFFFFFFFF,
+    }
+    a = _ctx_args(args)
+    if a:
+        ev["args"] = a
+    _append_event(ev)
+
+
 class RecordEvent:
     """Named span: context manager or decorator.
 
     ``with RecordEvent("translate"): ...`` records a complete-event when the
-    profiler is enabled; negligible cost when disabled.
+    profiler is enabled; negligible cost when disabled. (For spans that
+    should ALSO reach the telemetry event stream, use ``monitor.span`` —
+    it forwards here when the profiler is on.)
     """
 
     def __init__(self, name: str):
@@ -79,20 +167,8 @@ class RecordEvent:
         return self
 
     def __exit__(self, *exc):
-        # the _start >= _t0 guard drops spans that straddle an
-        # enable_profiler() reset — they belong to neither trace
-        if _enabled and self._start is not None and self._start >= _t0:
-            end = time.perf_counter()
-            ev = {
-                "name": self.name,
-                "ph": "X",
-                "ts": (self._start - _t0) * 1e6,   # chrome trace is in µs
-                "dur": (end - self._start) * 1e6,
-                "pid": os.getpid(),
-                "tid": threading.get_ident() & 0xFFFFFFFF,
-            }
-            with _events_lock:
-                _events.append(ev)
+        if _enabled and self._start is not None:
+            record_span(self.name, self._start, time.perf_counter())
         return False
 
     def __call__(self, fn):
@@ -107,7 +183,9 @@ def export_chrome_trace(path: str) -> int:
     """Write collected spans as a chrome://tracing / Perfetto JSON file.
 
     Returns the number of events written (the profiler.proto → chrome-trace
-    path of device_tracer.cc:815, host spans only)."""
+    path of device_tracer.cc:815, host spans only). Includes the
+    pass-boundary / checkpoint-commit instant markers recorded via
+    :func:`record_instant`."""
     evs = profiler_events()
     os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)
     with open(path, "w") as f:
@@ -128,43 +206,9 @@ def stop_device_trace() -> None:
 
 
 # ---------------------------------------------------------------------------
-# StatRegistry (platform/monitor.h)
+# StatRegistry (platform/monitor.h) — back-compat shims over
+# monitor.registry.STATS; new call sites use monitor.counter_add/gauge_set.
 # ---------------------------------------------------------------------------
-
-class StatRegistry:
-    """Thread-safe named counters (monitor.h:76 StatRegistry singleton)."""
-
-    def __init__(self):
-        self._lock = threading.Lock()
-        self._stats: dict[str, float] = {}
-
-    def add(self, name: str, value: float = 1.0) -> None:
-        with self._lock:
-            self._stats[name] = self._stats.get(name, 0.0) + value
-
-    def set(self, name: str, value: float) -> None:
-        with self._lock:
-            self._stats[name] = value
-
-    def get(self, name: str) -> float:
-        with self._lock:
-            return self._stats.get(name, 0.0)
-
-    def snapshot(self) -> dict[str, float]:
-        with self._lock:
-            return dict(self._stats)
-
-    def reset(self) -> None:
-        with self._lock:
-            self._stats.clear()
-
-    def report(self) -> str:
-        snap = self.snapshot()
-        return " ".join(f"{k}={snap[k]:g}" for k in sorted(snap))
-
-
-STATS = StatRegistry()            # process-global, like the reference
-
 
 def stat_add(name: str, value: float = 1.0) -> None:  # STAT_ADD(name, v)
     STATS.add(name, value)
@@ -247,6 +291,9 @@ class DumpStream:
     the queue to ``path`` — same shape as the reference's dump channel +
     dump_thread_num threads writing debug fields to (HDFS-bound) files
     (boxps_trainer.cc:96-108). Local filesystem here; pluggable later.
+    The writer thread inherits the spawner's pass/step context
+    (``monitor.context.spawn``) so its line counters and telemetry events
+    are attributed to the pass being dumped.
     """
 
     def __init__(self, path: str, mode: str = "w"):
@@ -255,10 +302,11 @@ class DumpStream:
         self._q: queue.Queue[str | tuple | None] = queue.Queue(maxsize=4096)
         self._error: BaseException | None = None
         self._f = open(path, mode)
-        self._thread = threading.Thread(target=self._drain, daemon=True)
+        self._thread = _mon_ctx.spawn(self._drain, name="pbtpu-dump-writer")
         self._thread.start()
 
     def _drain(self):
+        from paddlebox_tpu.monitor.hub import _HUB
         while True:
             job = self._q.get()
             if job is None:
@@ -268,6 +316,7 @@ class DumpStream:
             try:
                 if isinstance(job, str):
                     self._f.write(job)
+                    STATS.add("dump_stream.lines", 1)
                 else:  # deferred field-formatting job (see write_fields)
                     step, preds, labels, cols = job
                     fmts = {k: _col_formatter(v) for k, v in cols.items()}
@@ -278,6 +327,10 @@ class DumpStream:
                         out.append(f"{step} {i} {preds[i]:.6f} "
                                    f"{labels[i]:g}{tail}\n")
                     self._f.write("".join(out))
+                    STATS.add("dump_stream.lines", len(out))
+                    if _HUB._enabled:    # tagged from THIS writer thread
+                        _HUB.event("dump_fields_written", lines=len(out),
+                                   dump_step=int(step))
             except BaseException as e:
                 self._error = e
 

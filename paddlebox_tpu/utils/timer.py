@@ -1,45 +1,11 @@
-"""Per-stage wall-clock timers.
+"""Per-stage wall-clock timers — back-compat shim.
 
-The reference instruments every per-card stage: read/trans/cal/sync/main
-times printed by ``log_for_profile`` (boxps_worker.cc:746-759) plus the
-pull/push/dense-sync timers in DeviceBoxData (box_wrapper.h:375-391,
-PrintSyncTimer h:642). ``StageTimers`` is the equivalent instrument; the
-bench harness and trainer use it so throughput numbers stay comparable
-(BASELINE.md "In-repo measurement hooks").
+``StageTimers`` moved to :mod:`paddlebox_tpu.monitor.timers` (the telemetry
+hub owns the per-stage instrument: totals feed per-pass flight records and
+each scope emits a tagged span event when the hub's stream is on). This
+module keeps the historical import path working.
 """
 
 from __future__ import annotations
 
-import contextlib
-import time
-
-
-class StageTimers:
-    def __init__(self, stages: list[str]):
-        self.total: dict[str, float] = {s: 0.0 for s in stages}
-        self.count: dict[str, int] = {s: 0 for s in stages}
-
-    @contextlib.contextmanager
-    def __call__(self, stage: str):
-        t0 = time.perf_counter()
-        try:
-            yield
-        finally:
-            dt = time.perf_counter() - t0
-            self.total[stage] = self.total.get(stage, 0.0) + dt
-            self.count[stage] = self.count.get(stage, 0) + 1
-
-    def mean(self, stage: str) -> float:
-        c = self.count.get(stage, 0)
-        return self.total.get(stage, 0.0) / c if c else 0.0
-
-    def report(self) -> str:
-        """One log_for_profile-style line."""
-        parts = [f"{s}={self.total[s]:.3f}s/{self.count[s]}"
-                 for s in self.total]
-        return " ".join(parts)
-
-    def reset(self) -> None:
-        for s in self.total:
-            self.total[s] = 0.0
-            self.count[s] = 0
+from paddlebox_tpu.monitor.timers import StageTimers  # noqa: F401
