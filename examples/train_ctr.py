@@ -25,6 +25,14 @@ Prometheus text exposition (``metrics.prom``), and a chrome trace
 (``trace.json``) with pass-boundary / checkpoint-commit markers.
 ``--short`` trains one day instead of two (the tier-1 telemetry smoke
 runs this path).
+
+``--multihost`` demos the ISSUE-5 whole-world crash recovery instead: a
+2-process world (FileStore control plane, run-scoped heartbeats +
+watchdog, lockstep pass barriers, per-rank crash-safe snapshots) loses
+rank 1 to a hard kill mid-run; the relaunched world runs the COORDINATED
+resume election — every rank publishes its intact snapshot cursors, the
+highest cursor every rank holds intact wins — and finishes training from
+the same cursor on every rank.
 """
 
 from __future__ import annotations
@@ -61,6 +69,91 @@ def synth_files(root: str, schema, n_files: int = 4, lines: int = 512,
             fh.write("\n".join(rows) + "\n")
         files.append(p)
     return files
+
+
+def _multihost_worker() -> int:
+    """One rank of the --multihost recovery demo (spawned by launch)."""
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    from paddlebox_tpu.data import DataFeedSchema, SlotDataset
+    from paddlebox_tpu.distributed import HeartbeatMonitor, RoleMaker
+    from paddlebox_tpu.embedding import EmbeddingConfig, HostEmbeddingStore
+    from paddlebox_tpu.fleet import BoxPS
+    from paddlebox_tpu.models import DNNCTRModel
+    from paddlebox_tpu.parallel import make_mesh
+    from paddlebox_tpu.train import Trainer, TrainerConfig
+    from paddlebox_tpu.utils.pass_ckpt import PassCheckpointer
+
+    rm = RoleMaker.from_env()
+    col = rm.collectives(timeout_s=120)
+    # col.store is already run-id-namespaced by RoleMaker
+    hb = HeartbeatMonitor(col.store, rm.rank, rm.world_size,
+                          interval_s=1.0)
+    col.watchdog = hb          # barrier waits fail with NAMED dead ranks
+
+    num_slots = 4
+    schema = DataFeedSchema.ctr(num_sparse=num_slots, num_float=1,
+                                batch_size=64, max_len=1)
+    data_dir = tempfile.mkdtemp(prefix=f"pbtpu_mh_rank{rm.rank}_")
+    files = synth_files(data_dir, schema, n_files=2, lines=256,
+                        seed=100 + rm.rank)      # per-rank shard
+    ds = SlotDataset(schema)
+    ds.set_filelist(files)
+    ds.load_into_memory(global_shuffle=False)
+
+    store = HostEmbeddingStore(EmbeddingConfig(dim=4, learning_rate=0.1))
+    tr = Trainer(DNNCTRModel(num_slots=num_slots, emb_dim=4, dense_dim=1,
+                             hidden=(16,)),
+                 store, schema, make_mesh(1),
+                 TrainerConfig(global_batch_size=64, dense_lr=3e-3,
+                               auc_buckets=1 << 10), seed=7 + rm.rank)
+    box = BoxPS(store)
+    box.set_date(20260803)
+    box.attach_collectives(col, heartbeat=hb)    # lockstep pass barriers
+    ckpt = PassCheckpointer(
+        os.path.join(os.environ["PBTPU_MH_ROOT"], f"rank{rm.rank}"),
+        keep_last_n=3, base_every=2)
+
+    # coordinated resume election: all ranks restore the SAME cursor
+    cursor = tr.resume(ckpt, box=box, collectives=col)
+    start = (int(cursor["pass_id"]) if cursor is not None else 0) + 1
+    print(f"[rank {rm.rank}] elected cursor: "
+          f"{None if cursor is None else cursor.get('elected')} "
+          f"-> entering pass {start}", flush=True)
+    for p in range(start, 4):
+        box.begin_pass()
+        stats = tr.train_pass(ds)
+        box.end_pass(checkpointer=ckpt, trainer=tr, dataset=ds)
+        print(f"[rank {rm.rank}] pass {box.pass_id}: "
+              f"auc={stats['auc']:.3f}", flush=True)
+        if (p == 2 and rm.rank == 1
+                and os.environ.get("PBTPU_MH_KILL") == "1"):
+            print("[rank 1] simulating preemption: hard kill, no cleanup",
+                  flush=True)
+            os._exit(137)
+    hb.close()
+    print(f"[rank {rm.rank}] done", flush=True)
+    return 0
+
+
+def _multihost_demo() -> int:
+    """Parent of the --multihost demo: world 1 loses rank 1 mid-run; the
+    relaunched world 2 elects the newest snapshot every rank holds intact
+    and finishes from it."""
+    from paddlebox_tpu.distributed.launch import launch
+    root = tempfile.mkdtemp(prefix="pbtpu_mh_demo_")
+    env = {"PBTPU_MH_ROOT": root, "JAX_PLATFORMS": "cpu"}
+    print("== world 1: rank 1 will be hard-killed after pass 2 ==")
+    code = launch(2, [sys.executable, os.path.abspath(__file__),
+                      "--mh-worker"], base_env=dict(env, PBTPU_MH_KILL="1"))
+    print(f"== world 1 fail-stopped (exit {code}) ==")
+    print("== world 2: coordinated resume election ==")
+    code = launch(2, [sys.executable, os.path.abspath(__file__),
+                      "--mh-worker"], base_env=env)
+    print(f"== world 2 finished (exit {code}) ==")
+    assert code == 0, "resumed world failed"
+    print("multihost recovery demo complete:", root)
+    return 0
 
 
 def main() -> int:
@@ -203,4 +296,13 @@ def main() -> int:
 
 
 if __name__ == "__main__":
+    # runnable as a plain script (and as its own --mh-worker subprocess)
+    # without an installed package or PYTHONPATH
+    _REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    if _REPO not in sys.path:
+        sys.path.insert(0, _REPO)
+    if "--mh-worker" in sys.argv:
+        sys.exit(_multihost_worker())
+    if "--multihost" in sys.argv:
+        sys.exit(_multihost_demo())
     sys.exit(main())

@@ -169,6 +169,22 @@ class Flags:
     fs_retry_backoff_s: float = 0.2         # (new) doubles per attempt
     fs_command_timeout_s: float = 0.0       # (new) 0 disables
 
+    # --- multi-host resilience (new — distributed/resilience.py) ---
+    # Heartbeat publish/scan period per rank (run-scoped FileStore keys).
+    heartbeat_interval_s: float = 2.0       # (new)
+    # A peer whose heartbeat SEQ stops advancing this long is dead
+    # (peer_lost): the publisher is a daemon thread, so a frozen seq means
+    # the process itself is gone.
+    heartbeat_lost_s: float = 30.0          # (new)
+    # A peer whose heartbeat beats but whose pass/step progress is frozen
+    # this long is hung (peer_stalled) — stuck collective, dead remote FS.
+    heartbeat_stall_s: float = 120.0        # (new)
+    # Mid-pass snapshot cadence (steps) for Trainer.enable_midpass_snapshots
+    # drivers; 0 = pass-boundary snapshots only. A mid-pass kill then
+    # resumes from the dataset/shuffle cursor instead of replaying the
+    # whole pass.
+    ckpt_midpass_every_steps: int = 0       # (new)
+
     # --- telemetry (new — monitor/ TelemetryHub + utils/profiler) ---
     # RecordEvent span ring capacity: the profiler keeps at most this many
     # spans, dropping oldest-first (profiler.dropped_spans counts); 0 =

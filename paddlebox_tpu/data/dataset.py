@@ -126,7 +126,11 @@ class SlotDataset:
                         routing: RoutingMode) -> SlotRecordBatch:
         if self._service is None:
             return self._shuffler.shuffle(batch, routing)
-        routed = route_records(batch, self._service.world, routing)
+        # random-mode routing draws from the PERSISTENT shuffle generator
+        # so shuffle_state() checkpoints the routing decisions too (a
+        # mid-pass resume replays identical destinations)
+        routed = route_records(batch, self._service.world, routing,
+                               rng=self._shuffler.rng)
         received = self._service.exchange(routed, self.schema)
         merged = (SlotRecordBatch.concat(received) if received
                   else SlotRecordBatch.empty(self.schema))
@@ -137,6 +141,19 @@ class SlotDataset:
     def local_shuffle(self) -> None:
         if self.records is not None and self.records.num:
             self.records = self._shuffler.shuffle(self.records)
+
+    # ---- crash-recovery shuffle cursor (distributed/resilience.py) ----
+
+    def shuffle_state(self) -> dict:
+        """The shuffle RNG cursor: JSON-serializable bit-generator state.
+        Recorded into pass snapshots (PassCheckpointer cursor) so a
+        resumed rank replays the identical per-pass permutations — the
+        state BEFORE a pass's draw reproduces that pass's order, the
+        state after it produces the next pass's."""
+        return self._shuffler.state_dict()
+
+    def set_shuffle_state(self, state: dict) -> None:
+        self._shuffler.load_state_dict(state)
 
     def slots_shuffle(self, slot_names: Sequence[str], seed: int = 0) -> None:
         """Shuffle the values of the given sparse slots *across examples*

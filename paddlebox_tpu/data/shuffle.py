@@ -30,9 +30,16 @@ RoutingMode = Literal["random", "ins_id", "search_id"]
 
 
 def route_records(batch: SlotRecordBatch, world_size: int, mode: RoutingMode,
-                  seed: int = 0) -> list[SlotRecordBatch | None]:
+                  seed: int = 0, rng: np.random.Generator | None = None
+                  ) -> list[SlotRecordBatch | None]:
     """Split a batch into per-destination sub-batches (reference
-    ShuffleData's routing switch, data_set.cc:1934-1942)."""
+    ShuffleData's routing switch, data_set.cc:1934-1942).
+
+    ``random`` routing draws from ``rng`` when given (a persistent,
+    checkpointable generator — see :meth:`LocalShuffler.state_dict`) and
+    falls back to a throwaway generator seeded with ``seed``. Mid-pass
+    crash recovery snapshots that generator state so a resumed rank
+    replays the identical routing decisions."""
     if world_size == 1:
         return [batch]
     if mode == "search_id":
@@ -40,7 +47,7 @@ def route_records(batch: SlotRecordBatch, world_size: int, mode: RoutingMode,
     elif mode == "ins_id":
         dest = (hash64_array(batch.ins_id) % np.uint64(world_size)).astype(np.int64)
     else:
-        rng = np.random.default_rng(seed)
+        rng = np.random.default_rng(seed) if rng is None else rng
         dest = rng.integers(0, world_size, size=batch.num)
     out: list[SlotRecordBatch | None] = []
     for r in range(world_size):
@@ -82,7 +89,14 @@ def deserialize_batch(data: bytes, schema) -> SlotRecordBatch:
 
 
 class LocalShuffler:
-    """Single-host shuffle: a permutation. world_size == 1."""
+    """Single-host shuffle: a permutation. world_size == 1.
+
+    The generator is persistent across passes, and its state is part of
+    the crash-recovery dataset cursor: ``state_dict``/``load_state_dict``
+    round-trip the bit-generator state (JSON-serializable), so a resumed
+    rank draws the exact permutation sequence the killed run would have —
+    mid-pass resume depends on replaying the SAME pass order.
+    """
 
     def __init__(self, seed: int = 0):
         self.rng = np.random.default_rng(seed)
@@ -90,6 +104,12 @@ class LocalShuffler:
     def shuffle(self, batch: SlotRecordBatch, mode: RoutingMode = "random"
                 ) -> SlotRecordBatch:
         return batch.shuffle(self.rng)
+
+    def state_dict(self) -> dict:
+        return self.rng.bit_generator.state
+
+    def load_state_dict(self, state: dict) -> None:
+        self.rng.bit_generator.state = state
 
 
 class TcpShuffleService:

@@ -12,6 +12,10 @@ HDFS rendezvous store (gloo_wrapper.h:45-200), and the fleet role makers
 - ``RoleMaker`` — rank/world from env, optional jax.distributed init for
   real multi-host TPU pods.
 - ``launch`` — one-process-per-host launcher (fleetrun equivalent).
+- ``resilience`` — whole-world crash recovery: run-scoped heartbeats with
+  a dead/stalled-peer watchdog (named-rank diagnostics through every
+  collective wait) and the coordinated resume election that makes all
+  ranks restore the SAME snapshot cursor.
 - ``ps`` — host parameter-server cluster (the PSLib/FleetWrapper + brpc-PS
   capability: sharded sparse tables with in-table optimizers, async dense
   tables, save/load/shrink over TCP).
@@ -23,5 +27,8 @@ over the mesh inside jit.
 from paddlebox_tpu.distributed.store import FileStore  # noqa: F401
 from paddlebox_tpu.distributed.collectives import HostCollectives  # noqa: F401
 from paddlebox_tpu.distributed.role_maker import RoleMaker  # noqa: F401
+from paddlebox_tpu.distributed.resilience import (  # noqa: F401
+    HeartbeatMonitor, PeerFailureError, PeerLostError, PeerStalledError,
+    coordinated_resume)
 from paddlebox_tpu.distributed.ps import (PSClient, PSServer,  # noqa: F401
                                           RemoteEmbeddingStore)

@@ -56,12 +56,17 @@ _REDUCERS: dict[str, Callable] = {
 
 class HostCollectives:
     def __init__(self, store: FileStore, rank: int, world: int,
-                 run_id: str = "", cleanup_lag: int = 8):
+                 run_id: str = "", cleanup_lag: int = 8, watchdog=None):
         if not (0 <= rank < world):
             raise ValueError(f"rank {rank} outside world {world}")
         self.store = store
         self.rank = rank
         self.world = world
+        # optional HeartbeatMonitor (distributed/resilience.py): its
+        # check() is polled inside every store wait, so a dead or stalled
+        # peer surfaces as a named-rank error instead of the full barrier
+        # timeout
+        self.watchdog = watchdog
         # run_id namespaces keys so a relaunched job against the same
         # persistent store dir never consumes a dead run's published values
         # (the launcher stamps PBTPU_RUN_ID per launch)
@@ -87,13 +92,17 @@ class HostCollectives:
     def _wrote(self, key: str) -> None:
         self._written.setdefault(self._seq, []).append(key)
 
+    def _check(self):
+        w = self.watchdog
+        return w.check if w is not None else None
+
     def barrier(self, name: str = "barrier") -> None:
         if self.world == 1:
             return
         key = self._next(name)
         self.store.add(key, self.rank)
         self._wrote(f"{key}.{self.rank}")
-        self.store.wait_count(key, self.world)
+        self.store.wait_count(key, self.world, check=self._check())
 
     def all_gather(self, value: Any, name: str = "gather") -> list[Any]:
         if self.world == 1:
@@ -101,7 +110,7 @@ class HostCollectives:
         key = self._next(name)
         self.store.set(f"{key}.v{self.rank}", _dump(value))
         self._wrote(f"{key}.v{self.rank}")
-        return [_load(self.store.wait(f"{key}.v{r}"))
+        return [_load(self.store.wait(f"{key}.v{r}", check=self._check()))
                 for r in range(self.world)]
 
     def all_reduce(self, value: np.ndarray, op: str = "sum",
@@ -114,13 +123,14 @@ class HostCollectives:
         self.store.set(f"{key}.v{self.rank}", _dump(value))
         self._wrote(f"{key}.v{self.rank}")
         if self.rank == 0:
-            parts = [_load(self.store.wait(f"{key}.v{r}"))
+            parts = [_load(self.store.wait(f"{key}.v{r}",
+                                           check=self._check()))
                      for r in range(self.world)]
             out = _REDUCERS[op](parts)
             self.store.set(f"{key}.out", _dump(out))
             self._wrote(f"{key}.out")
             return out
-        return _load(self.store.wait(f"{key}.out"))
+        return _load(self.store.wait(f"{key}.out", check=self._check()))
 
     def broadcast(self, value: Any, root: int = 0,
                   name: str = "bcast") -> Any:
@@ -131,4 +141,4 @@ class HostCollectives:
             self.store.set(f"{key}.out", _dump(value))
             self._wrote(f"{key}.out")
             return value
-        return _load(self.store.wait(f"{key}.out"))
+        return _load(self.store.wait(f"{key}.out", check=self._check()))

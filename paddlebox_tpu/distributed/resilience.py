@@ -1,0 +1,308 @@
+"""Whole-world crash resilience: heartbeats, hang watchdog, resume election.
+
+PR 3 made the *per-host* pass lifecycle crash-safe (atomic manifested
+snapshots, torn-newest fallback — utils/pass_ckpt.py). At multi-host scale
+that is not enough: the reference's production loop treats node loss and
+remote-FS failure as the norm (SURVEY.md §5), and a world where each rank
+independently picks its own "newest intact snapshot" diverges the moment
+one rank's newest save tore mid-commit. Recovery must be a *coordinated
+protocol* (cf. Parallax's fail-stop data-parallel model, arXiv:1808.02621):
+
+- :func:`coordinated_resume` — every rank publishes the cursors of its
+  intact snapshots through the rendezvous store; the world deterministically
+  elects the **highest cursor every rank holds intact** (the torn-newest
+  fallback becomes a world decision, not N local ones), barriers, restores
+  that exact snapshot on every rank, and barriers again before training
+  re-enters the pass loop.
+- :class:`HeartbeatMonitor` — each rank publishes a run-scoped heartbeat
+  key carrying a monotonic sequence plus the live pass/step (read from the
+  telemetry pass context, so no trainer wiring is needed), and watches its
+  peers: a stamp that stops advancing means the process died
+  (``peer_lost``); a stamp that advances while pass/step progress is frozen
+  means the rank is hung (``peer_stalled``). Both emit telemetry events
+  (PR 4 hub) and raise :class:`PeerLostError` / :class:`PeerStalledError`
+  *naming the ranks* through the ``check`` hook the store waits poll —
+  instead of an opaque 300 s barrier timeout.
+
+Key namespacing: every key is prefixed by the launch's run id (satellite of
+ISSUE 5) so a restarted world can never consume a dead run's heartbeats or
+barrier arrivals.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import socket
+import threading
+import time
+
+from paddlebox_tpu import monitor
+from paddlebox_tpu.config import flags as config_flags
+from paddlebox_tpu.distributed.collectives import HostCollectives
+from paddlebox_tpu.distributed.store import FileStore
+from paddlebox_tpu.monitor import context as mon_ctx
+
+
+class PeerFailureError(RuntimeError):
+    """A peer rank is dead or hung; carries the offending ranks."""
+
+    def __init__(self, msg: str, ranks: list[int]):
+        super().__init__(msg)
+        self.ranks = list(ranks)
+
+
+class PeerLostError(PeerFailureError):
+    """Peer heartbeat stopped entirely — the process is gone."""
+
+
+class PeerStalledError(PeerFailureError):
+    """Peer heartbeat still beats but its pass/step progress is frozen —
+    the rank is hung (stuck collective, deadlocked IO, live-lock)."""
+
+
+class HeartbeatMonitor:
+    """Publish this rank's heartbeat and watch every peer's.
+
+    The published payload is JSON: ``{seq, rank, pid, host, pass, step}``.
+    ``seq`` increments per publish — staleness is judged by *observed
+    change* against the local monotonic clock, never by comparing wall
+    clocks across hosts (a shared-FS store gives no clock guarantees).
+
+    Detection model:
+
+    - **lost**: the peer's ``seq`` has not advanced for ``lost_after_s``.
+      The publisher is a daemon thread that survives any Python-level hang,
+      so a frozen seq means the *process* is gone (SIGKILL, OOM, node
+      loss).
+    - **stalled**: ``seq`` advances but the payload's ``(pass, step)`` has
+      not changed for ``stall_after_s`` — the interpreter is alive but
+      training is not progressing (hung collective, dead remote FS).
+      Progress is read from :mod:`paddlebox_tpu.monitor.context`, which the
+      trainer already advances per step.
+
+    A background watchdog thread scans peers every ``interval_s`` and
+    latches the first failure; :meth:`check` (polled inside every store
+    wait via ``HostCollectives.watchdog``) re-raises it with the named
+    ranks. Scanning also happens inline in ``check`` so the monitor works
+    without the thread (``watch=False``).
+    """
+
+    def __init__(self, store: FileStore, rank: int, world: int,
+                 run_id: str = "", interval_s: float | None = None,
+                 lost_after_s: float | None = None,
+                 stall_after_s: float | None = None,
+                 watch: bool = True, start: bool = True):
+        self.store = store
+        self.rank = rank
+        self.world = world
+        prefix = f"{run_id}." if run_id else ""
+        self._key = lambda r: f"{prefix}hb.{r}"
+        self.interval_s = (config_flags.heartbeat_interval_s
+                           if interval_s is None else float(interval_s))
+        self.lost_after_s = (config_flags.heartbeat_lost_s
+                             if lost_after_s is None else float(lost_after_s))
+        self.stall_after_s = (config_flags.heartbeat_stall_s
+                              if stall_after_s is None
+                              else float(stall_after_s))
+        self._seq = 0
+        self._stop = threading.Event()
+        self._failure: PeerFailureError | None = None
+        self._reported: set[tuple[str, int]] = set()
+        # per-peer observation state: (last_seq, seq_seen_mono,
+        #                              last_progress, progress_seen_mono)
+        self._obs: dict[int, list] = {}
+        self._watch = watch
+        self._threads: list[threading.Thread] = []
+        if start:
+            self.start()
+
+    # -- publishing --------------------------------------------------------
+
+    def publish(self) -> None:
+        """Write one heartbeat for this rank (also called by the
+        publisher thread every ``interval_s``)."""
+        self._seq += 1
+        ctx = mon_ctx.current()
+        payload = {"seq": self._seq, "rank": self.rank, "pid": os.getpid(),
+                   "host": socket.gethostname(),
+                   "pass": ctx.pass_id, "step": ctx.step}
+        self.store.set(self._key(self.rank), json.dumps(payload).encode())
+
+    def _publisher(self) -> None:
+        while not self._stop.is_set():
+            try:
+                self.publish()
+            except OSError:
+                pass             # store blip: better a late beat than death
+            self._stop.wait(self.interval_s)
+
+    def _watchdog(self) -> None:
+        while not self._stop.is_set():
+            try:
+                self.scan()
+            except PeerFailureError:
+                return           # latched; check() raises it to the caller
+            self._stop.wait(self.interval_s)
+
+    def start(self) -> None:
+        if self._threads:
+            return
+        t = threading.Thread(target=self._publisher, daemon=True,
+                             name=f"pbtpu-heartbeat-{self.rank}")
+        t.start()
+        self._threads.append(t)
+        if self._watch and self.world > 1:
+            w = threading.Thread(target=self._watchdog, daemon=True,
+                                 name=f"pbtpu-watchdog-{self.rank}")
+            w.start()
+            self._threads.append(w)
+
+    def close(self) -> None:
+        self._stop.set()
+        for t in self._threads:
+            t.join(timeout=self.interval_s + 2.0)
+        self._threads = []
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+        return False
+
+    # -- watching ----------------------------------------------------------
+
+    def _read_peer(self, r: int) -> dict | None:
+        raw = self.store.get(self._key(r))
+        if raw is None:
+            return None
+        try:
+            return json.loads(raw.decode())
+        except (ValueError, UnicodeDecodeError):
+            return None          # torn read under a non-atomic NFS rename
+
+    def scan(self) -> None:
+        """One watchdog pass over every peer; latches + raises on the
+        first dead/stalled peer found. Ranks never seen at all are in a
+        grace period (startup skew) judged only against ``lost_after_s``
+        from the first scan."""
+        now = time.monotonic()
+        lost, stalled = [], []
+        for r in range(self.world):
+            if r == self.rank:
+                continue
+            p = self._read_peer(r)
+            obs = self._obs.get(r)
+            if obs is None:
+                obs = self._obs[r] = [None, now, None, now]
+            if p is not None and p.get("seq") != obs[0]:
+                obs[0], obs[1] = p.get("seq"), now
+            prog = None if p is None else (p.get("pass"), p.get("step"))
+            if prog != obs[2]:
+                obs[2], obs[3] = prog, now
+            if now - obs[1] > self.lost_after_s:
+                lost.append(r)
+            elif (obs[0] is not None and prog is not None
+                    and prog != (None, None)
+                    and now - obs[3] > self.stall_after_s):
+                # only a rank that HAS published training progress can
+                # stall; a rank idling before its first pass is merely slow
+                stalled.append(r)
+        for kind, ranks, exc in (("peer_lost", lost, PeerLostError),
+                                 ("peer_stalled", stalled,
+                                  PeerStalledError)):
+            if not ranks:
+                continue
+            for r in ranks:
+                if (kind, r) not in self._reported:
+                    self._reported.add((kind, r))
+                    monitor.counter_add(f"resilience.{kind}")
+                    monitor.event(kind, rank=int(r),
+                                  observer=int(self.rank),
+                                  after_s=(self.lost_after_s
+                                           if kind == "peer_lost"
+                                           else self.stall_after_s))
+            limit = (self.lost_after_s if kind == "peer_lost"
+                     else self.stall_after_s)
+            err = exc(
+                f"rank{'s' if len(ranks) > 1 else ''} {ranks} "
+                f"{'lost (heartbeat stopped)' if kind == 'peer_lost' else 'stalled (no pass/step progress)'} "
+                f"for > {limit:.1f}s (observer rank {self.rank})", ranks)
+            if self._failure is None:
+                self._failure = err
+            raise err
+
+    def check(self) -> None:
+        """Raise the latched (or freshly scanned) peer failure, if any.
+        Cheap enough to poll from the store wait loops."""
+        if self._failure is not None:
+            raise self._failure
+        if not self._watch or not self._threads:
+            # no background watchdog: scan inline (rate-limited by the
+            # store poll interval of the caller)
+            self.scan()
+
+
+# ---------------------------------------------------------------------------
+# coordinated resume election
+# ---------------------------------------------------------------------------
+
+def elect_resume_cursor(local_cursors: list[tuple[int, int]],
+                        all_cursors: list[list]) -> tuple[int, int] | None:
+    """The pure election: given every rank's intact-cursor lists (as
+    gathered), return the highest ``(pass_id, mid_steps)`` present in ALL
+    of them, or None when no snapshot is common (whole-world fresh start).
+    Deterministic — every rank computes the same result from the same
+    gathered lists, so no leader is needed."""
+    common = set(tuple(c) for c in all_cursors[0])
+    for lst in all_cursors[1:]:
+        common &= set(tuple(c) for c in lst)
+    del local_cursors  # identical information rides all_cursors
+    return max(common) if common else None
+
+
+def coordinated_resume(checkpointer, trainer, collectives: HostCollectives,
+                       box=None, metrics=None) -> dict | None:
+    """Whole-world resume: elect the newest snapshot intact on EVERY rank,
+    restore it everywhere, and barrier so no rank trains ahead.
+
+    Returns the elected snapshot's cursor dict (plus ``"elected"``), or
+    None when any rank has nothing intact (the world starts fresh
+    together — resuming a world where one rank lost its snapshots would
+    silently diverge the planes).
+    """
+    mine = checkpointer.intact_cursors()
+    gathered = collectives.all_gather([list(c) for c in mine],
+                                      name="resume_candidates")
+    elected = elect_resume_cursor(mine, gathered)
+    monitor.event("resume_election",
+                  elected=(list(elected) if elected else None),
+                  rank=collectives.rank,
+                  local_newest=(list(mine[-1]) if mine else None),
+                  world=collectives.world)
+    # barrier BEFORE restoring: every rank must have read the gathered
+    # lists before any rank's resume starts overwriting / pruning state
+    collectives.barrier("resume_elected")
+    if elected is None:
+        # whole-world fresh start: any surviving local snapshots belong to
+        # timelines the world just abandoned — left on disk, a later
+        # election could match a STALE pass-N snapshot on this rank
+        # against a freshly-retrained pass-N on another and silently
+        # diverge the planes. Discard them everywhere, then barrier so no
+        # rank trains before the wipe is global.
+        checkpointer.discard_all_snapshots()
+        collectives.barrier("resume_fresh")
+        return None
+    if elected not in mine:      # cannot happen post-election; belt+braces
+        raise RuntimeError(
+            f"rank {collectives.rank} elected cursor {elected} is not in "
+            f"its intact set {mine} — election protocol violated")
+    cursor = checkpointer.resume(trainer, box=box, metrics=metrics,
+                                 at=elected)
+    monitor.counter_add("resilience.coordinated_resumes")
+    # barrier AFTER restoring: no rank enters the pass loop until the
+    # whole world stands on the elected snapshot
+    collectives.barrier("resume_restored")
+    cursor["elected"] = list(elected)
+    return cursor

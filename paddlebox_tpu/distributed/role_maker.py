@@ -65,10 +65,15 @@ class RoleMaker:
                 "the dead run's published collective results (the launcher "
                 "stamps this automatically; site scripts must set it, e.g. "
                 "to the scheduler job id)")
+        # run-id namespacing lives at the STORE level: every key this
+        # launch writes — collective rounds, heartbeats, barrier
+        # arrivals — is prefixed once, so a restarted job against the
+        # same persistent store dir can never consume a dead run's keys.
+        # (HostCollectives/HeartbeatMonitor keep their own run_id
+        # parameters for direct users on bare stores; don't set both.)
         store = FileStore(self.store_dir or "/tmp/pbtpu_store",
-                          timeout_s=timeout_s)
-        return HostCollectives(store, self.rank, self.world_size,
-                               run_id=self.run_id)
+                          timeout_s=timeout_s, namespace=self.run_id)
+        return HostCollectives(store, self.rank, self.world_size)
 
     def init_distributed(self, sim_cpu_devices: int | None = None) -> None:
         """Join the global JAX process group (real multi-host pods).

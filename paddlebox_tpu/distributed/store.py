@@ -4,29 +4,57 @@ Reference: gloo's ``HdfsStore`` (gloo_wrapper.h:45) — set/get/wait on a
 shared filesystem so hosts can rendezvous without a standing service. Works
 on any mount every host can see (NFS, FUSE'd object store, /tmp for
 single-machine tests).
+
+Crash-resilience notes (multi-host recovery protocol):
+
+- ``set`` publishes atomically through a tmp file whose suffix carries
+  hostname + pid + a fresh uuid — two HOSTS on a shared mount can share a
+  pid, so a pid-only suffix could interleave two writers' bytes into one
+  tmp file and publish garbage.
+- ``namespace`` (normally the per-launch run id) prefixes every key, so a
+  relaunched job against the same persistent store dir can never read —
+  or be satisfied by — a previous launch's keys. :meth:`sweep_stale`
+  additionally reclaims abandoned keys by age (disk hygiene; the
+  namespace is the correctness barrier, age-based cleanup is not).
+- ``wait``/``wait_count`` accept a ``check`` callable polled every loop
+  iteration: the heartbeat watchdog raises through it with *named* dead or
+  stalled ranks instead of letting the caller sit out an opaque timeout,
+  and ``wait_count``'s own timeout names which ranks never arrived.
 """
 
 from __future__ import annotations
 
 import os
+import socket
 import time
+import uuid
+from typing import Callable
 
 
 class FileStore:
     def __init__(self, root: str, timeout_s: float = 300.0,
-                 poll_s: float = 0.02):
+                 poll_s: float = 0.02, namespace: str = ""):
         self.root = root
         self.timeout_s = timeout_s
         self.poll_s = poll_s
+        # key namespace (the launcher's run id): "" = no prefix, matching
+        # the single-host/test default
+        self.namespace = namespace
         os.makedirs(root, exist_ok=True)
 
     def _path(self, key: str) -> str:
+        if self.namespace:
+            key = f"{self.namespace}.{key}"
         safe = key.replace("/", "_")
         return os.path.join(self.root, safe)
 
     def set(self, key: str, value: bytes) -> None:
         path = self._path(key)
-        tmp = f"{path}.tmp.{os.getpid()}"
+        # hostname + pid + uuid: pid alone collides across hosts sharing
+        # the mount, and a recycled pid on one host could race its
+        # predecessor's leftover tmp file
+        tmp = (f"{path}.tmp.{socket.gethostname()}.{os.getpid()}."
+               f"{uuid.uuid4().hex[:8]}")
         with open(tmp, "wb") as f:
             f.write(value)
         os.replace(tmp, path)  # atomic publish
@@ -44,12 +72,15 @@ class FileStore:
         except FileNotFoundError:
             return None
 
-    def wait(self, key: str, timeout_s: float | None = None) -> bytes:
+    def wait(self, key: str, timeout_s: float | None = None,
+             check: Callable[[], None] | None = None) -> bytes:
         deadline = time.monotonic() + (timeout_s or self.timeout_s)
         while True:
             v = self.get(key)
             if v is not None:
                 return v
+            if check is not None:
+                check()          # watchdog: raise with named ranks
             if time.monotonic() > deadline:
                 raise TimeoutError(f"store key {key!r} not set within "
                                    f"{timeout_s or self.timeout_s}s")
@@ -60,16 +91,60 @@ class FileStore:
         self.set(f"{key}.{rank}", b"1")
 
     def count(self, key: str, world: int) -> int:
-        return sum(
-            1 for r in range(world)
-            if os.path.exists(self._path(f"{key}.{r}")))
+        return world - len(self.missing_ranks(key, world))
+
+    def missing_ranks(self, key: str, world: int) -> list[int]:
+        return [r for r in range(world)
+                if not os.path.exists(self._path(f"{key}.{r}"))]
 
     def wait_count(self, key: str, world: int,
-                   timeout_s: float | None = None) -> None:
+                   timeout_s: float | None = None,
+                   check: Callable[[], None] | None = None) -> None:
         deadline = time.monotonic() + (timeout_s or self.timeout_s)
-        while self.count(key, world) < world:
+        while True:
+            missing = self.missing_ranks(key, world)
+            if not missing:
+                return
+            if check is not None:
+                check()          # watchdog: raise with named ranks
             if time.monotonic() > deadline:
                 raise TimeoutError(
-                    f"barrier {key!r}: {self.count(key, world)}/{world} "
-                    "ranks arrived")
+                    f"barrier {key!r}: {world - len(missing)}/{world} "
+                    f"ranks arrived; missing ranks {missing}")
             time.sleep(self.poll_s)
+
+    def sweep_stale(self, max_age_s: float) -> int:
+        """Unlink OTHER namespaces' store files older than ``max_age_s``
+        (by mtime); returns the count removed. Hygiene for persistent
+        store dirs reused across launches — an abandoned run's keys (and
+        orphaned ``.tmp.`` files) would otherwise accumulate forever. The
+        run-id *namespace* is what prevents a previous launch's keys from
+        satisfying a barrier; this sweep merely reclaims the disk.
+
+        The current namespace's keys are NEVER swept, whatever their age:
+        a rank can legitimately sit minutes in a barrier (a straggler
+        peer in a long pass) with its arrival file aging past any
+        threshold — deleting it would wedge the live collective. An
+        un-namespaced store therefore refuses to sweep (no way to tell
+        our keys from a dead run's). Concurrent-safe: a racing unlink is
+        ignored."""
+        if not self.namespace:
+            raise ValueError(
+                "sweep_stale needs a namespaced store: without a run-id "
+                "prefix the sweep cannot distinguish the live run's keys "
+                "(e.g. a barrier arrival aging while a straggler trains) "
+                "from an abandoned run's")
+        own = f"{self.namespace}."
+        now = time.time()
+        removed = 0
+        for name in os.listdir(self.root):
+            if name.startswith(own):
+                continue         # the live run's keys are untouchable
+            p = os.path.join(self.root, name)
+            try:
+                if now - os.path.getmtime(p) > max_age_s:
+                    os.remove(p)
+                    removed += 1
+            except OSError:
+                pass             # raced with another sweeper / live writer
+        return removed

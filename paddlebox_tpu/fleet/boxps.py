@@ -41,6 +41,28 @@ class BoxPS:
         self.pass_id = 0
         self.in_pass = False
         self._pass_t0 = 0.0
+        # multi-host lifecycle (attach_collectives): lockstep barriers at
+        # the pass boundaries + the heartbeat/watchdog pair
+        self._col = None
+        self._heartbeat = None
+
+    # ---- multi-host lifecycle (ISSUE 5) ----
+
+    def attach_collectives(self, collectives, heartbeat=None) -> None:
+        """Make the pass lifecycle world-synchronous: ``begin_pass`` and
+        ``end_pass`` barrier over the rendezvous store so no rank trains a
+        pass the world has not entered (the reference's MPICluster barrier
+        around BeginPass/EndPass, box_wrapper.h:415). With a
+        ``HeartbeatMonitor``, the barriers poll its watchdog — a dead or
+        stalled peer surfaces as a named-rank PeerLost/PeerStalled error
+        instead of the bare store timeout — and each boundary publishes a
+        fresh heartbeat so peers see this rank's pass progress
+        immediately."""
+        self._col = collectives
+        self._heartbeat = heartbeat
+        if heartbeat is not None and getattr(collectives, "watchdog",
+                                             None) is None:
+            collectives.watchdog = heartbeat
 
     @property
     def phase(self) -> int:
@@ -56,23 +78,35 @@ class BoxPS:
     def begin_pass(self) -> None:
         if self.in_pass:
             raise RuntimeError("begin_pass while a pass is open")
+        if self._col is not None:
+            # lockstep: no rank opens pass N+1 until the world is ready
+            self._col.barrier("begin_pass")
         self.in_pass = True
         self.pass_id += 1
         self._pass_t0 = time.time()
         # telemetry pass scope: everything until end_pass — trainer steps,
         # worker threads, checkpoint commits — is tagged with this pass
         monitor.hub().begin_pass(self.pass_id, phase=self.phase)
+        if self._heartbeat is not None:
+            self._heartbeat.publish()     # peers see the new pass at once
 
     def end_pass(self, need_save_delta: bool = False,
                  delta_path: str | None = None,
-                 checkpointer=None, trainer=None) -> dict[str, Any]:
+                 checkpointer=None, trainer=None,
+                 dataset=None) -> dict[str, Any]:
         """Close the pass; optionally snapshot the delta plane
         (BoxPSDataset.end_pass(need_save_delta), dataset.py:1124).
 
         With ``checkpointer`` (a PassCheckpointer) + ``trainer``, commits
         the full crash-safe pass snapshot instead: dense + optimizer +
         sparse base-or-delta + metrics + cursor, atomically manifested —
-        the need_save_delta flow upgraded to a resumable one."""
+        the need_save_delta flow upgraded to a resumable one. ``dataset``
+        additionally records the shuffle RNG cursor
+        (SlotDataset.shuffle_state) so a resumed rank draws the identical
+        next-pass permutation. With attached collectives the snapshot is
+        followed by a world barrier: no rank starts the next pass before
+        every rank's snapshot committed (the election's common prefix
+        stays one pass deep at most)."""
         if not self.in_pass:
             raise RuntimeError("end_pass without begin_pass")
         self.in_pass = False
@@ -81,8 +115,13 @@ class BoxPS:
         if checkpointer is not None:
             if trainer is None:
                 raise ValueError("end_pass(checkpointer=...) needs trainer")
+            shuffle_state = (dataset.shuffle_state()
+                             if dataset is not None
+                             and hasattr(dataset, "shuffle_state")
+                             else None)
             out["snapshot"] = checkpointer.save(trainer, box=self,
-                                                metrics=self.metrics)
+                                                metrics=self.metrics,
+                                                shuffle_state=shuffle_state)
         if need_save_delta:
             if delta_path is None:
                 raise ValueError("need_save_delta requires delta_path")
@@ -91,6 +130,10 @@ class BoxPS:
         # flight-record commit LAST: checkpoint/delta durations and bytes
         # above land in this pass's stats_delta and event stream
         out["flight_record"] = monitor.hub().end_pass(metrics=self.metrics)
+        if self._heartbeat is not None:
+            self._heartbeat.publish()
+        if self._col is not None:
+            self._col.barrier("end_pass")
         return out
 
     def flip_phase(self) -> None:

@@ -29,10 +29,13 @@ import json
 import os
 import tempfile
 import time
+import warnings
 from typing import Any
 
+from paddlebox_tpu import monitor
 from paddlebox_tpu.embedding import HostEmbeddingStore
 from paddlebox_tpu.utils import checkpoint as ckpt_lib
+from paddlebox_tpu.utils import faultpoint
 from paddlebox_tpu.utils import fs as fs_lib
 
 
@@ -101,6 +104,7 @@ class FleetUtil:
             stage = os.path.join(d, "m")
             os.makedirs(stage)
             write(stage)
+            faultpoint.hit("remote_ckpt.upload.pre")
             parent = path.rsplit("/", 1)[0]
             self._fs.makedirs(parent)
             # a leftover target (torn upload, re-save of the same day/pass)
@@ -111,6 +115,17 @@ class FleetUtil:
 
     def _write_donefile(self, name: str, day: int, pass_id: int,
                         path: str) -> None:
+        # crash-replay idempotency: the fs retry policy deliberately never
+        # retries append (utils/fs.py — a retried partial append could
+        # double-write), so a restarted save that reaches this line again
+        # must skip the append when the last committed line already names
+        # this exact (day, pass, path)
+        last = self.latest(name)
+        if (last is not None and int(last.get("day", -1)) == int(day)
+                and int(last.get("pass", -1)) == int(pass_id)
+                and last.get("path") == path):
+            monitor.counter_add("fleet.donefile_dedup")
+            return
         line = json.dumps({"day": day, "pass": pass_id, "path": path,
                            "ts": int(time.time())})
         self._fs.write_text(os.path.join(self.root, name), line + "\n",
@@ -148,10 +163,32 @@ class FleetUtil:
         if not bases:
             raise FileNotFoundError(
                 f"no base model{f' for day {day}' if day else ''} in {self.root}")
-        base = bases[-1]
-        day = int(base["day"])
         with tempfile.TemporaryDirectory(prefix="pbtpu_fetch_") as tmp:
-            base_local = self._fetch_dir(base["path"], tmp, "base")
+            # newest base first; a base whose download fails (remote-FS
+            # outage surviving the CommandFS retry budget) is diagnosed
+            # and skipped — recovery falls back to the previous committed
+            # base + its delta replay rather than dying on the freshest
+            base, base_local, fetch_err = None, None, None
+            for i, cand in enumerate(reversed(bases)):
+                try:
+                    base_local = self._fetch_dir(cand["path"], tmp,
+                                                 f"base{i}")
+                    base = cand
+                    break
+                except RuntimeError as e:
+                    fetch_err = e
+                    monitor.counter_add("fleet.base_fetch_fallbacks")
+                    monitor.event("fleet_base_fetch_fallback",
+                                  path=cand["path"], error=str(e)[:300])
+                    warnings.warn(
+                        f"base model {cand['path']} failed to download "
+                        f"({e}); falling back to the previous donefile "
+                        f"entry")
+            if base is None:
+                raise RuntimeError(
+                    f"every base model donefile entry failed to download "
+                    f"from {self.root} (last: {fetch_err})")
+            day = int(base["day"])
             store = HostEmbeddingStore.load(os.path.join(base_local,
                                                          "sparse"))
             dense_file = os.path.join(base_local, "dense.npz")
@@ -162,7 +199,16 @@ class FleetUtil:
                     continue
                 if int(d["day"]) < day:
                     continue
-                d_local = self._fetch_dir(d["path"], tmp, f"d{i}")
+                try:
+                    d_local = self._fetch_dir(d["path"], tmp, f"d{i}")
+                except RuntimeError as e:
+                    # a delta is state, not discovery: skipping one would
+                    # silently serve a model missing a pass — fail with
+                    # the donefile identity in the diagnosis
+                    raise RuntimeError(
+                        f"delta model {d['path']} (day {d['day']} pass "
+                        f"{d['pass']}) failed to download during recovery "
+                        f"replay: {e}") from e
                 for f in sorted(glob.glob(os.path.join(d_local, "sparse",
                                                        "delta-*.npz"))):
                     store.apply_delta_file(f)
@@ -178,6 +224,7 @@ class FleetUtil:
         the root is remote."""
         if not self._remote:
             return path
+        faultpoint.hit("remote_ckpt.download.pre")
         local = os.path.join(tmp, tag)
         self._fs.get(path, local)
         return local

@@ -262,6 +262,13 @@ class Trainer:
         self._push_stager = PushOperandStager()
         self.push_applies = 0       # deferred applies dispatched (tests)
         self._overlap_ws = None
+        # mid-pass snapshot hook (enable_midpass_snapshots): (checkpointer,
+        # every_steps, box, metrics). midpass_cursor_extra carries
+        # driver-supplied cursor fields — notably the shuffle RNG state
+        # captured BEFORE the pass's permutation draw, so a mid-pass
+        # resume replays the identical pass order.
+        self._midpass: tuple | None = None
+        self.midpass_cursor_extra: dict = {}
         self.feed_mgr.register_pre_flush(self.flush_push)
         self._rebuild_steps()
         self._auc_fn = jax.jit(auc_lib.auc_update)
@@ -1068,8 +1075,8 @@ class Trainer:
         return multi_hot or wide
 
     def train_pass(self, dataset, metrics: Any = None,
-                   preload_keys: np.ndarray | None = None
-                   ) -> dict[str, float]:
+                   preload_keys: np.ndarray | None = None,
+                   skip_steps: int = 0) -> dict[str, float]:
         """One pass over the dataset (§3.1 hot loop + §3.4 lifecycle).
 
         `metrics`: optional MetricRegistry; every registered metric gets
@@ -1080,6 +1087,11 @@ class Trainer:
         feed thread WHILE this pass trains (the PreLoadIntoMemory +
         BeginFeedPass pairing, data_set.cc:1712 / box_wrapper.h:994) —
         the next ``train_pass`` consumes the staging at its boundary.
+        `skip_steps`: mid-pass crash recovery — the first `skip_steps`
+        batches of the pass are packed but NOT trained (their effects are
+        already in the restored state; the resume cursor's ``mid_steps``),
+        so the pass continues exactly where the killed run stopped.
+        Reported stats (steps/loss/auc) cover only the executed tail.
 
         Telemetry: runs inside the hub's pass scope (opened here when no
         BoxPS lifecycle already did) so every event/span — including ones
@@ -1094,7 +1106,8 @@ class Trainer:
         stage0 = self.timers.snapshot()
         applies0 = self.push_applies
         try:
-            out = self._train_pass_impl(dataset, metrics, preload_keys)
+            out = self._train_pass_impl(dataset, metrics, preload_keys,
+                                        skip_steps=skip_steps)
         except BaseException as e:
             if owned_pass:
                 hub.abort_pass(reason=repr(e))
@@ -1114,8 +1127,8 @@ class Trainer:
         return out
 
     def _train_pass_impl(self, dataset, metrics: Any = None,
-                         preload_keys: np.ndarray | None = None
-                         ) -> dict[str, float]:
+                         preload_keys: np.ndarray | None = None,
+                         skip_steps: int = 0) -> dict[str, float]:
         cfg = self.cfg
         ws = self.feed_mgr.begin_pass(dataset.unique_keys())
         self.feed_mgr.pass_opened()
@@ -1152,6 +1165,11 @@ class Trainer:
         use_super = (self._superstep_fn is not None and dstate is not None
                      and mode == "allreduce")
         k_sd = cfg.steps_per_dispatch if use_super else 1
+        if (skip_steps or self._midpass is not None) and k_sd > 1:
+            raise NotImplementedError(
+                "mid-pass resume/snapshots need steps_per_dispatch == 1 "
+                "(the cursor is per single-step program)")
+        skip_remaining = int(skip_steps)
         pack_it = self._pack_iter(dataset, ws, cfg.global_batch_size,
                                   group=k_sd)
         try:
@@ -1160,6 +1178,13 @@ class Trainer:
                     pbs, staged, stacked = item
                 else:
                     pbs, staged, stacked = [item[0]], item[1], False
+                if skip_remaining > 0:
+                    # mid-pass resume: this batch's effects already live in
+                    # the restored planes — consume it (keeps the batch
+                    # stream and step cadence aligned) but train nothing
+                    skip_remaining -= 1
+                    pass_step += 1
+                    continue
                 pb = pbs[-1]
                 mon_ctx.set_step(self.global_step)
                 with monitor.span("pack_batch"):
@@ -1283,6 +1308,11 @@ class Trainer:
                 dev_losses.append(loss)
                 dev_dropped.append(dropped)
                 self.global_step += len(pbs)
+                mp = self._midpass
+                if (mp is not None and mp[1] > 0
+                        and pass_step % mp[1] == 0):
+                    table = self._midpass_save(table, ws, dstate, params,
+                                               opt_state, pass_step)
         finally:
             # close the pack generator explicitly so its finally (cancel
             # event + producer join) runs NOW, not whenever GC finalizes
@@ -1640,6 +1670,63 @@ class Trainer:
         if opt_state is not None:
             self.opt_state = jax.device_put(opt_state, repl)
 
+    def enable_midpass_snapshots(self, checkpointer, every_steps: int,
+                                 box, metrics=None) -> None:
+        """Commit a crash-safe snapshot every ``every_steps`` steps INSIDE
+        each training pass (ISSUE 5 mid-pass resume). The snapshot's
+        cursor records the last COMPLETED pass, ``mid_steps`` (steps of
+        the open pass already trained), and the shuffle RNG state the
+        driver stashed in ``midpass_cursor_extra['shuffle_state']``
+        (captured BEFORE the pass's permutation draw) — so a kill between
+        pass boundaries resumes via ``train_pass(skip_steps=mid_steps)``
+        from the dataset cursor instead of replaying the pass. Allreduce
+        dense sync with ``steps_per_dispatch == 1`` only: the snapshot
+        needs the live dense planes off the single-step loop."""
+        if every_steps <= 0:
+            self._midpass = None
+            return
+        if self.cfg.dense_sync_mode != "allreduce" \
+                or self.cfg.steps_per_dispatch != 1:
+            raise NotImplementedError(
+                "mid-pass snapshots need dense_sync_mode='allreduce' and "
+                "steps_per_dispatch=1")
+        if box is None:
+            raise ValueError("enable_midpass_snapshots needs a BoxPS "
+                             "(the cursor's pass identity)")
+        self._midpass = (checkpointer, int(every_steps), box, metrics)
+
+    def _midpass_save(self, table, ws, dstate, params, opt_state,
+                      pass_step: int):
+        """Commit a MID-pass snapshot: land the pending deferred push,
+        mark + flush the device tier, and save with the LIVE dense planes
+        (the loop's dstate/params — ``trainer.params`` still holds the
+        pass-start values mid-pass). The feed manager's in-pass guard is
+        lifted only around the save: at this instruction the loop owns a
+        quiescent table (no step dispatched past it), so the D2H gather
+        reads a live buffer."""
+        from paddlebox_tpu.utils import faultpoint
+        ckpt, _every, box, metrics = self._midpass
+        table = self._dispatch_pending_apply(table)
+        ws.table = table
+        dense = (self.unpack_dense(dstate) if dstate is not None
+                 else (params, opt_state))
+        self.feed_mgr.pass_closed()
+        try:
+            # mark this pass's touched rows unsynced so the checkpointer's
+            # flush_sparse materializes them (no data moves here)
+            self.feed_mgr.end_pass(ws, table)
+            ckpt.save(
+                self, box=box,
+                metrics=(metrics if metrics is not None else box.metrics),
+                pass_id=int(box.pass_id) - 1, mid_steps=int(pass_step),
+                dense_override=dense,
+                shuffle_state=self.midpass_cursor_extra.get(
+                    "shuffle_state"))
+        finally:
+            self.feed_mgr.pass_opened()
+        faultpoint.hit("trainer.midpass.post_save")
+        return table
+
     def save_checkpoint(self, checkpointer, box=None, metrics=None,
                         pass_id: int | None = None) -> str:
         """Snapshot the complete post-pass state (dense + optimizer +
@@ -1650,7 +1737,8 @@ class Trainer:
         return checkpointer.save(self, box=box, metrics=metrics,
                                  pass_id=pass_id)
 
-    def resume(self, checkpointer, box=None, metrics=None) -> dict | None:
+    def resume(self, checkpointer, box=None, metrics=None,
+               collectives=None) -> dict | None:
         """Crash recovery: restore every plane from the newest snapshot
         whose manifest chain verifies (base + ordered deltas checksum-
         clean, tombstone-consistent replay via ``store.restore``), falling
@@ -1660,9 +1748,23 @@ class Trainer:
         invalidated via the store's mutation counter), the dense
         params/optimizer state mode-aware (``restore_dense``), the metric
         registry + phase bit, and the pass/step cursor. Returns the cursor
-        dict ({pass_id, global_step, date, phase}) — the driver re-enters
-        its pass loop at ``cursor["pass_id"] + 1`` — or None when there is
-        nothing to resume (fresh start)."""
+        dict ({pass_id, global_step, date, phase, mid_steps,
+        shuffle_state}) — the driver re-enters its pass loop at
+        ``cursor["pass_id"] + 1`` (with ``skip_steps=mid_steps`` when
+        resuming mid-pass) — or None when there is nothing to resume
+        (fresh start).
+
+        ``collectives`` (a HostCollectives with world > 1) switches to the
+        COORDINATED multi-host path: every rank publishes its intact
+        snapshot cursors, the world elects the highest cursor every rank
+        holds intact, barriers, and all ranks restore that same snapshot
+        (distributed/resilience.coordinated_resume) — a torn newest
+        snapshot on one rank rolls the whole world back together instead
+        of diverging it."""
+        if collectives is not None and collectives.world > 1:
+            from paddlebox_tpu.distributed import resilience
+            return resilience.coordinated_resume(
+                checkpointer, self, collectives, box=box, metrics=metrics)
         return checkpointer.resume(self, box=box, metrics=metrics)
 
     def eval_pass(self, dataset) -> dict[str, float]:

@@ -58,6 +58,17 @@ POINTS: tuple[str, ...] = (
     # utils/pass_ckpt.save: manifest committed — resume must land on THIS
     # snapshot.
     "pass_ckpt.post_manifest",
+    # train/trainer._midpass_save: a MID-pass snapshot just committed —
+    # dying here must resume from the dataset/shuffle cursor (skip the
+    # already-trained steps), not replay the pass from its start.
+    "trainer.midpass.post_save",
+    # remote (hdfs://) checkpoint roots: local snapshot committed, upload
+    # not yet run — the remote donefile must still name only fully
+    # uploaded snapshots (pass_ckpt remote mirror + FleetUtil._save_dir).
+    "remote_ckpt.upload.pre",
+    # remote restore: about to download a snapshot/model dir — dying here
+    # must leave the next resume able to re-download from the donefile.
+    "remote_ckpt.download.pre",
 )
 
 

@@ -15,7 +15,11 @@ a pass touches —
   length and lets retention reclaim old chains),
 - metric/AUC registry state and the join/update phase bit,
 - the pass/step cursor (``BoxPS.pass_id``, ``date``,
-  ``Trainer.global_step``),
+  ``Trainer.global_step``) — plus, since ISSUE 5, the **dataset/shuffle
+  cursor**: ``mid_steps`` (steps already trained inside an open pass) and
+  the shuffle RNG state (``SlotDataset.shuffle_state``), so a kill
+  mid-pass resumes deterministically from the cursor instead of replaying
+  the pass,
 
 after first flushing the device tier (pending deferred push applies +
 lazily-retained rows — ``Trainer.flush_sparse``), so the snapshot is the
@@ -28,16 +32,30 @@ files — is written LAST. A snapshot without a committed manifest never
 happened; one whose checksums no longer verify is diagnosed and skipped.
 ``resume`` therefore walks snapshots newest-first and restores the first
 one that fully verifies, falling back past a torn/truncated newest
-snapshot automatically. ``keep_last_n`` prunes old snapshots (and any
-sparse chain directory no surviving snapshot references) after each
-successful save.
+snapshot automatically — or restores exactly the cursor a multi-host
+resume ELECTION agreed on (``resume(at=...)``,
+distributed/resilience.coordinated_resume), discarding any newer local
+snapshots from the abandoned timeline. ``keep_last_n`` prunes old
+snapshots (and any sparse chain directory no surviving snapshot
+references) after each successful save.
+
+Remote (``hdfs://``/``afs://``/…) roots: construct with a remote URI and
+the checkpointer stages locally — the full atomic local commit runs
+first, then the snapshot dir + new chain members upload over the
+registered CommandFS (riding its retry/backoff), and a line lands in
+``snapshots.donefile`` only AFTER the upload, so a torn upload is never
+discoverable. Resume with an empty local staging dir reads the donefile
+newest-first, downloads to a temp dir, verifies, and falls back past any
+entry that fails to download or verify (with a diagnostic event).
 """
 
 from __future__ import annotations
 
+import json
 import os
 import re
 import shutil
+import tempfile
 import time
 import warnings
 from typing import Any
@@ -46,11 +64,14 @@ from paddlebox_tpu import monitor
 from paddlebox_tpu.config import flags as config_flags
 from paddlebox_tpu.utils import checkpoint as ckpt_lib
 from paddlebox_tpu.utils import faultpoint
+from paddlebox_tpu.utils import fs as fs_lib
 from paddlebox_tpu.utils import profiler
 from paddlebox_tpu.utils.checkpoint import CheckpointCorruptError
 
-_PASS_RE = re.compile(r"^pass-(\d+)$")
+_PASS_RE = re.compile(r"^pass-(\d+)(?:\.mid(\d+))?$")
 _CHAIN_RE = re.compile(r"^chain-(\d+)$")
+
+REMOTE_DONEFILE = "snapshots.donefile"
 
 
 def _dense_tree(trainer) -> dict[str, Any]:
@@ -64,11 +85,23 @@ def _metric_tree(metrics) -> dict[str, Any]:
 class PassCheckpointer:
     """Owns one snapshot root. One instance per training job; the driver
     calls :meth:`save` at every pass boundary (directly or through
-    ``BoxPS.end_pass``) and :meth:`resume` once at startup."""
+    ``BoxPS.end_pass``) and :meth:`resume` once at startup.
+
+    ``root`` may be a remote URI (any scheme registered with utils/fs.py);
+    snapshots then stage under ``staging_dir`` (a fresh temp dir by
+    default — the remote root is authoritative across host loss) and
+    mirror up after each local commit."""
 
     def __init__(self, root: str, keep_last_n: int | None = None,
-                 base_every: int | None = None):
-        self.root = root
+                 base_every: int | None = None,
+                 staging_dir: str | None = None):
+        if fs_lib.is_remote(root):
+            self.remote_root: str | None = root.rstrip("/")
+            self.root = staging_dir or tempfile.mkdtemp(
+                prefix="pbtpu_ckpt_stage_")
+        else:
+            self.remote_root = None
+            self.root = root
         self.keep_last_n = (config_flags.ckpt_keep_last_n
                             if keep_last_n is None else int(keep_last_n))
         if self.keep_last_n < 2:
@@ -76,10 +109,15 @@ class PassCheckpointer:
             raise ValueError("keep_last_n must be >= 2 for crash safety")
         self.base_every = (config_flags.ckpt_base_every
                            if base_every is None else int(base_every))
-        os.makedirs(root, exist_ok=True)
+        os.makedirs(self.root, exist_ok=True)
         self._chain_gen = 0
         self._chain_dir: str | None = None
         self._deltas_in_chain = 0
+        # chains whose FULL directory this process already mirrored up; a
+        # chain continued across a restart re-uploads whole once, then
+        # rides the incremental per-delta path
+        self._uploaded_chains: set[str] = set()
+        self._remote_synced = False
         # store.save_count as of OUR last save/resume: any foreign
         # save_base/save_delta in between (e.g. FleetUtil donefile models
         # sharing the store) consumed the dirty mask + tombstones, so the
@@ -91,29 +129,51 @@ class PassCheckpointer:
 
     # ---- paths -----------------------------------------------------------
 
-    def snap_dir(self, pass_id: int) -> str:
-        return os.path.join(self.root, f"pass-{pass_id:05d}")
+    def snap_name(self, pass_id: int, mid_steps: int = 0) -> str:
+        """``pass-PPPPP`` for a pass-boundary snapshot; a mid-pass one is
+        ``pass-PPPPP.midSSSSS`` — pass_id is the last COMPLETED pass and
+        mid_steps the steps already trained into the next. Lexicographic
+        name order == (pass_id, mid_steps) cursor order."""
+        name = f"pass-{pass_id:05d}"
+        if mid_steps:
+            name += f".mid{mid_steps:05d}"
+        return name
+
+    def snap_dir(self, pass_id: int, mid_steps: int = 0) -> str:
+        return os.path.join(self.root, self.snap_name(pass_id, mid_steps))
 
     def _chain_path(self, name: str) -> str:
         return os.path.join(self.root, name)
 
-    def _list_snaps(self) -> list[tuple[int, str]]:
+    def _list_snaps(self) -> list[tuple[int, int, str]]:
+        """[(pass_id, mid_steps, path)] sorted ascending by cursor."""
         out = []
         for n in os.listdir(self.root):
             m = _PASS_RE.match(n)
             if m and os.path.isdir(os.path.join(self.root, n)):
-                out.append((int(m.group(1)), os.path.join(self.root, n)))
+                out.append((int(m.group(1)), int(m.group(2) or 0),
+                            os.path.join(self.root, n)))
         return sorted(out)
 
     # ---- save ------------------------------------------------------------
 
     def save(self, trainer, box=None, metrics=None,
-             pass_id: int | None = None) -> str:
+             pass_id: int | None = None, mid_steps: int = 0,
+             dense_override: tuple | None = None,
+             shuffle_state: dict | None = None) -> str:
         """Snapshot the complete post-pass state. Returns the snapshot dir.
 
         Members land atomically in dependency order (sparse chain → dense
         → metrics), manifest last — a kill anywhere before the manifest
         commit leaves this snapshot invisible and the previous one intact.
+
+        ``mid_steps`` > 0 marks a MID-pass snapshot: ``pass_id`` is then
+        the last completed pass and the cursor records how many steps of
+        the open pass are already trained (the trainer's midpass hook
+        passes the live dense planes via ``dense_override`` — mid-pass,
+        ``trainer.params`` still holds the pass-start values).
+        ``shuffle_state`` rides the cursor so the resumed rank replays the
+        identical pass order (SlotDataset.shuffle_state).
         """
         t_save0 = time.perf_counter()
         if pass_id is None:
@@ -161,11 +221,16 @@ class PassCheckpointer:
                          + [f"delta-{i:05d}.npz"
                             for i in range(1, save_seq + 1)])}
 
-        snap = self.snap_dir(pass_id)
+        snap = self.snap_dir(pass_id, mid_steps)
         os.makedirs(snap, exist_ok=True)
         files: dict[str, dict] = {}
         dense_f = os.path.join(snap, "dense.npz")
-        ckpt_lib.save_pytree(_dense_tree(trainer), dense_f)
+        if dense_override is not None:
+            dense_tree = {"params": dense_override[0],
+                          "opt_state": dense_override[1]}
+        else:
+            dense_tree = _dense_tree(trainer)
+        ckpt_lib.save_pytree(dense_tree, dense_f)
         files["dense.npz"] = ckpt_lib.file_entry(dense_f)
         if metrics is not None and metrics.names():
             met_f = os.path.join(snap, "metrics.npz")
@@ -177,13 +242,18 @@ class PassCheckpointer:
             "global_step": int(trainer.global_step),
             "date": None if box is None else box.date,
             "phase": None if metrics is None else int(metrics.phase),
+            "mid_steps": int(mid_steps),
+            "shuffle_state": shuffle_state,
         }
+        if mid_steps:
+            parent = self.snap_name(pass_id)          # the completed pass
+        else:
+            parent = (self.snap_name(pass_id - 1) if pass_id > 1 else None)
         faultpoint.hit("pass_ckpt.pre_manifest")
         ckpt_lib.write_manifest(
             snap, files, cursor=cursor, save_seq=save_seq,
             chain_dir=chain_name, chain_files=chain_files,
-            parent_snapshot=(f"pass-{pass_id - 1:05d}"
-                             if pass_id > 1 else None))
+            parent_snapshot=parent)
         faultpoint.hit("pass_ckpt.post_manifest")
         # checkpoint lifecycle telemetry: duration + bytes per save, plus
         # a chrome-trace instant so the timeline reads commit points
@@ -197,14 +267,161 @@ class PassCheckpointer:
         monitor.counter_add("ckpt.bytes", nbytes)
         if rotate:
             monitor.counter_add("ckpt.base_rotations")
+        if mid_steps:
+            monitor.counter_add("ckpt.midpass_saves")
         monitor.event("checkpoint_save", type="lifecycle",
                       snapshot=os.path.basename(snap), seconds=seconds,
                       bytes=int(nbytes), rotated=bool(rotate),
-                      chain=chain_name, save_seq=int(save_seq))
+                      chain=chain_name, save_seq=int(save_seq),
+                      mid_steps=int(mid_steps))
         profiler.record_instant("checkpoint_commit",
                                 {"snapshot": os.path.basename(snap)})
+        if self.remote_root is not None:
+            self._upload(snap, chain_name, rotate, save_seq, cursor)
         self._prune()
         return snap
+
+    # ---- remote mirror ---------------------------------------------------
+
+    def _remote_fs(self):
+        fs, _ = fs_lib.resolve(self.remote_root)
+        return fs
+
+    def _upload(self, snap: str, chain_name: str, rotated: bool,
+                save_seq: int, cursor: dict) -> None:
+        """Mirror the just-committed snapshot to the remote root. Donefile
+        line lands ONLY after every byte uploaded — a kill anywhere in
+        here leaves the remote donefile naming only complete uploads (the
+        local commit already happened, so a same-host restart loses
+        nothing either)."""
+        t0 = time.perf_counter()
+        faultpoint.hit("remote_ckpt.upload.pre")
+        fs = self._remote_fs()
+        rroot = self.remote_root
+        snap_name = os.path.basename(snap)
+        local_chain = self._chain_path(chain_name)
+        remote_chain = f"{rroot}/{chain_name}"
+        try:
+            fs.makedirs(rroot)
+            if rotated or chain_name not in self._uploaded_chains:
+                # whole-chain upload: fresh rotation, or a chain continued
+                # across a process restart (unknown remote contents —
+                # replace)
+                fs.rm(remote_chain)
+                fs.put(local_chain, remote_chain)
+            else:
+                # incremental: only the new delta + the refreshed chain
+                # manifest/meta cross the wire
+                for name in (f"delta-{save_seq:05d}.npz", "meta.json",
+                             ckpt_lib.MANIFEST_NAME):
+                    fs.put(os.path.join(local_chain, name),
+                           f"{remote_chain}/{name}")
+            self._uploaded_chains.add(chain_name)
+            # a leftover target (torn upload / re-save after an elected
+            # rollback) must go first: `put` into an EXISTING dir nests
+            # the source
+            fs.rm(f"{rroot}/{snap_name}")
+            fs.put(snap, f"{rroot}/{snap_name}")
+        except BaseException:
+            # a half-uploaded chain must not ride the incremental path on
+            # the next save — force a full re-upload (download-side CRC
+            # verification is the backstop, this is the repair)
+            self._uploaded_chains.discard(chain_name)
+            raise
+        line = json.dumps({"pass": int(cursor["pass_id"]),
+                           "mid": int(cursor["mid_steps"]),
+                           "snapshot": snap_name, "chain": chain_name,
+                           "save_seq": int(save_seq),
+                           "ts": int(time.time())})
+        fs.write_text(f"{rroot}/{REMOTE_DONEFILE}", line + "\n",
+                      append=True)
+        seconds = time.perf_counter() - t0
+        monitor.counter_add("ckpt.remote_uploads")
+        monitor.counter_add("ckpt.remote_upload_seconds", seconds)
+        monitor.event("checkpoint_remote_upload", type="lifecycle",
+                      snapshot=snap_name, chain=chain_name,
+                      seconds=seconds)
+
+    def _remote_entries(self) -> list[dict]:
+        """Donefile entries in append order, with ``reset_after`` lines
+        applied: an elected rollback masks the abandoned timeline's newer
+        entries so a later restore can never resurrect them."""
+        fs = self._remote_fs()
+        path = f"{self.remote_root}/{REMOTE_DONEFILE}"
+        if not fs.exists(path):
+            return []
+        out: list[dict] = []
+        for raw in fs.read_lines(path):
+            raw = raw.strip()
+            if not raw:
+                continue
+            e = json.loads(raw)
+            if "reset_after" in e:
+                ra = tuple(e["reset_after"])
+                out = [x for x in out
+                       if (int(x["pass"]), int(x.get("mid", 0))) <= ra]
+            else:
+                out.append(e)
+        return out
+
+    def _sync_from_remote(self) -> bool:
+        """Populate the local staging root from the remote donefile:
+        download up to ``keep_last_n`` entries (newest first — chains
+        shared between entries cross the wire once) to a temp dir, land
+        them locally, verify each; fall back past entries that fail to
+        download or verify, with a diagnostic. Returns True when at least
+        one verified snapshot landed.
+
+        Multiple entries matter for the multi-host election: a
+        replacement host that synced only the newest cursor would publish
+        a single candidate, and any surviving rank missing exactly that
+        cursor would collapse the intersection — and the whole world —
+        to a fresh start even though an older COMMON cursor sits one
+        donefile entry back."""
+        self._remote_synced = True
+        try:
+            entries = self._remote_entries()
+        except (RuntimeError, ValueError, OSError) as e:
+            warnings.warn(f"remote snapshot donefile unreadable ({e}); "
+                          f"starting fresh")
+            return False
+        fs = self._remote_fs()
+        landed = 0
+        got_chains: set[str] = set()
+        for e in reversed(entries):
+            if landed >= self.keep_last_n:
+                break
+            snap_name, chain_name = e["snapshot"], e["chain"]
+            try:
+                faultpoint.hit("remote_ckpt.download.pre")
+                names = [chain_name] if chain_name not in got_chains \
+                    else []
+                names.append(snap_name)
+                with tempfile.TemporaryDirectory(dir=self.root) as tmp:
+                    for name in names:
+                        fs.get(f"{self.remote_root}/{name}",
+                               os.path.join(tmp, name))
+                    for name in names:
+                        dst = os.path.join(self.root, name)
+                        shutil.rmtree(dst, ignore_errors=True)
+                        os.replace(os.path.join(tmp, name), dst)
+                self._verify_snapshot(os.path.join(self.root, snap_name))
+            except (RuntimeError, OSError, CheckpointCorruptError) as err:
+                monitor.counter_add("ckpt.remote_fallbacks")
+                monitor.event("checkpoint_remote_fallback",
+                              type="lifecycle", snapshot=snap_name,
+                              error=str(err)[:300])
+                warnings.warn(
+                    f"remote snapshot {snap_name} failed to restore "
+                    f"({err}); falling back to the previous donefile "
+                    f"entry")
+                continue
+            got_chains.add(chain_name)
+            landed += 1
+            monitor.counter_add("ckpt.remote_downloads")
+            monitor.event("checkpoint_remote_download", type="lifecycle",
+                          snapshot=snap_name, chain=chain_name)
+        return landed > 0
 
     # ---- discovery / verification ---------------------------------------
 
@@ -241,37 +458,93 @@ class PassCheckpointer:
                 f"{e}") from e
         return manifest
 
+    def intact_cursors(self) -> list[tuple[int, int]]:
+        """Every locally intact snapshot's ``(pass_id, mid_steps)``,
+        ascending — the candidate list this rank publishes into the
+        multi-host resume election. An empty local staging dir with a
+        remote root syncs the newest remote entry down first, so a
+        replacement host joins the election with what the donefile can
+        actually deliver."""
+        out = []
+        for pass_id, mid, snap in self._list_snaps():
+            try:
+                self._verify_snapshot(snap)
+                out.append((pass_id, mid))
+            except CheckpointCorruptError:
+                continue
+        if not out and self.remote_root is not None \
+                and not self._remote_synced:
+            if self._sync_from_remote():
+                return self.intact_cursors()
+        return out
+
     def latest_valid(self) -> tuple[int, str, dict] | None:
         """Newest snapshot that fully verifies, walking past torn ones
-        (with a warning naming the diagnosis). None = nothing to resume."""
-        for pass_id, snap in reversed(self._list_snaps()):
-            try:
-                return pass_id, snap, self._verify_snapshot(snap)
-            except CheckpointCorruptError as e:
-                # flaky-storage observability: a torn snapshot shows up in
-                # the flight record / exposition, not only in this warning
-                monitor.counter_add("ckpt.torn_fallbacks")
-                monitor.event("checkpoint_torn_fallback", type="lifecycle",
-                              snapshot=os.path.basename(snap),
-                              error=str(e)[:300])
-                warnings.warn(
-                    f"snapshot {snap} failed verification ({e}); falling "
-                    f"back to the previous one")
+        (with a warning naming the diagnosis). None = nothing to resume.
+        Returns (pass_id, snap_dir, manifest) — a mid-pass snapshot's
+        mid_steps rides manifest["cursor"]."""
+        for _attempt in (0, 1):
+            for pass_id, mid, snap in reversed(self._list_snaps()):
+                try:
+                    return pass_id, snap, self._verify_snapshot(snap)
+                except CheckpointCorruptError as e:
+                    # flaky-storage observability: a torn snapshot shows
+                    # up in the flight record / exposition, not only in
+                    # this warning
+                    monitor.counter_add("ckpt.torn_fallbacks")
+                    monitor.event("checkpoint_torn_fallback",
+                                  type="lifecycle",
+                                  snapshot=os.path.basename(snap),
+                                  error=str(e)[:300])
+                    warnings.warn(
+                        f"snapshot {snap} failed verification ({e}); "
+                        f"falling back to the previous one")
+            # nothing locally intact (none, or all torn): a remote root
+            # may still deliver — sync once and re-walk
+            if _attempt == 0 and self.remote_root is not None \
+                    and not self._remote_synced:
+                if not self._sync_from_remote():
+                    break
+            else:
+                break
         return None
 
     # ---- resume ----------------------------------------------------------
 
-    def resume(self, trainer, box=None, metrics=None) -> dict | None:
+    def resume(self, trainer, box=None, metrics=None,
+               at: tuple[int, int] | None = None) -> dict | None:
         """Restore every plane from the newest valid snapshot; return its
-        cursor dict ({pass_id, global_step, date, phase}), or None when no
-        valid snapshot exists (fresh start). The driver re-enters its pass
-        loop at ``cursor['pass_id'] + 1``."""
+        cursor dict ({pass_id, global_step, date, phase, mid_steps,
+        shuffle_state}), or None when no valid snapshot exists (fresh
+        start). The driver re-enters its pass loop at
+        ``cursor['pass_id'] + 1`` (skipping the first ``mid_steps`` steps
+        of that pass when resuming mid-pass).
+
+        ``at=(pass_id, mid_steps)`` restores EXACTLY that snapshot — the
+        multi-host election's contract: every rank lands on the agreed
+        cursor, and any newer local snapshots (an abandoned timeline the
+        world did not elect) are discarded so they can never resurface.
+        Raises if the elected snapshot is missing or torn (the rank
+        claimed it intact in the election)."""
         t_res0 = time.perf_counter()
-        found = self.latest_valid()
-        if found is None:
-            return None
-        pass_id, snap, manifest = found
+        if at is not None:
+            at = (int(at[0]), int(at[1]))
+            snap = self.snap_dir(*at)
+            try:
+                manifest = self._verify_snapshot(snap)
+            except CheckpointCorruptError as e:
+                raise RuntimeError(
+                    f"elected snapshot {self.snap_name(*at)} no longer "
+                    f"verifies on this rank: {e}") from e
+            pass_id = at[0]
+        else:
+            found = self.latest_valid()
+            if found is None:
+                return None
+            pass_id, snap, manifest = found
         cursor = dict(manifest["cursor"])
+        cursor.setdefault("mid_steps", 0)
+        cursor.setdefault("shuffle_state", None)
         chain_name = manifest["chain_dir"]
         seq = int(manifest["save_seq"])
 
@@ -302,6 +575,9 @@ class PassCheckpointer:
             if cursor.get("date") is not None:
                 box.date = int(cursor["date"])
 
+        if at is not None:
+            self._discard_newer_than(at)
+
         # continue the chain where the snapshot left it: the next save
         # deltas into the same chain dir (store._save_seq was set by
         # restore; stale higher-numbered deltas from the crashed run get
@@ -320,19 +596,67 @@ class PassCheckpointer:
         monitor.event("checkpoint_resume", type="lifecycle",
                       snapshot=os.path.basename(snap), seconds=seconds,
                       resumed_pass=int(cursor["pass_id"]),
-                      chain=chain_name, save_seq=seq)
+                      mid_steps=int(cursor["mid_steps"]),
+                      chain=chain_name, save_seq=seq, elected=at is not None)
         return cursor
+
+    def discard_all_snapshots(self) -> None:
+        """Remove every local snapshot (and mask all remote donefile
+        entries with a reset line). The fresh-start arm of the multi-host
+        election: a world whose intersection is empty retrains from pass
+        1, and a stale pass-N snapshot surviving on one rank could alias
+        a freshly-retrained pass-N on another at the NEXT election —
+        silent divergence. (-1, 0) sorts below every real cursor."""
+        self._discard_newer_than((-1, 0))
+
+    def _discard_newer_than(self, at: tuple[int, int]) -> None:
+        """Remove local snapshots newer than the elected cursor — they
+        belong to a timeline the world abandoned and must never win a
+        later newest-first walk — and mask them in the remote donefile
+        with a ``reset_after`` line (their dirs get overwritten as the
+        re-run reaches those passes again)."""
+        dropped = [(p, m, s) for p, m, s in self._list_snaps()
+                   if (p, m) > at]
+        for p, m, s in dropped:
+            shutil.rmtree(s, ignore_errors=True)
+        if dropped:
+            monitor.event("checkpoint_timeline_reset", type="lifecycle",
+                          elected=list(at),
+                          dropped=[os.path.basename(s)
+                                   for _, _, s in dropped])
+        if self.remote_root is not None:
+            try:
+                fs = self._remote_fs()
+                if fs.exists(f"{self.remote_root}/{REMOTE_DONEFILE}"):
+                    line = json.dumps({"reset_after": list(at),
+                                       "ts": int(time.time())})
+                    fs.write_text(
+                        f"{self.remote_root}/{REMOTE_DONEFILE}",
+                        line + "\n", append=True)
+            except RuntimeError as e:
+                # the election already agreed; a masked donefile is an
+                # optimization of later restores, not a correctness gate
+                warnings.warn(f"remote donefile reset failed ({e})")
 
     # ---- retention -------------------------------------------------------
 
     def _prune(self) -> None:
         """Drop snapshots beyond keep_last_n, then chain dirs no surviving
-        snapshot references. Never touches the open chain."""
+        snapshot references. Never touches the open chain.
+
+        Pass-boundary and mid-pass snapshots retain in SEPARATE pools
+        (keep_last_n each): ranks mid-pass-snapshot on their own step
+        cadence, so letting a fast rank's mids evict its pass-boundary
+        snapshots would strip the cursors the ranks still hold in COMMON
+        and collapse the next election to a fresh start."""
         snaps = self._list_snaps()
-        for _, snap in snaps[:-self.keep_last_n]:
+        fulls = [s for s in snaps if s[1] == 0]
+        mids = [s for s in snaps if s[1] > 0]
+        for _, _, snap in (fulls[:-self.keep_last_n]
+                           + mids[:-self.keep_last_n]):
             shutil.rmtree(snap, ignore_errors=True)
         referenced = {self._chain_dir}
-        for _, snap in self._list_snaps():
+        for _, _, snap in self._list_snaps():
             try:
                 m = ckpt_lib.read_manifest(snap)
             except CheckpointCorruptError:

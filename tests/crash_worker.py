@@ -8,11 +8,27 @@ bitwise comparison. Fault points are armed purely through the environment
 same invocation serves as the golden run, the killed run, and the
 resuming re-run.
 
+ISSUE 5 extensions (all env-driven so the golden run's MATH never
+changes — snapshot cadence and mirroring are read-only side effects):
+
+- every pass reshuffles the dataset through the persistent shuffle RNG
+  (base order rebound each pass, so each pass's order depends only on the
+  RNG state at its start — the checkpointable dataset cursor);
+- ``PBTPU_CRASH_MIDPASS=<k>`` commits a MID-pass snapshot every k steps
+  (Trainer.enable_midpass_snapshots) and a resumed run honors the
+  cursor's ``mid_steps``/``shuffle_state`` via train_pass(skip_steps=…);
+- ``PBTPU_CRASH_REMOTE=<uri>`` points the checkpointer at a remote
+  (mock-hdfs CommandFS) root: local atomic commit → upload → donefile;
+  ``PBTPU_CRASH_WIPE_LOCAL=1`` additionally empties the local staging
+  root at startup (simulating resume on a REPLACEMENT host, which must
+  download from the donefile).
+
 Usage: python tests/crash_worker.py ROOT OUT_NPZ [--passes N]
 """
 
 import argparse
 import os
+import shutil
 import sys
 
 os.environ.setdefault("JAX_PLATFORMS", "cpu")
@@ -20,6 +36,9 @@ os.environ.setdefault("JAX_PLATFORMS", "cpu")
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 if REPO not in sys.path:
     sys.path.insert(0, REPO)
+TESTS = os.path.join(REPO, "tests")
+if TESTS not in sys.path:
+    sys.path.insert(0, TESTS)
 
 import jax  # noqa: E402
 
@@ -27,6 +46,7 @@ jax.config.update("jax_platforms", "cpu")
 
 import numpy as np  # noqa: E402
 
+import mockfs  # noqa: E402
 from paddlebox_tpu.data import DataFeedSchema, SlotDataset  # noqa: E402
 from paddlebox_tpu.data.parser import parse_multislot_lines  # noqa: E402
 from paddlebox_tpu.embedding import (EmbeddingConfig,  # noqa: E402
@@ -70,9 +90,20 @@ def main() -> None:
     ap.add_argument("root")
     ap.add_argument("out")
     ap.add_argument("--passes", type=int, default=3)
+    ap.add_argument("--seed", type=int, default=11,
+                    help="dataset seed (multi-host workers shard by rank)")
     args = ap.parse_args()
 
-    ds, schema = synth()
+    mockfs.register_from_env()         # hdfs:// roots in the kill matrix
+    remote = os.environ.get("PBTPU_CRASH_REMOTE", "")
+    midpass = int(os.environ.get("PBTPU_CRASH_MIDPASS", "0"))
+    if os.environ.get("PBTPU_CRASH_WIPE_LOCAL", "") == "1":
+        # replacement-host model: the local staging root is gone; only
+        # the remote donefile can deliver the resume
+        shutil.rmtree(args.root, ignore_errors=True)
+
+    ds, schema = synth(seed=args.seed)
+    base = ds.records                  # pristine order; reshuffled per pass
     store = HostEmbeddingStore(EmbeddingConfig(dim=4, learning_rate=0.05))
     mesh = make_mesh(1)
     tr = Trainer(DNNCTRModel(num_slots=NUM_SLOTS, emb_dim=4, dense_dim=1,
@@ -84,16 +115,35 @@ def main() -> None:
     box = BoxPS(store)
     box.set_date(20260801)
     box.init_metric("job_auc", n_buckets=128)
-    ckpt = PassCheckpointer(args.root, keep_last_n=2, base_every=2)
+    if remote:
+        ckpt = PassCheckpointer(remote, keep_last_n=4, base_every=2,
+                                staging_dir=args.root)
+    else:
+        ckpt = PassCheckpointer(args.root, keep_last_n=4, base_every=2)
+    if midpass > 0:
+        tr.enable_midpass_snapshots(ckpt, midpass, box,
+                                    metrics=box.metrics)
 
     cursor = tr.resume(ckpt, box=box)
+    skip = 0
+    if cursor is not None:
+        if cursor.get("shuffle_state"):
+            ds.set_shuffle_state(cursor["shuffle_state"])
+        skip = int(cursor.get("mid_steps") or 0)
     start = (int(cursor["pass_id"]) if cursor is not None else 0) + 1
-    print(f"worker: resume cursor={cursor} -> starting at pass {start}",
-          flush=True)
-    for _ in range(start, args.passes + 1):
+    print(f"worker: resume cursor={None if cursor is None else {k: cursor[k] for k in ('pass_id', 'global_step', 'mid_steps')}} "
+          f"-> starting at pass {start} (skip {skip})", flush=True)
+    for p in range(start, args.passes + 1):
+        # each pass's order = one permutation of the pristine base, drawn
+        # from the persistent RNG — so the state BEFORE the draw (stashed
+        # in the mid-pass cursor) fully determines the pass order
+        tr.midpass_cursor_extra = {"shuffle_state": ds.shuffle_state()}
+        ds.records = base
+        ds.local_shuffle()
         box.begin_pass()
-        tr.train_pass(ds, metrics=box.metrics)
-        box.end_pass(checkpointer=ckpt, trainer=tr)
+        tr.train_pass(ds, metrics=box.metrics,
+                      skip_steps=(skip if p == start else 0))
+        box.end_pass(checkpointer=ckpt, trainer=tr, dataset=ds)
 
     # final-state dump for bitwise comparison
     tr.flush_sparse()

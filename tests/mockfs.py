@@ -1,0 +1,108 @@
+"""Shared mock remote filesystem for tests and crash workers.
+
+A tiny argv-based CLI maps ``<scheme>://…`` URIs onto a sandbox directory
+— the same contract a real ``hadoop fs``/``gsutil`` deployment fills in
+production (InitAfsAPI, box_wrapper.h:577). ``register_mockfs`` builds the
+CommandFS and registers it for a scheme; crash workers do the same from
+environment variables (PBTPU_MOCKFS_ROOT/PBTPU_MOCKFS_SCHEME) so a
+subprocess kill→resume matrix can exercise hdfs://-schemed checkpoint
+roots end-to-end.
+"""
+
+import os
+import sys
+import textwrap
+
+from paddlebox_tpu.utils import fs as fs_lib
+
+MOCK_CLI = textwrap.dedent("""
+    import os, shutil, sys
+    ROOT = os.environ["MOCKFS_ROOT"]
+    SCHEME = os.environ.get("MOCKFS_SCHEME", "mock")
+
+    def local(p):
+        pre = SCHEME + "://"
+        assert p.startswith(pre), p
+        return os.path.join(ROOT, p[len(pre):])
+
+    op = sys.argv[1]
+    if op == "cat":
+        with open(local(sys.argv[2]), "rb") as f:
+            sys.stdout.buffer.write(f.read())
+    elif op == "ls":
+        d = local(sys.argv[2])
+        for n in sorted(os.listdir(d)):
+            print(sys.argv[2].rstrip("/") + "/" + n)
+    elif op == "put":
+        # hadoop-faithful: put INTO an existing directory nests the source
+        # under it (this is the semantics FleetUtil._save_dir must survive)
+        src, dst = sys.argv[2], local(sys.argv[3])
+        if os.environ.get("MOCKFS_FAIL_PUT_DIR") and os.path.isdir(src):
+            # injected outage for directory uploads (checkpoint dirs) —
+            # file puts (donefile lines) still succeed, so a broken
+            # upload→donefile ordering would be caught red-handed
+            sys.stderr.write("injected put outage (dir)\\n")
+            sys.exit(7)
+        os.makedirs(os.path.dirname(dst), exist_ok=True)
+        if os.path.isdir(dst):
+            dst = os.path.join(dst, os.path.basename(src.rstrip("/")))
+        if os.path.isdir(src):
+            if os.path.exists(dst):
+                shutil.rmtree(dst)
+            shutil.copytree(src, dst)
+        else:
+            shutil.copy2(src, dst)
+    elif op == "get":
+        src, dst = local(sys.argv[2]), sys.argv[3]
+        if os.path.isdir(src):
+            shutil.copytree(src, dst)
+        else:
+            shutil.copy2(src, dst)
+    elif op == "mkdir":
+        os.makedirs(local(sys.argv[2]), exist_ok=True)
+    elif op == "test":
+        sys.exit(0 if os.path.exists(local(sys.argv[2])) else 1)
+    elif op == "rm":
+        p = local(sys.argv[2])
+        if os.path.isdir(p):
+            shutil.rmtree(p)
+        elif os.path.exists(p):
+            os.remove(p)
+    else:
+        sys.exit(2)
+""")
+
+
+def write_cli(dirpath: str) -> str:
+    cli = os.path.join(dirpath, "mockfs_cli.py")
+    with open(cli, "w") as f:
+        f.write(MOCK_CLI)
+    return cli
+
+
+def register_mockfs(sandbox_root: str, cli_path: str | None = None,
+                    scheme: str = "mock") -> fs_lib.CommandFS:
+    """Register a CommandFS for ``scheme`` backed by the sandbox CLI."""
+    os.makedirs(sandbox_root, exist_ok=True)
+    if cli_path is None:
+        cli_path = write_cli(sandbox_root)
+    base = f"{sys.executable} {cli_path}"
+    fs = fs_lib.CommandFS(
+        cat=f"{base} cat {{path}}", ls=f"{base} ls {{path}}",
+        put=f"{base} put {{src}} {{dst}}", get=f"{base} get {{src}} {{dst}}",
+        mkdir=f"{base} mkdir {{path}}", test=f"{base} test {{path}}",
+        rm=f"{base} rm {{path}}",
+        env={"MOCKFS_ROOT": str(sandbox_root), "MOCKFS_SCHEME": scheme})
+    fs_lib.register_fs(scheme, fs)
+    return fs
+
+
+def register_from_env() -> fs_lib.CommandFS | None:
+    """Worker-side hook: register the mock fs from PBTPU_MOCKFS_ROOT /
+    PBTPU_MOCKFS_SCHEME (set by the test driving the subprocess)."""
+    root = os.environ.get("PBTPU_MOCKFS_ROOT")
+    if not root:
+        return None
+    return register_mockfs(root,
+                           scheme=os.environ.get("PBTPU_MOCKFS_SCHEME",
+                                                 "hdfs"))

@@ -1,0 +1,194 @@
+"""Worker process for the multi-host kill→resume matrix (ISSUE 5).
+
+Launched (2 processes) by tests/test_multihost_crash.py via
+paddlebox_tpu.distributed.launch. Each rank runs its own deterministic
+shard of a pass-loop training job (per-rank dataset/seed, per-rank
+snapshot root — the data-parallel sparse-training shape where one
+diverging or dead rank poisons the world), coordinated through the
+FileStore control plane:
+
+- run-scoped heartbeats + the dead/stalled-peer watchdog polled inside
+  every barrier/collective wait (named-rank errors, peer_lost /
+  peer_stalled telemetry into ``events_{rank}.jsonl``),
+- lockstep pass boundaries (BoxPS.attach_collectives),
+- COORDINATED resume election on startup: every rank publishes its intact
+  snapshot cursors, the world restores the highest cursor every rank
+  holds intact (``resume_{rank}.json`` records the elected cursor for the
+  pytest side), including mid-pass cursors (skip_steps + shuffle state).
+
+Environment knobs (set by the test):
+  PBTPU_TEST_WORKDIR         output dir (npz dumps, resume/err json, events)
+  PBTPU_CRASH_ROOT           snapshot roots base (per-rank subdir appended)
+  PBTPU_CRASH_MIDPASS        mid-pass snapshot cadence (steps; 0 = off)
+  PBTPU_CRASH_REMOTE_BASE    remote snapshot base URI (per-rank suffix)
+  PBTPU_CRASH_WIPE_LOCAL_RANK  rank whose local staging root is wiped at
+                               startup (replacement-host download path)
+  PBTPU_FAULTPOINT_ONLY_RANK  faultpoint armed only on this rank
+  PBTPU_TEST_STALL_RANK / _STALL_S   hang injection (mid pass 2)
+  PBTPU_TEST_STALL_AFTER_S   watchdog stall threshold override
+"""
+
+import json
+import os
+import shutil
+import sys
+import time
+import traceback
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np  # noqa: E402
+
+import mockfs  # noqa: E402
+from crash_worker import synth  # noqa: E402
+from paddlebox_tpu import monitor  # noqa: E402
+from paddlebox_tpu.distributed import RoleMaker  # noqa: E402
+from paddlebox_tpu.distributed.resilience import HeartbeatMonitor  # noqa: E402
+from paddlebox_tpu.embedding import (EmbeddingConfig,  # noqa: E402
+                                     HostEmbeddingStore)
+from paddlebox_tpu.fleet import BoxPS  # noqa: E402
+from paddlebox_tpu.models import DNNCTRModel  # noqa: E402
+from paddlebox_tpu.parallel import make_mesh  # noqa: E402
+from paddlebox_tpu.train import Trainer, TrainerConfig  # noqa: E402
+from paddlebox_tpu.utils import faultpoint  # noqa: E402
+from paddlebox_tpu.utils.pass_ckpt import PassCheckpointer  # noqa: E402
+
+PASSES = 3
+NUM_SLOTS = 3
+
+
+def run(rank_log) -> None:
+    rm = RoleMaker.from_env()
+    work = os.environ["PBTPU_TEST_WORKDIR"]
+    only = os.environ.get("PBTPU_FAULTPOINT_ONLY_RANK", "")
+    if only and only != str(rm.rank):
+        faultpoint.disarm()
+    mockfs.register_from_env()
+
+    # telemetry: rank-tagged JSONL so the pytest side can assert the
+    # resume_election / peer_lost / peer_stalled event stream
+    monitor.hub().enable(monitor.JsonlSink(
+        os.path.join(work, f"events_{rm.rank}.jsonl")))
+
+    col = rm.collectives(timeout_s=90)
+    # col.store is already run-id-namespaced (RoleMaker) — no hb run_id
+    hb = HeartbeatMonitor(
+        col.store, rm.rank, rm.world_size,
+        interval_s=0.2, lost_after_s=15.0,
+        stall_after_s=float(os.environ.get("PBTPU_TEST_STALL_AFTER_S",
+                                           "60")))
+    col.watchdog = hb
+
+    crash_root = os.environ["PBTPU_CRASH_ROOT"]
+    local_root = os.path.join(crash_root, f"rank{rm.rank}")
+    if os.environ.get("PBTPU_CRASH_WIPE_LOCAL_RANK", "") == str(rm.rank):
+        shutil.rmtree(local_root, ignore_errors=True)
+    remote_base = os.environ.get("PBTPU_CRASH_REMOTE_BASE", "")
+    midpass = int(os.environ.get("PBTPU_CRASH_MIDPASS", "0"))
+    stall_rank = os.environ.get("PBTPU_TEST_STALL_RANK", "")
+    stall_s = float(os.environ.get("PBTPU_TEST_STALL_S", "45"))
+
+    ds, schema = synth(seed=11 + rm.rank)
+    base = ds.records
+    store = HostEmbeddingStore(EmbeddingConfig(dim=4, learning_rate=0.05))
+    tr = Trainer(DNNCTRModel(num_slots=NUM_SLOTS, emb_dim=4, dense_dim=1,
+                             hidden=(8,)),
+                 store, schema, make_mesh(1),
+                 TrainerConfig(global_batch_size=64, dense_lr=2e-3,
+                               auc_buckets=1 << 8),
+                 seed=7 + rm.rank)
+    box = BoxPS(store)
+    box.set_date(20260801)
+    box.init_metric("job_auc", n_buckets=128)
+    box.attach_collectives(col, heartbeat=hb)
+    if remote_base:
+        ckpt = PassCheckpointer(f"{remote_base}/rank{rm.rank}",
+                                keep_last_n=4, base_every=2,
+                                staging_dir=local_root)
+    else:
+        ckpt = PassCheckpointer(local_root, keep_last_n=4, base_every=2)
+    if midpass > 0:
+        tr.enable_midpass_snapshots(ckpt, midpass, box, metrics=box.metrics)
+
+    # ---- coordinated resume election --------------------------------------
+    cursor = tr.resume(ckpt, box=box, collectives=col)
+    skip = 0
+    if cursor is not None:
+        if cursor.get("shuffle_state"):
+            ds.set_shuffle_state(cursor["shuffle_state"])
+        skip = int(cursor.get("mid_steps") or 0)
+    start = (int(cursor["pass_id"]) if cursor is not None else 0) + 1
+    with open(os.path.join(work, f"resume_{rm.rank}.json"), "w") as f:
+        json.dump({"rank": rm.rank,
+                   "elected": None if cursor is None
+                   else cursor.get("elected"),
+                   "pass_id": None if cursor is None
+                   else int(cursor["pass_id"]),
+                   "mid_steps": skip, "start": start}, f)
+    rank_log(f"resume cursor={cursor is not None} start={start} "
+             f"skip={skip}")
+
+    for p in range(start, PASSES + 1):
+        tr.midpass_cursor_extra = {"shuffle_state": ds.shuffle_state()}
+        ds.records = base
+        ds.local_shuffle()
+        box.begin_pass()
+        tr.train_pass(ds, metrics=box.metrics,
+                      skip_steps=(skip if p == start else 0))
+        if stall_rank == str(rm.rank) and p == 2:
+            # hang injection: the interpreter (and its heartbeat daemon)
+            # stay alive but pass/step progress freezes — peers must name
+            # this rank in a PeerStalledError instead of timing out
+            rank_log(f"stalling for {stall_s}s mid pass {p}")
+            time.sleep(stall_s)
+        box.end_pass(checkpointer=ckpt, trainer=tr, dataset=ds)
+
+    # ---- final-state dump -------------------------------------------------
+    tr.flush_sparse()
+    keys = np.sort(np.asarray(ds.unique_keys(), dtype=np.uint64))
+    rows = store.get_rows(keys)
+    dense = {f"p{i}": np.asarray(leaf) for i, leaf in
+             enumerate(jax.tree_util.tree_leaves(
+                 {"params": tr.params, "opt": tr.opt_state}))}
+    met = box.metrics.get_state("job_auc")
+    np.savez(os.path.join(work, f"out_{rm.rank}.npz"),
+             keys=keys, rows=rows,
+             global_step=np.int64(tr.global_step),
+             pass_id=np.int64(box.pass_id),
+             met_pos=np.asarray(met["pos"]),
+             met_neg=np.asarray(met["neg"]), **dense)
+    col.barrier("done")
+    hb.close()
+    monitor.hub().disable()
+    rank_log("done")
+
+
+def main() -> None:
+    rm_rank = os.environ.get("PBTPU_TRAINER_ID", "?")
+    work = os.environ["PBTPU_TEST_WORKDIR"]
+
+    def rank_log(msg):
+        print(f"rank {rm_rank}: {msg}", flush=True)
+
+    try:
+        run(rank_log)
+    except BaseException as e:
+        # surface the failure to the pytest side (launch() inherits stdio)
+        with open(os.path.join(work, f"err_{rm_rank}.txt"), "w") as f:
+            f.write(f"{type(e).__name__}: {e}\n")
+            f.write(traceback.format_exc())
+        from paddlebox_tpu import monitor as _mon
+        _mon.hub().disable()   # flush the JSONL sink: peer_* events land
+        raise
+
+
+if __name__ == "__main__":
+    main()

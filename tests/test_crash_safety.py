@@ -39,7 +39,29 @@ POINT_AFTER = {
     "trainer.push_apply.pre": 6,        # mid pass-2 deferred apply
     "pass_ckpt.pre_manifest": 1,        # pass-2 snapshot uncommitted
     "pass_ckpt.post_manifest": 1,       # pass-2 snapshot committed
+    # ISSUE 5 points (the mid-pass/remote env of MIDPASS_REMOTE_ENV):
+    "trainer.midpass.post_save": 2,     # mid pass-2 snapshot committed —
+                                        # resume must skip from the cursor
+    "remote_ckpt.upload.pre": 3,        # pass-2's first mirror upload
+    # fires on the RESUME path (download with a wiped staging root) — the
+    # dedicated test_kill_during_remote_download_resume flow, not the
+    # generic kill→resume roundtrip
+    "remote_ckpt.download.pre": 0,
 }
+
+# points that only sit on the mid-pass / remote-mirror code paths run the
+# worker with that configuration — which provably does not change the
+# final planes (test_midpass_remote_run_matches_plain_golden)
+MIDPASS_REMOTE_POINTS = {"trainer.midpass.post_save",
+                         "remote_ckpt.upload.pre",
+                         "remote_ckpt.download.pre"}
+
+
+def _midpass_remote_env(tmp_path):
+    return {"PBTPU_MOCKFS_ROOT": str(tmp_path / "mock_root"),
+            "PBTPU_MOCKFS_SCHEME": "hdfs",
+            "PBTPU_CRASH_MIDPASS": "2",
+            "PBTPU_CRASH_REMOTE": "hdfs://ck"}
 
 
 @pytest.fixture(autouse=True)
@@ -83,18 +105,21 @@ def _assert_bitwise_equal(golden, out):
 
 def _kill_resume_roundtrip(point, tmp_path, golden):
     root, out = tmp_path / "root", tmp_path / "out.npz"
+    env = (_midpass_remote_env(tmp_path)
+           if point in MIDPASS_REMOTE_POINTS else {})
     killed = _run_worker(
         root, out, check=False,
-        env_extra={"PBTPU_FAULTPOINT": point,
-                   "PBTPU_FAULTPOINT_AFTER": str(POINT_AFTER[point])})
+        env_extra=dict(env, PBTPU_FAULTPOINT=point,
+                       PBTPU_FAULTPOINT_AFTER=str(POINT_AFTER[point])))
     assert killed.returncode == 137, (
         f"expected the armed kill, got rc={killed.returncode}:\n"
         f"{killed.stdout}\n{killed.stderr}")
     assert f"FAULTPOINT KILL {point}" in killed.stderr
     assert not out.exists()
-    resumed = _run_worker(root, out)
+    resumed = _run_worker(root, out, env_extra=env)
     assert "resume cursor=" in resumed.stdout
     _assert_bitwise_equal(golden, out)
+    return resumed
 
 
 def test_kill_resume_smoke(tmp_path, golden):
@@ -104,12 +129,55 @@ def test_kill_resume_smoke(tmp_path, golden):
 
 
 @pytest.mark.slow
-@pytest.mark.parametrize("point", [p for p in faultpoint.POINTS
-                                   if p != "store.save_delta.pre_manifest"])
+@pytest.mark.parametrize("point",
+                         [p for p in faultpoint.POINTS
+                          if p not in ("store.save_delta.pre_manifest",
+                                       "remote_ckpt.download.pre")])
 def test_kill_resume_matrix(point, tmp_path, golden):
     """Every registered fault point: kill there, resume, prove bit-identical
-    dense params + table rows + metric state vs the uninterrupted run."""
-    _kill_resume_roundtrip(point, tmp_path, golden)
+    dense params + table rows + metric state vs the uninterrupted run. The
+    mid-pass point's resume must come back through the shuffle cursor
+    (skip_steps), not a pass replay."""
+    resumed = _kill_resume_roundtrip(point, tmp_path, golden)
+    if point == "trainer.midpass.post_save":
+        assert "(skip 2)" in resumed.stdout, resumed.stdout
+
+
+@pytest.mark.slow
+def test_kill_during_remote_download_resume(tmp_path, golden):
+    """remote_ckpt.download.pre fires on the RESUME path: train + mirror,
+    wipe the local staging root (replacement host), kill the resume mid
+    download, then a THIRD run re-downloads from the donefile and lands
+    bit-identical."""
+    env = _midpass_remote_env(tmp_path)
+    root, out = tmp_path / "root", tmp_path / "out.npz"
+    _run_worker(root, tmp_path / "full.npz", env_extra=env)  # mirror built
+    killed = _run_worker(
+        root, out, check=False,
+        env_extra=dict(env, PBTPU_CRASH_WIPE_LOCAL="1",
+                       PBTPU_FAULTPOINT="remote_ckpt.download.pre",
+                       PBTPU_FAULTPOINT_AFTER="0"))
+    assert killed.returncode == 137, (killed.stdout, killed.stderr)
+    assert "FAULTPOINT KILL remote_ckpt.download.pre" in killed.stderr
+    resumed = _run_worker(root, out,
+                          env_extra=dict(env, PBTPU_CRASH_WIPE_LOCAL="1"))
+    assert "resume cursor=" in resumed.stdout
+    _assert_bitwise_equal(golden, out)
+
+
+def test_midpass_remote_run_matches_plain_golden(tmp_path, golden):
+    """Mid-pass snapshots + the remote mirror are read-only side effects:
+    a full run with both on lands the SAME final planes as the plain
+    golden (the matrix's license to flip them per point), and the remote
+    root ends up holding a donefile + uploaded snapshots."""
+    env = _midpass_remote_env(tmp_path)
+    out = tmp_path / "out.npz"
+    _run_worker(tmp_path / "root", out, env_extra=env)
+    _assert_bitwise_equal(golden, out)
+    mock_root = tmp_path / "mock_root" / "ck"
+    assert (mock_root / "snapshots.donefile").exists()
+    assert any(n.startswith("pass-") for n in os.listdir(mock_root))
+    assert any(".mid" in n for n in os.listdir(mock_root))
 
 
 def test_every_point_has_a_matrix_entry():

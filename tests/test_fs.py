@@ -14,71 +14,16 @@ import numpy as np
 import pytest
 
 from paddlebox_tpu.utils import fs as fs_lib
-
-MOCK_CLI = textwrap.dedent("""
-    import os, shutil, sys
-    ROOT = os.environ["MOCKFS_ROOT"]
-
-    def local(p):
-        assert p.startswith("mock://"), p
-        return os.path.join(ROOT, p[len("mock://"):])
-
-    op = sys.argv[1]
-    if op == "cat":
-        with open(local(sys.argv[2]), "rb") as f:
-            sys.stdout.buffer.write(f.read())
-    elif op == "ls":
-        d = local(sys.argv[2])
-        for n in sorted(os.listdir(d)):
-            print(sys.argv[2].rstrip("/") + "/" + n)
-    elif op == "put":
-        # hadoop-faithful: put INTO an existing directory nests the source
-        # under it (this is the semantics FleetUtil._save_dir must survive)
-        src, dst = sys.argv[2], local(sys.argv[3])
-        os.makedirs(os.path.dirname(dst), exist_ok=True)
-        if os.path.isdir(dst):
-            dst = os.path.join(dst, os.path.basename(src.rstrip("/")))
-        if os.path.isdir(src):
-            if os.path.exists(dst):
-                shutil.rmtree(dst)
-            shutil.copytree(src, dst)
-        else:
-            shutil.copy2(src, dst)
-    elif op == "get":
-        src, dst = local(sys.argv[2]), sys.argv[3]
-        if os.path.isdir(src):
-            shutil.copytree(src, dst)
-        else:
-            shutil.copy2(src, dst)
-    elif op == "mkdir":
-        os.makedirs(local(sys.argv[2]), exist_ok=True)
-    elif op == "test":
-        sys.exit(0 if os.path.exists(local(sys.argv[2])) else 1)
-    elif op == "rm":
-        p = local(sys.argv[2])
-        if os.path.isdir(p):
-            shutil.rmtree(p)
-        elif os.path.exists(p):
-            os.remove(p)
-    else:
-        sys.exit(2)
-""")
+from tests.mockfs import register_mockfs
 
 
 @pytest.fixture
 def mockfs(tmp_path, monkeypatch):
-    """Register a CommandFS for mock:// backed by the sandbox CLI."""
-    cli = tmp_path / "mockfs_cli.py"
-    cli.write_text(MOCK_CLI)
+    """Register a CommandFS for mock:// backed by the sandbox CLI
+    (tests/mockfs.py — shared with the crash workers, which register the
+    same CLI under hdfs:// for the remote-root kill matrix)."""
     root = tmp_path / "mockfs_root"
-    root.mkdir()
-    base = f"{sys.executable} {cli}"
-    fs = fs_lib.CommandFS(
-        cat=f"{base} cat {{path}}", ls=f"{base} ls {{path}}",
-        put=f"{base} put {{src}} {{dst}}", get=f"{base} get {{src}} {{dst}}",
-        mkdir=f"{base} mkdir {{path}}", test=f"{base} test {{path}}",
-        rm=f"{base} rm {{path}}", env={"MOCKFS_ROOT": str(root)})
-    fs_lib.register_fs("mock", fs)
+    fs = register_mockfs(str(root))
     yield fs, root
     fs_lib._REGISTRY.pop("mock", None)
 
@@ -433,6 +378,99 @@ def test_command_fs_get_retry_cleans_partial_download(tmp_path):
         retries=3, retry_backoff=0.01)
     fs.get(str(src), str(dst))
     assert dst.read_text() == "full payload"
+
+
+# ---------------------------------------------------------------------------
+# FleetUtil remote roots under injected failures (ISSUE 5 satellites)
+# ---------------------------------------------------------------------------
+
+def _trained_fleet_store(v=1.0, n=20):
+    from paddlebox_tpu.embedding import EmbeddingConfig, HostEmbeddingStore
+    store = HostEmbeddingStore(EmbeddingConfig(dim=2))
+    keys = np.arange(1, n + 1, dtype=np.uint64)
+    rows = store.lookup_or_init(keys)
+    rows[:, 2] = v
+    store.write_back(keys, rows)
+    return store, keys
+
+
+def test_fleet_donefile_idempotent_on_crash_replay(mockfs):
+    """The fs retry policy deliberately never retries append (a partial
+    append could double-write); the crash-replay window that leaves open
+    is closed here: re-appending the exact last (day, pass, path) line is
+    a no-op, while a NEW pass still appends."""
+    from paddlebox_tpu.fleet.fleet_util import FleetUtil
+    fs, root = mockfs
+    fleet = FleetUtil("mock://idem")
+    fleet._write_donefile("delta_model.donefile", 1, 1, "mock://idem/1/d1")
+    fleet._write_donefile("delta_model.donefile", 1, 1, "mock://idem/1/d1")
+    lines = (root / "idem" / "delta_model.donefile").read_text().splitlines()
+    assert len(lines) == 1
+    fleet._write_donefile("delta_model.donefile", 1, 2, "mock://idem/1/d2")
+    lines = (root / "idem" / "delta_model.donefile").read_text().splitlines()
+    assert len(lines) == 2
+
+
+def test_fleet_failed_upload_never_writes_donefile(mockfs, monkeypatch):
+    """A failed checkpoint-dir upload (past the retry budget) must never
+    leave a donefile line naming the un-uploaded model — the donefile is
+    written strictly AFTER the upload completes."""
+    from paddlebox_tpu.fleet.fleet_util import FleetUtil
+    fs, root = mockfs
+    store, keys = _trained_fleet_store()
+    fleet = FleetUtil("mock://up")
+    monkeypatch.setitem(fs._env, "MOCKFS_FAIL_PUT_DIR", "1")
+    monkeypatch.setattr(fs, "_retries", 2)
+    monkeypatch.setattr(fs, "_retry_backoff", 0.01)
+    with pytest.raises(RuntimeError, match="put failed after 2 attempts"):
+        fleet.save_model(store, {"w": np.zeros(2, np.float32)}, day=1)
+    assert not (root / "up" / "base_model.donefile").exists()
+    assert fleet.latest() is None
+    # outage over: the re-save lands model AND donefile
+    monkeypatch.delitem(fs._env, "MOCKFS_FAIL_PUT_DIR")
+    fleet.save_model(store, {"w": np.zeros(2, np.float32)}, day=1)
+    assert fleet.latest()["day"] == 1
+
+
+def test_fleet_failed_base_download_falls_back_with_diagnostic(mockfs):
+    """A newest base whose download fails must not kill recovery: the
+    load walks back to the previous committed base entry, warning with
+    the failed path."""
+    from paddlebox_tpu.fleet.fleet_util import FleetUtil
+    fs, root = mockfs
+    fleet = FleetUtil("mock://fb")
+    store1, keys = _trained_fleet_store(v=1.0)
+    fleet.save_model(store1, {"w": np.ones(2, np.float32)}, day=1)
+    store2, _ = _trained_fleet_store(v=2.0)
+    fleet.save_model(store2, {"w": np.ones(2, np.float32) * 2}, day=2)
+    # the newest (day-2) base becomes undownloadable
+    fs.rm(fleet.base_dir(2))
+    with pytest.warns(UserWarning, match="falling back"):
+        got_store, dense, day = FleetUtil("mock://fb").load_model(
+            {"w": np.zeros(2, np.float32)})
+    assert day == 1
+    assert (got_store.get_rows(keys)[:, 2] == 1.0).all()
+    np.testing.assert_array_equal(dense["w"], np.ones(2, np.float32))
+
+
+def test_fleet_failed_delta_download_raises_diagnostic(mockfs):
+    """A delta is STATE, not discovery: silently skipping one would serve
+    a model missing a pass. A failed delta download raises naming the
+    donefile identity."""
+    from paddlebox_tpu.fleet.fleet_util import FleetUtil
+    fs, root = mockfs
+    fleet = FleetUtil("mock://fd")
+    store1, keys = _trained_fleet_store(v=1.0)
+    fleet.save_model(store1, {"w": np.ones(2, np.float32)}, day=1)
+    rows = store1.get_rows(keys[:3])
+    rows[:, 2] = 9.0
+    store1.write_back(keys[:3], rows)
+    fleet.save_delta_model(store1, {"w": np.ones(2, np.float32)},
+                           day=1, pass_id=1)
+    fs.rm(fleet.delta_dir(1, 1))
+    with pytest.raises(RuntimeError,
+                       match=r"delta model .* pass 1.* failed to download"):
+        FleetUtil("mock://fd").load_model({"w": np.zeros(2, np.float32)})
 
 
 def test_command_fs_ctor_timeout_zero_means_no_timeout(tmp_path):

@@ -1,0 +1,236 @@
+"""Multi-host kill→resume matrix + stalled-peer watchdog (ISSUE 5).
+
+Two real worker processes (tests/multihost_crash_worker.py via the
+launcher) train per-rank shards in lockstep over the FileStore control
+plane, snapshotting per pass (and mid-pass). The acceptance bar:
+
+- hard-kill one rank at every registered fault point (including a
+  MID-pass kill and the remote upload/download points on an
+  hdfs://-schemed root), restart the world, and prove the coordinated
+  election lands every rank on the SAME cursor and the resumed world's
+  final dense+sparse+metric planes are bit-identical to an uninterrupted
+  2-worker run (per rank);
+- a stalled (hung, not dead) peer surfaces a named-rank
+  PeerStalledError + a ``peer_stalled`` telemetry event on the observing
+  rank — never an opaque barrier timeout.
+
+One election smoke runs in tier-1 (the CI satellite); the full matrix and
+the hang scenario are ``slow``.
+"""
+
+import json
+import os
+import sys
+
+import numpy as np
+import pytest
+
+from paddlebox_tpu.distributed.launch import launch
+from paddlebox_tpu.utils import faultpoint
+
+TESTS_DIR = os.path.dirname(os.path.abspath(__file__))
+WORKER = os.path.join(TESTS_DIR, "multihost_crash_worker.py")
+WORLD = 2
+
+# mirrors test_crash_safety.POINT_AFTER for the multi-host worker's
+# cadence (3 passes x 4 steps, mid-pass snapshots every 2 steps, remote
+# mirror on): the counts just need the armed kill to FIRE mid-run; the
+# election + parity assertions carry the correctness burden.
+POINT_AFTER = {
+    "ckpt.dense.pre_replace": 2,
+    "store.save_base.pre_replace": 1,
+    "store.save_delta.pre_replace": 1,
+    "store.save_delta.pre_manifest": 1,
+    "feed_pass.flush.pre": 3,
+    "trainer.push_apply.pre": 6,
+    "pass_ckpt.pre_manifest": 3,
+    "pass_ckpt.post_manifest": 3,
+    "trainer.midpass.post_save": 2,     # pass-2's first mid-pass snapshot
+    "remote_ckpt.upload.pre": 4,
+}
+
+
+def _env(tmp_path, extra=None, remote=True, midpass=2):
+    env = {
+        "PBTPU_TEST_WORKDIR": str(tmp_path / "work"),
+        "PBTPU_CRASH_ROOT": str(tmp_path / "snaps"),
+    }
+    if midpass:
+        env["PBTPU_CRASH_MIDPASS"] = str(midpass)
+    if remote:
+        env["PBTPU_MOCKFS_ROOT"] = str(tmp_path / "mock_root")
+        env["PBTPU_MOCKFS_SCHEME"] = "hdfs"
+        env["PBTPU_CRASH_REMOTE_BASE"] = "hdfs://snaps"
+    env.update(extra or {})
+    os.makedirs(env["PBTPU_TEST_WORKDIR"], exist_ok=True)
+    return env
+
+
+def _launch(tmp_path, env):
+    return launch(WORLD, [sys.executable, WORKER],
+                  store_dir=str(tmp_path / "store"), base_env=env)
+
+
+def _load_outs(tmp_path):
+    outs = []
+    for r in range(WORLD):
+        p = tmp_path / "work" / f"out_{r}.npz"
+        assert p.exists(), f"rank {r} produced no final dump"
+        with np.load(p) as z:
+            outs.append({k: z[k] for k in z.files})
+    return outs
+
+
+def _resume_info(tmp_path):
+    infos = []
+    for r in range(WORLD):
+        with open(tmp_path / "work" / f"resume_{r}.json") as f:
+            infos.append(json.load(f))
+    return infos
+
+
+def _events(tmp_path, rank):
+    p = tmp_path / "work" / f"events_{rank}.jsonl"
+    if not p.exists():
+        return []
+    return [json.loads(ln) for ln in p.read_text().splitlines() if ln]
+
+
+@pytest.fixture(scope="module")
+def golden(tmp_path_factory):
+    """Uninterrupted 2-worker run (plain local roots, no mid-pass /
+    remote — those are proven state-neutral in test_crash_safety) →
+    per-rank final npz."""
+    d = tmp_path_factory.mktemp("mh_golden")
+    env = _env(d, remote=False, midpass=0)
+    code = _launch(d, env)
+    assert code == 0, f"golden multihost run failed ({code})"
+    return _load_outs(d)
+
+
+def _assert_world_parity(golden, tmp_path):
+    outs = _load_outs(tmp_path)
+    for r in range(WORLD):
+        assert sorted(outs[r]) == sorted(golden[r])
+        for k in golden[r]:
+            np.testing.assert_array_equal(
+                golden[r][k], outs[r][k],
+                err_msg=f"rank {r} plane {k!r} diverged after the "
+                        f"multi-host kill -> elected resume")
+
+
+def _kill_resume_world(tmp_path, golden, point, extra_env=None):
+    """Kill rank 1 at `point` (whole world fail-stops), relaunch against
+    the same roots, assert both ranks elected the SAME cursor and final
+    state parity."""
+    kill_env = _env(tmp_path, extra={
+        "PBTPU_FAULTPOINT": point,
+        "PBTPU_FAULTPOINT_AFTER": str(POINT_AFTER[point]),
+        "PBTPU_FAULTPOINT_ONLY_RANK": "1", **(extra_env or {})})
+    code = _launch(tmp_path, kill_env)
+    assert code == 137, f"expected the armed kill on rank 1, got {code}"
+    resume_env = _env(tmp_path, extra=extra_env)
+    code = _launch(tmp_path, resume_env)
+    assert code == 0, (
+        f"resume world failed ({code}); worker errors: "
+        + "; ".join(
+            (tmp_path / "work" / f"err_{r}.txt").read_text()[:400]
+            for r in range(WORLD)
+            if (tmp_path / "work" / f"err_{r}.txt").exists()))
+    infos = _resume_info(tmp_path)
+    assert infos[0]["elected"] is not None, infos
+    assert infos[0]["elected"] == infos[1]["elected"], (
+        f"world diverged at election: {infos}")
+    assert infos[0]["mid_steps"] == infos[1]["mid_steps"]
+    _assert_world_parity(golden, tmp_path)
+    return infos
+
+
+def test_two_host_election_smoke(tmp_path, golden):
+    """Tier-1 (CI satellite): kill rank 1 with its pass-2 snapshot
+    UNCOMMITTED (pre_manifest) while rank 0 may well have committed its
+    own — the election must roll BOTH ranks back to pass 1 (never let
+    rank 0 resume ahead), and the resumed world must be bit-identical.
+    Local FileStore only — no remote/mid-pass riders, keeps tier-1 lean."""
+    kill_env = _env(tmp_path, remote=False, midpass=0, extra={
+        "PBTPU_FAULTPOINT": "pass_ckpt.pre_manifest",
+        "PBTPU_FAULTPOINT_AFTER": "1",       # pass-2's snapshot commit
+        "PBTPU_FAULTPOINT_ONLY_RANK": "1"})
+    code = _launch(tmp_path, kill_env)
+    assert code == 137, f"expected the armed kill on rank 1, got {code}"
+    resume_env = _env(tmp_path, remote=False, midpass=0)
+    code = _launch(tmp_path, resume_env)
+    assert code == 0
+    infos = _resume_info(tmp_path)
+    # rank 1's pass-2 snapshot never committed -> the world elects pass 1
+    assert infos[0]["elected"] == infos[1]["elected"] == [1, 0], infos
+    assert [i["start"] for i in infos] == [2, 2]
+    _assert_world_parity(golden, tmp_path)
+    # both ranks' event streams carry the election record
+    for r in range(WORLD):
+        names = [e.get("name") for e in _events(tmp_path, r)]
+        assert "resume_election" in names
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("point",
+                         [p for p in faultpoint.POINTS
+                          if p not in ("pass_ckpt.pre_manifest",
+                                       "remote_ckpt.download.pre")])
+def test_multihost_kill_resume_matrix(point, tmp_path, golden):
+    """Every registered fault point, multi-host: kill rank 1 there
+    (mid-pass snapshots + hdfs:// remote mirror ON so every point is on
+    the executed path), restart the world, elected resume, per-rank
+    bitwise parity."""
+    infos = _kill_resume_world(tmp_path, golden, point)
+    if point == "trainer.midpass.post_save":
+        # the kill landed right after rank 1's mid-pass-2 commit: the
+        # world must resume FROM THE SHUFFLE CURSOR (skip the trained
+        # steps), not replay the pass
+        assert infos[0]["elected"] == [1, 2], infos
+        assert infos[0]["mid_steps"] == 2
+
+
+@pytest.mark.slow
+def test_multihost_kill_during_remote_download(tmp_path, golden):
+    """Replacement-host flow: after a mirrored run, rank 1 loses its local
+    staging root and is killed mid-download on the restart; the THIRD
+    launch re-downloads from the donefile, elects, and lands parity."""
+    env = _env(tmp_path)
+    code = _launch(tmp_path, env)
+    assert code == 0
+    kill_env = _env(tmp_path, extra={
+        "PBTPU_CRASH_WIPE_LOCAL_RANK": "1",
+        "PBTPU_FAULTPOINT": "remote_ckpt.download.pre",
+        "PBTPU_FAULTPOINT_AFTER": "0",
+        "PBTPU_FAULTPOINT_ONLY_RANK": "1"})
+    code = _launch(tmp_path, kill_env)
+    assert code == 137, f"expected the download kill, got {code}"
+    resume_env = _env(tmp_path, extra={"PBTPU_CRASH_WIPE_LOCAL_RANK": "1"})
+    code = _launch(tmp_path, resume_env)
+    assert code == 0
+    infos = _resume_info(tmp_path)
+    assert infos[0]["elected"] == infos[1]["elected"] is not None
+    _assert_world_parity(golden, tmp_path)
+
+
+@pytest.mark.slow
+def test_stalled_peer_names_rank_and_emits_event(tmp_path):
+    """Hang (not death): rank 1 sleeps mid pass 2 with its heartbeat still
+    beating. Rank 0's watchdog must fail the run with a PeerStalledError
+    NAMING rank 1 — not a bare 300 s barrier timeout — and emit the
+    peer_stalled telemetry event."""
+    env = _env(tmp_path, remote=False, midpass=0, extra={
+        "PBTPU_TEST_STALL_RANK": "1",
+        "PBTPU_TEST_STALL_S": "90",
+        "PBTPU_TEST_STALL_AFTER_S": "10"})
+    code = _launch(tmp_path, env)
+    assert code not in (0, 137), f"expected a watchdog failure, got {code}"
+    err = (tmp_path / "work" / "err_0.txt")
+    assert err.exists(), "rank 0 exited without a recorded error"
+    text = err.read_text()
+    assert "PeerStalledError" in text and "[1]" in text, text[:800]
+    assert "stalled" in text
+    events = _events(tmp_path, 0)
+    stalled = [e for e in events if e.get("name") == "peer_stalled"]
+    assert stalled and stalled[0]["fields"]["rank"] == 1, events[-10:]

@@ -1,0 +1,481 @@
+"""Multi-host crash resilience: store namespacing, heartbeat watchdog,
+coordinated resume election, and mid-pass cursors (ISSUE 5).
+
+Cross-process behavior (real kills, real launcher) lives in
+tests/test_multihost_crash.py; this file proves the building blocks
+in-process: the FileStore satellites, the named-rank watchdog errors, the
+pure election, and the PassCheckpointer election/mid-pass API the
+multi-host protocol rides."""
+
+import json
+import os
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from paddlebox_tpu.distributed import FileStore, HostCollectives
+from paddlebox_tpu.distributed.resilience import (HeartbeatMonitor,
+                                                  PeerLostError,
+                                                  PeerStalledError,
+                                                  coordinated_resume,
+                                                  elect_resume_cursor)
+from paddlebox_tpu.monitor import context as mon_ctx
+
+
+# ---------------------------------------------------------------------------
+# FileStore satellites
+# ---------------------------------------------------------------------------
+
+def test_filestore_namespace_isolates_runs(tmp_path):
+    """A previous launch's keys must not satisfy a new launch's waits or
+    barriers — the run-id namespace is the correctness barrier."""
+    old = FileStore(str(tmp_path), timeout_s=0.3, namespace="run_old")
+    old.set("day", b"20260801")
+    old.add("barrier.1", 0)
+    old.add("barrier.1", 1)
+    new = FileStore(str(tmp_path), timeout_s=0.3, namespace="run_new")
+    assert new.get("day") is None
+    with pytest.raises(TimeoutError):
+        new.wait_count("barrier.1", 2, timeout_s=0.2)
+    # same store dir, both runs live side by side
+    assert old.get("day") == b"20260801"
+
+
+def test_filestore_wait_count_names_missing_ranks(tmp_path):
+    st = FileStore(str(tmp_path), timeout_s=0.3)
+    st.add("b", 0)
+    st.add("b", 2)
+    with pytest.raises(TimeoutError, match=r"missing ranks \[1, 3\]"):
+        st.wait_count("b", 4, timeout_s=0.2)
+    assert st.missing_ranks("b", 4) == [1, 3]
+    assert st.count("b", 4) == 2
+
+
+def test_filestore_tmp_suffix_collision_safe(tmp_path):
+    """Two writers sharing a pid (two hosts on one mount) must not share a
+    tmp file: the suffix carries hostname + pid + a fresh uuid, and
+    concurrent sets leave no .tmp. litter behind."""
+    st = FileStore(str(tmp_path))
+    errs = []
+
+    def writer(i):
+        try:
+            for k in range(50):
+                st.set("hot", f"{i}.{k}".encode())
+        except Exception as e:  # pragma: no cover
+            errs.append(e)
+
+    ts = [threading.Thread(target=writer, args=(i,)) for i in range(4)]
+    [t.start() for t in ts]
+    [t.join() for t in ts]
+    assert not errs
+    assert st.get("hot") is not None
+    assert not [n for n in os.listdir(tmp_path) if ".tmp." in n]
+
+
+def test_filestore_sweep_stale(tmp_path):
+    dead = FileStore(str(tmp_path), namespace="run_dead")
+    dead.set("k", b"1")
+    live = FileStore(str(tmp_path), namespace="run_live")
+    live.set("aged_arrival", b"2")
+    past = time.time() - 7200
+    os.utime(dead._path("k"), (past, past))
+    # the live run's own key ages too (a barrier arrival waiting out a
+    # straggler) — it must survive any threshold
+    os.utime(live._path("aged_arrival"), (past, past))
+    assert live.sweep_stale(3600) == 1
+    assert dead.get("k") is None
+    assert live.get("aged_arrival") == b"2"
+    # an un-namespaced store cannot tell its keys from a dead run's
+    with pytest.raises(ValueError, match="namespaced"):
+        FileStore(str(tmp_path)).sweep_stale(3600)
+
+
+def test_filestore_wait_check_callback_preempts_timeout(tmp_path):
+    st = FileStore(str(tmp_path), timeout_s=30)
+
+    def boom():
+        raise PeerLostError("rank [1] lost", [1])
+
+    t0 = time.monotonic()
+    with pytest.raises(PeerLostError):
+        st.wait("never", check=boom)
+    with pytest.raises(PeerLostError):
+        st.wait_count("neverb", 2, check=boom)
+    assert time.monotonic() - t0 < 5.0   # no 30s timeout paid
+
+
+# ---------------------------------------------------------------------------
+# heartbeat watchdog
+# ---------------------------------------------------------------------------
+
+def _monitor(st, rank, world, **kw):
+    kw.setdefault("interval_s", 0.05)
+    kw.setdefault("watch", False)        # deterministic: scan via check()
+    return HeartbeatMonitor(st, rank, world, run_id="r", **kw)
+
+
+def test_watchdog_detects_lost_peer_with_named_rank(tmp_path):
+    st = FileStore(str(tmp_path))
+    h0 = _monitor(st, 0, 2, lost_after_s=0.4, stall_after_s=30)
+    h1 = _monitor(st, 1, 2, lost_after_s=0.4, stall_after_s=30)
+    try:
+        time.sleep(0.15)
+        h0.check()                       # both alive
+        h1.close()                       # rank 1 "dies" (publisher stops)
+        deadline = time.monotonic() + 5.0
+        with pytest.raises(PeerLostError, match=r"\[1\]") as ei:
+            while time.monotonic() < deadline:
+                h0.check()
+                time.sleep(0.1)
+        assert ei.value.ranks == [1]
+        # latched: the next check re-raises immediately
+        with pytest.raises(PeerLostError):
+            h0.check()
+    finally:
+        h0.close()
+        h1.close()
+
+
+def test_watchdog_detects_stalled_peer(tmp_path):
+    """A peer whose process is alive (heartbeat beating) but whose
+    pass/step progress froze must surface as peer_stalled — the hung-rank
+    signature a plain liveness check cannot see."""
+    st = FileStore(str(tmp_path))
+    handle = mon_ctx.enter_pass(3)       # both monitors read this context
+    mon_ctx.set_step(7)
+    h0 = _monitor(st, 0, 2, lost_after_s=30, stall_after_s=0.4)
+    h1 = _monitor(st, 1, 2, lost_after_s=30, stall_after_s=0.4)
+    try:
+        time.sleep(0.15)
+        h0.check()
+        # progress frozen from here on (h1 keeps beating via its thread)
+        deadline = time.monotonic() + 5.0
+        with pytest.raises(PeerStalledError, match=r"\[1\]"):
+            while time.monotonic() < deadline:
+                h0.check()
+                time.sleep(0.1)
+    finally:
+        h0.close()
+        h1.close()
+        mon_ctx.exit_pass(handle)
+
+
+def test_collectives_barrier_raises_named_rank_not_timeout(tmp_path):
+    """The acceptance shape: a barrier against a dead peer fails with the
+    watchdog's named-rank error, not the opaque store timeout."""
+    st = FileStore(str(tmp_path), timeout_s=60)
+    h0 = _monitor(st, 0, 2, lost_after_s=0.3, stall_after_s=30)
+    col = HostCollectives(st, 0, 2, run_id="r", watchdog=h0)
+    try:
+        time.sleep(0.1)   # a beat or two… then rank 1 simply never exists
+        t0 = time.monotonic()
+        with pytest.raises(PeerLostError, match=r"ranks? \[1\]"):
+            col.barrier("never_arrives")
+        assert time.monotonic() - t0 < 10.0
+    finally:
+        h0.close()
+
+
+# ---------------------------------------------------------------------------
+# election
+# ---------------------------------------------------------------------------
+
+def test_elect_resume_cursor_pure_cases():
+    # unanimous newest
+    assert elect_resume_cursor([], [[[1, 0], [2, 0]],
+                                    [[1, 0], [2, 0]]]) == (2, 0)
+    # one rank's newest tore: the world rolls back together
+    assert elect_resume_cursor([], [[[1, 0], [2, 0]], [[1, 0]]]) == (1, 0)
+    # mid-pass cursors order between pass boundaries
+    assert elect_resume_cursor([], [[[1, 0], [1, 2], [2, 0]],
+                                    [[1, 0], [1, 2]]]) == (1, 2)
+    # a rank with nothing intact forces a whole-world fresh start
+    assert elect_resume_cursor([], [[[1, 0]], []]) is None
+    assert elect_resume_cursor([], [[], []]) is None
+
+
+def _tiny_job(tmp_path, tag, seed=7):
+    from paddlebox_tpu.embedding import EmbeddingConfig, HostEmbeddingStore
+    from paddlebox_tpu.fleet import BoxPS
+    from paddlebox_tpu.models import DNNCTRModel
+    from paddlebox_tpu.parallel import make_mesh
+    from paddlebox_tpu.train import Trainer, TrainerConfig
+    from paddlebox_tpu.utils.pass_ckpt import PassCheckpointer
+    from tests.crash_worker import NUM_SLOTS, synth
+    ds, schema = synth(n=128, seed=11)
+    store = HostEmbeddingStore(EmbeddingConfig(dim=4, learning_rate=0.05))
+    tr = Trainer(DNNCTRModel(num_slots=NUM_SLOTS, emb_dim=4, dense_dim=1,
+                             hidden=(8,)),
+                 store, schema, make_mesh(1),
+                 TrainerConfig(global_batch_size=64, auc_buckets=1 << 8),
+                 seed=seed)
+    box = BoxPS(store)
+    ck = PassCheckpointer(str(tmp_path / tag), keep_last_n=6, base_every=4)
+    return ds, tr, store, box, ck
+
+
+def test_coordinated_resume_rolls_world_back_to_common_cursor(tmp_path):
+    """Two 'ranks' (threads, separate trainers/roots): rank 0 holds intact
+    passes {1,2}, rank 1's pass-2 snapshot is torn. The election must land
+    BOTH on pass 1, and rank 0's abandoned pass-2 snapshot must be
+    discarded so it can never win a later newest-first walk."""
+    jobs = [_tiny_job(tmp_path, f"rank{r}") for r in range(2)]
+    for r, (ds, tr, store, box, ck) in enumerate(jobs):
+        for _ in range(2):
+            box.begin_pass()
+            tr.train_pass(ds)
+            box.end_pass(checkpointer=ck, trainer=tr, dataset=ds)
+    # tear rank 1's newest snapshot (truncate its dense plane)
+    ck1 = jobs[1][4]
+    dense2 = os.path.join(ck1.snap_dir(2), "dense.npz")
+    raw = open(dense2, "rb").read()
+    with open(dense2, "wb") as f:
+        f.write(raw[:-32])
+    assert jobs[0][4].intact_cursors() == [(1, 0), (2, 0)]
+    assert ck1.intact_cursors() == [(1, 0)]
+
+    st = FileStore(str(tmp_path / "store"), timeout_s=30)
+    fresh = [_tiny_job(tmp_path, f"rank{r}", seed=50 + r)
+             for r in range(2)]
+    results, errs = [None, None], []
+
+    def resume_rank(r):
+        try:
+            ds, tr, store, box, ck = fresh[r]
+            col = HostCollectives(st, r, 2, run_id="x")
+            results[r] = coordinated_resume(ck, tr, col, box=box)
+        except BaseException as e:  # pragma: no cover
+            errs.append(e)
+
+    ts = [threading.Thread(target=resume_rank, args=(r,)) for r in range(2)]
+    [t.start() for t in ts]
+    [t.join() for t in ts]
+    assert not errs, errs
+    for r in range(2):
+        assert results[r]["pass_id"] == 1
+        assert results[r]["elected"] == [1, 0]
+        assert fresh[r][3].pass_id == 1
+    # rank 0's pass-2 snapshot (abandoned timeline) is gone
+    assert fresh[0][4].intact_cursors() == [(1, 0)]
+    assert not os.path.exists(fresh[0][4].snap_dir(2))
+
+
+def test_coordinated_resume_fresh_start_when_any_rank_empty(tmp_path):
+    jobs = [_tiny_job(tmp_path, f"er{r}") for r in range(2)]
+    ds, tr, store, box, ck = jobs[0]
+    box.begin_pass(); tr.train_pass(ds)
+    box.end_pass(checkpointer=ck, trainer=tr)
+    st = FileStore(str(tmp_path / "store2"), timeout_s=30)
+    results, errs = [0, 0], []
+
+    def resume_rank(r):
+        try:
+            dsr, trr, _, boxr, ckr = jobs[r]
+            col = HostCollectives(st, r, 2, run_id="y")
+            results[r] = coordinated_resume(ckr, trr, col, box=boxr)
+        except BaseException as e:  # pragma: no cover
+            errs.append(e)
+
+    ts = [threading.Thread(target=resume_rank, args=(r,)) for r in range(2)]
+    [t.start() for t in ts]
+    [t.join() for t in ts]
+    assert not errs, errs
+    assert results == [None, None]       # whole-world fresh start
+    # rank 0's pass-1 snapshot belonged to a timeline the world just
+    # abandoned — left intact, a future election could match it against a
+    # freshly retrained pass-1 on rank 1 (silent divergence). It must be
+    # discarded with the fresh start.
+    assert jobs[0][4].intact_cursors() == []
+
+
+def test_prune_keeps_fulls_and_mids_in_separate_pools(tmp_path):
+    """Ranks mid-pass-snapshot on their own step cadence; mids must never
+    evict pass-boundary snapshots (the cursors ranks hold in COMMON), or
+    intra-pass skew > keep_last_n*every_steps would collapse the next
+    election to a fresh start."""
+    from paddlebox_tpu.utils.pass_ckpt import PassCheckpointer
+    ds, tr, store, box, _ = _tiny_job(tmp_path, "pp_unused")
+    ck = PassCheckpointer(str(tmp_path / "pp"), keep_last_n=2,
+                          base_every=8)
+    tr.enable_midpass_snapshots(ck, 1, box)
+    for _ in range(3):                   # 2 steps/pass -> 2 mids + 1 full
+        box.begin_pass()
+        tr.train_pass(ds)
+        box.end_pass(checkpointer=ck, trainer=tr)
+    cursors = ck.intact_cursors()
+    assert [c for c in cursors if c[1] == 0] == [(2, 0), (3, 0)]
+    assert [c for c in cursors if c[1] > 0] == [(2, 1), (2, 2)]
+
+
+# ---------------------------------------------------------------------------
+# mid-pass snapshots + cursor resume (in-process bit-parity)
+# ---------------------------------------------------------------------------
+
+def test_midpass_snapshot_skip_resume_bit_identical(tmp_path):
+    """Kill-free core of the mid-pass tentpole: snapshot at step 2 of
+    pass 2, restore it into a FRESH job, replay the pass order from the
+    shuffle cursor with skip_steps=2, and land bit-identical dense +
+    sparse + metric planes and the same global_step."""
+    import jax
+    ds, tr, store, box, ck = _tiny_job(tmp_path, "mid")
+    box.init_metric("m", n_buckets=64)
+    tr.enable_midpass_snapshots(ck, 2, box, metrics=box.metrics)
+    base = ds.records
+    for _ in range(2):
+        tr.midpass_cursor_extra = {"shuffle_state": ds.shuffle_state()}
+        ds.records = base
+        ds.local_shuffle()
+        box.begin_pass()
+        tr.train_pass(ds, metrics=box.metrics)
+        box.end_pass(checkpointer=ck, trainer=tr, dataset=ds)
+    tr.flush_sparse()
+    keys = np.sort(np.asarray(ds.unique_keys(), np.uint64))
+    want_rows = store.get_rows(keys)
+    want_params = jax.tree.map(np.asarray, tr.params)
+    want_met = box.metrics.get_state("m")
+    assert (1, 2) in ck.intact_cursors()
+
+    from paddlebox_tpu.utils.pass_ckpt import PassCheckpointer
+    ds2, tr2, store2, box2, _ = _tiny_job(tmp_path, "mid_unused", seed=99)
+    box2.init_metric("m", n_buckets=64)
+    ck2 = PassCheckpointer(str(tmp_path / "mid"), keep_last_n=6,
+                           base_every=4)
+    cursor = ck2.resume(tr2, box=box2, metrics=box2.metrics, at=(1, 2))
+    assert cursor["pass_id"] == 1 and cursor["mid_steps"] == 2
+    assert cursor["shuffle_state"] is not None
+    ds2.set_shuffle_state(cursor["shuffle_state"])
+    base2 = ds2.records
+    ds2.records = base2
+    ds2.local_shuffle()                  # replays pass-2's permutation
+    box2.begin_pass()
+    tr2.train_pass(ds2, metrics=box2.metrics,
+                   skip_steps=cursor["mid_steps"])
+    box2.end_pass(trainer=tr2, checkpointer=ck2, dataset=ds2)
+    tr2.flush_sparse()
+    np.testing.assert_array_equal(want_rows, store2.get_rows(keys))
+    jax.tree_util.tree_map(
+        lambda a, b: np.testing.assert_array_equal(np.asarray(a),
+                                                   np.asarray(b)),
+        want_params, tr2.params)
+    np.testing.assert_array_equal(np.asarray(want_met["pos"]),
+                                  np.asarray(box2.metrics.get_state("m")["pos"]))
+    assert tr2.global_step == tr.global_step
+
+
+def test_midpass_snapshot_cadence_and_naming(tmp_path):
+    ds, tr, store, box, ck = _tiny_job(tmp_path, "cad")
+    tr.enable_midpass_snapshots(ck, 1, box)      # every step
+    box.begin_pass()
+    tr.train_pass(ds)
+    box.end_pass(checkpointer=ck, trainer=tr)
+    names = sorted(n for n in os.listdir(ck.root) if n.startswith("pass-"))
+    # 128 examples / batch 64 = 2 steps: mids at 1 and 2, then the full
+    assert names == ["pass-00000.mid00001", "pass-00000.mid00002",
+                     "pass-00001"]
+    # cursor ordering: full pass-1 outranks its own mid snapshots
+    assert ck.intact_cursors() == [(0, 1), (0, 2), (1, 0)]
+
+
+def test_midpass_requires_allreduce_single_step(tmp_path):
+    from paddlebox_tpu.embedding import EmbeddingConfig, HostEmbeddingStore
+    from paddlebox_tpu.fleet import BoxPS
+    from paddlebox_tpu.models import DNNCTRModel
+    from paddlebox_tpu.parallel import make_mesh
+    from paddlebox_tpu.train import Trainer, TrainerConfig
+    from tests.crash_worker import NUM_SLOTS, synth
+    ds, schema = synth(n=64)
+    store = HostEmbeddingStore(EmbeddingConfig(dim=4))
+    tr = Trainer(DNNCTRModel(num_slots=NUM_SLOTS, emb_dim=4, dense_dim=1,
+                             hidden=(8,)),
+                 store, schema, make_mesh(1),
+                 TrainerConfig(global_batch_size=64,
+                               dense_sync_mode="kstep"), seed=1)
+    with pytest.raises(NotImplementedError, match="allreduce"):
+        tr.enable_midpass_snapshots(object(), 2, BoxPS(store))
+
+
+# ---------------------------------------------------------------------------
+# remote snapshot roots (in-process, mock CommandFS)
+# ---------------------------------------------------------------------------
+
+@pytest.fixture
+def hdfs_mock(tmp_path):
+    from paddlebox_tpu.utils import fs as fs_lib
+    from tests.mockfs import register_mockfs
+    root = tmp_path / "hdfs_root"
+    fs = register_mockfs(str(root), scheme="hdfsmock")
+    yield fs, root
+    fs_lib._REGISTRY.pop("hdfsmock", None)
+
+
+def test_remote_root_upload_donefile_and_replacement_host_resume(
+        tmp_path, hdfs_mock):
+    """PassCheckpointer over a remote root: local atomic commit → upload →
+    donefile; a REPLACEMENT host (empty staging dir) resumes purely from
+    the donefile, bit-identical."""
+    import jax
+    from paddlebox_tpu.utils.pass_ckpt import PassCheckpointer
+    fs, mock_root = hdfs_mock
+    ds, tr, store, box, _ = _tiny_job(tmp_path, "unused_local")
+    ck = PassCheckpointer("hdfsmock://snaps", keep_last_n=4, base_every=2,
+                          staging_dir=str(tmp_path / "stage_a"))
+    for _ in range(2):
+        box.begin_pass()
+        tr.train_pass(ds)
+        box.end_pass(checkpointer=ck, trainer=tr, dataset=ds)
+    tr.flush_sparse()
+    keys = np.sort(np.asarray(ds.unique_keys(), np.uint64))
+    want_rows = store.get_rows(keys)
+    want_params = jax.tree.map(np.asarray, tr.params)
+    done = mock_root / "snaps" / "snapshots.donefile"
+    assert done.exists()
+    entries = [json.loads(ln) for ln in done.read_text().splitlines()]
+    assert [(e["pass"], e["mid"]) for e in entries] == [(1, 0), (2, 0)]
+    assert (mock_root / "snaps" / "pass-00002" / "MANIFEST.json").exists()
+
+    ds2, tr2, store2, box2, _ = _tiny_job(tmp_path, "unused2", seed=42)
+    ck2 = PassCheckpointer("hdfsmock://snaps", keep_last_n=4, base_every=2,
+                           staging_dir=str(tmp_path / "stage_b"))
+    # syncs up to keep_last_n donefile entries, not just the newest — a
+    # replacement host must join the election with every cursor the
+    # donefile can deliver, or a surviving rank one pass behind would
+    # collapse the intersection to a fresh start
+    assert ck2.intact_cursors() == [(1, 0), (2, 0)]
+    cursor = tr2.resume(ck2, box=box2)
+    assert cursor["pass_id"] == 2
+    np.testing.assert_array_equal(want_rows, store2.get_rows(keys))
+    jax.tree_util.tree_map(
+        lambda a, b: np.testing.assert_array_equal(np.asarray(a),
+                                                   np.asarray(b)),
+        want_params, tr2.params)
+
+
+def test_remote_resume_falls_back_past_torn_remote_snapshot(
+        tmp_path, hdfs_mock):
+    """A torn REMOTE newest snapshot (upload raced the kill but the
+    donefile line landed — or bit rot on the remote store) is diagnosed
+    and the restore falls back to the previous donefile entry."""
+    from paddlebox_tpu.utils.pass_ckpt import PassCheckpointer
+    fs, mock_root = hdfs_mock
+    ds, tr, store, box, _ = _tiny_job(tmp_path, "unused_t")
+    ck = PassCheckpointer("hdfsmock://t", keep_last_n=4, base_every=2,
+                          staging_dir=str(tmp_path / "stage_t"))
+    for _ in range(2):
+        box.begin_pass()
+        tr.train_pass(ds)
+        box.end_pass(checkpointer=ck, trainer=tr, dataset=ds)
+    # corrupt the remote pass-2 dense plane (size intact CRC broken)
+    f = mock_root / "t" / "pass-00002" / "dense.npz"
+    raw = bytearray(f.read_bytes())
+    raw[len(raw) // 2] ^= 0xFF
+    f.write_bytes(bytes(raw))
+
+    ds2, tr2, store2, box2, _ = _tiny_job(tmp_path, "unused_t2", seed=42)
+    ck2 = PassCheckpointer("hdfsmock://t", keep_last_n=4, base_every=2,
+                           staging_dir=str(tmp_path / "stage_t2"))
+    with pytest.warns(UserWarning, match="falling back"):
+        cursor = tr2.resume(ck2, box=box2)
+    assert cursor is not None and cursor["pass_id"] == 1
