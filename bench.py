@@ -819,6 +819,88 @@ def host_bench(small: bool) -> dict:
     return out
 
 
+def elastic_drill(small: bool, tiny: bool = False) -> dict:
+    """Elastic rank-loss recovery drill (ISSUE 6): measure what a world
+    shrink actually costs. A 2-member elastic world trains one pass on
+    its shard, "loses" rank 1, and runs the REAL recovery path — world
+    re-formation (generation seal over a FileStore), coordinated resume
+    election, restore, and the cursor-preserving re-route of the departed
+    rank's records — timed as ``world_resize_seconds``; the continued
+    pass then trains the whole working set at N−1 and its throughput is
+    recorded as the ``elastic_degraded`` matrix point (gated by
+    BENCH_BEST.json like every other point). The numbers answer the two
+    operator questions: how long is the pass stalled by a rank loss, and
+    how fast does the shrunk world train."""
+    import tempfile as _tempfile
+    import time as _t
+    from paddlebox_tpu.data import DataFeedSchema, SlotDataset
+    from paddlebox_tpu.distributed.resilience import (ElasticWorld,
+                                                      coordinated_resume)
+    from paddlebox_tpu.distributed.store import FileStore
+    from paddlebox_tpu.embedding import EmbeddingConfig, HostEmbeddingStore
+    from paddlebox_tpu.fleet import BoxPS
+    from paddlebox_tpu.models import DeepFMModel
+    from paddlebox_tpu.parallel import make_mesh
+    from paddlebox_tpu.train import Trainer, TrainerConfig
+
+    bs = 64
+    n_ex = bs * (4 if tiny else (16 if small else 128))
+    schema = DataFeedSchema.ctr(num_sparse=4, num_float=1, batch_size=bs,
+                                max_len=1)
+    rec = _synth_pass(schema, n_ex, 4,
+                      [s for s in schema.float_slots if s.name != "label"],
+                      2000, seed=3)
+    store = HostEmbeddingStore(EmbeddingConfig(dim=8, optimizer="adagrad",
+                                               learning_rate=0.05))
+    tr = Trainer(DeepFMModel(num_slots=4, emb_dim=8, dense_dim=1,
+                             hidden=(16,)),
+                 store, schema, make_mesh(1),
+                 TrainerConfig(global_batch_size=bs))
+    box = BoxPS(store)
+    with _tempfile.TemporaryDirectory() as td:
+        from paddlebox_tpu.utils.pass_ckpt import PassCheckpointer
+        ckpt = PassCheckpointer(os.path.join(td, "snaps"), keep_last_n=2)
+        world = ElasticWorld(
+            FileStore(os.path.join(td, "store"), namespace="bench",
+                      poll_s=0.005),
+            0, [0, 1], heartbeat_interval_s=0.2, lost_after_s=600,
+            stall_after_s=600, reform_timeout_s=0.25)
+        ds = SlotDataset(schema)
+        ds.records = rec
+        shards = ds.member_shards(2)
+        ds_mine = SlotDataset(schema)
+        ds_mine.records = shards[0]
+        box.begin_pass()
+        tr.train_pass(ds_mine)
+        box.end_pass(checkpointer=ckpt, trainer=tr, dataset=ds)
+        # rank 1 "dies" at the pass boundary: re-form, re-elect, re-route
+        t0 = _t.perf_counter()
+        world2 = world.reform([1])
+        cursor = coordinated_resume(ckpt, tr, world2.collectives, box=box)
+        routed = ds.reroute_records(shards[1], world2.world)
+        resize_s = _t.perf_counter() - t0
+        # degraded continuation: the shrunk world carries the whole
+        # working set (warm, like steady state after a shrink)
+        ds_all = SlotDataset(schema)
+        ds_all.records = rec
+        box.begin_pass()
+        tr.train_pass(ds_all)          # warmup (compiles at new shapes)
+        box.end_pass(trainer=tr)
+        box.begin_pass()
+        t1 = _t.perf_counter()
+        out = tr.train_pass(ds_all)
+        seconds = _t.perf_counter() - t1
+        box.end_pass(trainer=tr)
+        world2.close()
+    eps = out["steps"] * bs / max(seconds, 1e-9)
+    return {"examples_per_sec_per_chip": round(eps, 1),
+            "world_resize_seconds": round(resize_s, 4),
+            "resumed_pass": None if cursor is None else cursor["pass_id"],
+            "rerouted_records": sum(int(r.num) for r in routed
+                                    if r is not None),
+            "world": 1}
+
+
 def dryrun_main() -> int:
     """Fast CPU smoke of the bench's regression-gate, stage-attribution,
     and push-floor code paths (tier-1: exercised on every PR instead of
@@ -849,6 +931,22 @@ def dryrun_main() -> int:
                             (attr.get("stages") or {}).get("sparse_push"))
     checks["floor_ok"] = "closed" in (detail.get("push_floor") or {})
     ctx.clear()
+    # elastic drill rides the dryrun too: the artifact schema must carry
+    # world_resize_seconds and the degraded matrix point, and tier-1 must
+    # catch drift in those fields before a chip run does
+    try:
+        drill = elastic_drill(True, tiny=True)
+    except Exception as e:
+        drill = {"error": repr(e)}
+    detail.setdefault("matrix", {})["elastic_degraded"] = drill
+    detail["world_resize_seconds"] = drill.get("world_resize_seconds")
+    checks["elastic_fields"] = (
+        isinstance(drill.get("world_resize_seconds"), float)
+        and drill["world_resize_seconds"] > 0
+        and isinstance(drill.get("examples_per_sec_per_chip"),
+                       (int, float))
+        and drill.get("resumed_pass") == 1
+        and drill.get("rerouted_records", 0) > 0)
     detail["telemetry"] = monitor.hub().summary()
     monitor.hub().disable()
     checks["telemetry_embedded"] = (
@@ -881,6 +979,7 @@ def dryrun_main() -> int:
         "push_overlap": detail.get("push_overlap"),
         "push_floor_closed": (detail.get("push_floor") or {}
                               ).get("closed"),
+        "world_resize_seconds": detail.get("world_resize_seconds"),
         "overlap_ab": attr.get("overlap_ab"),
         "stages": attr.get("stages"),
         "gate_example_lines": g1.get("lines"),
@@ -1152,6 +1251,16 @@ def _enrich(small: bool, detail: dict, ctx: dict,
             except Exception as e:   # a matrix point must not kill the run
                 matrix[mname] = {"error": repr(e)}
             _mark(f"matrix point {mname} done")
+        if os.environ.get("PBTPU_BENCH_ELASTIC", "1") != "0":
+            # elastic rank-loss drill: world_resize_seconds + the
+            # degraded (N−1) throughput point, gate-held like the rest
+            try:
+                matrix["elastic_degraded"] = elastic_drill(small)
+                detail["world_resize_seconds"] = \
+                    matrix["elastic_degraded"]["world_resize_seconds"]
+            except Exception as e:
+                matrix["elastic_degraded"] = {"error": repr(e)}
+            _mark("matrix point elastic_degraded done")
         detail["matrix"] = matrix
     if os.environ.get("PBTPU_BENCH_HOST", "1") != "0":
         # tunnel-immune host section, in a CPU subprocess: the parent

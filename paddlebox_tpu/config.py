@@ -184,6 +184,23 @@ class Flags:
     # resumes from the dataset/shuffle cursor instead of replaying the
     # whole pass.
     ckpt_midpass_every_steps: int = 0       # (new)
+    # --- elastic rank-loss recovery (new — distributed/resilience.py) ---
+    # World-size floor for shrink-to-N−1 continuation: when survivors of a
+    # peer failure would number fewer than this, the world checkpoints and
+    # exits cleanly instead of re-forming (an operator decided N/2 ranks
+    # can't carry the working set; 1 = always continue, down to solo).
+    elastic_min_world: int = 1              # (new)
+    # Re-formation epoch patience: how long a survivor waits for its
+    # believed-surviving peers to arrive at (and then ack) a proposed
+    # generation before sealing without them / escalating past them.
+    # Bounds the blast radius of a SECOND failure inside re-formation.
+    elastic_reform_timeout_s: float = 30.0  # (new)
+    # Bounded retry around the re-formation + election + restore sequence
+    # (each retry escalates the generation, dropping newly-failed ranks),
+    # with exponential backoff between attempts. Exhaustion raises the
+    # original PeerFailureError — fail-stop, the pre-elastic behavior.
+    elastic_max_reforms: int = 4            # (new)
+    elastic_reform_backoff_s: float = 0.5   # (new) doubles per attempt
 
     # --- telemetry (new — monitor/ TelemetryHub + utils/profiler) ---
     # RecordEvent span ring capacity: the profiler keeps at most this many

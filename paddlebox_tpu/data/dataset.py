@@ -155,6 +155,30 @@ class SlotDataset:
     def set_shuffle_state(self, state: dict) -> None:
         self._shuffler.load_state_dict(state)
 
+    # ---- elastic world shrink (distributed/resilience.py, ISSUE 6) ----
+
+    def member_shards(self, world_size: int) -> list[SlotRecordBatch]:
+        """Deterministic per-member slices of the current records — the
+        same round-robin split :meth:`prepare_train` uses, returned
+        instead of stored. Every rank computes the identical partition
+        from the identically-shuffled records, so after a rank loss the
+        survivors know exactly which records the departed rank owned
+        without ever having talked to it."""
+        assert self.records is not None
+        n = self.records.num
+        return [self.records.select(np.arange(d, n, world_size))
+                for d in range(world_size)]
+
+    def reroute_records(self, batch: SlotRecordBatch, world_size: int
+                        ) -> list[SlotRecordBatch | None]:
+        """Cursor-preserving re-route of ``batch`` across ``world_size``
+        survivors, drawing destinations from THE persistent shuffle
+        generator (:meth:`shuffle_state`'s cursor). See
+        :func:`paddlebox_tpu.data.shuffle.elastic_reroute` for the
+        lockstep contract."""
+        from paddlebox_tpu.data.shuffle import elastic_reroute
+        return elastic_reroute(batch, world_size, self._shuffler.rng)
+
     def slots_shuffle(self, slot_names: Sequence[str], seed: int = 0) -> None:
         """Shuffle the values of the given sparse slots *across examples*
         (reference BoxPSDataset.slots_shuffle, dataset.py:1191 — used for

@@ -88,6 +88,24 @@ def deserialize_batch(data: bytes, schema) -> SlotRecordBatch:
     )
 
 
+def elastic_reroute(batch: SlotRecordBatch, world_size: int,
+                    rng: np.random.Generator
+                    ) -> list[SlotRecordBatch | None]:
+    """Re-partition a departed rank's unconsumed records across the
+    surviving world (elastic shrink, distributed/resilience.py).
+
+    This is ``route_records`` in random mode drawing from the PERSISTENT
+    shuffle generator — the checkpointable cursor every rank restores to
+    the same state. Because all survivors hold identical RNG state and
+    call this with identical inputs in the same order, each computes the
+    SAME destination assignment and simply keeps its own slice: the
+    departed rank's records land on exactly one survivor each with no
+    exchange traffic, and the generator advances identically everywhere
+    (an empty batch draws nothing, and a world of one routes without
+    drawing — both keep the cursor in lockstep)."""
+    return route_records(batch, world_size, "random", rng=rng)
+
+
 class LocalShuffler:
     """Single-host shuffle: a permutation. world_size == 1.
 

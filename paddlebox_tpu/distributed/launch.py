@@ -39,12 +39,20 @@ def _free_ports(n: int) -> list[int]:
 
 
 def launch(nprocs: int, cmd: list[str], store_dir: str | None = None,
-           base_env: dict | None = None) -> int:
-    """Spawn `nprocs` worker processes; returns first nonzero exit code.
+           base_env: dict | None = None, fail_stop: bool = True,
+           timeout_s: float | None = None):
+    """Spawn `nprocs` worker processes.
 
-    Fail-stop: the moment any worker exits nonzero, the survivors are
-    terminated (a hung peer would otherwise block on its next collective
-    until the store timeout)."""
+    ``fail_stop=True`` (default): returns the first nonzero exit code —
+    the moment any worker exits nonzero, the survivors are terminated (a
+    hung peer would otherwise block on its next collective until the
+    store timeout).
+
+    ``fail_stop=False`` (elastic launches): one rank dying is the EVENT
+    under test, not the end of the job — the launcher waits for every
+    worker to exit on its own (up to ``timeout_s``) and returns the list
+    of per-rank exit codes, so the caller can assert the victim died with
+    its expected code while the survivors shrank and finished."""
     store_dir = store_dir or tempfile.mkdtemp(prefix="pbtpu_store_")
     # one endpoint per rank (shuffle/PS transports) + a dedicated port for
     # the jax.distributed coordinator — rank 0 binds its own endpoint for
@@ -64,19 +72,28 @@ def launch(nprocs: int, cmd: list[str], store_dir: str | None = None,
         env["PBTPU_RUN_ID"] = run_id
         procs.append(subprocess.Popen(cmd, env=env))
     code = 0
+    deadline = (None if timeout_s is None
+                else time.monotonic() + timeout_s)
     try:
         live = set(range(nprocs))
-        while live and code == 0:
+        while live and (fail_stop is False or code == 0):
+            if deadline is not None and time.monotonic() > deadline:
+                if fail_stop and code == 0 and live:
+                    code = 124          # timed out: live workers were
+                break                   # terminated below, not clean
             for i in sorted(live):
                 rc = procs[i].poll()
                 if rc is None:
                     continue
                 live.discard(i)
-                if rc != 0:
+                if rc != 0 and code == 0:
                     code = rc
-                    break
+                    if fail_stop:
+                        break
             else:
                 time.sleep(0.05)
+                continue
+            break
     finally:
         for p in procs:
             if p.poll() is None:
@@ -86,6 +103,8 @@ def launch(nprocs: int, cmd: list[str], store_dir: str | None = None,
                 p.wait(timeout=10)
             except subprocess.TimeoutExpired:
                 p.kill()
+    if not fail_stop:
+        return [p.poll() for p in procs]
     return code
 
 

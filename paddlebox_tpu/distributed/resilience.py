@@ -27,6 +27,33 @@ protocol* (cf. Parallax's fail-stop data-parallel model, arXiv:1808.02621):
 Key namespacing: every key is prefixed by the launch's run id (satellite of
 ISSUE 5) so a restarted world can never consume a dead run's heartbeats or
 barrier arrivals.
+
+ISSUE 6 adds the *response*: instead of fail-stopping on a named peer
+failure, the survivors re-form the world at N−1 and continue
+(:class:`ElasticWorld`). The re-formation epoch is itself a crash window —
+a second failure mid-re-formation must resolve to either the old or the
+new generation, never a mixed world — so membership changes go through a
+**generation-sealed protocol** over the rendezvous store:
+
+1. every survivor publishes an *arrival* under the proposed generation g;
+2. when a survivor sees every peer it believes alive arrive (or its
+   patience expires), it attempts to **seal** generation g's membership
+   with an exclusive-create store record — exactly one proposal wins, and
+   that record IS the membership (a rank not named in it is fenced and
+   exits cleanly);
+3. members then *ack* the sealed record and wait for every member's ack —
+   a member dying between seal and ack is detected by timeout, and the
+   survivors escalate to generation g+1 without it.
+
+Generations are totally ordered and sealed at most once, so two disjoint
+survivor sets can never both form ("split brain" is structurally
+impossible); every post-formation key — barriers, collective rounds,
+heartbeats — lives under a generation-scoped store namespace
+(``run_id.gN``), so a fenced straggler's stale keys can never satisfy the
+new world's waits (and the departed rank's old-namespace keys are swept,
+:meth:`FileStore.sweep_stale`). After formation the survivors rerun the
+PR-5 resume election over the new membership and restore the highest
+snapshot cursor every *survivor* holds intact.
 """
 
 from __future__ import annotations
@@ -42,6 +69,7 @@ from paddlebox_tpu.config import flags as config_flags
 from paddlebox_tpu.distributed.collectives import HostCollectives
 from paddlebox_tpu.distributed.store import FileStore
 from paddlebox_tpu.monitor import context as mon_ctx
+from paddlebox_tpu.utils import faultpoint
 
 
 class PeerFailureError(RuntimeError):
@@ -92,10 +120,18 @@ class HeartbeatMonitor:
                  run_id: str = "", interval_s: float | None = None,
                  lost_after_s: float | None = None,
                  stall_after_s: float | None = None,
-                 watch: bool = True, start: bool = True):
+                 watch: bool = True, start: bool = True,
+                 rank_names: list[int] | None = None):
         self.store = store
         self.rank = rank
         self.world = world
+        # rank_names maps this monitor's dense 0..world-1 ranks to the
+        # launcher's ORIGINAL rank ids (elastic shrunk worlds renumber
+        # densely); errors and telemetry always name the original rank so
+        # operators and drivers speak one rank language across
+        # generations. None = identity.
+        self._names = (None if rank_names is None
+                       else [int(r) for r in rank_names])
         prefix = f"{run_id}." if run_id else ""
         self._key = lambda r: f"{prefix}hb.{r}"
         self.interval_s = (config_flags.heartbeat_interval_s
@@ -209,26 +245,30 @@ class HeartbeatMonitor:
                 # only a rank that HAS published training progress can
                 # stall; a rank idling before its first pass is merely slow
                 stalled.append(r)
+        name = (lambda r: r) if self._names is None \
+            else (lambda r: self._names[r])
         for kind, ranks, exc in (("peer_lost", lost, PeerLostError),
                                  ("peer_stalled", stalled,
                                   PeerStalledError)):
             if not ranks:
                 continue
+            named = [name(r) for r in ranks]
             for r in ranks:
                 if (kind, r) not in self._reported:
                     self._reported.add((kind, r))
                     monitor.counter_add(f"resilience.{kind}")
-                    monitor.event(kind, rank=int(r),
-                                  observer=int(self.rank),
+                    monitor.event(kind, rank=int(name(r)),
+                                  observer=int(name(self.rank)),
                                   after_s=(self.lost_after_s
                                            if kind == "peer_lost"
                                            else self.stall_after_s))
             limit = (self.lost_after_s if kind == "peer_lost"
                      else self.stall_after_s)
             err = exc(
-                f"rank{'s' if len(ranks) > 1 else ''} {ranks} "
+                f"rank{'s' if len(named) > 1 else ''} {named} "
                 f"{'lost (heartbeat stopped)' if kind == 'peer_lost' else 'stalled (no pass/step progress)'} "
-                f"for > {limit:.1f}s (observer rank {self.rank})", ranks)
+                f"for > {limit:.1f}s (observer rank {name(self.rank)})",
+                named)
             if self._failure is None:
                 self._failure = err
             raise err
@@ -306,3 +346,227 @@ def coordinated_resume(checkpointer, trainer, collectives: HostCollectives,
     collectives.barrier("resume_restored")
     cursor["elected"] = list(elected)
     return cursor
+
+
+# ---------------------------------------------------------------------------
+# elastic world re-formation (shrink-to-N−1 continuation, ISSUE 6)
+# ---------------------------------------------------------------------------
+
+
+class WorldFencedError(RuntimeError):
+    """This rank was excluded from a sealed generation — the surviving
+    world moved on without it (it was believed dead/stalled, or arrived
+    after the membership sealed). The only safe response is a clean exit:
+    its state belongs to a timeline the world abandoned."""
+
+    def __init__(self, gen: int, members: list[int], rank: int):
+        super().__init__(
+            f"rank {rank} fenced: generation {gen} sealed with members "
+            f"{members} — this rank is no longer part of the world")
+        self.gen = gen
+        self.members = list(members)
+
+
+class WorldTooSmallError(RuntimeError):
+    """Surviving membership fell below ``flags.elastic_min_world`` — the
+    driver should checkpoint and exit cleanly instead of continuing."""
+
+    def __init__(self, survivors: list[int], floor: int):
+        super().__init__(
+            f"survivors {survivors} fall below elastic_min_world={floor}; "
+            f"checkpoint and exit cleanly instead of shrinking further")
+        self.survivors = list(survivors)
+        self.floor = floor
+
+
+def _world_key(gen: int) -> str:
+    # the generation suffix is deliberately NOT a bare number:
+    # sweep_stale(rank=…) removes keys whose final dot component is a
+    # rank id, and "g3" can never alias rank 3
+    return f"elastic.world.g{gen}"
+
+
+def _reform_key(gen: int, kind: str, rank: int) -> str:
+    return f"elastic.reform.g{gen}.{kind}.{rank}"
+
+
+class ElasticWorld:
+    """One generation of the elastic world: membership, the
+    generation-scoped collectives + heartbeat watchdog, and the
+    re-formation protocol that produces the next generation.
+
+    ``store`` is the BASE run-namespaced FileStore (the re-formation
+    epoch's arrival/seal/ack keys live there, visible across
+    generations); every formed generation's working keys ride a scoped
+    view (``store.scoped("gN")``). ``members`` are ORIGINAL launcher
+    ranks; within a generation ranks renumber densely
+    (``members.index(orig_rank)``) so :class:`HostCollectives` — and
+    everything above it — sees an ordinary contiguous world of size
+    ``len(members)``.
+    """
+
+    def __init__(self, store: FileStore, orig_rank: int,
+                 members: list[int], gen: int = 0,
+                 heartbeat_interval_s: float | None = None,
+                 lost_after_s: float | None = None,
+                 stall_after_s: float | None = None,
+                 reform_timeout_s: float | None = None,
+                 collectives_timeout_s: float | None = None,
+                 initial_world: int | None = None):
+        if orig_rank not in members:
+            raise ValueError(f"rank {orig_rank} not in members {members}")
+        self.store = store
+        self.orig_rank = int(orig_rank)
+        self.members = sorted(int(m) for m in members)
+        self.gen = int(gen)
+        self.initial_world = (len(self.members) if initial_world is None
+                              else int(initial_world))
+        self.reform_timeout_s = (
+            config_flags.elastic_reform_timeout_s
+            if reform_timeout_s is None else float(reform_timeout_s))
+        self._hb_kw = dict(interval_s=heartbeat_interval_s,
+                           lost_after_s=lost_after_s,
+                           stall_after_s=stall_after_s)
+        self._col_timeout = collectives_timeout_s
+        # gen 0 runs on the base namespace (bit-compatible with the
+        # pre-elastic PR-5 layout); later generations get their own scope
+        gen_store = store if self.gen == 0 else store.scoped(f"g{self.gen}")
+        if collectives_timeout_s is not None:
+            gen_store.timeout_s = float(collectives_timeout_s)
+        self.rank = self.members.index(self.orig_rank)
+        self.world = len(self.members)
+        # errors/events name ORIGINAL launcher ranks (rank_names), so the
+        # driver's dead-set bookkeeping works unchanged across renumbered
+        # generations
+        self.heartbeat = HeartbeatMonitor(gen_store, self.rank, self.world,
+                                          rank_names=self.members,
+                                          **self._hb_kw)
+        self.collectives = HostCollectives(gen_store, self.rank, self.world,
+                                           watchdog=self.heartbeat)
+        monitor.gauge_set("resilience.world_size", self.world)
+        monitor.gauge_set("resilience.degraded",
+                          1.0 if self.world < self.initial_world else 0.0)
+
+    # -- liveness ---------------------------------------------------------
+
+    def check(self) -> None:
+        """Poll the generation watchdog (raises PeerLost/PeerStalled
+        naming ORIGINAL launcher ranks)."""
+        self.heartbeat.check()
+
+    def close(self) -> None:
+        self.heartbeat.close()
+
+    # -- re-formation -----------------------------------------------------
+
+    def reform(self, dead_orig_ranks: list[int]) -> "ElasticWorld":
+        """Form the next generation without ``dead_orig_ranks``; returns
+        the new :class:`ElasticWorld` (this one's watchdog is closed).
+
+        Raises :class:`WorldFencedError` when a sealed membership excludes
+        this rank, and :class:`WorldTooSmallError` when survivors fall
+        below ``flags.elastic_min_world``. A FURTHER failure during
+        re-formation (a survivor that never arrives, or arrives but never
+        acks) escalates to the next generation number without it — each
+        generation seals at most once, so every rank that forms lands on
+        the same (gen, members) and a straggler can only be fenced, never
+        split off into a second world."""
+        self.close()
+        dead = set(int(r) for r in dead_orig_ranks)
+        gen = self.gen
+        members = self.members
+        floor = max(1, int(config_flags.elastic_min_world))
+        while True:
+            gen += 1
+            survivors = [r for r in members if r not in dead]
+            if self.orig_rank not in survivors:
+                raise WorldFencedError(gen, survivors, self.orig_rank)
+            if len(survivors) < floor:
+                raise WorldTooSmallError(survivors, floor)
+            t0 = time.monotonic()
+            formed, missing = self._attempt(gen, survivors)
+            if formed is None:
+                # a survivor died INSIDE re-formation: escalate past it
+                monitor.counter_add("resilience.reform_escalations")
+                monitor.event("reform_escalated", gen=gen,
+                              missing=sorted(missing),
+                              rank=self.orig_rank)
+                dead |= set(missing)
+                continue
+            seconds = time.monotonic() - t0
+            monitor.counter_add("resilience.world_reforms")
+            monitor.event("world_resize", type="lifecycle",
+                          from_world=len(members), to_world=len(formed),
+                          gen=gen, members=list(formed),
+                          departed=sorted(set(members) - set(formed)),
+                          rank=self.orig_rank, seconds=seconds)
+            # ghost hygiene: the departed ranks' heartbeat keys, barrier
+            # arrivals and collective contributions must never satisfy a
+            # later wait_count (every survivor sweeps; unlink races are
+            # benign)
+            if self.store.namespace:
+                for r in sorted(set(members) - set(formed)):
+                    self.store.sweep_stale(rank=r)
+            return ElasticWorld(
+                self.store, self.orig_rank, formed, gen=gen,
+                heartbeat_interval_s=self._hb_kw["interval_s"],
+                lost_after_s=self._hb_kw["lost_after_s"],
+                stall_after_s=self._hb_kw["stall_after_s"],
+                reform_timeout_s=self.reform_timeout_s,
+                collectives_timeout_s=self._col_timeout,
+                initial_world=self.initial_world)
+
+    def _attempt(self, gen: int, expected: list[int]
+                 ) -> tuple[list[int] | None, list[int]]:
+        """One generation attempt. Returns (members, []) when generation
+        ``gen`` formed with this rank in it, or (None, missing_ranks)
+        when the attempt must escalate. Raises WorldFencedError when the
+        sealed membership excludes this rank."""
+        store = self.store
+        me = self.orig_rank
+        faultpoint.hit("elastic.reform.pre_arrive")
+        store.set(_reform_key(gen, "arrive", me),
+                  json.dumps({"rank": me, "host": socket.gethostname(),
+                              "pid": os.getpid(),
+                              "expect": expected}).encode())
+        poll = store.poll_s
+        deadline = time.monotonic() + self.reform_timeout_s
+        members: list[int] | None = None
+        while members is None:
+            raw = store.get(_world_key(gen))
+            if raw is not None:
+                members = [int(r) for r in json.loads(raw)["members"]]
+                break
+            arrived = [r for r in expected
+                       if store.get(_reform_key(gen, "arrive", r))
+                       is not None]
+            if (set(arrived) == set(expected)
+                    or time.monotonic() > deadline):
+                # seal with whoever arrived — exactly one sealer wins;
+                # losers read the winner's record on the next poll
+                proposal = json.dumps(
+                    {"gen": gen, "members": sorted(arrived),
+                     "sealed_by": me, "ts": int(time.time())}).encode()
+                if store.set_exclusive(_world_key(gen), proposal):
+                    members = sorted(arrived)
+                    monitor.event("reform_sealed", gen=gen,
+                                  members=members, rank=me)
+                    break
+            time.sleep(poll)
+        faultpoint.hit("elastic.reform.post_seal")
+        if me not in members:
+            raise WorldFencedError(gen, members, me)
+        store.set(_reform_key(gen, "ack", me), b"1")
+        faultpoint.hit("elastic.reform.post_ack")
+        deadline = time.monotonic() + self.reform_timeout_s
+        while True:
+            missing = [r for r in members
+                       if store.get(_reform_key(gen, "ack", r)) is None]
+            if not missing:
+                return members, []
+            if time.monotonic() > deadline:
+                # a member died between seal and ack: nobody trains under
+                # this generation (everyone still here times out the same
+                # way) — escalate without the missing ranks
+                return None, missing
+            time.sleep(poll)

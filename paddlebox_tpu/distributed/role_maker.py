@@ -53,7 +53,24 @@ class RoleMaker:
     def is_first_worker(self) -> bool:
         return self.rank == 0
 
-    def collectives(self, timeout_s: float = 300.0) -> HostCollectives:
+    def with_members(self, members: list[int]) -> "RoleMaker":
+        """A shrunk view of this role for an elastic world re-formation:
+        ``members`` are the surviving ORIGINAL ranks; the survivors keep
+        their endpoints and renumber densely (the new rank is the index
+        within the sorted member list), so everything built from a
+        RoleMaker — shuffle services, PS maps, collectives — sees an
+        ordinary contiguous world of the new size."""
+        members = sorted(int(m) for m in members)
+        if self.rank not in members:
+            raise ValueError(
+                f"rank {self.rank} is not among surviving members "
+                f"{members} — a fenced rank has no shrunk role")
+        return RoleMaker(rank=members.index(self.rank),
+                         endpoints=[self.endpoints[m] for m in members],
+                         store_dir=self.store_dir, run_id=self.run_id,
+                         coordinator=self.coordinator)
+
+    def _check_store_env(self) -> None:
         if self.world_size > 1 and not self.store_dir:
             raise ValueError(
                 f"multi-host run needs {ENV_STORE} (shared filesystem dir) "
@@ -65,15 +82,34 @@ class RoleMaker:
                 "the dead run's published collective results (the launcher "
                 "stamps this automatically; site scripts must set it, e.g. "
                 "to the scheduler job id)")
-        # run-id namespacing lives at the STORE level: every key this
-        # launch writes — collective rounds, heartbeats, barrier
-        # arrivals — is prefixed once, so a restarted job against the
-        # same persistent store dir can never consume a dead run's keys.
+
+    def base_store(self, timeout_s: float = 300.0) -> FileStore:
+        """The launch's run-namespaced rendezvous store. Run-id
+        namespacing lives at the STORE level: every key this launch
+        writes — collective rounds, heartbeats, barrier arrivals, elastic
+        re-formation records — is prefixed once, so a restarted job
+        against the same persistent store dir can never consume a dead
+        run's keys."""
+        self._check_store_env()
+        return FileStore(self.store_dir or "/tmp/pbtpu_store",
+                         timeout_s=timeout_s, namespace=self.run_id)
+
+    def collectives(self, timeout_s: float = 300.0) -> HostCollectives:
         # (HostCollectives/HeartbeatMonitor keep their own run_id
         # parameters for direct users on bare stores; don't set both.)
-        store = FileStore(self.store_dir or "/tmp/pbtpu_store",
-                          timeout_s=timeout_s, namespace=self.run_id)
-        return HostCollectives(store, self.rank, self.world_size)
+        return HostCollectives(self.base_store(timeout_s), self.rank,
+                               self.world_size)
+
+    def elastic_world(self, timeout_s: float = 300.0, **kw):
+        """An :class:`~paddlebox_tpu.distributed.resilience.ElasticWorld`
+        for this launch: generation 0 spans every launched rank; on a
+        peer failure the driver calls ``world.reform`` (usually through
+        ``Trainer.recover_world``) to shrink and continue. Heartbeat and
+        re-formation tunables pass through ``**kw``."""
+        from paddlebox_tpu.distributed.resilience import ElasticWorld
+        return ElasticWorld(self.base_store(timeout_s), self.rank,
+                            list(range(self.world_size)),
+                            collectives_timeout_s=timeout_s, **kw)
 
     def init_distributed(self, sim_cpu_devices: int | None = None) -> None:
         """Join the global JAX process group (real multi-host pods).

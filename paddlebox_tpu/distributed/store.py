@@ -25,6 +25,7 @@ Crash-resilience notes (multi-host recovery protocol):
 from __future__ import annotations
 
 import os
+import re
 import socket
 import time
 import uuid
@@ -42,6 +43,17 @@ class FileStore:
         self.namespace = namespace
         os.makedirs(root, exist_ok=True)
 
+    def scoped(self, suffix: str) -> "FileStore":
+        """A view of the same store dir with ``suffix`` appended to the
+        namespace. The elastic world re-formation protocol scopes every
+        generation's keys this way (``run_id.gN``): a rank still at
+        generation N-1 can never satisfy — or be satisfied by — a
+        generation-N wait, so a shrunk world and a fenced straggler can
+        share the store dir without mixing."""
+        ns = f"{self.namespace}.{suffix}" if self.namespace else suffix
+        return FileStore(self.root, timeout_s=self.timeout_s,
+                         poll_s=self.poll_s, namespace=ns)
+
     def _path(self, key: str) -> str:
         if self.namespace:
             key = f"{self.namespace}.{key}"
@@ -58,6 +70,28 @@ class FileStore:
         with open(tmp, "wb") as f:
             f.write(value)
         os.replace(tmp, path)  # atomic publish
+
+    def set_exclusive(self, key: str, value: bytes) -> bool:
+        """Publish ``key`` only if it does not exist yet; returns whether
+        THIS caller won. Atomic via ``os.link`` (hard-link creation fails
+        with EEXIST exactly once per target, and the linked content is
+        complete — the classic NFS-safe lockfile move), so N racing
+        writers agree on a single winner whose full value every reader
+        sees. The elastic re-formation protocol seals each generation's
+        membership through this: one survivor's proposal becomes THE
+        membership record for that generation."""
+        path = self._path(key)
+        tmp = (f"{path}.tmp.{socket.gethostname()}.{os.getpid()}."
+               f"{uuid.uuid4().hex[:8]}")
+        with open(tmp, "wb") as f:
+            f.write(value)
+        try:
+            os.link(tmp, path)
+            return True
+        except FileExistsError:
+            return False
+        finally:
+            os.remove(tmp)
 
     def delete(self, key: str) -> None:
         try:
@@ -113,36 +147,71 @@ class FileStore:
                     f"ranks arrived; missing ranks {missing}")
             time.sleep(self.poll_s)
 
-    def sweep_stale(self, max_age_s: float) -> int:
-        """Unlink OTHER namespaces' store files older than ``max_age_s``
-        (by mtime); returns the count removed. Hygiene for persistent
-        store dirs reused across launches — an abandoned run's keys (and
-        orphaned ``.tmp.`` files) would otherwise accumulate forever. The
-        run-id *namespace* is what prevents a previous launch's keys from
-        satisfying a barrier; this sweep merely reclaims the disk.
+    def sweep_stale(self, max_age_s: float | None = None,
+                    rank: int | None = None) -> int:
+        """Store hygiene; returns the count of files removed. Two modes,
+        combinable:
 
-        The current namespace's keys are NEVER swept, whatever their age:
-        a rank can legitimately sit minutes in a barrier (a straggler
-        peer in a long pass) with its arrival file aging past any
-        threshold — deleting it would wedge the live collective. An
-        un-namespaced store therefore refuses to sweep (no way to tell
-        our keys from a dead run's). Concurrent-safe: a racing unlink is
-        ignored."""
+        - ``max_age_s``: unlink OTHER namespaces' store files older than
+          ``max_age_s`` (by mtime). For persistent store dirs reused
+          across launches — an abandoned run's keys (and orphaned
+          ``.tmp.`` files) would otherwise accumulate forever. The run-id
+          *namespace* is what prevents a previous launch's keys from
+          satisfying a barrier; this sweep merely reclaims the disk.
+          The current namespace's keys are NEVER age-swept, whatever
+          their age: a rank can legitimately sit minutes in a barrier (a
+          straggler peer in a long pass) with its arrival file aging past
+          any threshold — deleting it would wedge the live collective.
+
+        - ``rank``: remove the named DEPARTED rank's keys *within the
+          live namespace* — its heartbeat (``hb.<rank>``), barrier
+          arrivals and collective contributions (keys whose final dot
+          component is ``<rank>`` or ``v<rank>``). After an elastic world
+          shrink the new generation's ``wait_count`` must never count the
+          ghost's stale arrivals, and a lingering heartbeat file would
+          read as a live-then-frozen peer forever. Rank ownership is
+          encoded in the key suffix by every writer (``add``, the
+          collectives, the heartbeat monitor, the re-formation protocol);
+          non-rank-owned keys (sealed ``...gN`` records, ``.out`` reduce
+          results) never end in a bare rank number. Generation-scoped
+          sub-namespaces (``<ns>.gN.…``) are NEVER rank-swept: their
+          keys use the generation's DENSE renumbering, so an original
+          rank id could alias a surviving rank's live key there (old
+          generations are inert and age out; the new one is live).
+
+        An un-namespaced store refuses to sweep (no way to tell our keys
+        from a dead run's, nor a rank's keys from same-named files of
+        another launch). Concurrent-safe: a racing unlink is ignored."""
         if not self.namespace:
             raise ValueError(
                 "sweep_stale needs a namespaced store: without a run-id "
                 "prefix the sweep cannot distinguish the live run's keys "
                 "(e.g. a barrier arrival aging while a straggler trains) "
                 "from an abandoned run's")
+        if max_age_s is None and rank is None:
+            raise ValueError("sweep_stale needs max_age_s and/or rank")
         own = f"{self.namespace}."
+        rank_suffixes = (None if rank is None
+                         else {str(int(rank)), f"v{int(rank)}"})
         now = time.time()
         removed = 0
         for name in os.listdir(self.root):
-            if name.startswith(own):
-                continue         # the live run's keys are untouchable
             p = os.path.join(self.root, name)
             try:
-                if now - os.path.getmtime(p) > max_age_s:
+                if name.startswith(own):
+                    # live namespace: only the departed rank's keys go —
+                    # but never inside a generation scope, whose dense
+                    # renumbering could alias a survivor's key
+                    rest = name[len(own):]
+                    gen_scoped = re.match(r"g\d+\.", rest) is not None
+                    if (rank_suffixes is not None and not gen_scoped
+                            and ".tmp." not in name
+                            and name.rsplit(".", 1)[-1] in rank_suffixes):
+                        os.remove(p)
+                        removed += 1
+                    continue
+                if (max_age_s is not None
+                        and now - os.path.getmtime(p) > max_age_s):
                     os.remove(p)
                     removed += 1
             except OSError:

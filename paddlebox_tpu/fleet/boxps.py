@@ -57,12 +57,32 @@ class BoxPS:
         stalled peer surfaces as a named-rank PeerLost/PeerStalled error
         instead of the bare store timeout — and each boundary publishes a
         fresh heartbeat so peers see this rank's pass progress
-        immediately."""
+        immediately.
+
+        Re-attachable: after an elastic world re-formation the driver (or
+        ``Trainer.recover_world``) attaches the NEW generation's
+        collectives + heartbeat — pass barriers then ride the new
+        generation's store namespace, so a fenced straggler's stale
+        arrivals can never satisfy them."""
         self._col = collectives
         self._heartbeat = heartbeat
         if heartbeat is not None and getattr(collectives, "watchdog",
                                              None) is None:
             collectives.watchdog = heartbeat
+
+    def abort_pass(self, reason: str = "") -> None:
+        """Close an open pass WITHOUT the end-of-pass snapshot/barrier —
+        the elastic drain path: a peer failure unwound the step loop
+        mid-pass, the world is about to re-form, and the normal
+        ``end_pass`` barrier would hang on the dead rank. Safe when no
+        pass is open (no-op). The telemetry pass scope is aborted so the
+        flight record is not committed for a half-trained pass."""
+        if not self.in_pass:
+            return
+        self.in_pass = False
+        monitor.hub().abort_pass(reason=reason or "pass aborted")
+        monitor.event("pass_aborted", pass_id=int(self.pass_id),
+                      reason=reason[:200])
 
     @property
     def phase(self) -> int:

@@ -43,6 +43,7 @@ from paddlebox_tpu.parallel import mesh as mesh_lib
 from paddlebox_tpu import monitor
 from paddlebox_tpu.monitor import context as mon_ctx
 from paddlebox_tpu.monitor.timers import StageTimers
+from paddlebox_tpu.utils import faultpoint
 from paddlebox_tpu.utils.profiler import DumpStream, dump_tree, find_nonfinite
 
 # arity of the binned-push host plan inside a staged batch tuple:
@@ -269,6 +270,19 @@ class Trainer:
         # resume replays the identical pass order.
         self._midpass: tuple | None = None
         self.midpass_cursor_extra: dict = {}
+        # elastic peer liveness hook (distributed/resilience.ElasticWorld
+        # .check): polled once per step so a dead/stalled peer aborts the
+        # step loop at a safe boundary (the finally below drains the
+        # push-overlap stager and rebinds live state) instead of training
+        # on until the next pass barrier. None = no watchdog attached.
+        self.peer_check: Callable[[], None] | None = None
+        # post-pass cursor crumbs for the elastic drain snapshot: how far
+        # the (possibly aborted) last pass got, its working set, and
+        # whether it ended by exception
+        self.last_pass_steps = 0
+        self._last_ws = None
+        self._last_dense: tuple | None = None
+        self._pass_aborted = False
         self.feed_mgr.register_pre_flush(self.flush_push)
         self._rebuild_steps()
         self._auc_fn = jax.jit(auc_lib.auc_update)
@@ -823,6 +837,7 @@ class Trainer:
         backend deadlocks its collective rendezvous when another thread
         dispatches transfers mid-step, and single-dispatcher discipline
         costs nothing: the put itself is an async dispatch)."""
+        faultpoint.hit("trainer.pack.pre")
         with self.timers("translate"):
             idx = ws.translate(pb.ids, pb.mask)
             labels, dense = self.split_floats(pb.floats)
@@ -1187,6 +1202,12 @@ class Trainer:
                     continue
                 pb = pbs[-1]
                 mon_ctx.set_step(self.global_step)
+                if self.peer_check is not None:
+                    # elastic watchdog: a dead/stalled peer aborts HERE —
+                    # a step boundary, before this batch dispatches — and
+                    # the finally below drains in-flight work
+                    self.peer_check()
+                faultpoint.hit("trainer.step.pre")
                 with monitor.span("pack_batch"):
                     idx, mask, dense, labels, *plan = staged
                 with self.timers("train"), monitor.span("train_step"):
@@ -1201,6 +1222,7 @@ class Trainer:
                         table, gp_flat, loss, preds, dropped = self._step_fn(
                             table, params, idx, mask, dense, labels, *plan)
                         self.dense_table.push(np.asarray(gp_flat))
+                        pass_step += 1
                     elif self.push_overlap:
                         # deferred push pipeline: dispatch step N-1's
                         # pending table apply FIRST (the next step's pull
@@ -1314,6 +1336,13 @@ class Trainer:
                     table = self._midpass_save(table, ws, dstate, params,
                                                opt_state, pass_step)
         finally:
+            import sys as _sys
+            # elastic drain crumbs: how far this pass got and whether it
+            # aborted (a peer failure unwinding through here) — the
+            # drain snapshot reads these after the exception lands
+            self.last_pass_steps = pass_step
+            self._last_ws = ws
+            self._pass_aborted = _sys.exc_info()[0] is not None
             # close the pack generator explicitly so its finally (cancel
             # event + producer join) runs NOW, not whenever GC finalizes
             # the suspended frame — on a non-refcounting interpreter the
@@ -1337,7 +1366,17 @@ class Trainer:
                 self.params = jax.device_put(
                     self._unravel(self.dense_table.pull()), repl)
                 self.opt_state = self.dense_table.state_dict()
+                self._last_dense = None      # state dict IS the state
             else:
+                # elastic drain crumb: the LIVE loop planes exactly as
+                # _midpass_save would store them — for kstep, BEFORE the
+                # finalize pmean below (k·x/k can round for
+                # non-power-of-2 shard counts, and the drain snapshot
+                # must stay bit-identical to the stacked loop state the
+                # uninterrupted run continues from)
+                self._last_dense = (self.unpack_dense(dstate)
+                                    if dstate is not None
+                                    else (params, opt_state))
                 if mode == "kstep":  # end-of-pass sync (trainer Finalize)
                     params, opt_state = self._sync_fn(params, opt_state)
                 if dstate is not None:
@@ -1679,17 +1718,38 @@ class Trainer:
         driver stashed in ``midpass_cursor_extra['shuffle_state']``
         (captured BEFORE the pass's permutation draw) — so a kill between
         pass boundaries resumes via ``train_pass(skip_steps=mid_steps)``
-        from the dataset cursor instead of replaying the pass. Allreduce
-        dense sync with ``steps_per_dispatch == 1`` only: the snapshot
-        needs the live dense planes off the single-step loop."""
+        from the dataset cursor instead of replaying the pass.
+
+        Supported dense-sync modes (all with ``steps_per_dispatch == 1``
+        — the cursor is per single-step program):
+
+        - ``allreduce``: any cadence; the live flat/pytree dense state
+          rides ``dense_override``.
+        - ``kstep``: ``every_steps`` must land on the K-step sync
+          boundary (a multiple of ``param_sync_step``) — that is where
+          the per-shard replicas are consistent with the uninterrupted
+          run's sync cadence; the snapshot stores the STACKED per-shard
+          planes, so the resume is bit-exact.
+        - ``async``: the snapshot quiesces the host dense table
+          (``flush()``) and stores its state dict — exact state at the
+          boundary, though the continued run's grad-merge timing remains
+          async-nondeterministic by design.
+        """
         if every_steps <= 0:
             self._midpass = None
             return
-        if self.cfg.dense_sync_mode != "allreduce" \
-                or self.cfg.steps_per_dispatch != 1:
+        mode = self.cfg.dense_sync_mode
+        if self.cfg.steps_per_dispatch != 1:
             raise NotImplementedError(
-                "mid-pass snapshots need dense_sync_mode='allreduce' and "
-                "steps_per_dispatch=1")
+                "mid-pass snapshots need steps_per_dispatch=1 (the "
+                "cursor is per single-step program)")
+        if mode == "kstep" and every_steps % self.cfg.param_sync_step:
+            raise NotImplementedError(
+                f"kstep mid-pass snapshots must land on the K-step sync "
+                f"boundary: every_steps={every_steps} is not a multiple "
+                f"of param_sync_step={self.cfg.param_sync_step} — "
+                f"between syncs the replicas' consistency cadence would "
+                f"diverge from the uninterrupted run on resume")
         if box is None:
             raise ValueError("enable_midpass_snapshots needs a BoxPS "
                              "(the cursor's pass identity)")
@@ -1704,12 +1764,20 @@ class Trainer:
         lifted only around the save: at this instruction the loop owns a
         quiescent table (no step dispatched past it), so the D2H gather
         reads a live buffer."""
-        from paddlebox_tpu.utils import faultpoint
         ckpt, _every, box, metrics = self._midpass
         table = self._dispatch_pending_apply(table)
         ws.table = table
-        dense = (self.unpack_dense(dstate) if dstate is not None
-                 else (params, opt_state))
+        if self.cfg.dense_sync_mode == "async":
+            # quiesce the host dense table: every pushed grad applied, so
+            # the state dict is THE dense state at this step boundary
+            self.dense_table.flush()
+            dense = (self._unravel(self.dense_table.pull()),
+                     self.dense_table.state_dict())
+        else:
+            # allreduce: live flat/pytree state; kstep: the loop's STACKED
+            # per-shard planes (restore_dense detects stacked shapes)
+            dense = (self.unpack_dense(dstate) if dstate is not None
+                     else (params, opt_state))
         self.feed_mgr.pass_closed()
         try:
             # mark this pass's touched rows unsynced so the checkpointer's
@@ -1726,6 +1794,113 @@ class Trainer:
             self.feed_mgr.pass_opened()
         faultpoint.hit("trainer.midpass.post_save")
         return table
+
+    def drain_and_snapshot(self, checkpointer, box, metrics=None
+                           ) -> str | None:
+        """Elastic drain point: after a peer failure aborted the step
+        loop, the in-flight work is already landed (the pass's finally
+        dispatched the pending deferred push, rebound the live dense
+        planes, and closed the pack pipeline) — commit a mid-pass
+        snapshot at the abort step so the coming election can keep as
+        much of this pass as the world holds in common. Returns the
+        snapshot dir, or None when there is nothing to snapshot (the
+        failure surfaced at a pass boundary, or a kstep abort landed
+        between sync boundaries — the election then falls back to the
+        newest committed snapshot)."""
+        if box is None or not box.in_pass or not self._pass_aborted:
+            return None
+        steps = int(self.last_pass_steps)
+        ws = self._last_ws
+        if steps <= 0 or ws is None:
+            return None
+        mode = self.cfg.dense_sync_mode
+        if mode == "kstep" and steps % self.cfg.param_sync_step:
+            # between syncs the uninterrupted run's cadence cannot be
+            # reproduced from here; skipping is safe — the election falls
+            # back — and observable
+            monitor.event("drain_snapshot_skipped",
+                          reason="kstep_off_sync_boundary", steps=steps)
+            return None
+        if mode == "async":
+            self.dense_table.flush()
+            dense = (self._unravel(self.dense_table.pull()),
+                     self.dense_table.state_dict())
+        else:
+            # the pre-finalize loop planes the pass finally stashed —
+            # for kstep the STACKED per-shard state, not the pmean'd
+            # finalize output (which can differ by an ulp for
+            # non-power-of-2 shard counts)
+            dense = self._last_dense
+        # the aborted pass never reached feed end_pass: mark its touched
+        # rows unsynced so the checkpointer's flush materializes them
+        self.feed_mgr.end_pass(ws, ws.table)
+        snap = checkpointer.save(
+            self, box=box,
+            metrics=(metrics if metrics is not None else box.metrics),
+            pass_id=int(box.pass_id) - 1, mid_steps=steps,
+            dense_override=dense,
+            shuffle_state=self.midpass_cursor_extra.get("shuffle_state"))
+        monitor.counter_add("resilience.drain_snapshots")
+        monitor.event("drain_snapshot", type="lifecycle",
+                      snapshot=snap, mid_steps=steps)
+        return snap
+
+    def recover_world(self, world, failure, checkpointer, box,
+                      metrics=None):
+        """The elastic catch-arm: a :class:`PeerFailureError` escaped the
+        pass loop — drain-snapshot, re-form the world without the dead
+        ranks, re-run the coordinated resume election over the survivors,
+        and hand back ``(new_world, cursor)`` for the driver to continue
+        from (``cursor`` may be None when the survivors hold no common
+        snapshot: whole-world fresh start).
+
+        Bounded retry with exponential backoff: a FURTHER failure during
+        the re-formation/election window escalates the generation and
+        retries up to ``flags.elastic_max_reforms`` times; exhaustion
+        re-raises the original failure (fail-stop, the pre-elastic
+        behavior). When survivors would fall below
+        ``flags.elastic_min_world`` the drain snapshot already committed
+        — returns ``(None, None)`` so the driver checkpoints-and-exits
+        cleanly. A :class:`WorldFencedError` (this rank was excluded by a
+        sealed generation) propagates: the rank's timeline was abandoned,
+        exiting cleanly is the only safe move."""
+        from paddlebox_tpu.distributed import resilience
+        self.drain_and_snapshot(checkpointer, box, metrics=metrics)
+        if box is not None and box.in_pass:
+            box.abort_pass(reason=repr(failure))
+        dead = sorted(set(int(r) for r in failure.ranks))
+        backoff = float(config_flags.elastic_reform_backoff_s)
+        for attempt in range(max(1, int(config_flags.elastic_max_reforms))):
+            if attempt:
+                time.sleep(backoff)
+                backoff *= 2.0
+            try:
+                new_world = world.reform(dead)
+            except resilience.WorldTooSmallError as e:
+                monitor.event("elastic_min_world_exit", type="lifecycle",
+                              survivors=e.survivors, floor=e.floor)
+                return None, None
+            self.peer_check = new_world.check
+            if box is not None:
+                box.attach_collectives(new_world.collectives,
+                                       heartbeat=new_world.heartbeat)
+            try:
+                cursor = resilience.coordinated_resume(
+                    checkpointer, self, new_world.collectives, box=box,
+                    metrics=(metrics if metrics is not None
+                             else (box.metrics if box is not None
+                                   else None)))
+                monitor.counter_add("resilience.elastic_recoveries")
+                return new_world, cursor
+            except resilience.PeerFailureError as e:
+                # another rank died inside the election/restore window;
+                # the restore is idempotent (at worst this rank already
+                # stands on the elected snapshot and re-elects it) —
+                # escalate the generation without the newly dead
+                world = new_world
+                dead = sorted(set(int(r) for r in e.ranks))
+                failure = e
+        raise failure
 
     def save_checkpoint(self, checkpointer, box=None, metrics=None,
                         pass_id: int | None = None) -> str:

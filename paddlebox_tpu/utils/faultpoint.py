@@ -69,6 +69,34 @@ POINTS: tuple[str, ...] = (
     # remote restore: about to download a snapshot/model dir — dying here
     # must leave the next resume able to re-download from the donefile.
     "remote_ckpt.download.pre",
+    # train/trainer._pack_host: a batch's translate/plan is about to run
+    # on the pack-pipeline thread — a mid-pass kill in the host pipeline
+    # (the "pack" phase of the elastic kill matrix; also a plain
+    # kill→resume window).
+    "trainer.pack.pre",
+    # train/trainer train loop: the jitted step for this batch is about
+    # to dispatch — the tightest mid-pass kill window (elastic "step
+    # dispatch" phase; also a plain kill→resume window).
+    "trainer.step.pre",
+    # distributed/resilience ElasticWorld._attempt: the re-formation
+    # window itself. pre_arrive = drained + snapshotted, about to join
+    # the epoch; post_seal = membership sealed/read, ack not yet sent;
+    # post_ack = acked, peers may or may not have completed — a kill at
+    # any of these must leave the survivors converging on ONE generation
+    # (the next one, without this rank), never a mixed world.
+    "elastic.reform.pre_arrive",
+    "elastic.reform.post_seal",
+    "elastic.reform.post_ack",
+)
+
+# Points that fire only inside the elastic re-formation window: the
+# single-host and plain multi-host kill→resume matrices never reach them
+# (no reform happens there) — they are covered by the elastic kill matrix
+# (tests/test_elastic.py) instead.
+ELASTIC_POINTS: tuple[str, ...] = (
+    "elastic.reform.pre_arrive",
+    "elastic.reform.post_seal",
+    "elastic.reform.post_ack",
 )
 
 

@@ -333,6 +333,7 @@ class PassCheckpointer:
                            "snapshot": snap_name, "chain": chain_name,
                            "save_seq": int(save_seq),
                            "ts": int(time.time())})
+        self._repair_donefile(fs)
         fs.write_text(f"{rroot}/{REMOTE_DONEFILE}", line + "\n",
                       append=True)
         seconds = time.perf_counter() - t0
@@ -342,19 +343,51 @@ class PassCheckpointer:
                       snapshot=snap_name, chain=chain_name,
                       seconds=seconds)
 
-    def _remote_entries(self) -> list[dict]:
-        """Donefile entries in append order, with ``reset_after`` lines
-        applied: an elected rollback masks the abandoned timeline's newer
-        entries so a later restore can never resurrect them."""
+    def _read_donefile_raw(self) -> list[str]:
+        """Raw donefile lines. Falls back to the ``.compact`` staging
+        copy when the main file is missing — the compaction rewrite
+        uploads the compacted content there FIRST, so a kill between the
+        main file's rm and put can never lose the donefile."""
         fs = self._remote_fs()
         path = f"{self.remote_root}/{REMOTE_DONEFILE}"
         if not fs.exists(path):
-            return []
+            alt = f"{path}.compact"
+            if not fs.exists(alt):
+                return []
+            path = alt
+        return [ln.strip() for ln in fs.read_lines(path) if ln.strip()]
+
+    def _repair_donefile(self, fs) -> None:
+        """Finish an interrupted compaction BEFORE appending: a kill
+        between the compaction's rm and put leaves only the ``.compact``
+        staging copy — readers fall back to it, but an append would
+        recreate the main file with a single line, silently shadowing
+        the whole history (and the next prune would then reclaim every
+        'unreferenced' dir). Restore the main file from the staging copy
+        first; the append then extends the full history."""
+        path = f"{self.remote_root}/{REMOTE_DONEFILE}"
+        alt = f"{path}.compact"
+        if fs.exists(path) or not fs.exists(alt):
+            return
+        tmp = os.path.join(self.root, f".donefile.repair.{os.getpid()}")
+        try:
+            fs.get(alt, tmp)
+            fs.put(tmp, path)
+            fs.rm(alt)
+        finally:
+            if os.path.exists(tmp):
+                os.remove(tmp)
+        monitor.counter_add("ckpt.donefile_repairs")
+        monitor.event("donefile_repaired", type="lifecycle")
+
+    def _remote_entries(self, raw_lines: list[str] | None = None
+                        ) -> list[dict]:
+        """Donefile entries in append order, with ``reset_after`` lines
+        applied: an elected rollback masks the abandoned timeline's newer
+        entries so a later restore can never resurrect them."""
         out: list[dict] = []
-        for raw in fs.read_lines(path):
-            raw = raw.strip()
-            if not raw:
-                continue
+        for raw in (self._read_donefile_raw() if raw_lines is None
+                    else raw_lines):
             e = json.loads(raw)
             if "reset_after" in e:
                 ra = tuple(e["reset_after"])
@@ -667,3 +700,75 @@ class PassCheckpointer:
             if _CHAIN_RE.match(n) and n not in referenced:
                 shutil.rmtree(os.path.join(self.root, n),
                               ignore_errors=True)
+        if self.remote_root is not None:
+            try:
+                self._prune_remote()
+            except (RuntimeError, OSError, ValueError) as e:
+                # retention is hygiene, not correctness: the donefile's
+                # download-side verification is the backstop, and the
+                # next save retries the compaction
+                warnings.warn(f"remote snapshot retention failed ({e}); "
+                              f"will retry at the next save")
+
+    def _prune_remote(self) -> None:
+        """Mirror-side retention (ISSUE 6 satellite): without this, the
+        remote root and ``snapshots.donefile`` grow unboundedly — every
+        pass appends a line and uploads a dir, and an elected rollback's
+        masked (``reset_after``-shadowed) entries stay on disk forever.
+
+        Keeps the newest ``keep_last_n`` donefile entries per pool
+        (pass-boundary and mid-pass separately, mirroring local
+        retention), rewrites the donefile to exactly those lines —
+        dropping pruned AND masked lines — and then removes remote
+        snapshot/chain dirs no kept entry references. Order matters for
+        crash safety: the donefile shrinks FIRST (a kill after that
+        leaves orphan dirs the next compaction reclaims, never a donefile
+        line naming a deleted dir), and the rewrite itself stages the
+        compacted content at ``snapshots.donefile.compact`` before
+        replacing the main file (readers fall back to the staging copy,
+        so no kill point loses the donefile)."""
+        raw = self._read_donefile_raw()
+        if not raw:
+            return
+        entries = self._remote_entries(raw)
+        keep = max(1, int(self.keep_last_n))
+        fulls = [e for e in entries if not int(e.get("mid", 0))]
+        mids = [e for e in entries if int(e.get("mid", 0))]
+        kept_ids = {id(e) for e in fulls[-keep:] + mids[-keep:]}
+        kept = [e for e in entries if id(e) in kept_ids]
+        fs = self._remote_fs()
+        donefile = f"{self.remote_root}/{REMOTE_DONEFILE}"
+        if len(kept) != len(raw):
+            # two-phase donefile rewrite: stage → replace → unstage
+            tmp = os.path.join(self.root,
+                               f".donefile.compact.{os.getpid()}")
+            with open(tmp, "w") as f:
+                for e in kept:
+                    f.write(json.dumps(e) + "\n")
+            try:
+                fs.rm(f"{donefile}.compact")
+                fs.put(tmp, f"{donefile}.compact")
+                fs.rm(donefile)
+                fs.put(tmp, donefile)
+                fs.rm(f"{donefile}.compact")
+            finally:
+                os.remove(tmp)
+            monitor.counter_add("ckpt.donefile_compactions")
+            monitor.event("donefile_compacted", type="lifecycle",
+                          dropped=len(raw) - len(kept), kept=len(kept))
+        kept_snaps = {e["snapshot"] for e in kept}
+        kept_chains = {e["chain"] for e in kept}
+        if self._chain_dir is not None:
+            kept_chains.add(self._chain_dir)     # the open chain
+        removed = 0
+        for path in fs.ls(self.remote_root):
+            name = os.path.basename(path.rstrip("/"))
+            if _PASS_RE.match(name) and name not in kept_snaps:
+                fs.rm(f"{self.remote_root}/{name}")
+                removed += 1
+            elif _CHAIN_RE.match(name) and name not in kept_chains:
+                fs.rm(f"{self.remote_root}/{name}")
+                self._uploaded_chains.discard(name)
+                removed += 1
+        if removed:
+            monitor.counter_add("ckpt.remote_pruned_dirs", removed)

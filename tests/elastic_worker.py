@@ -1,0 +1,431 @@
+"""Worker process for the elastic shrink-to-N−1 kill matrix (ISSUE 6).
+
+Launched (N processes, ``fail_stop=False``) by tests/test_elastic.py.
+Every rank loads the SAME full dataset (ins_id = 1..n) and partitions
+each pass deterministically over the live member list through the
+persistent shuffle RNG — identical state on every rank at every pass
+boundary — so after a rank loss the survivors know exactly which records
+the departed rank owned and re-route its unconsumed tail among
+themselves with zero exchange traffic (``SlotDataset.reroute_records``).
+
+The failure-response loop is the production shape:
+
+  try: partition → begin_pass → train_pass → end_pass(checkpointer)
+  except PeerFailureError:
+      world, cursor = trainer.recover_world(world, e, ckpt, box)
+      # drain + mid-pass drain-snapshot + generation-sealed re-formation
+      # + coordinated election over the survivors + restore; continue
+      # the pass from the elected cursor with the dead tail re-routed
+
+Outputs per ORIGINAL rank r (under PBTPU_TEST_WORKDIR):
+  out_{r}.npz       final dense/sparse/metric planes + global AUC
+  info_{r}.json     elected cursor, final generation/members, reroute ids
+  consumed_{r}.json per-pass consumed ins_ids of the SURVIVING timeline
+  events_{r}.jsonl  telemetry (world_resize / reform_* / peer_* events)
+
+Env knobs (see tests/test_elastic.py):
+  PBTPU_ELASTIC_ROOT        snapshot roots base (per-rank subdir)
+  PBTPU_ELASTIC_PASSES      pass count (default 3)
+  PBTPU_ELASTIC_N           dataset size (default 768 → 8 steps/rank @ 3)
+  PBTPU_ELASTIC_MIDPASS     mid-pass snapshot cadence (default 2)
+  PBTPU_ELASTIC_STEP_SLEEP  per-step sleep (slows passes so detection
+                            lands MID-pass — the mid-cursor reroute path)
+  PBTPU_ELASTIC_LOST_S      watchdog lost_after seconds (default 2.0)
+  PBTPU_FAULTPOINT(+_ONLY_RANK/_AFTER)   first victim's kill
+  PBTPU_FAULTPOINT2(+_RANK/_AFTER)       second victim (in-reform kills)
+  PBTPU_ELASTIC_SIM         JSON {"orig_members": [...], "dead": [...],
+                            "elected": [q, m]} — SIMULATED-shrink golden:
+                            no kill, no reform; replay the exact record
+                            schedule the recovered world trained, at N−1
+                            from the start. Final planes must be
+                            bit-identical to the survivors of the real
+                            killed run.
+"""
+
+import json
+import os
+import sys
+import time
+import traceback
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np  # noqa: E402
+
+from crash_worker import synth  # noqa: E402
+from paddlebox_tpu import monitor  # noqa: E402
+from paddlebox_tpu.data import SlotDataset  # noqa: E402
+from paddlebox_tpu.data.slot_record import SlotRecordBatch  # noqa: E402
+from paddlebox_tpu.distributed import RoleMaker  # noqa: E402
+from paddlebox_tpu.distributed.resilience import (PeerFailureError,  # noqa: E402
+                                                  WorldFencedError)
+from paddlebox_tpu.embedding import (EmbeddingConfig,  # noqa: E402
+                                     HostEmbeddingStore)
+from paddlebox_tpu.fleet import BoxPS  # noqa: E402
+from paddlebox_tpu.models import DNNCTRModel  # noqa: E402
+from paddlebox_tpu.parallel import make_mesh  # noqa: E402
+from paddlebox_tpu.train import Trainer, TrainerConfig  # noqa: E402
+from paddlebox_tpu.utils import faultpoint  # noqa: E402
+from paddlebox_tpu.utils.pass_ckpt import PassCheckpointer  # noqa: E402
+
+NUM_SLOTS = 3
+BS = 32
+
+
+def _ds_for(schema, records) -> SlotDataset:
+    d = SlotDataset(schema)
+    d.records = records
+    return d
+
+
+def _concat(parts):
+    parts = [p for p in parts if p is not None and p.num > 0]
+    return SlotRecordBatch.concat(parts) if parts else None
+
+
+def build_pass_records(ds, base, me, members, old_members=None, skip=0):
+    """One pass's record stream for rank ``me``, via the shared RNG.
+
+    Draws EXACTLY: one permutation (the pass order), plus — when
+    continuing a shrunk pass (``skip`` > 0 over the ``old_members``
+    partition) — one reroute draw per departed-rank tail, in sorted
+    departed order. Every rank (live survivors AND the simulated golden)
+    performs the same draws in the same order, so the cursor stays in
+    lockstep. Returns (records_or_None, own_head_ids): records to train
+    with skip_steps=0, and the ins_ids of the already-consumed own head
+    (the elected cursor's m batches)."""
+    ds.records = base
+    ds.local_shuffle()                    # the pass's permutation draw
+    if skip > 0 and old_members is not None:
+        shards = ds.member_shards(len(old_members))
+        own = shards[old_members.index(me)]
+        head = min(skip * BS, own.num)
+        own_head_ids = [int(i) for i in own.ins_id[:head]]
+        own_tail = own.select(np.arange(head, own.num))
+        adopted = []
+        for d in sorted(set(old_members) - set(members)):
+            dsh = shards[old_members.index(d)]
+            dhead = min(skip * BS, dsh.num)
+            tail = dsh.select(np.arange(dhead, dsh.num))
+            routed = ds.reroute_records(tail, len(members))
+            adopted.append(routed[members.index(me)])
+        return _concat([own_tail] + adopted), own_head_ids
+    shards = ds.member_shards(len(members))
+    return shards[members.index(me)], []
+
+
+def reroute_info(ds_probe, base, me, members, old_members, skip,
+                 shuffle_state):
+    """Recompute (on a throwaway RNG clone) what build_pass_records will
+    assign, for the exactly-once audit: the departed ranks' head ids
+    (consumed-by-the-departed per the elected cursor), their re-routed
+    tail ids, and the ids THIS rank adopts."""
+    probe = SlotDataset(ds_probe.schema)
+    probe.set_shuffle_state(shuffle_state)
+    probe.records = base
+    probe.local_shuffle()
+    shards = probe.member_shards(len(old_members))
+    dead_head, dead_tail, adopted = [], [], []
+    for d in sorted(set(old_members) - set(members)):
+        dsh = shards[old_members.index(d)]
+        dhead = min(skip * BS, dsh.num)
+        dead_head += [int(i) for i in dsh.ins_id[:dhead]]
+        tail = dsh.select(np.arange(dhead, dsh.num))
+        dead_tail += [int(i) for i in tail.ins_id]
+        routed = probe.reroute_records(tail, len(members))
+        mine = routed[members.index(me)]
+        if mine is not None:
+            adopted += [int(i) for i in mine.ins_id]
+    return {"dead_head_ids": dead_head, "dead_tail_ids": dead_tail,
+            "adopted_ids": adopted}
+
+
+def global_auc(col, metrics, name="job_auc") -> float:
+    st = metrics.get_state(name)
+    pos = np.asarray(col.all_reduce(np.asarray(st["pos"], np.float64)))
+    neg = np.asarray(col.all_reduce(np.asarray(st["neg"], np.float64)))
+    p, n = pos.sum(), neg.sum()
+    if p == 0 or n == 0:
+        return float("nan")
+    neg_below = np.concatenate([[0.0], np.cumsum(neg)[:-1]])
+    return float((pos * (neg_below + neg / 2)).sum() / (p * n))
+
+
+def run(log) -> None:
+    rm = RoleMaker.from_env()
+    work = os.environ["PBTPU_TEST_WORKDIR"]
+    passes = int(os.environ.get("PBTPU_ELASTIC_PASSES", "3"))
+    n_ex = int(os.environ.get("PBTPU_ELASTIC_N", "768"))
+    midpass = int(os.environ.get("PBTPU_ELASTIC_MIDPASS", "2"))
+    step_sleep = float(os.environ.get("PBTPU_ELASTIC_STEP_SLEEP", "0"))
+    lost_s = float(os.environ.get("PBTPU_ELASTIC_LOST_S", "2.0"))
+    sim = os.environ.get("PBTPU_ELASTIC_SIM", "")
+    sim = json.loads(sim) if sim else None
+
+    # ---- identity: launcher rank vs ORIGINAL rank -------------------------
+    if sim is not None:
+        orig_members = sorted(sim["orig_members"])
+        survivors = [r for r in orig_members if r not in set(sim["dead"])]
+        me = survivors[rm.rank]           # sim rank i IS survivor i
+        members = list(survivors)
+    else:
+        me = rm.rank
+        orig_members = list(range(rm.world_size))
+        members = list(orig_members)
+
+    # victim arming: each process keeps only ITS designated fault point
+    only = os.environ.get("PBTPU_FAULTPOINT_ONLY_RANK", "")
+    if only and only != str(me):
+        faultpoint.disarm()
+    fp2, fp2_rank = (os.environ.get("PBTPU_FAULTPOINT2", ""),
+                     os.environ.get("PBTPU_FAULTPOINT2_RANK", ""))
+    if fp2 and fp2_rank == str(me):
+        faultpoint.arm(fp2, "kill",
+                       int(os.environ.get("PBTPU_FAULTPOINT2_AFTER", "0")))
+
+    monitor.hub().enable(monitor.JsonlSink(
+        os.path.join(work, f"events_{me}.jsonl")))
+
+    # ---- deterministic shared dataset: ins_id = 1..n ----------------------
+    ds, schema = synth(n=n_ex, seed=11)
+    base = ds.records
+    base.ins_id = np.arange(1, n_ex + 1, dtype=np.uint64)
+
+    store = HostEmbeddingStore(EmbeddingConfig(dim=4, learning_rate=0.05))
+    tr = Trainer(DNNCTRModel(num_slots=NUM_SLOTS, emb_dim=4, dense_dim=1,
+                             hidden=(8,)),
+                 store, schema, make_mesh(1),
+                 TrainerConfig(global_batch_size=BS, dense_lr=2e-3,
+                               auc_buckets=1 << 8),
+                 seed=7 + me)
+    box = BoxPS(store)
+    box.set_date(20260801)
+    box.init_metric("job_auc", n_buckets=128)
+    ckpt = PassCheckpointer(
+        os.path.join(os.environ["PBTPU_ELASTIC_ROOT"], f"rank{me}"),
+        keep_last_n=4, base_every=2)
+    if midpass > 0:
+        tr.enable_midpass_snapshots(ckpt, midpass, box, metrics=box.metrics)
+
+    if sim is None:
+        world = rm.elastic_world(
+            timeout_s=60, heartbeat_interval_s=0.15, lost_after_s=lost_s,
+            stall_after_s=90.0, reform_timeout_s=8.0)
+        # warmup grace: pass 1 compiles the step programs, and N jax
+        # processes compiling on few cores can starve a publisher thread
+        # past a tight lost_after — a mutual false-positive would fence
+        # half the world. Generous until the first pass boundary;
+        # re-formed worlds keep the tight constructor value (compile is
+        # long done by then).
+        world.heartbeat.lost_after_s = max(lost_s, 10.0)
+        box.attach_collectives(world.collectives,
+                               heartbeat=world.heartbeat)
+        if step_sleep > 0:
+            tr.peer_check = lambda: (time.sleep(step_sleep), world.check())
+        else:
+            tr.peer_check = world.check
+    else:
+        world = None
+        col = rm.collectives(timeout_s=60)
+        box.attach_collectives(col)
+
+    # ---- schedule bookkeeping --------------------------------------------
+    consumed: dict[int, list[int]] = {}
+    info: dict = {"rank": me, "orig_members": orig_members,
+                  "elected": None, "mid_steps": 0, "gen": 0,
+                  "members": members, "reroute": None, "fenced": False,
+                  "min_world_exit": False}
+    init_shuffle_state = ds.shuffle_state()
+    p = 1
+    skip = 0
+    old_members: list[int] | None = None
+    sim_q, sim_m = ((int(sim["elected"][0]), int(sim["elected"][1]))
+                    if sim is not None else (None, None))
+
+    def train_one(recs, skip_steps=0):
+        dsp = _ds_for(schema, recs)
+        return tr.train_pass(dsp, metrics=box.metrics,
+                             skip_steps=skip_steps)
+
+    while p <= passes:
+        try:
+            pre_state = ds.shuffle_state()
+            tr.midpass_cursor_extra = {"shuffle_state": pre_state}
+            if sim is not None:
+                # golden schedule, from the observed elected cursor: the
+                # pre-kill passes partition over the ORIGINAL world (each
+                # survivor trains only its own shard — the departed
+                # rank's state never reached the survivors); the kill
+                # pass trains the own head then the re-routed
+                # continuation; later passes partition over survivors
+                if p <= sim_q:
+                    pass_members, pass_old, pass_skip = orig_members, \
+                        None, 0
+                elif p == sim_q + 1 and sim_m > 0:
+                    pass_members, pass_old, pass_skip = members, \
+                        orig_members, sim_m
+                else:
+                    pass_members, pass_old, pass_skip = members, None, 0
+            else:
+                pass_members, pass_old, pass_skip = members, \
+                    old_members, skip
+            sim_kill_pass = (sim is not None and pass_old is not None)
+            if sim_kill_pass:
+                # head of the OWN old-partition shard first (the state
+                # the real run restored to), then the continuation — one
+                # box pass, two train segments, same math/step count
+                probe = _ds_for(schema, base)
+                probe.set_shuffle_state(pre_state)
+                probe.local_shuffle()
+                own_full = probe.member_shards(
+                    len(pass_old))[pass_old.index(me)]
+                head = own_full.select(
+                    np.arange(0, min(pass_skip * BS, own_full.num)))
+                recs, _ = build_pass_records(
+                    ds, base, me, pass_members, old_members=pass_old,
+                    skip=pass_skip)
+                box.begin_pass()
+                ids = []
+                if head.num >= BS:
+                    out = train_one(head)
+                    ids += [int(i)
+                            for i in head.ins_id[:out["steps"] * BS]]
+                if recs is not None and recs.num >= BS:
+                    out = train_one(recs)
+                    ids += [int(i)
+                            for i in recs.ins_id[:out["steps"] * BS]]
+                consumed[p] = sorted(set(ids))
+                box.end_pass(checkpointer=ckpt, trainer=tr, dataset=ds)
+                p += 1
+                continue
+            recs, own_head = build_pass_records(
+                ds, base, me, pass_members, old_members=pass_old,
+                skip=pass_skip)
+            this_skip = pass_skip if pass_old is None else 0
+            box.begin_pass()
+            if recs is not None and recs.num >= BS:
+                out = train_one(recs, skip_steps=this_skip)
+                hi = (this_skip + out["steps"]) * BS
+                ids = [int(i) for i in recs.ins_id[:hi]]
+            else:
+                ids = []
+            # record BEFORE end_pass: its barrier may raise on a dead
+            # peer, and the trained pass must stay accounted (the
+            # election rollback truncates as needed)
+            consumed[p] = sorted(set(consumed.get(p, []) + ids
+                                     + own_head))
+            box.end_pass(checkpointer=ckpt, trainer=tr, dataset=ds)
+            skip = 0
+            old_members = None
+            if p == 1 and world is not None:
+                world.heartbeat.lost_after_s = lost_s   # grace over
+            p += 1
+        except PeerFailureError as e:
+            log(f"peer failure in pass {p}: {e}")
+            pre_members = list(world.members)
+            try:
+                new_world, cursor = tr.recover_world(
+                    world, e, ckpt, box, metrics=box.metrics)
+            except WorldFencedError as fe:
+                log(f"fenced during recovery: {fe}")
+                info["fenced"] = True
+                break
+            if new_world is None:
+                info["min_world_exit"] = True
+                break
+            world = new_world
+            members = list(world.members)
+            if step_sleep > 0:
+                tr.peer_check = lambda: (time.sleep(step_sleep),
+                                         world.check())
+            else:
+                tr.peer_check = world.check
+            info.update(gen=world.gen, members=members)
+            # post-shrink snapshots: mid cursors of re-routed passes
+            # would be ambiguous across member sets — pass boundaries
+            # only from here (the continued run is short)
+            tr.enable_midpass_snapshots(ckpt, 0, box)
+            if cursor is None:
+                # no common snapshot: whole-world fresh start
+                consumed.clear()
+                ds.set_shuffle_state(init_shuffle_state)
+                p, skip, old_members = 1, 0, None
+                continue
+            info["elected"] = cursor.get("elected")
+            q, m = int(cursor["pass_id"]), int(cursor.get("mid_steps")
+                                               or 0)
+            info["mid_steps"] = m
+            if cursor.get("shuffle_state"):
+                ds.set_shuffle_state(cursor["shuffle_state"])
+            # the surviving timeline: passes <= q stand; the kill pass
+            # q+1 keeps only the own-head consumption (m batches) —
+            # departed-head consumption belongs to the departed rank
+            consumed = {pp: v for pp, v in consumed.items() if pp <= q}
+            p = q + 1
+            skip = m
+            old_members = pre_members if m > 0 else None
+            if m > 0 and cursor.get("shuffle_state"):
+                info["reroute"] = reroute_info(
+                    ds, base, me, members, old_members, m,
+                    cursor["shuffle_state"])
+        except WorldFencedError as e:
+            log(f"fenced: {e}")
+            info["fenced"] = True
+            break
+
+    # ---- final dump -------------------------------------------------------
+    col = world.collectives if world is not None else col
+    if not info["fenced"] and not info["min_world_exit"]:
+        info["global_auc"] = global_auc(col, box.metrics)
+        tr.flush_sparse()
+        keys = np.sort(np.asarray(base.unique_keys(), dtype=np.uint64))
+        rows = store.get_rows(keys)
+        dense = {f"p{i}": np.asarray(leaf) for i, leaf in
+                 enumerate(jax.tree_util.tree_leaves(
+                     {"params": tr.params, "opt": tr.opt_state}))}
+        met = box.metrics.get_state("job_auc")
+        np.savez(os.path.join(work, f"out_{me}.npz"),
+                 keys=keys, rows=rows,
+                 global_step=np.int64(tr.global_step),
+                 pass_id=np.int64(box.pass_id),
+                 met_pos=np.asarray(met["pos"]),
+                 met_neg=np.asarray(met["neg"]), **dense)
+        col.barrier("done")
+    with open(os.path.join(work, f"info_{me}.json"), "w") as f:
+        json.dump(info, f)
+    with open(os.path.join(work, f"consumed_{me}.json"), "w") as f:
+        json.dump({str(k): v for k, v in consumed.items()}, f)
+    if world is not None:
+        world.close()
+    monitor.hub().disable()
+    log("done")
+
+
+def main() -> None:
+    work = os.environ["PBTPU_TEST_WORKDIR"]
+    os.makedirs(work, exist_ok=True)
+    rank = os.environ.get("PBTPU_TRAINER_ID", "?")
+
+    def log(msg):
+        print(f"elastic rank {rank}: {msg}", flush=True)
+
+    try:
+        run(log)
+    except BaseException as e:
+        with open(os.path.join(work, f"err_{rank}.txt"), "w") as f:
+            f.write(f"{type(e).__name__}: {e}\n")
+            f.write(traceback.format_exc())
+        monitor.hub().disable()
+        raise
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,413 @@
+"""Elastic rank-loss recovery: shrink-to-N−1 continuation (ISSUE 6).
+
+Two layers:
+
+- tier-1 in-process tests of the re-formation protocol itself: the
+  generation-sealed membership (exclusive-create seal, ack phase,
+  escalation past a second failure), fencing, ghost-key sweeping, and
+  the closed-registry guard tying every elastic fault point to this
+  file's kill matrix;
+
+- the ``slow`` elastic kill matrix: a REAL 3-process world
+  (local-FileStore control plane, ``fail_stop=False`` launcher) loses
+  rank 1 in each phase of the hot loop — pack, step dispatch, deferred
+  push apply, end_pass — and, with a second armed victim, at each kill
+  point INSIDE the re-formation window. The acceptance bar per phase:
+
+  * the survivors converge on ONE generation with the same membership
+    and the same elected cursor (never a mixed world);
+  * the departed rank's unconsumed records (past the elected cursor) are
+    consumed exactly once across the survivors (per-record audit from
+    the workers' consumed logs);
+  * the survivors' final dense+sparse+metric planes — and the global
+    AUC — are bit-identical to an UNINTERRUPTED N−1 run that trains the
+    same record schedule (the simulated-shrink golden, launched from the
+    observed elected cursor).
+"""
+
+import json
+import os
+import sys
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from paddlebox_tpu.distributed.launch import launch
+from paddlebox_tpu.distributed.resilience import (ElasticWorld,
+                                                  WorldFencedError,
+                                                  WorldTooSmallError)
+from paddlebox_tpu.distributed.store import FileStore
+from paddlebox_tpu.utils import faultpoint
+
+TESTS_DIR = os.path.dirname(os.path.abspath(__file__))
+WORKER = os.path.join(TESTS_DIR, "elastic_worker.py")
+WORLD = 3
+PASSES = 3
+BS = 32
+N_EX = 768                       # 8 steps per rank per pass at world 3
+
+# the elastic kill matrix: phase name -> (victim point, AFTER count,
+# extra env). Counts assume 3 passes x 8 steps, mid-pass cadence 2.
+PHASES = {
+    # host pack pipeline (producer thread), mid pass 2
+    "pack": ("trainer.pack.pre", 9, {}),
+    # step dispatch, with slowed steps so the survivors detect MID-pass
+    # and the elected cursor carries mid_steps > 0 — the re-route path
+    "step_dispatch": ("trainer.step.pre", 9,
+                      {"PBTPU_ELASTIC_STEP_SLEEP": "0.25",
+                       "PBTPU_ELASTIC_LOST_S": "1.2"}),
+    # deferred push apply (flags.push_overlap auto-on for allreduce)
+    "push_apply": ("trainer.push_apply.pre", 12, {}),
+    # the end-of-pass snapshot commit window
+    "end_pass": ("pass_ckpt.pre_manifest", 9, {}),
+}
+
+
+def _env(tmp_path, extra=None):
+    env = {
+        "PBTPU_TEST_WORKDIR": str(tmp_path / "work"),
+        "PBTPU_ELASTIC_ROOT": str(tmp_path / "snaps"),
+        "PBTPU_ELASTIC_PASSES": str(PASSES),
+        "PBTPU_ELASTIC_N": str(N_EX),
+    }
+    env.update(extra or {})
+    os.makedirs(env["PBTPU_TEST_WORKDIR"], exist_ok=True)
+    return env
+
+
+def _launch(tmp_path, env, nprocs=WORLD):
+    return launch(nprocs, [sys.executable, WORKER],
+                  store_dir=str(tmp_path / f"store_{nprocs}"),
+                  base_env=env, fail_stop=False, timeout_s=420)
+
+
+def _info(tmp_path, rank):
+    with open(tmp_path / "work" / f"info_{rank}.json") as f:
+        return json.load(f)
+
+
+def _consumed(tmp_path, rank):
+    with open(tmp_path / "work" / f"consumed_{rank}.json") as f:
+        return {int(k): set(v) for k, v in json.load(f).items()}
+
+
+def _out(tmp_path, rank):
+    p = tmp_path / "work" / f"out_{rank}.npz"
+    assert p.exists(), f"rank {rank} produced no final dump"
+    with np.load(p) as z:
+        return {k: z[k] for k in z.files}
+
+
+def _events(tmp_path, rank):
+    p = tmp_path / "work" / f"events_{rank}.jsonl"
+    if not p.exists():
+        return []
+    return [json.loads(ln) for ln in p.read_text().splitlines() if ln]
+
+
+def _worker_errors(tmp_path):
+    return "; ".join(
+        (tmp_path / "work" / f"err_{r}.txt").read_text()[:500]
+        for r in range(WORLD)
+        if (tmp_path / "work" / f"err_{r}.txt").exists())
+
+
+def _run_sim_golden(tmp_path, survivors, dead, elected):
+    """The uninterrupted N−1 comparator: same record schedule, no kill."""
+    d = tmp_path / "sim"
+    env = _env(d, extra={"PBTPU_ELASTIC_SIM": json.dumps(
+        {"orig_members": list(range(WORLD)), "dead": sorted(dead),
+         "elected": list(elected)})})
+    codes = _launch(d, env, nprocs=len(survivors))
+    assert codes == [0] * len(survivors), (codes, _worker_errors(d))
+    return d
+
+
+def _audit_exactly_once(tmp_path, survivors, elected):
+    """Per-record audit: across survivors, no record consumed twice in
+    any pass of the surviving timeline; the departed ranks' unconsumed
+    tails are covered (up to drop_last batch remainders); adopted shares
+    are disjoint and cover the rerouted tail exactly."""
+    q, m = elected
+    consumed = {r: _consumed(tmp_path, r) for r in survivors}
+    for p in range(1, PASSES + 1):
+        seen: set = set()
+        for r in survivors:
+            ids = consumed[r].get(p, set())
+            dup = seen & ids
+            assert not dup, (f"pass {p}: records consumed twice "
+                             f"{sorted(dup)[:8]}")
+            seen |= ids
+    if m > 0:
+        infos = [_info(tmp_path, r) for r in survivors]
+        rr = [i["reroute"] for i in infos]
+        assert all(x is not None for x in rr), infos
+        # every survivor derived the SAME dead tail from the cursor
+        tails = [set(x["dead_tail_ids"]) for x in rr]
+        assert all(t == tails[0] for t in tails)
+        adopted_all: set = set()
+        for x in rr:
+            a = set(x["adopted_ids"])
+            assert not adopted_all & a, "adopted shares overlap"
+            adopted_all |= a
+        assert adopted_all == tails[0], (
+            "re-route did not cover the departed tail exactly once")
+        # consumption of the kill pass covers the dead tail up to
+        # drop_last remainders (< one batch per survivor)
+        kill_seen = set()
+        for r in survivors:
+            kill_seen |= consumed[r].get(q + 1, set())
+        uncovered = tails[0] - kill_seen
+        assert len(uncovered) < BS * len(survivors), (
+            f"{len(uncovered)} departed-tail records never consumed")
+
+
+def _assert_parity(live_dir, sim_dir, survivors):
+    for r in survivors:
+        live, gold = _out(live_dir, r), _out(sim_dir, r)
+        assert sorted(live) == sorted(gold)
+        for k in gold:
+            np.testing.assert_array_equal(
+                gold[k], live[k],
+                err_msg=f"rank {r} plane {k!r} diverged between the "
+                        f"killed+recovered run and the uninterrupted "
+                        f"N−1 run")
+    live_auc = [_info(live_dir, r)["global_auc"] for r in survivors]
+    sim_auc = [_info(sim_dir, r)["global_auc"] for r in survivors]
+    assert all(a == live_auc[0] for a in live_auc)
+    assert live_auc[0] == pytest.approx(sim_auc[0], abs=1e-12), (
+        f"final AUC diverged: recovered {live_auc[0]} vs "
+        f"uninterrupted N−1 {sim_auc[0]}")
+
+
+def _run_phase(tmp_path, point, after, extra, second=None):
+    extra = dict(extra)
+    extra.update({"PBTPU_FAULTPOINT": point,
+                  "PBTPU_FAULTPOINT_AFTER": str(after),
+                  "PBTPU_FAULTPOINT_ONLY_RANK": "1"})
+    victims = {1}
+    if second is not None:
+        extra.update({"PBTPU_FAULTPOINT2": second,
+                      "PBTPU_FAULTPOINT2_RANK": "2",
+                      "PBTPU_FAULTPOINT2_AFTER": "0"})
+        victims.add(2)
+    env = _env(tmp_path, extra=extra)
+    codes = _launch(tmp_path, env)
+    survivors = sorted(set(range(WORLD)) - victims)
+    for v in victims:
+        assert codes[v] == 137, (codes, _worker_errors(tmp_path))
+    for s in survivors:
+        assert codes[s] == 0, (codes, _worker_errors(tmp_path))
+    infos = [_info(tmp_path, r) for r in survivors]
+    # one generation, one membership, one elected cursor — never mixed
+    assert all(i["gen"] == infos[0]["gen"] and i["gen"] >= 1
+               for i in infos), infos
+    assert all(i["members"] == survivors for i in infos), infos
+    assert all(i["elected"] == infos[0]["elected"] for i in infos), infos
+    assert infos[0]["elected"] is not None, infos
+    elected = tuple(infos[0]["elected"])
+    _audit_exactly_once(tmp_path, survivors, elected)
+    sim_dir = _run_sim_golden(tmp_path, survivors, victims, elected)
+    _assert_parity(tmp_path, sim_dir, survivors)
+    # telemetry: the world_resize events name every departed rank — one
+    # event per generation transition, so a victim that dies AFTER
+    # acking a formed generation departs in a LATER event than one that
+    # died before it (the union covers the whole victim set)
+    for s in survivors:
+        resize = [e for e in _events(tmp_path, s)
+                  if e.get("name") == "world_resize"]
+        assert resize, f"rank {s} emitted no world_resize event"
+        departed = set()
+        for e in resize:
+            departed |= set(e["fields"]["departed"])
+        assert departed == victims, (departed, victims)
+    return infos
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("phase", sorted(PHASES))
+def test_elastic_kill_matrix(phase, tmp_path):
+    """Kill rank 1 of a 3-rank world in each hot-loop phase: survivors
+    re-form at N−1, re-elect, re-route, and finish — state bit-identical
+    to the uninterrupted 2-rank run of the same schedule."""
+    point, after, extra = PHASES[phase]
+    infos = _run_phase(tmp_path, point, after, extra)
+    if phase == "step_dispatch":
+        # slowed steps force MID-pass detection: the elected cursor must
+        # carry mid_steps and the re-route path must actually run
+        assert infos[0]["mid_steps"] > 0, infos
+        assert infos[0]["reroute"] is not None
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("reform_point", sorted(faultpoint.ELASTIC_POINTS))
+def test_elastic_kill_inside_reformation(reform_point, tmp_path):
+    """The re-formation window is itself a crash window: rank 1 dies in
+    the step loop, then rank 2 dies INSIDE the resulting re-formation
+    (before arriving / after the seal / after its ack). The survivor
+    must escalate to a single consistent generation of one, finish the
+    schedule, and match the uninterrupted 1-rank run — never a mixed
+    world."""
+    point, after, extra = PHASES["step_dispatch"]
+    infos = _run_phase(tmp_path, point, after, extra,
+                       second=reform_point)
+    assert infos[0]["members"] == [0]
+
+
+def test_elastic_points_are_registered_and_scoped():
+    """Closed-registry guard (mirrors test_crash_safety): the in-reform
+    kill matrix above parametrizes over faultpoint.ELASTIC_POINTS, so a
+    new elastic crash window cannot be registered without a matrix
+    entry; and only genuinely reform-scoped points may hide from the
+    plain kill→resume matrices."""
+    assert set(faultpoint.ELASTIC_POINTS) <= set(faultpoint.POINTS)
+    assert all(p.startswith("elastic.")
+               for p in faultpoint.ELASTIC_POINTS)
+
+
+# ---------------------------------------------------------------------------
+# tier-1 in-process protocol tests (threads as ranks, no subprocesses)
+# ---------------------------------------------------------------------------
+
+
+def _world(tmp_path, rank, members, **kw):
+    kw.setdefault("heartbeat_interval_s", 0.05)
+    kw.setdefault("lost_after_s", 30.0)
+    kw.setdefault("stall_after_s", 60.0)
+    kw.setdefault("reform_timeout_s", 2.0)
+    return ElasticWorld(FileStore(str(tmp_path), namespace="r",
+                                  poll_s=0.01),
+                        rank, members, **kw)
+
+
+def test_reform_converges_on_one_generation(tmp_path):
+    """3 ranks, rank 1 dead: both survivors form gen 1 with members
+    [0, 2], renumbered densely, and the new generation's collectives
+    work."""
+    results, errs = [None] * 3, []
+
+    def rank(r):
+        try:
+            w = _world(tmp_path, r, [0, 1, 2])
+            if r == 1:
+                w.close()
+                return
+            nw = w.reform([1])
+            results[r] = (nw.gen, nw.members, nw.rank, nw.world)
+            nw.collectives.barrier("post_reform")
+            nw.close()
+        except BaseException as e:    # pragma: no cover
+            errs.append((r, e))
+
+    ts = [threading.Thread(target=rank, args=(r,)) for r in range(3)]
+    [t.start() for t in ts]
+    [t.join() for t in ts]
+    assert not errs, errs
+    assert results[0] == (1, [0, 2], 0, 2)
+    assert results[2] == (1, [0, 2], 1, 2)
+
+
+def test_reform_seal_is_exclusive_and_fences_stragglers(tmp_path):
+    """A survivor whose peers never arrive seals the generation alone
+    after its patience expires; a straggler arriving later reads the
+    sealed membership, finds itself excluded, and is FENCED (clean
+    exit), never split into a second world."""
+    w0 = _world(tmp_path, 0, [0, 1, 2], reform_timeout_s=0.5)
+    nw = w0.reform([1])
+    assert (nw.gen, nw.members) == (1, [0])
+    nw.close()
+    w2 = _world(tmp_path, 2, [0, 1, 2], reform_timeout_s=0.5)
+    with pytest.raises(WorldFencedError):
+        w2.reform([1])
+
+
+def test_reform_escalates_past_arrived_but_unacked_rank(tmp_path):
+    """A rank that arrives at the proposed generation but dies before
+    acking (the post_seal crash window): the survivor times out the ack
+    phase and escalates to the NEXT generation without it — generations
+    seal at most once each, so no membership mixes."""
+    store = FileStore(str(tmp_path), namespace="r", poll_s=0.01)
+    # fake rank 2: arrived at g1 (but will never ack)
+    store.set("elastic.reform.g1.arrive.2",
+              json.dumps({"rank": 2}).encode())
+    w0 = _world(tmp_path, 0, [0, 1, 2], reform_timeout_s=0.6)
+    nw = w0.reform([1])
+    assert (nw.gen, nw.members) == (2, [0])
+    # g1 sealed with both, g2 sealed with the survivor alone
+    g1 = json.loads(store.get("elastic.world.g1"))
+    g2 = json.loads(store.get("elastic.world.g2"))
+    assert g1["members"] == [0, 2] and g2["members"] == [0]
+    nw.close()
+
+
+def test_reform_respects_min_world_floor(tmp_path):
+    from paddlebox_tpu.config import flags, set_flags
+    old = flags.elastic_min_world
+    set_flags(elastic_min_world=2)
+    try:
+        w0 = _world(tmp_path, 0, [0, 1], reform_timeout_s=0.3)
+        with pytest.raises(WorldTooSmallError):
+            w0.reform([1])
+    finally:
+        set_flags(elastic_min_world=old)
+
+
+def test_reform_sweeps_departed_rank_keys(tmp_path):
+    """After re-formation the departed rank's heartbeat and barrier
+    arrivals are gone from the live namespace — the new generation's
+    wait_count can never count ghosts — while other ranks' keys and the
+    sealed world records survive."""
+    store = FileStore(str(tmp_path), namespace="r", poll_s=0.01)
+    store.set("hb.1", b"ghost")
+    store.add("end_pass.7", 1)
+    store.add("end_pass.7", 0)
+    store.set("gather.3.v1", b"npyghost")
+    # a NEW-generation key owned by gen-local rank 1 — which is a
+    # SURVIVOR under the generation's dense renumbering — must never be
+    # rank-swept (the race that once ate a live election value)
+    store.scoped("g1").set("resume_candidates.1.v1", b"live")
+    w0 = _world(tmp_path, 0, [0, 1], reform_timeout_s=0.3)
+    nw = w0.reform([1])
+    assert store.get("hb.1") is None
+    assert store.get("gather.3.v1") is None
+    assert store.missing_ranks("end_pass.7", 2) == [1]
+    assert store.get("end_pass.7.0") is not None     # rank 0's arrival
+    assert store.get("elastic.world.g1") is not None  # sealed record
+    assert store.scoped("g1").get("resume_candidates.1.v1") == b"live"
+    nw.close()
+
+
+def test_gen_collectives_isolated_from_old_generation(tmp_path):
+    """A fenced straggler still writing under the OLD generation can
+    never satisfy the new generation's waits: gen keys are
+    store-namespace scoped."""
+    base = FileStore(str(tmp_path), namespace="r", poll_s=0.01)
+    stale = base.scoped("g0_fake")
+    stale.set("barrier.1.0", b"1")
+    g1 = base.scoped("g1")
+    assert g1.get("barrier.1.0") is None
+    g1.set("x", b"1")
+    assert base.get("x") is None
+
+
+def test_heartbeat_names_original_ranks(tmp_path):
+    """In a shrunk generation the watchdog errors name ORIGINAL launcher
+    ranks, not gen-local indices — drivers keep one rank language."""
+    from paddlebox_tpu.distributed.resilience import (HeartbeatMonitor,
+                                                      PeerLostError)
+    store = FileStore(str(tmp_path), poll_s=0.01)
+    # gen-local world of 2 mapping to original ranks [0, 5]
+    h0 = HeartbeatMonitor(store, 0, 2, rank_names=[0, 5],
+                          interval_s=0.05, lost_after_s=0.3,
+                          stall_after_s=30, watch=False)
+    try:
+        deadline = time.monotonic() + 5.0
+        with pytest.raises(PeerLostError, match=r"\[5\]") as ei:
+            while time.monotonic() < deadline:
+                h0.check()
+                time.sleep(0.05)
+        assert ei.value.ranks == [5]
+    finally:
+        h0.close()

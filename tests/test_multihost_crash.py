@@ -47,6 +47,8 @@ POINT_AFTER = {
     "pass_ckpt.post_manifest": 3,
     "trainer.midpass.post_save": 2,     # pass-2's first mid-pass snapshot
     "remote_ckpt.upload.pre": 4,
+    "trainer.pack.pre": 5,              # pass-2 pack (producer thread)
+    "trainer.step.pre": 5,              # pass-2 step dispatch
 }
 
 
@@ -176,7 +178,8 @@ def test_two_host_election_smoke(tmp_path, golden):
 @pytest.mark.parametrize("point",
                          [p for p in faultpoint.POINTS
                           if p not in ("pass_ckpt.pre_manifest",
-                                       "remote_ckpt.download.pre")])
+                                       "remote_ckpt.download.pre")
+                          and p not in faultpoint.ELASTIC_POINTS])
 def test_multihost_kill_resume_matrix(point, tmp_path, golden):
     """Every registered fault point, multi-host: kill rank 1 there
     (mid-pass snapshots + hdfs:// remote mirror ON so every point is on
@@ -212,6 +215,49 @@ def test_multihost_kill_during_remote_download(tmp_path, golden):
     infos = _resume_info(tmp_path)
     assert infos[0]["elected"] == infos[1]["elected"] is not None
     _assert_world_parity(golden, tmp_path)
+
+
+def test_elastic_two_to_one_shrink_smoke(tmp_path):
+    """Tier-1 (ISSUE 6 satellite): a 2-rank elastic world loses rank 1
+    mid pass 2 and CONTINUES — rank 0 re-forms the world at size 1,
+    re-elects its resume cursor, trains the remaining schedule (pass 3
+    carries the whole dataset) and exits cleanly, all without operator
+    action. The full 3-rank phase matrix incl. kills inside re-formation
+    is ``-m slow`` in tests/test_elastic.py."""
+    worker = os.path.join(TESTS_DIR, "elastic_worker.py")
+    env = {
+        "PBTPU_TEST_WORKDIR": str(tmp_path / "work"),
+        "PBTPU_ELASTIC_ROOT": str(tmp_path / "snaps"),
+        "PBTPU_ELASTIC_PASSES": "3",
+        "PBTPU_ELASTIC_N": "256",            # 4 steps/rank at world 2
+        "PBTPU_FAULTPOINT": "trainer.step.pre",
+        "PBTPU_FAULTPOINT_AFTER": "5",       # pass-2 step 2 on rank 1
+        "PBTPU_FAULTPOINT_ONLY_RANK": "1",
+    }
+    os.makedirs(env["PBTPU_TEST_WORKDIR"], exist_ok=True)
+    codes = launch(2, [sys.executable, worker],
+                   store_dir=str(tmp_path / "store"), base_env=env,
+                   fail_stop=False, timeout_s=300)
+    assert codes[1] == 137, codes            # the armed kill fired
+    assert codes[0] == 0, (
+        codes,
+        (tmp_path / "work" / "err_0.txt").read_text()[:800]
+        if (tmp_path / "work" / "err_0.txt").exists() else "")
+    with open(tmp_path / "work" / "info_0.json") as f:
+        info = json.load(f)
+    assert info["gen"] >= 1 and info["members"] == [0], info
+    assert info["elected"] is not None
+    p = tmp_path / "work" / "out_0.npz"
+    assert p.exists()
+    with np.load(p) as z:
+        assert int(z["pass_id"]) == 3        # the full schedule finished
+        assert int(z["global_step"]) > 0
+    events = [json.loads(ln) for ln in
+              (tmp_path / "work" / "events_0.jsonl").read_text()
+              .splitlines() if ln]
+    resize = [e for e in events if e.get("name") == "world_resize"]
+    assert resize and resize[-1]["fields"]["departed"] == [1], \
+        [e.get("name") for e in events][-20:]
 
 
 @pytest.mark.slow

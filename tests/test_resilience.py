@@ -379,7 +379,10 @@ def test_midpass_snapshot_cadence_and_naming(tmp_path):
     assert ck.intact_cursors() == [(0, 1), (0, 2), (1, 0)]
 
 
-def test_midpass_requires_allreduce_single_step(tmp_path):
+def test_midpass_kstep_needs_sync_boundary_cadence(tmp_path):
+    """kstep mid-pass snapshots are allowed ONLY at the K-step sync
+    boundary (ISSUE 6 satellite): a cadence that is not a multiple of
+    param_sync_step refuses with a clear error; a multiple is accepted."""
     from paddlebox_tpu.embedding import EmbeddingConfig, HostEmbeddingStore
     from paddlebox_tpu.fleet import BoxPS
     from paddlebox_tpu.models import DNNCTRModel
@@ -392,9 +395,143 @@ def test_midpass_requires_allreduce_single_step(tmp_path):
                              hidden=(8,)),
                  store, schema, make_mesh(1),
                  TrainerConfig(global_batch_size=64,
-                               dense_sync_mode="kstep"), seed=1)
-    with pytest.raises(NotImplementedError, match="allreduce"):
-        tr.enable_midpass_snapshots(object(), 2, BoxPS(store))
+                               dense_sync_mode="kstep",
+                               param_sync_step=2), seed=1)
+    with pytest.raises(NotImplementedError, match="sync boundary"):
+        tr.enable_midpass_snapshots(object(), 3, BoxPS(store))
+    tr.enable_midpass_snapshots(object(), 4, BoxPS(store))   # multiple: ok
+    assert tr._midpass is not None
+
+
+def _tiny_job_mode(tmp_path, tag, mode, seed=7, n=256, **cfg_kw):
+    from paddlebox_tpu.embedding import EmbeddingConfig, HostEmbeddingStore
+    from paddlebox_tpu.fleet import BoxPS
+    from paddlebox_tpu.models import DNNCTRModel
+    from paddlebox_tpu.parallel import make_mesh
+    from paddlebox_tpu.train import Trainer, TrainerConfig
+    from paddlebox_tpu.utils.pass_ckpt import PassCheckpointer
+    from tests.crash_worker import NUM_SLOTS, synth
+    ds, schema = synth(n=n, seed=11)
+    store = HostEmbeddingStore(EmbeddingConfig(dim=4, learning_rate=0.05))
+    tr = Trainer(DNNCTRModel(num_slots=NUM_SLOTS, emb_dim=4, dense_dim=1,
+                             hidden=(8,)),
+                 store, schema, make_mesh(1),
+                 TrainerConfig(global_batch_size=64, auc_buckets=1 << 8,
+                               dense_sync_mode=mode, **cfg_kw),
+                 seed=seed)
+    box = BoxPS(store)
+    ck = PassCheckpointer(str(tmp_path / tag), keep_last_n=6, base_every=4)
+    return ds, tr, store, box, ck
+
+
+def test_midpass_kstep_skip_resume_bit_identical(tmp_path):
+    """ISSUE 6 satellite: mid-pass snapshots in the K-step dense-sync
+    mode — the snapshot lands on the sync boundary (every_steps a
+    multiple of K) and stores the STACKED per-shard planes, so a resumed
+    run replays the remaining steps (syncs included) bit-identically."""
+    import jax
+    ds, tr, store, box, ck = _tiny_job_mode(tmp_path, "km", "kstep",
+                                            param_sync_step=2)
+    tr.enable_midpass_snapshots(ck, 2, box)
+    for _ in range(2):                        # 256 ex / 64 = 4 steps
+        tr.midpass_cursor_extra = {"shuffle_state": ds.shuffle_state()}
+        box.begin_pass()
+        tr.train_pass(ds)
+        box.end_pass(checkpointer=ck, trainer=tr, dataset=ds)
+    tr.flush_sparse()
+    keys = np.sort(np.asarray(ds.unique_keys(), np.uint64))
+    want_rows = store.get_rows(keys)
+    want_params = jax.tree.map(np.asarray, tr.eval_params())
+    assert (1, 2) in ck.intact_cursors()
+
+    from paddlebox_tpu.utils.pass_ckpt import PassCheckpointer
+    ds2, tr2, store2, box2, _ = _tiny_job_mode(tmp_path, "km_u", "kstep",
+                                               seed=99,
+                                               param_sync_step=2)
+    ck2 = PassCheckpointer(str(tmp_path / "km"), keep_last_n=6,
+                           base_every=4)
+    cursor = ck2.resume(tr2, box=box2, at=(1, 2))
+    assert cursor["pass_id"] == 1 and cursor["mid_steps"] == 2
+    box2.begin_pass()
+    tr2.train_pass(ds2, skip_steps=2)
+    box2.end_pass(trainer=tr2, checkpointer=ck2, dataset=ds2)
+    tr2.flush_sparse()
+    np.testing.assert_array_equal(want_rows, store2.get_rows(keys))
+    jax.tree_util.tree_map(
+        lambda a, b: np.testing.assert_array_equal(np.asarray(a),
+                                                   np.asarray(b)),
+        want_params, jax.tree.map(np.asarray, tr2.eval_params()))
+    assert tr2.global_step == tr.global_step
+
+
+def test_midpass_async_quiesces_and_resumes(tmp_path):
+    """Async dense sync: the mid-pass snapshot quiesces the host dense
+    table (flush) and stores its exact state dict; a resumed run
+    restores it and continues (the continued grad-merge timing stays
+    async-nondeterministic by design, so the assertion is exact state at
+    the cursor + a working continuation, not bitwise end parity)."""
+    import numpy as _np
+    ds, tr, store, box, ck = _tiny_job_mode(tmp_path, "am", "async")
+    tr.enable_midpass_snapshots(ck, 2, box)
+    tr.midpass_cursor_extra = {"shuffle_state": ds.shuffle_state()}
+    box.begin_pass()
+    tr.train_pass(ds)
+    box.end_pass(checkpointer=ck, trainer=tr, dataset=ds)
+    assert (0, 2) in ck.intact_cursors()
+
+    from paddlebox_tpu.utils.pass_ckpt import PassCheckpointer
+    ds2, tr2, store2, box2, _ = _tiny_job_mode(tmp_path, "am_u", "async",
+                                               seed=99)
+    ck2 = PassCheckpointer(str(tmp_path / "am"), keep_last_n=6,
+                           base_every=4)
+    cursor = ck2.resume(tr2, box=box2, at=(0, 2))
+    assert cursor["mid_steps"] == 2
+    # the table state (params + Adam moments + applied-step count) is
+    # exactly what the snapshot quiesced
+    st = tr2.dense_table.state_dict()
+    assert int(_np.asarray(st["steps"]).reshape(-1)[0]) > 0
+    box2.begin_pass()
+    out = tr2.train_pass(ds2, skip_steps=2)
+    box2.end_pass(trainer=tr2)
+    assert out["steps"] == 2                  # the remaining tail only
+    tr.dense_table.stop()
+    tr2.dense_table.stop()
+
+
+def test_drain_snapshot_commits_abort_cursor(tmp_path):
+    """The elastic drain point: a peer failure aborts the step loop at a
+    step boundary; drain_and_snapshot commits a mid-pass snapshot at the
+    abort step (resumable like any mid cursor) and abort_pass closes the
+    box without the world barrier."""
+    from paddlebox_tpu.distributed.resilience import PeerLostError
+    ds, tr, store, box, ck = _tiny_job(tmp_path, "drain")
+    calls = [0]
+
+    def check():
+        calls[0] += 1
+        if calls[0] == 2:                     # before step 2 dispatches
+            raise PeerLostError("rank [1] lost", [1])
+
+    tr.peer_check = check
+    tr.midpass_cursor_extra = {"shuffle_state": ds.shuffle_state()}
+    box.begin_pass()
+    with pytest.raises(PeerLostError):
+        tr.train_pass(ds)
+    assert box.in_pass and tr.last_pass_steps == 1
+    snap = tr.drain_and_snapshot(ck, box)
+    assert snap is not None
+    assert ck.intact_cursors() == [(0, 1)]
+    box.abort_pass(reason="peer lost")
+    assert not box.in_pass
+    # a fresh job resumes exactly at the abort cursor
+    ds2, tr2, store2, box2, _ = _tiny_job(tmp_path, "drain_u", seed=99)
+    from paddlebox_tpu.utils.pass_ckpt import PassCheckpointer
+    ck2 = PassCheckpointer(str(tmp_path / "drain"), keep_last_n=6,
+                           base_every=4)
+    cursor = ck2.resume(tr2, box=box2, at=(0, 1))
+    assert cursor["mid_steps"] == 1
+    assert cursor["shuffle_state"] is not None
+    assert tr2.global_step == tr.global_step
 
 
 # ---------------------------------------------------------------------------
@@ -451,6 +588,111 @@ def test_remote_root_upload_donefile_and_replacement_host_resume(
         lambda a, b: np.testing.assert_array_equal(np.asarray(a),
                                                    np.asarray(b)),
         want_params, tr2.params)
+
+
+def test_remote_retention_compacts_donefile_and_prunes_dirs(
+        tmp_path, hdfs_mock):
+    """ISSUE 6 satellite: the mirror no longer grows unboundedly — the
+    donefile is rewritten to the retained entries (per pool) and remote
+    snapshot/chain dirs no kept entry references are removed; a
+    replacement host still resumes from the compacted donefile."""
+    from paddlebox_tpu.utils.pass_ckpt import PassCheckpointer
+    fs, mock_root = hdfs_mock
+    ds, tr, store, box, _ = _tiny_job(tmp_path, "unused_rr")
+    ck = PassCheckpointer("hdfsmock://rr", keep_last_n=2, base_every=2,
+                          staging_dir=str(tmp_path / "stage_rr"))
+    for _ in range(5):
+        box.begin_pass()
+        tr.train_pass(ds)
+        box.end_pass(checkpointer=ck, trainer=tr, dataset=ds)
+    done = mock_root / "rr" / "snapshots.donefile"
+    entries = [json.loads(ln) for ln in done.read_text().splitlines()]
+    assert [(e["pass"], e["mid"]) for e in entries] == [(4, 0), (5, 0)]
+    names = sorted(os.listdir(mock_root / "rr"))
+    kept_snaps = {e["snapshot"] for e in entries}
+    kept_chains = {e["chain"] for e in entries} | {ck._chain_dir}
+    for n in names:
+        if n.startswith("pass-"):
+            assert n in kept_snaps, f"pruned snapshot {n} still mirrored"
+        if n.startswith("chain-"):
+            assert n in kept_chains, f"unreferenced chain {n} survived"
+    # replacement host resumes from the compacted donefile
+    tr.flush_sparse()
+    keys = np.sort(np.asarray(ds.unique_keys(), np.uint64))
+    want_rows = store.get_rows(keys)
+    ds2, tr2, store2, box2, _ = _tiny_job(tmp_path, "unused_rr2",
+                                          seed=42)
+    ck2 = PassCheckpointer("hdfsmock://rr", keep_last_n=2, base_every=2,
+                           staging_dir=str(tmp_path / "stage_rr2"))
+    cursor = tr2.resume(ck2, box=box2)
+    assert cursor["pass_id"] == 5
+    np.testing.assert_array_equal(want_rows, store2.get_rows(keys))
+
+
+def test_donefile_compaction_drops_masked_lines(tmp_path, hdfs_mock):
+    """An elected rollback appends a ``reset_after`` line; the next
+    save's compaction materializes the mask away — the rewritten
+    donefile carries only live entries, no masks, no shadowed lines."""
+    from paddlebox_tpu.utils.pass_ckpt import PassCheckpointer
+    fs, mock_root = hdfs_mock
+    ds, tr, store, box, _ = _tiny_job(tmp_path, "unused_mask")
+    ck = PassCheckpointer("hdfsmock://mm", keep_last_n=3, base_every=2,
+                          staging_dir=str(tmp_path / "stage_mm"))
+    for _ in range(3):
+        box.begin_pass()
+        tr.train_pass(ds)
+        box.end_pass(checkpointer=ck, trainer=tr, dataset=ds)
+    # elected rollback to pass 1 masks passes 2-3 with a reset line
+    cursor = ck.resume(tr, box=box, at=(1, 0))
+    assert cursor["pass_id"] == 1
+    done = mock_root / "mm" / "snapshots.donefile"
+    raw = [json.loads(ln) for ln in done.read_text().splitlines()]
+    assert any("reset_after" in e for e in raw)
+    # retrain pass 2: its save compacts masked + shadowed lines away
+    box.begin_pass()
+    tr.train_pass(ds)
+    box.end_pass(checkpointer=ck, trainer=tr, dataset=ds)
+    raw = [json.loads(ln) for ln in done.read_text().splitlines()]
+    assert not any("reset_after" in e for e in raw), raw
+    assert [(e["pass"], e["mid"]) for e in raw] == [(1, 0), (2, 0)]
+
+
+def test_donefile_append_repairs_interrupted_compaction(tmp_path,
+                                                        hdfs_mock):
+    """A kill between the compaction's rm(donefile) and put(donefile)
+    leaves only the ``.compact`` staging copy. The NEXT save must
+    restore the main file from it before appending — an append into a
+    recreated empty donefile would shadow the whole history with one
+    line, and the following prune would reclaim every 'unreferenced'
+    mirror dir."""
+    import shutil as _sh
+    from paddlebox_tpu.utils.pass_ckpt import PassCheckpointer
+    fs, mock_root = hdfs_mock
+    ds, tr, store, box, _ = _tiny_job(tmp_path, "unused_rep")
+    ck = PassCheckpointer("hdfsmock://rep", keep_last_n=4, base_every=2,
+                          staging_dir=str(tmp_path / "stage_rep"))
+    for _ in range(2):
+        box.begin_pass()
+        tr.train_pass(ds)
+        box.end_pass(checkpointer=ck, trainer=tr, dataset=ds)
+    done = mock_root / "rep" / "snapshots.donefile"
+    before = done.read_text().splitlines()
+    assert len(before) == 2
+    # the crash window: compacted content staged, main file removed
+    _sh.copy(done, str(done) + ".compact")
+    done.unlink()
+    box.begin_pass()
+    tr.train_pass(ds)
+    box.end_pass(checkpointer=ck, trainer=tr, dataset=ds)
+    raw = [json.loads(ln) for ln in done.read_text().splitlines()]
+    assert [(e["pass"], e["mid"]) for e in raw] == [(1, 0), (2, 0),
+                                                    (3, 0)], raw
+    assert not (mock_root / "rep" / "snapshots.donefile.compact").exists()
+    # every surviving entry's mirror dirs are still referenced/alive
+    names = set(os.listdir(mock_root / "rep"))
+    for e in raw:
+        assert e["snapshot"] in names
+        assert e["chain"] in names
 
 
 def test_remote_resume_falls_back_past_torn_remote_snapshot(
