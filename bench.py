@@ -100,12 +100,23 @@ def load_bench_best() -> dict | None:
 
 def collect_gate_metrics(eps_chip: float, detail: dict) -> dict:
     """Flatten this run's recorded numbers into the gate's metric
-    namespace (higher is better for every one of them)."""
+    namespace. Throughput metrics are higher-is-better; names ending in
+    ``_ms``/``_seconds`` (the serving drill's latency points) are
+    lower-is-better — apply_regression_gate keys the direction off the
+    suffix."""
     m = {"headline_eps": eps_chip}
     for name, point in (detail.get("matrix") or {}).items():
         if isinstance(point, dict) and \
                 "examples_per_sec_per_chip" in point:
             m[f"matrix.{name}"] = point["examples_per_sec_per_chip"]
+    srv = (detail.get("matrix") or {}).get("serving")
+    if isinstance(srv, dict):
+        # the train→publish→serve loop's operator-facing numbers: how
+        # long a publish takes, how long the hot-swap pauses requests,
+        # and the tail latency the frontend holds under load
+        for k in ("publish_seconds", "swap_pause_ms", "p99_ms"):
+            if isinstance(srv.get(k), (int, float)):
+                m[f"serving.{k}"] = srv[k]
     e2e = detail.get("e2e")
     if isinstance(e2e, dict) and "examples_per_sec_per_chip" in e2e:
         m["e2e_eps"] = e2e["examples_per_sec_per_chip"]
@@ -145,7 +156,18 @@ def apply_regression_gate(current: dict, best: dict | None,
         if cur is None:
             lines[name] = "missing (not measured this run)"
             continue
-        rel = cur / best_v - 1.0
+        # latency-flavored metrics (…_ms/_seconds) are lower-is-better:
+        # rel is the signed improvement fraction either way, so the
+        # threshold/waiver/line machinery below is direction-blind.
+        # Sub-floor latencies are timer noise — the swap pause is one
+        # attribute rebind, sub-µs, where scheduler jitter alone is a
+        # multi-x relative swing — so both sides clamp to the floor:
+        # noise never trips the gate, real-scale regressions still do
+        if name.endswith(("_ms", "_seconds")):
+            floor = 1.0 if name.endswith("_ms") else 0.05
+            rel = max(best_v, floor) / max(cur, floor) - 1.0
+        else:
+            rel = cur / best_v - 1.0
         if rel < -thresh:
             if name in waivers:
                 lines[name] = (f"REGRESS({rel:+.0%}) waived: "
@@ -901,6 +923,112 @@ def elastic_drill(small: bool, tiny: bool = False) -> dict:
             "world": 1}
 
 
+def serving_drill(small: bool, tiny: bool = False) -> dict:
+    """Train→publish→serve drill (ISSUE 7): the online loop's three
+    operator numbers, measured on the REAL path. A one-pass job publishes
+    a base artifact (timed as ``publish_seconds`` — plane snapshot, int8
+    cold-row quantization, CRC-chained manifest, donefile announce), a
+    ServingServer tails + loads it, and a BatchingFrontend drives the
+    predictor at concurrency while pass 2's delta publish hot-swaps
+    underneath the traffic — ``swap_pause_ms`` (the atomic handle rebind
+    requests actually see) and the served ``p50_ms``/``p99_ms`` land as
+    gate-held matrix points (latency metrics compare lower-is-better off
+    the ``_ms``/``_seconds`` suffix). Zero request failures across the
+    swap is asserted — the drill fails loudly rather than record a tail
+    latency from a broken loop."""
+    import tempfile as _tempfile
+    import threading as _threading
+    import time as _t
+    from paddlebox_tpu.data import DataFeedSchema, SlotDataset
+    from paddlebox_tpu.embedding import EmbeddingConfig, HostEmbeddingStore
+    from paddlebox_tpu.fleet import BoxPS
+    from paddlebox_tpu.models import DeepFMModel
+    from paddlebox_tpu.parallel import make_mesh
+    from paddlebox_tpu.serving import (BatchingFrontend, ServingPublisher,
+                                       ServingServer)
+    from paddlebox_tpu.train import Trainer, TrainerConfig
+
+    bs = 64
+    n_ex = bs * (2 if tiny else (8 if small else 64))
+    schema = DataFeedSchema.ctr(num_sparse=4, num_float=1, batch_size=bs,
+                                max_len=1)
+    rec = _synth_pass(schema, n_ex, 4,
+                      [s for s in schema.float_slots if s.name != "label"],
+                      2000, seed=11)
+    store = HostEmbeddingStore(EmbeddingConfig(dim=8, optimizer="adagrad",
+                                               learning_rate=0.05))
+    model = DeepFMModel(num_slots=4, emb_dim=8, dense_dim=1, hidden=(16,))
+    tr = Trainer(model, store, schema, make_mesh(1),
+                 TrainerConfig(global_batch_size=bs))
+    box = BoxPS(store)
+    ds = SlotDataset(schema)
+    ds.records = rec
+    with _tempfile.TemporaryDirectory() as td:
+        root = os.path.join(td, "serve")
+        pub = ServingPublisher(root, model, schema, publish_base_every=8,
+                               quant="int8", hot_top_k=64)
+        box.begin_pass()
+        tr.train_pass(ds)
+        info = box.end_pass(trainer=tr, publisher=pub)["publish"]
+        srv = ServingServer(root, poll_s=0.01)
+        if srv.poll_once() != 1:
+            raise RuntimeError("server failed to load the published base")
+        pb = next(iter(ds.batches(batch_size=bs)))
+        lc, lw, _ = schema.float_split_cols("label")
+        floats = np.concatenate(
+            [pb.floats[:, :lc], pb.floats[:, lc + lw:]], axis=1)
+        ids64 = pb.ids.astype(np.uint64)
+        fe = BatchingFrontend(srv, max_batch=32, max_wait_s=0.002).start()
+        try:
+            # warmup OUTSIDE the window: the first batch compiles the
+            # frontend's one fixed shape
+            for f in [fe.submit(ids64[i], pb.mask[i], floats[i])
+                      for i in range(32)]:
+                f.result(timeout=300)
+            # pass 2 trains + publishes its delta while the frontend is
+            # live; the swap itself lands mid-traffic below
+            box.begin_pass()
+            tr.train_pass(ds)
+            d_info = box.end_pass(trainer=tr, publisher=pub)["publish"]
+            n_req = bs * (4 if tiny else (16 if small else 64))
+            futs: list = []
+
+            def _load():
+                r = np.random.default_rng(5)
+                while len(futs) < n_req:
+                    i = int(r.integers(0, bs))
+                    futs.append(fe.submit(ids64[i], pb.mask[i],
+                                          floats[i]))
+
+            t_load = _threading.Thread(target=_load, daemon=True)
+            t0 = _t.perf_counter()
+            t_load.start()
+            _t.sleep(0.01)                   # traffic in flight
+            if srv.poll_once() != 1:         # THE hot-swap, under load
+                raise RuntimeError("delta hot-swap did not apply")
+            t_load.join(timeout=600)
+            done = [f.result(timeout=300) for f in list(futs)]
+            serve_s = _t.perf_counter() - t0
+            st = fe.stats()
+        finally:
+            fe.stop()
+            srv.stop()
+    if srv.active is None or srv.active.version != 2:
+        raise RuntimeError("drill ended off the delta version")
+    if st.get("failures"):
+        raise RuntimeError(f"{st['failures']} requests failed across the "
+                           f"hot-swap — the latency numbers are not "
+                           f"trustable")
+    return {"publish_seconds": round(info["seconds"], 4),
+            "delta_publish_seconds": round(d_info["seconds"], 4),
+            "publish_bytes": int(info["bytes"]),
+            "swap_pause_ms": round(max(srv._last_swap_pause_ms, 1e-6), 6),
+            "p50_ms": st["p50_ms"], "p99_ms": st["p99_ms"],
+            "serve_eps": round(len(done) / max(serve_s, 1e-9), 1),
+            "requests": len(done), "failures": int(st["failures"]),
+            "swapped_to_version": srv.active.version}
+
+
 def dryrun_main() -> int:
     """Fast CPU smoke of the bench's regression-gate, stage-attribution,
     and push-floor code paths (tier-1: exercised on every PR instead of
@@ -947,6 +1075,32 @@ def dryrun_main() -> int:
                        (int, float))
         and drill.get("resumed_pass") == 1
         and drill.get("rerouted_records", 0) > 0)
+    # serving drill rides the dryrun too: the artifact schema must carry
+    # publish/swap/latency points (and their lower-is-better gating must
+    # hold) before a chip run records them
+    try:
+        sdrill = serving_drill(True, tiny=True)
+    except Exception as e:
+        sdrill = {"error": repr(e)}
+    detail.setdefault("matrix", {})["serving"] = sdrill
+    checks["serving_fields"] = (
+        isinstance(sdrill.get("publish_seconds"), float)
+        and sdrill["publish_seconds"] > 0
+        and isinstance(sdrill.get("swap_pause_ms"), float)
+        and sdrill["swap_pause_ms"] > 0
+        and isinstance(sdrill.get("p99_ms"), (int, float))
+        and sdrill.get("p99_ms", 0) > 0
+        and sdrill.get("failures") == 0
+        and sdrill.get("swapped_to_version") == 2)
+    g_lat = apply_regression_gate(
+        {"serving.p99_ms": 10.0},
+        {"device_kind": None, "metrics": {"serving.p99_ms": 5.0}}, "")
+    checks["latency_gate_trips_lower_is_better"] = (
+        not g_lat["ok"]
+        and apply_regression_gate(
+            {"serving.p99_ms": 4.0},
+            {"device_kind": None,
+             "metrics": {"serving.p99_ms": 5.0}}, "")["ok"])
     detail["telemetry"] = monitor.hub().summary()
     monitor.hub().disable()
     checks["telemetry_embedded"] = (
@@ -980,6 +1134,9 @@ def dryrun_main() -> int:
         "push_floor_closed": (detail.get("push_floor") or {}
                               ).get("closed"),
         "world_resize_seconds": detail.get("world_resize_seconds"),
+        "serving": {k: sdrill.get(k) for k in
+                    ("publish_seconds", "swap_pause_ms", "p99_ms",
+                     "error") if k in sdrill},
         "overlap_ab": attr.get("overlap_ab"),
         "stages": attr.get("stages"),
         "gate_example_lines": g1.get("lines"),
@@ -1117,6 +1274,12 @@ def main() -> None:
         "e2e_eps": (detail.get("e2e", {}).get(
             "examples_per_sec_per_chip")
             if isinstance(detail.get("e2e"), dict) else None),
+        "serving": ({k: detail["matrix"]["serving"].get(k) for k in
+                     ("publish_seconds", "swap_pause_ms", "p99_ms",
+                      "error")
+                     if k in detail["matrix"]["serving"]}
+                    if isinstance(detail.get("matrix", {}).get("serving"),
+                                  dict) else None),
         "host_feed_cap_eps": (detail.get("host", {}).get(
             "derived_max_feed_eps_per_chip")
             if isinstance(detail.get("host"), dict) else None),
@@ -1261,6 +1424,15 @@ def _enrich(small: bool, detail: dict, ctx: dict,
             except Exception as e:
                 matrix["elastic_degraded"] = {"error": repr(e)}
             _mark("matrix point elastic_degraded done")
+        if os.environ.get("PBTPU_BENCH_SERVING", "1") != "0":
+            # train→publish→serve drill: publish_seconds, swap_pause_ms
+            # and served p50/p99 — gate-held like every other point
+            # (latency metrics compare lower-is-better)
+            try:
+                matrix["serving"] = serving_drill(small)
+            except Exception as e:
+                matrix["serving"] = {"error": repr(e)}
+            _mark("matrix point serving done")
         detail["matrix"] = matrix
     if os.environ.get("PBTPU_BENCH_HOST", "1") != "0":
         # tunnel-immune host section, in a CPU subprocess: the parent

@@ -6,7 +6,10 @@ framework, end to end:
   → crash-safe per-pass snapshots (PassCheckpointer: atomic manifested
   base/delta chain) + day-end base models with donefiles (FleetUtil) →
   crash recovery via both paths → serving export (Predictor scores the
-  eval slice).
+  eval slice) → online serving (every end_pass publishes a versioned
+  base/delta artifact; a ServingServer tails the donefile, hot-swaps it
+  in, and a BatchingFrontend scores at concurrency — README "Serving
+  runbook").
 
 Runs hardware-free on the 8-virtual-device CPU mesh:
 
@@ -209,6 +212,17 @@ def main() -> int:
     ds = SlotDataset(schema)
     ds.set_filelist(files)
 
+    # online serving publisher (ISSUE 7): every end_pass below also
+    # ships this pass's model to the serving root — a full base every
+    # publish_base_every passes, an exact key-delta otherwise, cold rows
+    # int8, announced by donefile only after a verified commit
+    from paddlebox_tpu.serving import (BatchingFrontend, ServingPublisher,
+                                       ServingServer)
+    serve_root = os.path.join(work, "serving")
+    pub = ServingPublisher(serve_root, model, schema,
+                           publish_base_every=2, quant="int8",
+                           hot_top_k=256)
+
     days = [20260729] if short else [20260729, 20260730]
     passes_per_day = 2
     for day in days:
@@ -223,14 +237,18 @@ def main() -> int:
             # fleet.save_delta_model on top would write EMPTY fleet
             # deltas; the day-end fleet base below is a full snapshot
             # and stays exact regardless.)
-            info = box.end_pass(checkpointer=ckpt, trainer=tr)
+            info = box.end_pass(checkpointer=ckpt, trainer=tr,
+                                publisher=pub)
             last_snapshot_keys = len(store)
             msg = box.get_metric_msg("auc")
+            pinfo = info.get("publish", {})
             print(f"day {day} pass {box.pass_id}: "
                   f"auc={stats['auc']:.3f} "
                   f"registry_auc={msg.get('auc', float('nan')):.3f} "
                   f"loss={stats['loss_mean']:.4f} "
-                  f"({info['seconds']:.1f}s)")
+                  f"({info['seconds']:.1f}s) → published "
+                  f"v{pinfo.get('version')} ({pinfo.get('kind')}, "
+                  f"{pinfo.get('bytes', 0)} bytes)")
         # end of day: table hygiene, then persist the base model — the
         # saved base must reflect the post-shrink table so recovery
         # reproduces the live store exactly
@@ -277,6 +295,41 @@ def main() -> int:
            if pos.any() and (~pos).any() else float("nan"))
     print(f"serving: scored {len(probs)} examples, AUC={auc:.3f}")
     assert auc > 0.6, "serving scores lost the training signal"
+
+    # ---- online serving: tail the donefile, hot-swap, score at
+    # concurrency (README "Serving runbook"; the same server runs
+    # standalone as `python -m paddlebox_tpu.serving.server ROOT`) ----
+    srv = ServingServer(serve_root, poll_s=0.1)
+    applied = srv.poll_once()
+    h = srv.health()
+    print(f"serving host: applied {applied} published versions, "
+          f"status={h['status']} v{h['active_version']} "
+          f"(pass {h['active_pass']}, {h['table_keys']} keys, "
+          f"{h['hot_cached_keys']} hot-cached, "
+          f"swap pause {h['last_swap_pause_ms']}ms)")
+    assert h["status"] == "ok" and h["active_pass"] == box.pass_id
+    served = srv.predict_batch(pb)
+    # published artifacts quantize cold rows int8 and the publish ran
+    # BEFORE the day-end shrink — served scores track the live export
+    # within that bounded skew, and must carry the same ranking signal
+    assert np.corrcoef(probs, served)[0, 1] > 0.98
+    fe = BatchingFrontend(srv, max_batch=64, max_wait_s=0.005).start()
+    try:
+        lc, lw, _ = schema.float_split_cols("label")
+        floats = np.concatenate([pb.floats[:, :lc], pb.floats[:, lc + lw:]],
+                                axis=1)
+        futs = [fe.submit(pb.ids[i].astype(np.uint64), pb.mask[i],
+                          floats[i]) for i in range(32)]
+        got = np.asarray([f.result(timeout=300) for f in futs])
+        st = fe.stats()
+        np.testing.assert_allclose(got, served[:32], rtol=1e-5, atol=1e-6)
+        assert st["failures"] == 0
+        print(f"frontend: {st['count']} requests in {st['batches']} "
+              f"batches, p50={st['p50_ms']}ms p99={st['p99_ms']}ms, "
+              f"0 failures")
+    finally:
+        fe.stop()
+        srv.stop()
 
     if telemetry_dir:
         # flush the event stream, write the Prometheus exposition, and

@@ -65,19 +65,39 @@ def fp_width(cfg: EmbeddingConfig) -> int:
 
 
 # ---------------------------------------------------------------------------
+# generic per-row quantization (host) — shared by the device working-set
+# planes below and the serving publisher's cold-row artifact compression
+# (serving/artifact.py): one rule for "f32 matrix → (q, scale) planes".
+# ---------------------------------------------------------------------------
+
+def quantize_rows_np(x: np.ndarray, storage: str
+                     ) -> tuple[np.ndarray, np.ndarray]:
+    """f32 (N, D) → (q int8/int16 (N, D), scale f32 (N,)) with per-row
+    dynamic scaling (quantization error stays relative to each row's max
+    magnitude). D == 0 degenerates cleanly."""
+    dt, qm = _QINFO[storage]
+    x = np.asarray(x, np.float32)
+    scale = (np.abs(x).max(axis=1) / qm if x.shape[1]
+             else np.zeros(len(x), np.float32))
+    scale = np.maximum(scale, 1e-12).astype(np.float32)
+    q = np.round(x / scale[:, None]).astype(np.dtype(dt.__name__))
+    return q, scale
+
+
+def dequantize_rows_np(q: np.ndarray, scale: np.ndarray) -> np.ndarray:
+    """(q, scale) planes → f32 rows (the inverse of quantize_rows_np,
+    up to the bounded rounding error)."""
+    return q.astype(np.float32) * np.asarray(scale, np.float32)[:, None]
+
+
+# ---------------------------------------------------------------------------
 # plane <-> full-f32-row conversions (host + traced)
 # ---------------------------------------------------------------------------
 
 def encode_rows_np(rows: np.ndarray, cfg: EmbeddingConfig
                    ) -> tuple[np.ndarray, np.ndarray]:
     """Host-side f32 rows → (fp, qx) planes."""
-    qm = qmax(cfg)
-    x = rows[:, cfg.embedx_cols]
-    scale = np.abs(x).max(axis=1) / qm if cfg.total_dim else \
-        np.zeros(len(rows), np.float32)
-    scale = np.maximum(scale, 1e-12).astype(np.float32)
-    qx = np.round(x / scale[:, None]).astype(
-        np.dtype(qdtype(cfg).__name__))
+    qx, scale = quantize_rows_np(rows[:, cfg.embedx_cols], cfg.storage)
     fp = np.concatenate(
         [rows[:, :cfg.fixed_cols], rows[:, cfg.opt_cols], scale[:, None]],
         axis=1).astype(np.float32)

@@ -42,6 +42,28 @@ class ReplicaCache:
     def __len__(self) -> int:
         return len(self._rows)
 
+    @classmethod
+    def from_keys_rows(cls, keys: np.ndarray, rows: np.ndarray
+                       ) -> "ReplicaCache":
+        """Vectorized bulk build — the serving server's hot-key path: a
+        publish flags its hottest keys (by show count) and the server
+        installs their FULL-PRECISION rows here in one shot per swap
+        (cold rows ride the quantized ServingTable). Row ids are
+        assigned in key order, row 0 stays the null row."""
+        keys = np.asarray(keys).astype(np.uint64)
+        rows = np.asarray(rows, np.float32)
+        if len(keys) != len(rows):
+            raise ValueError(
+                f"keys ({len(keys)}) and rows ({len(rows)}) length "
+                "mismatch")
+        c = cls(dim=rows.shape[1] if rows.ndim == 2 else 0)
+        if len(keys):
+            c._index = {int(k): i + 1 for i, k in enumerate(keys.tolist())}
+            if len(c._index) != len(keys):
+                raise ValueError("duplicate keys in replica-cache build")
+            c._rows = [np.zeros(c.dim, np.float32)] + list(rows)
+        return c
+
     def add(self, keys: np.ndarray, values: np.ndarray) -> None:
         """Install/overwrite rows host-side (the feed-pass build)."""
         keys = np.asarray(keys).astype(np.uint64)

@@ -113,7 +113,7 @@ class BoxPS:
     def end_pass(self, need_save_delta: bool = False,
                  delta_path: str | None = None,
                  checkpointer=None, trainer=None,
-                 dataset=None) -> dict[str, Any]:
+                 dataset=None, publisher=None) -> dict[str, Any]:
         """Close the pass; optionally snapshot the delta plane
         (BoxPSDataset.end_pass(need_save_delta), dataset.py:1124).
 
@@ -126,7 +126,15 @@ class BoxPS:
         next-pass permutation. With attached collectives the snapshot is
         followed by a world barrier: no rank starts the next pass before
         every rank's snapshot committed (the election's common prefix
-        stays one pass deep at most)."""
+        stays one pass deep at most).
+
+        ``publisher`` (a serving.ServingPublisher, requires ``trainer``)
+        ships this pass's model to the serving plane — the reference's
+        per-pass xbox delta (SaveDelta → donefile → ad servers). Publish
+        runs AFTER the crash-safe snapshot; a publish failure degrades
+        (warn + telemetry, serving keeps its last good version) instead
+        of killing the pass loop — training is the producer, and the
+        serving side's staleness reporting is the alarm."""
         if not self.in_pass:
             raise RuntimeError("end_pass without begin_pass")
         self.in_pass = False
@@ -147,6 +155,24 @@ class BoxPS:
                 raise ValueError("need_save_delta requires delta_path")
             out["delta_file"] = self.store.save_delta(
                 delta_path, pass_id=self.pass_id)
+        if publisher is not None:
+            if trainer is None:
+                raise ValueError("end_pass(publisher=...) needs trainer "
+                                 "(the dense params to publish)")
+            try:
+                out["publish"] = publisher.publish(
+                    self.store, trainer.eval_params(),
+                    pass_id=self.pass_id)
+            except Exception as e:   # noqa: BLE001 — degrade, don't die
+                import warnings
+                out["publish"] = {"error": repr(e)}
+                monitor.counter_add("serving.publish_failures")
+                monitor.event("serving_publish_failed",
+                              pass_id=int(self.pass_id),
+                              error=repr(e)[:300])
+                warnings.warn(f"serving publish failed for pass "
+                              f"{self.pass_id} ({e!r}); serving stays on "
+                              f"its last good version")
         # flight-record commit LAST: checkpoint/delta durations and bytes
         # above land in this pass's stats_delta and event stream
         out["flight_record"] = monitor.hub().end_pass(metrics=self.metrics)

@@ -47,6 +47,10 @@ class FleetUtil:
         # keep the scheme (the commands want full URIs)
         self.root = output_root if self._remote else resolved
         self._fs.makedirs(self.root)
+        # (donefile, lineno, line) already diagnosed — a tailer re-reads
+        # the same file every poll, and one torn foreign line must not
+        # re-warn/re-count forever (it would drown the alert signal)
+        self._warned_malformed: set[tuple[str, int, str]] = set()
 
     # ---- paths ----
 
@@ -108,38 +112,69 @@ class FleetUtil:
             parent = path.rsplit("/", 1)[0]
             self._fs.makedirs(parent)
             # a leftover target (torn upload, re-save of the same day/pass)
-            # must go first: `hadoop fs -put` into an EXISTING dir nests the
-            # stage under it (path/m) while the donefile names path
-            self._fs.rm(path)
-            self._fs.put(stage, path)
+            # must never nest the stage under it (fs_lib.put_replacing)
+            fs_lib.put_replacing(self._fs, stage, path)
 
     def _write_donefile(self, name: str, day: int, pass_id: int,
                         path: str) -> None:
-        # crash-replay idempotency: the fs retry policy deliberately never
-        # retries append (utils/fs.py — a retried partial append could
-        # double-write), so a restarted save that reaches this line again
-        # must skip the append when the last committed line already names
-        # this exact (day, pass, path)
+        self.append_donefile(name, {"day": day, "pass": pass_id,
+                                    "path": path, "ts": int(time.time())},
+                             dedup=("day", "pass", "path"))
+
+    def append_donefile(self, name: str, entry: dict[str, Any],
+                        dedup: tuple[str, ...] = ("path",)) -> bool:
+        """Append one JSON line to a donefile under the output root.
+
+        Crash-replay idempotent: the fs retry policy deliberately never
+        retries append (utils/fs.py — a retried partial append could
+        double-write), so a restarted save that reaches this line again
+        must skip the append when the last committed line already carries
+        the same values for the ``dedup`` keys. Returns False on skip.
+        The serving publisher announces versions through this too —
+        donefile discipline lives in ONE place."""
         last = self.latest(name)
-        if (last is not None and int(last.get("day", -1)) == int(day)
-                and int(last.get("pass", -1)) == int(pass_id)
-                and last.get("path") == path):
+        if last is not None and all(last.get(k) == entry.get(k)
+                                    for k in dedup):
             monitor.counter_add("fleet.donefile_dedup")
-            return
-        line = json.dumps({"day": day, "pass": pass_id, "path": path,
-                           "ts": int(time.time())})
-        self._fs.write_text(os.path.join(self.root, name), line + "\n",
-                            append=True)
+            return False
+        self._fs.write_text(os.path.join(self.root, name),
+                            json.dumps(entry) + "\n", append=True)
+        return True
 
     def _entries(self, donefile: str) -> list[dict[str, Any]]:
         fname = os.path.join(self.root, donefile)
         if not self._fs.exists(fname):
             return []
         out = []
-        for line in self._fs.read_lines(fname):
+        for lineno, line in enumerate(self._fs.read_lines(fname), 1):
             line = line.strip()
-            if line:
-                out.append(json.loads(line))
+            if not line:
+                continue
+            # a half-written/foreign line must not brick model discovery:
+            # writers append atomically-at-best (a crashed foreign writer,
+            # or a non-JSON marker a tool dropped in, leaves a torn line)
+            # — skip it WITH A NAME, never raise mid-parse. Consumers fall
+            # back to the surviving entries; the publisher's re-announce
+            # after resume re-lands anything the torn line was meant to
+            # carry.
+            try:
+                e = json.loads(line)
+                if not isinstance(e, dict):
+                    raise ValueError(f"entry is {type(e).__name__}, "
+                                     f"not an object")
+            except ValueError as err:
+                seen = (donefile, lineno, line)
+                if seen not in self._warned_malformed:
+                    self._warned_malformed.add(seen)
+                    monitor.counter_add("fleet.donefile_malformed_lines")
+                    monitor.event("donefile_malformed_line",
+                                  donefile=donefile, lineno=lineno,
+                                  error=str(err)[:200])
+                    warnings.warn(
+                        f"malformed line {lineno} in donefile {donefile!r} "
+                        f"(skipped): {line[:120]!r} ({err})")
+                continue
+            out.append(e)
         return out
 
     def latest(self, donefile: str = "base_model.donefile"

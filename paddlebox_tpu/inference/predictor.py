@@ -58,6 +58,24 @@ class Predictor:
         return cls(model, params, table, schema,
                    label_slot=meta.get("label_slot", "label"))
 
+    def with_model(self, params: Any, table: ServingTable) -> "Predictor":
+        """Shallow clone serving new params/table through the SAME jitted
+        forward. The hot-swap server publishes a new model version every
+        pass; rebuilding a Predictor would re-jit (and recompile at the
+        first request of every version) — sharing ``_fwd`` keeps the XLA
+        compile cache across swaps, so a swap never stalls the request
+        path on a compile."""
+        p = object.__new__(Predictor)
+        p.model = self.model
+        p.params = params
+        p.table = table
+        p.schema = self.schema
+        p.label_slot = self.label_slot
+        p.layout = self.layout
+        p._device_params = jax.device_put(params)
+        p._fwd = self._fwd
+        return p
+
     # ------------------------------------------------------------------
     def predict(self, ids: np.ndarray, mask: np.ndarray,
                 dense: np.ndarray | None = None) -> np.ndarray:

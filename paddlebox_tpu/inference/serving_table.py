@@ -35,7 +35,14 @@ class ServingTable:
         # train/serve skew on below-threshold keys
         self.gate = gate
         if len(self.keys) and (self.keys[1:] == self.keys[:-1]).any():
-            raise ValueError("duplicate keys in serving table")
+            # name the offenders: "duplicate keys" without WHICH keys sends
+            # the operator diffing two multi-million-row exports by hand
+            dup = np.unique(self.keys[1:][self.keys[1:] == self.keys[:-1]])
+            shown = ", ".join(str(int(k)) for k in dup[:8])
+            more = f", … +{len(dup) - 8} more" if len(dup) > 8 else ""
+            raise ValueError(
+                f"duplicate keys in serving table: {len(dup)} key(s) "
+                f"appear more than once ({shown}{more})")
 
     # ------------------------------------------------------------------
     @property
@@ -50,6 +57,17 @@ class ServingTable:
         """Freeze a HostEmbeddingStore's pull plane for serving."""
         keys, vals = store.export_serving()
         return cls(keys, vals, gate=GateSpec.from_cfg(store.cfg))
+
+    def copy(self) -> "ServingTable":
+        """Deep copy for copy-on-write delta application: the hot-swap
+        server builds the NEXT version's table by copying the live one and
+        merging the delta into the copy, while the live table keeps
+        serving in-flight requests untouched."""
+        t = object.__new__(ServingTable)   # keys already sorted + deduped
+        t.keys = self.keys.copy()
+        t.vals = self.vals.copy()
+        t.gate = self.gate
+        return t
 
     # ------------------------------------------------------------------
     def _probe(self, keys: np.ndarray) -> tuple[np.ndarray, np.ndarray]:

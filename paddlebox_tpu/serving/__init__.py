@@ -1,0 +1,22 @@
+"""Online model publish + hot-swap serving (ISSUE 7).
+
+The crash-safe train→publish→serve loop: ``ServingPublisher`` ships a
+versioned base/delta artifact from every pass (announced by donefile only
+after a verified upload — a torn publish can never serve),
+``ServingServer`` tails the donefile, CRC-verifies, and hot-swaps the new
+version under load without dropping a request, ``BatchingFrontend``
+batches a request stream into the predictor at concurrency. See
+docs/PARITY.md "Online model publish + hot-swap serving" and the README
+serving runbook.
+"""
+
+from paddlebox_tpu.serving.artifact import (read_artifact, version_name,
+                                            write_artifact)
+from paddlebox_tpu.serving.frontend import BatchingFrontend
+from paddlebox_tpu.serving.publisher import DONEFILE, ServingPublisher
+from paddlebox_tpu.serving.server import (ServingModel, ServingServer,
+                                          ServingUnavailableError)
+
+__all__ = ["ServingPublisher", "ServingServer", "ServingModel",
+           "ServingUnavailableError", "BatchingFrontend", "DONEFILE",
+           "read_artifact", "write_artifact", "version_name"]

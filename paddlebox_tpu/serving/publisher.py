@@ -1,0 +1,268 @@
+"""ServingPublisher — the train→serve half of the online-learning loop.
+
+Reference flow (PAPER.md; fleet_util.py:722-745): every ``end_pass`` ships
+a base/delta "xbox" model — SaveBase writes the day's batch model,
+``save_delta_model`` the per-pass serving delta — and a donefile line
+announces each completed checkpoint so serving hosts can discover it
+minutes later. This publisher is that flow with the crash-safety the
+open-source glue leaves implicit:
+
+- every version directory commits ATOMICALLY (members tmp→fsync→replace,
+  MANIFEST.json with per-member CRC32 last — serving/artifact.py);
+- remote roots stage locally, upload, then VERIFY the upload (download
+  back + re-hash against the manifest) before anything is announced;
+- the donefile line is appended ONLY after verification — a kill anywhere
+  in the window (``serving.publish.{pre_manifest,pre_upload,
+  pre_donefile}``) leaves every announced version fully verifiable:
+  **a torn publish can never serve**. The re-publish after the training
+  side resumes re-lands the lost version idempotently
+  (FleetUtil.append_donefile dedups the crash-replayed line).
+
+Delta publishes diff the CURRENT pull plane against a retained copy of the
+last published one — deliberately NOT the store's dirty mask, which
+belongs to the PassCheckpointer (a second consumer of ``save_delta`` would
+force a base rotation every pass, see examples/train_ctr.py). The retained
+copy costs one serving-plane's host RAM and buys exact deltas with zero
+coupling to the checkpoint chain.
+
+Cold rows cross the wire quantized (int8/int16, per-row scale —
+embedding/quant.py); the hottest keys by show count stay f32 and are
+flagged ``hot`` so the serving side pins them in its replica cache
+(GpuReplicaCache semantics, box_wrapper.h:140-248).
+"""
+
+from __future__ import annotations
+
+import os
+import tempfile
+import time
+from typing import Any
+
+import numpy as np
+
+from paddlebox_tpu import monitor
+from paddlebox_tpu.embedding.gating import GateSpec
+from paddlebox_tpu.fleet.fleet_util import FleetUtil
+from paddlebox_tpu.inference import export as export_lib
+from paddlebox_tpu.serving import artifact as art
+from paddlebox_tpu.utils import checkpoint as ckpt_lib
+from paddlebox_tpu.utils import faultpoint
+from paddlebox_tpu.utils import fs as fs_lib
+
+DONEFILE = "serving_model.donefile"
+
+
+class ServingPublisher:
+    """Owns one serving output root (local dir or ``hdfs://…`` URI) and a
+    monotonic version sequence continued from its donefile. One instance
+    per training job; drive it per pass through
+    ``BoxPS.end_pass(publisher=…, trainer=…)`` or call :meth:`publish`
+    directly."""
+
+    def __init__(self, root: str, model: Any, schema: Any, *,
+                 publish_base_every: int | None = None,
+                 quant: str = "int8", hot_top_k: int = 1024,
+                 label_slot: str = "label", verify_upload: bool = True,
+                 staging_dir: str | None = None):
+        if quant not in ("f32", "int8", "int16"):
+            raise ValueError(f"quant must be f32|int8|int16, got {quant!r}")
+        self._remote = fs_lib.is_remote(root)
+        self.root = root if self._remote else fs_lib.resolve(root)[1]
+        self._fs = fs_lib.resolve(root)[0]
+        # donefile discipline (append-after-commit, idempotent replay,
+        # malformed-line-tolerant discovery) lives in FleetUtil — the
+        # serving donefile IS a fleet donefile
+        self._fleet = FleetUtil(root)
+        self.model = model
+        self.schema = schema
+        self.label_slot = label_slot
+        self.publish_base_every = (8 if publish_base_every is None
+                                   else int(publish_base_every))
+        if self.publish_base_every < 1:
+            raise ValueError("publish_base_every must be >= 1")
+        self.quant = quant
+        self.hot_top_k = int(hot_top_k)
+        self.verify_upload = bool(verify_upload)
+        self._staging = staging_dir
+        # continue the version sequence across restarts: the donefile is
+        # the authority (local state died with the previous process)
+        last = self._fleet.latest(DONEFILE)
+        self._version = int(last["version"]) if last else 0
+        # deltas need the retained previous plane — a restarted publisher
+        # has none, so its first publish is always a fresh base
+        self._last_pub: tuple[np.ndarray, np.ndarray] | None = None
+        self._deltas_since_base = 0
+
+    # ------------------------------------------------------------------
+
+    def _model_meta(self, pull_width: int) -> dict:
+        return {
+            "format_version": export_lib.FORMAT_VERSION,
+            "model": self.model.name,
+            "config": export_lib.model_config(self.model),
+            "schema": export_lib._schema_json(self.schema),
+            "label_slot": self.label_slot,
+            "pull_width": int(pull_width),
+        }
+
+    def _artifact_target(self, name: str) -> str:
+        return (f"{self.root.rstrip('/')}/{name}" if self._remote
+                else os.path.join(self.root, name))
+
+    def publish(self, store, dense_params, pass_id: int) -> dict:
+        """Snapshot ``store``'s pull plane + ``dense_params`` into the next
+        version (a full base every ``publish_base_every`` publishes, a
+        key-delta otherwise), verify it, announce it. Returns the publish
+        info dict ({version, kind, path, seconds, bytes, …})."""
+        t0 = time.perf_counter()
+        # export_serving runs the store's flush hooks first: pending
+        # deferred pushes + lazily-retained device rows land before the
+        # plane is read (same completeness contract as the checkpointer)
+        keys, vals = store.export_serving()
+        version = self._version + 1
+        is_base = (self._last_pub is None
+                   or self._deltas_since_base >= self.publish_base_every - 1)
+        kind = "base" if is_base else "delta"
+        name = art.version_name(version)
+        gate = GateSpec.from_cfg(store.cfg)
+        meta_kw: dict[str, Any] = {}
+        if is_base:
+            hot = np.zeros(len(keys), bool)
+            if self.hot_top_k > 0 and len(keys):
+                k = min(self.hot_top_k, len(keys))
+                # hottest by show count (pull col 0) — the replica-cache
+                # candidates; ties broken arbitrarily is fine
+                hot[np.argpartition(-vals[:, 0], k - 1)[:k]] = True
+            meta_kw.update(keys=keys, vals=vals, hot=hot,
+                           quant=self.quant,
+                           fixed_cols=int(store.cfg.fixed_cols))
+            parent = None
+        else:
+            pk, pv = self._last_pub
+            ch_keys, ch_rows, removed = _diff_plane(pk, pv, keys, vals)
+            meta_kw.update(keys=ch_keys, vals=ch_rows, removed=removed)
+            parent = self._version
+
+        tmp_stage = None
+        if self._remote:
+            if self._staging:
+                os.makedirs(self._staging, exist_ok=True)
+                stage_root = self._staging
+            else:
+                tmp_stage = tempfile.TemporaryDirectory(
+                    prefix="pbtpu_serve_pub_")
+                stage_root = tmp_stage.name
+            local_dir = os.path.join(stage_root, name)
+        else:
+            local_dir = os.path.join(self.root, name)
+        try:
+            manifest = art.write_artifact(
+                local_dir, version=version, pass_id=int(pass_id),
+                kind=kind, parent_version=parent,
+                model_meta=self._model_meta(vals.shape[1] if len(vals)
+                                            else store.cfg.pull_width),
+                dense_params=dense_params, gate=gate, ts=int(time.time()),
+                **meta_kw)
+
+            faultpoint.hit("serving.publish.pre_upload")
+            target = self._artifact_target(name)
+            if self._remote:
+                self._fs.makedirs(self.root)
+                fs_lib.put_replacing(self._fs, local_dir, target)
+            self._verify_published(target, manifest)
+        finally:
+            if tmp_stage is not None:
+                tmp_stage.cleanup()
+
+        faultpoint.hit("serving.publish.pre_donefile")
+        entry = {"version": version, "pass": int(pass_id), "kind": kind,
+                 "parent": parent, "path": target, "ts": int(time.time())}
+        announced = self._fleet.append_donefile(DONEFILE, entry,
+                                                dedup=("version", "path"))
+
+        self._version = version
+        self._deltas_since_base = 0 if is_base else \
+            self._deltas_since_base + 1
+        # retain the published plane for the next delta diff (copy: the
+        # caller's arrays go back to the live store)
+        self._last_pub = (keys.copy(), vals.copy())
+        seconds = time.perf_counter() - t0
+        nbytes = sum(e["bytes"] for e in manifest["files"].values())
+        monitor.counter_add("serving.publishes")
+        monitor.counter_add(f"serving.publish_{kind}s")
+        monitor.counter_add("serving.publish_seconds", seconds)
+        monitor.counter_add("serving.publish_bytes", nbytes)
+        monitor.event("serving_publish", type="lifecycle", version=version,
+                      kind=kind, pass_id=int(pass_id), seconds=seconds,
+                      bytes=int(nbytes), announced=bool(announced),
+                      keys=int(manifest["num_keys"]))
+        return {"version": version, "kind": kind, "path": target,
+                "seconds": seconds, "bytes": int(nbytes),
+                "keys": int(manifest["num_keys"]),
+                "announced": bool(announced)}
+
+    def _verify_published(self, target: str, manifest: dict) -> None:
+        """The "verified upload" the donefile contract requires: re-hash
+        what actually landed at the target against the manifest we
+        committed. Local roots verify in place; remote roots download the
+        artifact back (``verify_upload=False`` trusts the transport and
+        skips the round-trip — the serving side still verifies on every
+        fetch, this is the publish-side early warning)."""
+        if not self._remote:
+            ckpt_lib.verify_manifest(target)
+            return
+        if not self.verify_upload:
+            return
+        t0 = time.perf_counter()
+        with tempfile.TemporaryDirectory(
+                prefix="pbtpu_pub_verify_") as tmp:
+            local = os.path.join(tmp, "check")
+            self._fs.get(target, local)
+            got = ckpt_lib.verify_manifest(local)
+            if int(got.get("version", -1)) != int(manifest["version"]):
+                raise ckpt_lib.CheckpointCorruptError(
+                    target, f"uploaded artifact claims version "
+                            f"{got.get('version')} != published "
+                            f"{manifest['version']}")
+        monitor.counter_add("serving.upload_verify_seconds",
+                            time.perf_counter() - t0)
+
+    def latest_announced(self) -> dict | None:
+        return self._fleet.latest(DONEFILE)
+
+    def publish_if_behind(self, store, dense_params,
+                          pass_id: int) -> dict | None:
+        """Resume catch-up: a kill between the pass snapshot and the
+        donefile append loses that pass's announcement (the snapshot
+        committed, so the resumed run starts at the NEXT pass and would
+        never re-publish it). The driver calls this right after resume
+        with the restored cursor's pass — if the donefile is behind the
+        restored state, the current plane re-publishes (as a fresh base:
+        a restarted publisher holds no retained diff plane). None when
+        serving is already caught up."""
+        if pass_id < 1:
+            return None
+        last = self.latest_announced()
+        if last is not None and int(last.get("pass", -1)) >= int(pass_id):
+            return None
+        monitor.counter_add("serving.publish_catchups")
+        return self.publish(store, dense_params, pass_id=pass_id)
+
+
+def _diff_plane(prev_keys: np.ndarray, prev_vals: np.ndarray,
+                keys: np.ndarray, vals: np.ndarray
+                ) -> tuple[np.ndarray, np.ndarray, np.ndarray]:
+    """Exact key-delta between two pull planes: (changed_keys,
+    changed_rows, removed_keys). Changed = new key, or any pull column
+    differing bit-wise from the retained publish."""
+    order_p = np.argsort(prev_keys, kind="stable")
+    pk, pv = prev_keys[order_p], prev_vals[order_p]
+    pos = np.searchsorted(pk, keys)
+    pos_c = np.minimum(pos, max(len(pk) - 1, 0))
+    existed = (pk[pos_c] == keys) if len(pk) else np.zeros(len(keys), bool)
+    same = np.zeros(len(keys), bool)
+    if existed.any():
+        same[existed] = (pv[pos_c[existed]] == vals[existed]).all(axis=1)
+    changed = ~same
+    removed = pk[~np.isin(pk, keys)] if len(pk) else pk
+    return keys[changed], vals[changed], removed

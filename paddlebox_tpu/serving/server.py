@@ -1,0 +1,564 @@
+"""ServingServer — donefile-tailing, CRC-verifying, hot-swapping scorer.
+
+The serve half of the reference's online loop: ad-serving hosts watch the
+xbox donefile, download each announced base/delta, and swap the new model
+in while traffic flows (SURVEY.md; the minutes-scale train→serve latency
+PAPER.md advertises). The crash-safety contract mirrors the training side:
+
+- **Verify before build.** Every fetched version re-hashes against its
+  manifest (serving/artifact.py) — bytes that fail CRC never reach a
+  table. With the publisher's announce-after-verify discipline this
+  closes the loop: a torn publish is never announced, and even an
+  announced artifact later corrupted in storage is diagnosed, not served.
+- **Swap without a pause.** The next version's ServingTable + Predictor
+  build OFF the request path (the poll thread); the swap itself is one
+  atomic rebind of the versioned handle (``self._active``). In-flight
+  requests finish on the handle they grabbed; new requests see the new
+  version. Zero requests dropped, zero blocked — proven under concurrent
+  load by tests/test_serving.py.
+- **Degrade, don't die.** A version that fails to download (bounded retry
+  + exponential backoff) or verify is skipped with a named diagnostic;
+  deltas whose parent was skipped wait for the next base; when nothing
+  new can be loaded the server keeps serving the last good version and
+  reports staleness (pass lag + age) through the telemetry hub and the
+  health endpoint.
+
+Hot keys flagged by the publisher pin into a ReplicaCache
+(full-precision — the GpuReplicaCache role, box_wrapper.h:140-248),
+refreshed copy-on-write at every swap so the cache can never be observed
+mid-update.
+"""
+
+from __future__ import annotations
+
+import http.server
+import json
+import os
+import shutil
+import tempfile
+import threading
+import time
+from typing import Any
+
+import numpy as np
+
+from paddlebox_tpu import monitor
+from paddlebox_tpu.embedding.gating import GateSpec
+from paddlebox_tpu.embedding.replica_cache import ReplicaCache
+from paddlebox_tpu.fleet.fleet_util import FleetUtil
+from paddlebox_tpu.inference import export as export_lib
+from paddlebox_tpu.inference.predictor import Predictor
+from paddlebox_tpu.inference.serving_table import ServingTable
+from paddlebox_tpu.serving import artifact as art
+from paddlebox_tpu.serving.publisher import DONEFILE
+from paddlebox_tpu.utils import checkpoint as ckpt_lib
+from paddlebox_tpu.utils import fs as fs_lib
+from paddlebox_tpu.utils.checkpoint import CheckpointCorruptError
+
+
+def _entry_int(entry: dict | None, key: str) -> int | None:
+    """An int field off a donefile entry, None when absent/unparseable."""
+    if entry is None:
+        return None
+    try:
+        return int(entry[key])
+    except (KeyError, TypeError, ValueError):
+        return None
+
+
+class ServingUnavailableError(RuntimeError):
+    """No model version has been loaded yet (empty donefile, or every
+    announced version failed verification)."""
+
+
+class ServingModel:
+    """One immutable loaded version — the handle a request grabs once.
+    Everything a request touches hangs off this object, so an atomic
+    rebind of ``server._active`` IS the swap."""
+
+    __slots__ = ("version", "pass_id", "kind", "predictor", "table",
+                 "replica_cache", "hot_keys", "published_ts", "loaded_ts")
+
+    def __init__(self, version: int, pass_id: int, kind: str,
+                 predictor: Predictor, table: ServingTable,
+                 replica_cache: ReplicaCache | None,
+                 hot_keys: np.ndarray, published_ts: int):
+        self.version = version
+        self.pass_id = pass_id
+        self.kind = kind
+        self.predictor = predictor
+        self.table = table
+        self.replica_cache = replica_cache
+        self.hot_keys = hot_keys
+        self.published_ts = published_ts
+        self.loaded_ts = time.time()
+
+
+class ServingServer:
+    """Tails one serving root's donefile and serves the newest verified
+    version. Use :meth:`poll_once` for test-driven stepping or
+    :meth:`start` for the background tailer; score through
+    :meth:`predict` / :meth:`predict_batch` (or a
+    serving.frontend.BatchingFrontend on top)."""
+
+    def __init__(self, root: str, *, poll_s: float = 1.0,
+                 staging_dir: str | None = None,
+                 fetch_attempts: int = 3, fetch_backoff_s: float = 0.25,
+                 stale_pass_lag: int = 2, stale_after_s: float = 600.0,
+                 health_port: int | None = None):
+        self._remote = fs_lib.is_remote(root)
+        self.root = root if self._remote else fs_lib.resolve(root)[1]
+        self._fs = fs_lib.resolve(root)[0]
+        self._fleet = FleetUtil(root)   # donefile discovery (torn-line safe)
+        self.poll_s = float(poll_s)
+        self._staging = staging_dir
+        self.fetch_attempts = max(1, int(fetch_attempts))
+        self.fetch_backoff_s = float(fetch_backoff_s)
+        self.stale_pass_lag = int(stale_pass_lag)
+        self.stale_after_s = float(stale_after_s)
+        self._active: ServingModel | None = None
+        self._latest_announced: dict | None = None
+        self._skipped: dict[int, str] = {}     # version → diagnosis
+        self._unusable: set[str] = set()       # entries diagnosed once
+        self._swaps = 0
+        self._served = 0
+        self._request_failures = 0
+        self._last_error: str | None = None
+        self._last_swap_pause_ms = 0.0
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+        self._http: Any = None
+        self.health_port: int | None = None
+        if health_port is not None:
+            self._start_health_endpoint(int(health_port))
+
+    # ---- discovery + swap ------------------------------------------------
+
+    @property
+    def active(self) -> ServingModel | None:
+        return self._active
+
+    def poll_once(self) -> int:
+        """One tail step: read the donefile, fetch/verify/build every
+        version newer than the active one IN ORDER, swap each in. Returns
+        the number of versions applied. Never raises on a bad version —
+        it diagnoses, skips, and keeps the last good model serving."""
+        entries = self._fleet._entries(DONEFILE)
+        if entries:
+            self._latest_announced = entries[-1]
+        if self._active is None and entries:
+            # cold start: the donefile holds the job's whole history, but
+            # the newest loadable base + its trailing deltas fully
+            # determine the serving state — seek instead of replaying
+            # every version. Bases newest-first so a rotted newest base
+            # falls back to the previous one; entries before the oldest
+            # base are deltas with no loadable root and can never apply.
+            base_idx = [i for i, e in enumerate(entries)
+                        if str(e.get("kind", "")) == "base"]
+            applied = 0
+            for i in reversed(base_idx):
+                applied += self._apply_entries(entries[i:])
+                if self._active is not None:
+                    break
+            if not base_idx:
+                applied = self._apply_entries(entries)
+        else:
+            applied = self._apply_entries(entries)
+        self._update_staleness_gauges()
+        return applied
+
+    def _apply_entries(self, entries: list[dict]) -> int:
+        active_v = self._active.version if self._active else 0
+        applied = 0
+        for e in entries:
+            try:
+                version = int(e["version"])
+                kind = str(e["kind"])
+                path = str(e["path"])
+            except (KeyError, TypeError, ValueError) as err:
+                # versionless, so _skipped can't remember it — dedupe on
+                # the entry itself or every poll re-diagnoses the same
+                # foreign line forever (counter spam drowns the alert,
+                # and _last_error masks newer real errors)
+                seen = repr(sorted(e.items())) if isinstance(e, dict) \
+                    else repr(e)
+                if seen not in self._unusable:
+                    self._unusable.add(seen)
+                    self._diag(-1, f"unusable donefile entry {e!r}: {err}")
+                continue
+            if version <= active_v or version in self._skipped:
+                continue
+            if kind == "delta":
+                parent = e.get("parent")
+                if self._active is None or parent is None \
+                        or int(parent) != self._active.version:
+                    # parent skipped/never loaded: this delta can never
+                    # apply — wait for the next base to resync
+                    self._diag(version,
+                               f"delta v{version} parents "
+                               f"v{parent}, active is "
+                               f"v{self._active.version if self._active else None}"
+                               f" — waiting for the next base")
+                    continue
+            staged = None
+            try:
+                loaded, staged = self._fetch(path)
+                model = self._build(loaded, e)
+            except Exception as err:   # noqa: BLE001 — keep serving
+                self._diag(version, f"{kind} v{version} at {path}: "
+                                    f"{err!r}")
+                continue
+            finally:
+                # the build consumed the staged download (arrays are in
+                # memory, dense_file loaded) — a long-running remote
+                # tailer must not accumulate one artifact per publish
+                # until the staging disk fills
+                if staged is not None:
+                    shutil.rmtree(staged, ignore_errors=True)
+            t_swap = time.perf_counter()
+            self._active = model           # THE swap: one atomic rebind
+            pause_ms = (time.perf_counter() - t_swap) * 1e3
+            self._last_swap_pause_ms = pause_ms
+            self._swaps += 1
+            applied += 1
+            active_v = version
+            monitor.counter_add("serving.swaps")
+            monitor.gauge_set("serving.active_version", version)
+            monitor.event("serving_swap", type="lifecycle",
+                          version=version, kind=kind,
+                          pass_id=model.pass_id,
+                          swap_pause_ms=round(pause_ms, 3),
+                          keys=len(model.table))
+        return applied
+
+    def _diag(self, version: int, msg: str) -> None:
+        self._last_error = msg
+        if version >= 0:
+            self._skipped[version] = msg
+        monitor.counter_add("serving.version_fallbacks")
+        monitor.event("serving_version_fallback", version=version,
+                      error=msg[:300])
+        import warnings
+        warnings.warn(f"serving: {msg}; continuing on the last good "
+                      f"version")
+
+    def _fetch(self, path: str) -> tuple[dict, str | None]:
+        """Local view of one artifact, CRC-verified, plus the staging-dir
+        copy to remove once consumed (None when read in place). Remote
+        fetches get ``fetch_attempts`` tries with exponential backoff;
+        the partial download is removed before each retry and on
+        exhaustion."""
+        if not self._remote and os.path.isdir(path):
+            return art.read_artifact(path, verify=True), None
+        if self._staging is None:
+            # per-instance: two servers on one host (different roots)
+            # staging the same version basename into a shared fixed dir
+            # would clobber each other's download mid-read
+            self._staging = tempfile.mkdtemp(prefix="pbtpu_serving_stage_")
+        stage = self._staging
+        os.makedirs(stage, exist_ok=True)
+        local = os.path.join(stage, os.path.basename(path.rstrip("/")))
+        backoff = self.fetch_backoff_s
+        last: Exception | None = None
+        for attempt in range(self.fetch_attempts):
+            if attempt:
+                time.sleep(backoff)
+                backoff *= 2.0
+                monitor.counter_add("serving.fetch_retries")
+            shutil.rmtree(local, ignore_errors=True)
+            try:
+                self._fs.get(path, local)
+                out = art.read_artifact(local, verify=True)
+                return out, local
+            except (RuntimeError, OSError, ValueError,
+                    CheckpointCorruptError) as err:
+                last = err
+        shutil.rmtree(local, ignore_errors=True)
+        raise RuntimeError(
+            f"artifact {path} failed to fetch/verify after "
+            f"{self.fetch_attempts} attempts: {last}") from last
+
+    def _build(self, loaded: dict, entry: dict) -> ServingModel:
+        """Assemble the next ServingModel OFF the request path. Base →
+        fresh table (+ predictor; the jitted forward is reused across
+        versions of the same model config, so a swap never recompiles);
+        delta → copy-on-write merge into a copy of the active table."""
+        t0 = time.perf_counter()
+        meta = loaded["meta"]
+        if int(meta["version"]) != int(entry["version"]):
+            # CRCs only prove the artifact matches ITS manifest — a
+            # misdirected fetch (stale staging, wrong path in a foreign
+            # donefile line) verifies clean while being another version's
+            # model entirely
+            raise CheckpointCorruptError(
+                str(entry.get("path", "?")),
+                f"artifact claims v{meta['version']} != announced "
+                f"v{entry['version']}")
+        kind = meta["kind"]
+        mm = loaded["model_meta"]
+        if kind == "base":
+            g = meta.get("gate")
+            gate = (GateSpec(int(g[0]), int(g[1]), float(g[2]),
+                             float(g[3])) if g else None)
+            table = ServingTable(loaded["keys"], loaded["vals"], gate=gate)
+            hot_keys = np.asarray(loaded["keys"])[
+                np.asarray(loaded["hot"], bool)].astype(np.uint64)
+        else:
+            active = self._active
+            table = active.table.copy()
+            table._merge(loaded["keys"], loaded["rows"])
+            if len(loaded["removed"]):
+                table._drop(loaded["removed"])
+            hot_keys = active.hot_keys
+        predictor = self._make_predictor(mm, loaded["dense_file"], table)
+        cache = self._build_replica_cache(table, hot_keys)
+        monitor.counter_add("serving.build_seconds",
+                            time.perf_counter() - t0)
+        return ServingModel(int(meta["version"]), int(meta["pass_id"]),
+                            kind, predictor, table, cache, hot_keys,
+                            int(entry.get("ts", meta.get("ts", 0))))
+
+    def _make_predictor(self, model_meta: dict, dense_file: str,
+                        table: ServingTable) -> Predictor:
+        import jax
+        from paddlebox_tpu.models import MODEL_REGISTRY
+        from paddlebox_tpu.utils import checkpoint as _ckpt
+        active = self._active
+        if active is not None and \
+                active.predictor.model.name == model_meta["model"] and \
+                _normalize_cfg(export_lib.model_config(
+                    active.predictor.model)) \
+                == _normalize_cfg(model_meta["config"]):
+            template = active.predictor.params
+            params = _ckpt.load_pytree(template, dense_file)
+            # same architecture: share the compiled forward across the swap
+            return active.predictor.with_model(params, table)
+        cfg = _normalize_cfg(model_meta["config"])
+        import jax.numpy as jnp
+        if "compute_dtype" in cfg:
+            cfg = dict(cfg, compute_dtype=jnp.dtype(cfg["compute_dtype"]))
+        model = MODEL_REGISTRY[model_meta["model"]](**cfg)
+        template = model.init(jax.random.PRNGKey(0))
+        params = _ckpt.load_pytree(template, dense_file)
+        schema = export_lib._schema_from_json(model_meta["schema"])
+        return Predictor(model, params, table, schema,
+                         label_slot=model_meta.get("label_slot", "label"))
+
+    def _build_replica_cache(self, table: ServingTable,
+                             hot_keys: np.ndarray) -> ReplicaCache | None:
+        """Copy-on-write hot tier: a fresh cache per version, built from
+        the NEW table's rows for the flagged keys (keys evicted since the
+        flagging base simply drop out). The active version's cache is
+        never mutated — a device holding the old HBM mirror keeps it
+        consistent until it uploads the new one."""
+        if not len(hot_keys) or not len(table):
+            return None
+        pos, hit = table._probe(np.asarray(hot_keys, np.uint64))
+        live = np.asarray(hot_keys, np.uint64)[hit]
+        if not len(live):
+            return None
+        return ReplicaCache.from_keys_rows(live, table.vals[pos[hit]])
+
+    # ---- request path ----------------------------------------------------
+
+    def _handle(self) -> ServingModel:
+        m = self._active
+        if m is None:
+            raise ServingUnavailableError(
+                f"no serving model loaded from {self.root} yet "
+                f"(last error: {self._last_error})")
+        return m
+
+    def predict(self, ids: np.ndarray, mask: np.ndarray,
+                dense: np.ndarray | None = None) -> np.ndarray:
+        m = self._handle()
+        try:
+            out = m.predictor.predict(ids, mask, dense)
+        except Exception:
+            self._request_failures += 1
+            monitor.counter_add("serving.request_failures")
+            raise
+        self._served += len(np.asarray(ids))
+        return out
+
+    def predict_batch(self, pb) -> np.ndarray:
+        m = self._handle()
+        try:
+            out = m.predictor.predict_batch(pb)
+        except Exception:
+            self._request_failures += 1
+            monitor.counter_add("serving.request_failures")
+            raise
+        self._served += int(pb.num)
+        return out
+
+    # ---- staleness / health ----------------------------------------------
+
+    def _update_staleness_gauges(self) -> None:
+        h = self.health()
+        if h["pass_lag"] is not None:
+            monitor.gauge_set("serving.pass_lag", h["pass_lag"])
+        if h["age_seconds"] is not None:
+            monitor.gauge_set("serving.staleness_seconds",
+                              h["age_seconds"])
+
+    def health(self) -> dict:
+        """The health endpoint's payload: what is serving, how stale it
+        is, and whether the tail is degraded (newer versions announced
+        but unloadable). ``status``: ok | stale | degraded | empty."""
+        m = self._active
+        ann = self._latest_announced
+        # snapshot: the tailer thread inserts concurrently, and iterating
+        # the live dict from the HTTP thread can raise "changed size
+        # during iteration" exactly when versions are being skipped
+        skipped = list(self._skipped)
+        # the tail entry is whatever parses off the donefile — a foreign
+        # or hand-written last line must degrade the report, not 500 it
+        ann_v = _entry_int(ann, "version")
+        ann_pass = _entry_int(ann, "pass")
+        if m is None:
+            status = "empty"
+            pass_lag = ann_pass if ann_pass is not None else None
+            age = None
+        else:
+            pass_lag = (max(0, ann_pass - m.pass_id)
+                        if ann_pass is not None else 0)
+            age = time.time() - (m.published_ts or m.loaded_ts)
+            if ann_v is not None and ann_v > m.version \
+                    and any(v > m.version for v in skipped):
+                status = "degraded"
+            elif pass_lag > self.stale_pass_lag \
+                    or age > self.stale_after_s:
+                status = "stale"
+            else:
+                status = "ok"
+        return {"status": status,
+                "active_version": m.version if m else None,
+                "active_pass": m.pass_id if m else None,
+                "active_kind": m.kind if m else None,
+                "table_keys": len(m.table) if m else 0,
+                "hot_cached_keys": (len(m.replica_cache) - 1
+                                    if m and m.replica_cache else 0),
+                "announced_version": ann_v,
+                "announced_pass": ann_pass,
+                "pass_lag": pass_lag,
+                "age_seconds": None if age is None else round(age, 1),
+                "swaps": self._swaps,
+                "last_swap_pause_ms": round(self._last_swap_pause_ms, 3),
+                "served": self._served,
+                "request_failures": self._request_failures,
+                "skipped_versions": sorted(skipped),
+                "last_error": self._last_error}
+
+    # ---- background tailer ----------------------------------------------
+
+    def start(self) -> "ServingServer":
+        """Background donefile tailer: poll every ``poll_s`` seconds. A
+        poll that raises (remote-FS outage past the retry budget) is
+        recorded and the loop continues — the server's job under failure
+        is to keep serving what it has."""
+        if self._thread is not None:
+            return self
+        self._stop.clear()
+
+        def _run():
+            while not self._stop.is_set():
+                try:
+                    self.poll_once()
+                except Exception as e:   # noqa: BLE001
+                    self._last_error = f"poll failed: {e!r}"
+                    monitor.counter_add("serving.poll_failures")
+                self._stop.wait(self.poll_s)
+
+        self._thread = threading.Thread(target=_run, daemon=True,
+                                        name="serving-tailer")
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=30)
+            self._thread = None
+        if self._http is not None:
+            self._http.shutdown()
+            self._http = None
+
+    # ---- health endpoint -------------------------------------------------
+
+    def _start_health_endpoint(self, port: int) -> None:
+        """Tiny stdlib HTTP endpoint: ``/healthz`` returns the health()
+        JSON (200 while a model serves, 503 before the first load),
+        ``/metrics`` the telemetry hub's Prometheus exposition — the
+        operator surface the runbook (README) curls."""
+        server = self
+
+        class _Handler(http.server.BaseHTTPRequestHandler):
+            def do_GET(self):  # noqa: N802 (stdlib API)
+                if self.path.startswith("/healthz"):
+                    body = json.dumps(server.health()).encode()
+                    code = 503 if server._active is None else 200
+                    ctype = "application/json"
+                elif self.path.startswith("/metrics"):
+                    body = monitor.hub().prometheus_text().encode()
+                    code, ctype = 200, "text/plain; version=0.0.4"
+                else:
+                    body, code, ctype = b"not found", 404, "text/plain"
+                self.send_response(code)
+                self.send_header("Content-Type", ctype)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def log_message(self, *a):   # quiet: telemetry is the log
+                pass
+
+        self._http = http.server.ThreadingHTTPServer(("127.0.0.1", port),
+                                                     _Handler)
+        self.health_port = self._http.server_address[1]
+        threading.Thread(target=self._http.serve_forever, daemon=True,
+                         name="serving-health").start()
+
+
+def _normalize_cfg(cfg: dict) -> dict:
+    return {k: (tuple(v) if isinstance(v, list) else v)
+            for k, v in cfg.items()}
+
+
+def main(argv: list[str] | None = None) -> int:
+    """Runbook entrypoint (README "Serving runbook"):
+    ``python -m paddlebox_tpu.serving.server ROOT [--health-port N]``
+    tails ROOT's donefile forever, hot-swapping each announced version
+    and serving /healthz + /metrics."""
+    import argparse
+    ap = argparse.ArgumentParser(
+        description="Serve the newest verified model published to ROOT "
+                    "(tails serving_model.donefile; hot-swaps new "
+                    "versions under load; degrades to the last good "
+                    "version when publishes stop or verification fails)")
+    ap.add_argument("root", help="serving root (local dir or hdfs:// URI)")
+    ap.add_argument("--poll-s", type=float, default=1.0)
+    ap.add_argument("--health-port", type=int, default=8080,
+                    help="0 picks a free port; printed on startup")
+    ap.add_argument("--staging-dir", default=None,
+                    help="where remote artifacts download before verify")
+    ap.add_argument("--stale-pass-lag", type=int, default=2)
+    ap.add_argument("--stale-after-s", type=float, default=600.0)
+    args = ap.parse_args(argv)
+    srv = ServingServer(args.root, poll_s=args.poll_s,
+                        staging_dir=args.staging_dir,
+                        stale_pass_lag=args.stale_pass_lag,
+                        stale_after_s=args.stale_after_s,
+                        health_port=args.health_port).start()
+    print(f"serving {args.root}; health at "
+          f"http://127.0.0.1:{srv.health_port}/healthz", flush=True)
+    try:
+        while True:
+            time.sleep(60)
+    except KeyboardInterrupt:
+        srv.stop()
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1180,10 +1180,20 @@ class Trainer:
         use_super = (self._superstep_fn is not None and dstate is not None
                      and mode == "allreduce")
         k_sd = cfg.steps_per_dispatch if use_super else 1
-        if (skip_steps or self._midpass is not None) and k_sd > 1:
+        if k_sd > 1 and int(skip_steps) % k_sd:
+            # the superstep cursor advances k steps per dispatched
+            # program — a resume can only land BETWEEN dispatches (the
+            # same boundary rule as the kstep sync-boundary refusal)
             raise NotImplementedError(
-                "mid-pass resume/snapshots need steps_per_dispatch == 1 "
-                "(the cursor is per single-step program)")
+                f"mid-pass resume with steps_per_dispatch={k_sd} needs "
+                f"the cursor on a dispatch boundary: skip_steps="
+                f"{skip_steps} is not a multiple of {k_sd}")
+        if k_sd > 1 and self._midpass is not None \
+                and self._midpass[1] % k_sd:
+            raise NotImplementedError(
+                f"mid-pass snapshots with steps_per_dispatch={k_sd} need "
+                f"a cadence on the dispatch boundary: every_steps="
+                f"{self._midpass[1]} is not a multiple of {k_sd}")
         skip_remaining = int(skip_steps)
         pack_it = self._pack_iter(dataset, ws, cfg.global_batch_size,
                                   group=k_sd)
@@ -1194,11 +1204,13 @@ class Trainer:
                 else:
                     pbs, staged, stacked = [item[0]], item[1], False
                 if skip_remaining > 0:
-                    # mid-pass resume: this batch's effects already live in
-                    # the restored planes — consume it (keeps the batch
-                    # stream and step cadence aligned) but train nothing
-                    skip_remaining -= 1
-                    pass_step += 1
+                    # mid-pass resume: these batches' effects already live
+                    # in the restored planes — consume them (keeps the
+                    # batch stream and step cadence aligned) but train
+                    # nothing. Superstep groups skip whole (the boundary
+                    # check above guarantees skip_remaining covers them).
+                    skip_remaining -= len(pbs)
+                    pass_step += len(pbs)
                     continue
                 pb = pbs[-1]
                 mon_ctx.set_step(self.global_step)
@@ -1720,11 +1732,15 @@ class Trainer:
         pass boundaries resumes via ``train_pass(skip_steps=mid_steps)``
         from the dataset cursor instead of replaying the pass.
 
-        Supported dense-sync modes (all with ``steps_per_dispatch == 1``
-        — the cursor is per single-step program):
+        Supported dense-sync modes:
 
         - ``allreduce``: any cadence; the live flat/pytree dense state
-          rides ``dense_override``.
+          rides ``dense_override``. With ``steps_per_dispatch > 1`` the
+          cadence must land on the DISPATCH boundary (a multiple of
+          ``steps_per_dispatch`` — the cursor advances k steps per
+          dispatched superstep program, so snapshots/resume can only
+          land between dispatches; the same pattern as the kstep
+          sync-boundary rule below).
         - ``kstep``: ``every_steps`` must land on the K-step sync
           boundary (a multiple of ``param_sync_step``) — that is where
           the per-shard replicas are consistent with the uninterrupted
@@ -1739,10 +1755,14 @@ class Trainer:
             self._midpass = None
             return
         mode = self.cfg.dense_sync_mode
-        if self.cfg.steps_per_dispatch != 1:
+        if self.cfg.steps_per_dispatch > 1 \
+                and every_steps % self.cfg.steps_per_dispatch:
             raise NotImplementedError(
-                "mid-pass snapshots need steps_per_dispatch=1 (the "
-                "cursor is per single-step program)")
+                f"mid-pass snapshots with steps_per_dispatch="
+                f"{self.cfg.steps_per_dispatch} must land on the "
+                f"dispatch boundary: every_steps={every_steps} is not a "
+                f"multiple of it — the k-microbatch program commits k "
+                f"steps atomically, so no cursor exists between them")
         if mode == "kstep" and every_steps % self.cfg.param_sync_step:
             raise NotImplementedError(
                 f"kstep mid-pass snapshots must land on the K-step sync "

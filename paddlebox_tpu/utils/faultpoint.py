@@ -87,6 +87,19 @@ POINTS: tuple[str, ...] = (
     "elastic.reform.pre_arrive",
     "elastic.reform.post_seal",
     "elastic.reform.post_ack",
+    # serving/publisher.publish: the three windows of the model-publish
+    # protocol (ISSUE 7). pre_manifest = artifact members written, its
+    # MANIFEST.json not yet committed — the version must be invisible;
+    # pre_upload = local artifact committed, remote upload not yet run —
+    # the remote root may hold a torn copy but the donefile must not
+    # name it; pre_donefile = upload verified, the announce line not yet
+    # appended — the serving side must simply never see this version
+    # (the re-publish after resume re-lands it). A kill at ANY of these
+    # must leave every ANNOUNCED version fully verifiable: a torn
+    # publish must never serve.
+    "serving.publish.pre_manifest",
+    "serving.publish.pre_upload",
+    "serving.publish.pre_donefile",
 )
 
 # Points that fire only inside the elastic re-formation window: the
@@ -97,6 +110,16 @@ ELASTIC_POINTS: tuple[str, ...] = (
     "elastic.reform.pre_arrive",
     "elastic.reform.post_seal",
     "elastic.reform.post_ack",
+)
+
+# Points that fire only inside the serving publish path: the training
+# kill→resume matrices never publish a serving model — they are covered
+# by the publish/swap kill matrix (tests/test_serving.py) instead, which
+# carries its own closed-registry guard.
+SERVING_POINTS: tuple[str, ...] = (
+    "serving.publish.pre_manifest",
+    "serving.publish.pre_upload",
+    "serving.publish.pre_donefile",
 )
 
 

@@ -391,6 +391,19 @@ def is_remote(path: str) -> bool:
     return "://" in path and not path.lower().startswith("file://")
 
 
+def put_replacing(fs: FileSystem, local: str, remote: str) -> None:
+    """Upload a directory (or file) REPLACING any leftover target first.
+
+    `hadoop fs -put` into an EXISTING directory nests the source under it
+    (``remote/basename(local)``) while every donefile/manifest consumer
+    expects the content AT ``remote`` — so a torn previous upload or a
+    re-save of the same version would silently double-nest. Every
+    dir-upload site (checkpoint mirror, fleet day/pass models, serving
+    publish) must go through this rm-then-put."""
+    fs.rm(remote)
+    fs.put(local, remote)
+
+
 def init_afs_api(fs_name: str, fs_user: str = "", fs_passwd: str = "",
                  conf_path: str = "", hadoop_bin: str = "hadoop",
                  schemes: tuple = ("afs", "hdfs")) -> CommandFS:

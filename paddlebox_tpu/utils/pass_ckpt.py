@@ -307,8 +307,7 @@ class PassCheckpointer:
                 # whole-chain upload: fresh rotation, or a chain continued
                 # across a process restart (unknown remote contents —
                 # replace)
-                fs.rm(remote_chain)
-                fs.put(local_chain, remote_chain)
+                fs_lib.put_replacing(fs, local_chain, remote_chain)
             else:
                 # incremental: only the new delta + the refreshed chain
                 # manifest/meta cross the wire
@@ -318,10 +317,8 @@ class PassCheckpointer:
                            f"{remote_chain}/{name}")
             self._uploaded_chains.add(chain_name)
             # a leftover target (torn upload / re-save after an elected
-            # rollback) must go first: `put` into an EXISTING dir nests
-            # the source
-            fs.rm(f"{rroot}/{snap_name}")
-            fs.put(snap, f"{rroot}/{snap_name}")
+            # rollback) must never nest the source (fs_lib.put_replacing)
+            fs_lib.put_replacing(fs, snap, f"{rroot}/{snap_name}")
         except BaseException:
             # a half-uploaded chain must not ride the incremental path on
             # the next save — force a full re-upload (download-side CRC

@@ -1,0 +1,117 @@
+"""Subprocess driver for the publish/swap kill matrix (ISSUE 7).
+
+Trains a small deterministic pass loop with crash-safe checkpointing AND
+per-pass serving publishes (``BoxPS.end_pass(publisher=…)``), resuming
+from the snapshot root when one exists and catching serving up after a
+resume (``publish_if_behind`` — a kill between the pass snapshot and the
+donefile append must not orphan that pass's model). Fault points arm
+through the environment (PBTPU_FAULTPOINT / _ACTION / _AFTER), so one
+invocation serves as the golden run, the killed run, and the resuming
+re-run — the same contract as tests/crash_worker.py.
+
+On completion dumps the scores a predictor on the FINAL trained state
+assigns to the first deterministic batch — the parent compares them with
+what a ServingServer tailing the (killed + resumed) donefile serves:
+train→publish→serve parity through arbitrary publish-window kills.
+
+Usage: python tests/serving_worker.py ROOT OUT_NPZ [--passes N]
+       ROOT holds snaps/ (checkpointer) and serve/ (publish root);
+       PBTPU_SERVE_REMOTE=<uri> publishes to a mock-hdfs URI instead.
+"""
+
+import argparse
+import os
+import sys
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO not in sys.path:
+    sys.path.insert(0, REPO)
+TESTS = os.path.join(REPO, "tests")
+if TESTS not in sys.path:
+    sys.path.insert(0, TESTS)
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np  # noqa: E402
+
+import mockfs  # noqa: E402
+from crash_worker import NUM_SLOTS, synth  # noqa: E402
+from paddlebox_tpu.embedding import (EmbeddingConfig,  # noqa: E402
+                                     HostEmbeddingStore)
+from paddlebox_tpu.fleet import BoxPS  # noqa: E402
+from paddlebox_tpu.inference import Predictor, ServingTable  # noqa: E402
+from paddlebox_tpu.models import DNNCTRModel  # noqa: E402
+from paddlebox_tpu.parallel import make_mesh  # noqa: E402
+from paddlebox_tpu.serving import ServingPublisher  # noqa: E402
+from paddlebox_tpu.train import Trainer, TrainerConfig  # noqa: E402
+from paddlebox_tpu.utils.pass_ckpt import PassCheckpointer  # noqa: E402
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("root")
+    ap.add_argument("out")
+    ap.add_argument("--passes", type=int, default=3)
+    args = ap.parse_args()
+
+    mockfs.register_from_env()
+    serve_root = os.environ.get("PBTPU_SERVE_REMOTE",
+                                os.path.join(args.root, "serve"))
+
+    ds, schema = synth()
+    store = HostEmbeddingStore(EmbeddingConfig(dim=4, learning_rate=0.05))
+    model = DNNCTRModel(num_slots=NUM_SLOTS, emb_dim=4, dense_dim=1,
+                        hidden=(8,))
+    tr = Trainer(model, store, schema, make_mesh(1),
+                 TrainerConfig(global_batch_size=64, dense_lr=2e-3,
+                               auc_buckets=1 << 8), seed=7)
+    box = BoxPS(store)
+    box.set_date(20260803)
+    ckpt = PassCheckpointer(os.path.join(args.root, "snaps"),
+                            keep_last_n=4, base_every=2)
+    # quant="f32": the parity assertion in the parent is EXACT — the
+    # served scores must bit-match a predictor on the final state (the
+    # int8 cold-row error bound gets its own in-process test)
+    pub = ServingPublisher(serve_root, model, schema,
+                           publish_base_every=2, quant="f32",
+                           hot_top_k=4)
+
+    cursor = tr.resume(ckpt, box=box)
+    start = (int(cursor["pass_id"]) if cursor is not None else 0) + 1
+    print(f"worker: resume cursor="
+          f"{None if cursor is None else cursor['pass_id']} "
+          f"-> starting at pass {start}", flush=True)
+    if cursor is not None:
+        info = pub.publish_if_behind(store, tr.eval_params(),
+                                     pass_id=int(cursor["pass_id"]))
+        if info is not None:
+            print(f"worker: serving catch-up republished pass "
+                  f"{cursor['pass_id']} as v{info['version']}",
+                  flush=True)
+    for _p in range(start, args.passes + 1):
+        box.begin_pass()
+        tr.train_pass(ds)
+        out = box.end_pass(checkpointer=ckpt, trainer=tr, publisher=pub)
+        pinfo = out.get("publish", {})
+        print(f"worker: pass {box.pass_id} published "
+              f"v{pinfo.get('version')} kind={pinfo.get('kind')}",
+              flush=True)
+
+    # final-state scores: what serving MUST reproduce once it tails the
+    # donefile to the end
+    tr.flush_sparse()
+    pred = Predictor(model, tr.eval_params(),
+                     ServingTable.from_store(store), schema)
+    pb = next(iter(ds.batches(batch_size=64)))
+    probs = pred.predict_batch(pb)
+    np.savez(args.out, probs=np.asarray(probs),
+             pass_id=np.int64(box.pass_id))
+    print("worker: done", flush=True)
+
+
+if __name__ == "__main__":
+    main()

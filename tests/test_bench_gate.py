@@ -82,6 +82,60 @@ def test_collect_gate_metrics_namespace(bench):
                  "e2e_eps": 3.0, "host.derived_max_feed_eps": 9.0}
 
 
+def test_collect_gate_metrics_serving_points(bench):
+    """The serving drill's publish/swap/latency numbers land in the gate
+    namespace (ISSUE 7); a failed drill ({'error': …}) contributes
+    nothing instead of poisoning the namespace."""
+    detail = {"matrix": {"serving": {
+        "publish_seconds": 0.8, "swap_pause_ms": 0.02, "p99_ms": 12.5,
+        "p50_ms": 4.0, "serve_eps": 900.0}}}
+    m = bench.collect_gate_metrics(1.0, detail)
+    assert m["serving.publish_seconds"] == 0.8
+    assert m["serving.swap_pause_ms"] == 0.02
+    assert m["serving.p99_ms"] == 12.5
+    assert "serving.p50_ms" not in m      # only the three gated points
+    m2 = bench.collect_gate_metrics(1.0,
+                                    {"matrix": {"serving":
+                                                {"error": "boom"}}})
+    assert not any(k.startswith("serving.") for k in m2)
+
+
+def test_gate_latency_metrics_are_lower_is_better(bench):
+    """Metrics named *_ms / *_seconds gate in the latency direction: a
+    HIGHER current value regresses, a lower one is an improvement —
+    throughput metrics keep the original direction in the same pass."""
+    best = {"device_kind": None, "threshold": 0.10,
+            "metrics": {"serving.p99_ms": 10.0,
+                        "serving.publish_seconds": 2.0,
+                        "headline_eps": 1000.0}}
+    g = bench.apply_regression_gate(
+        {"serving.p99_ms": 20.0, "serving.publish_seconds": 1.0,
+         "headline_eps": 1000.0}, best, "cpu")
+    assert not g["ok"] and g["regressed"] == ["serving.p99_ms"]
+    assert g["lines"]["serving.p99_ms"].startswith("REGRESS(-50%")
+    assert g["lines"]["serving.publish_seconds"].startswith("ok(+100%")
+    g2 = bench.apply_regression_gate(
+        {"serving.p99_ms": 10.5, "headline_eps": 1000.0,
+         "serving.publish_seconds": 2.0}, best, "cpu")
+    assert g2["ok"]                       # within threshold both ways
+
+
+def test_gate_latency_floor_ignores_timer_noise(bench):
+    """Sub-floor latencies (the swap pause is one attribute rebind,
+    sub-µs) are timer noise: a 3x relative swing below the floor must not
+    trip the gate, while a real-scale regression past it still does."""
+    best = {"device_kind": "cpu",
+            "metrics": {"serving.swap_pause_ms": 0.0003,
+                        "serving.p99_ms": 10.0}}
+    g = bench.apply_regression_gate(
+        {"serving.swap_pause_ms": 0.0009, "serving.p99_ms": 10.0},
+        best, "cpu")
+    assert g["ok"] and g["lines"]["serving.swap_pause_ms"].startswith("ok")
+    g2 = bench.apply_regression_gate(
+        {"serving.swap_pause_ms": 5.0, "serving.p99_ms": 10.0}, best, "cpu")
+    assert not g2["ok"] and g2["regressed"] == ["serving.swap_pause_ms"]
+
+
 def test_committed_bench_best_is_wellformed():
     with open(os.path.join(REPO, "BENCH_BEST.json")) as f:
         best = json.load(f)
@@ -112,3 +166,11 @@ def test_bench_dryrun_smoke():
     assert out["push_overlap"] == "on"
     assert "stages" in out and "sparse_push" in out["stages"]
     assert out["gate_example_lines"]["headline_eps"].startswith("REGRESS")
+    # the serving drill's points must exist in the artifact (ISSUE 7):
+    # publish timed, hot-swap paused-and-measured, tail latency recorded,
+    # zero failed requests across the swap
+    assert out["checks"]["serving_fields"], out.get("serving")
+    assert out["checks"]["latency_gate_trips_lower_is_better"]
+    assert out["serving"]["publish_seconds"] > 0
+    assert out["serving"]["swap_pause_ms"] > 0
+    assert out["serving"]["p99_ms"] > 0

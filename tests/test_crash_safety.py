@@ -138,7 +138,8 @@ def test_kill_resume_smoke(tmp_path, golden):
                          [p for p in faultpoint.POINTS
                           if p not in ("store.save_delta.pre_manifest",
                                        "remote_ckpt.download.pre")
-                          and p not in faultpoint.ELASTIC_POINTS])
+                          and p not in faultpoint.ELASTIC_POINTS
+                          and p not in faultpoint.SERVING_POINTS])
 def test_kill_resume_matrix(point, tmp_path, golden):
     """Every registered fault point: kill there, resume, prove bit-identical
     dense params + table rows + metric state vs the uninterrupted run. The
@@ -191,10 +192,14 @@ def test_every_point_has_a_matrix_entry():
     kill→resume matrix. The elastic re-formation points fire only inside
     a world shrink — no reform happens in this single-host worker — so
     they are covered by the elastic kill matrix (tests/test_elastic.py)
-    instead; that file carries the same closed-registry guard."""
+    instead; the serving publish points fire only in the publish path
+    and are covered by the publish/swap kill matrix
+    (tests/test_serving.py). Both files carry the same closed-registry
+    guard."""
     assert (set(POINT_AFTER) | set(faultpoint.ELASTIC_POINTS)
-            == set(faultpoint.POINTS))
-    assert not set(POINT_AFTER) & set(faultpoint.ELASTIC_POINTS)
+            | set(faultpoint.SERVING_POINTS) == set(faultpoint.POINTS))
+    assert not set(POINT_AFTER) & (set(faultpoint.ELASTIC_POINTS)
+                                   | set(faultpoint.SERVING_POINTS))
 
 
 # ---------------------------------------------------------------------------

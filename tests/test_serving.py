@@ -1,0 +1,689 @@
+"""Crash-safe train→publish→serve loop (ISSUE 7).
+
+The acceptance bar: **a torn publish must never serve.** Tier-1 proves
+the loop end-to-end on CPU (one pass → publish → serve → scores match a
+Predictor on the same params) plus one publish kill-point; the ``slow``
+matrix kills a real training+publishing subprocess at EVERY
+``serving.publish.*`` fault point and proves every ANNOUNCED version
+verifies, the server never loads a torn one, and the resumed run catches
+serving up to score parity — plus hot-swap under concurrent load with
+zero dropped requests and stale-version fallback.
+"""
+
+import json
+import os
+import subprocess
+import sys
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+from paddlebox_tpu.data import DataFeedSchema
+from paddlebox_tpu.embedding import EmbeddingConfig, HostEmbeddingStore
+from paddlebox_tpu.fleet import BoxPS, FleetUtil
+from paddlebox_tpu.inference import Predictor, ServingTable
+from paddlebox_tpu.models import DeepFMModel
+from paddlebox_tpu.parallel import make_mesh
+from paddlebox_tpu.serving import (DONEFILE, BatchingFrontend,
+                                   ServingPublisher, ServingServer,
+                                   ServingUnavailableError, read_artifact)
+from paddlebox_tpu.train import Trainer, TrainerConfig
+from paddlebox_tpu.utils import faultpoint
+
+from test_train_e2e import synth_dataset, NUM_SLOTS
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+WORKER = os.path.join(REPO, "tests", "serving_worker.py")
+
+
+@pytest.fixture(autouse=True)
+def _disarm():
+    yield
+    faultpoint.disarm()
+
+
+@pytest.fixture()
+def job(tmp_path):
+    """One trained pass + a publisher + an untouched serving root."""
+    ds, schema = synth_dataset(256)
+    store = HostEmbeddingStore(EmbeddingConfig(dim=8, learning_rate=0.15))
+    model = DeepFMModel(num_slots=NUM_SLOTS, emb_dim=8, dense_dim=1,
+                        hidden=(16,))
+    tr = Trainer(model, store, schema, make_mesh(1),
+                 TrainerConfig(global_batch_size=64, dense_lr=3e-3))
+    box = BoxPS(store)
+    pub = ServingPublisher(str(tmp_path / "serve"), model, schema,
+                           publish_base_every=2, quant="f32",
+                           hot_top_k=16)
+    box.begin_pass()
+    tr.train_pass(ds)
+    return ds, schema, store, model, tr, box, pub, str(tmp_path / "serve")
+
+
+def _live_predictor(tr, store, model, schema):
+    return Predictor(model, tr.eval_params(),
+                     ServingTable.from_store(store), schema)
+
+
+# ---------------------------------------------------------------- tier-1
+
+
+def test_publish_serve_scores_match_predictor(job):
+    """The tier-1 loop: end_pass publishes, the server tails + swaps, and
+    the served scores bit-match a Predictor on the same params."""
+    ds, schema, store, model, tr, box, pub, root = job
+    info = box.end_pass(trainer=tr, publisher=pub)["publish"]
+    assert info["kind"] == "base" and info["announced"]
+    srv = ServingServer(root, poll_s=0.05)
+    assert srv.poll_once() == 1
+    h = srv.health()
+    assert h["status"] == "ok" and h["active_version"] == 1
+    assert h["active_pass"] == 1 and h["pass_lag"] == 0
+    pb = next(iter(ds.batches(batch_size=64)))
+    got = srv.predict_batch(pb)
+    want = _live_predictor(tr, store, model, schema).predict_batch(pb)
+    np.testing.assert_allclose(want, got, rtol=1e-6, atol=1e-7)
+    # hot keys landed in the replica cache at full precision
+    m = srv.active
+    assert m.replica_cache is not None and len(m.replica_cache) == 17
+    np.testing.assert_array_equal(
+        m.replica_cache.translate(m.hot_keys) > 0,
+        np.ones(len(m.hot_keys), bool))
+
+
+def test_delta_publish_and_hot_swap(job):
+    """Pass 2 publishes a key-delta; the server swaps to it and serves
+    the updated model; in-flight handles on v1 stay intact."""
+    ds, schema, store, model, tr, box, pub, root = job
+    box.end_pass(trainer=tr, publisher=pub)
+    srv = ServingServer(root)
+    srv.poll_once()
+    v1 = srv.active
+    box.begin_pass()
+    tr.train_pass(ds)
+    info = box.end_pass(trainer=tr, publisher=pub)["publish"]
+    assert info["kind"] == "delta"
+    assert srv.poll_once() == 1
+    assert srv.active.version == 2 and srv.active.kind == "delta"
+    pb = next(iter(ds.batches(batch_size=64)))
+    want = _live_predictor(tr, store, model, schema).predict_batch(pb)
+    np.testing.assert_allclose(want, srv.predict_batch(pb),
+                               rtol=1e-6, atol=1e-7)
+    # the v1 handle still serves its own (older) table — swap did not
+    # mutate it (copy-on-write)
+    assert v1.version == 1
+    old = v1.predictor.predict_batch(pb)
+    assert not np.allclose(old, want)
+
+
+def test_publish_killpoint_never_announces_torn(job):
+    """Tier-1 kill-point (ioerror flavor): a publish failing at
+    pre_donefile — artifact fully written and verified, announce lost —
+    leaves the donefile unchanged, the server on its last good version,
+    and the NEXT publish lands cleanly."""
+    ds, schema, store, model, tr, box, pub, root = job
+    box.end_pass(trainer=tr, publisher=pub)
+    srv = ServingServer(root)
+    srv.poll_once()
+    faultpoint.arm("serving.publish.pre_donefile", action="ioerror")
+    box.begin_pass()
+    tr.train_pass(ds)
+    with pytest.warns(UserWarning, match="publish failed"):
+        out = box.end_pass(trainer=tr, publisher=pub)
+    assert "error" in out["publish"]
+    faultpoint.disarm()
+    assert srv.poll_once() == 0            # nothing new announced
+    assert srv.active.version == 1
+    # every announced version still verifies (the invariant)
+    for e in FleetUtil(root)._entries(DONEFILE):
+        read_artifact(e["path"], verify=True)
+    # recovery: the next publish re-lands the state
+    box.begin_pass()
+    tr.train_pass(ds)
+    info = box.end_pass(trainer=tr, publisher=pub)["publish"]
+    assert info["announced"]
+    assert srv.poll_once() == 1
+    pb = next(iter(ds.batches(batch_size=64)))
+    want = _live_predictor(tr, store, model, schema).predict_batch(pb)
+    np.testing.assert_allclose(want, srv.predict_batch(pb),
+                               rtol=1e-6, atol=1e-7)
+
+
+def test_quantized_publish_bounded_error(tmp_path, job):
+    """int8 cold rows: served scores track the f32 predictor within the
+    quantization error bound; hot rows stay exact in the replica cache."""
+    ds, schema, store, model, tr, box, pub, _ = job
+    root8 = str(tmp_path / "serve8")
+    pub8 = ServingPublisher(root8, model, schema, publish_base_every=4,
+                            quant="int8", hot_top_k=8)
+    box.end_pass(trainer=tr, publisher=pub8)
+    srv = ServingServer(root8)
+    srv.poll_once()
+    pb = next(iter(ds.batches(batch_size=64)))
+    want = _live_predictor(tr, store, model, schema).predict_batch(pb)
+    got = srv.predict_batch(pb)
+    np.testing.assert_allclose(want, got, atol=0.02)
+    assert not np.array_equal(want, got)    # quantization really applied
+    m = srv.active
+    rows = store.get_rows(m.hot_keys)[:, :m.table.pull_width]
+    pos, hit = m.table._probe(m.hot_keys)
+    np.testing.assert_array_equal(rows[hit], m.table.vals[pos[hit]])
+
+
+def test_server_empty_root_unavailable(tmp_path):
+    srv = ServingServer(str(tmp_path / "nothing"))
+    assert srv.poll_once() == 0
+    assert srv.health()["status"] == "empty"
+    with pytest.raises(ServingUnavailableError):
+        srv.predict(np.zeros((1, 2), np.uint64), np.ones((1, 2), bool))
+
+
+def test_frontend_batches_and_scores(job):
+    ds, schema, store, model, tr, box, pub, root = job
+    box.end_pass(trainer=tr, publisher=pub)
+    srv = ServingServer(root)
+    srv.poll_once()
+    fe = BatchingFrontend(srv, max_batch=32, max_wait_s=0.01).start()
+    pb = next(iter(ds.batches(batch_size=64)))
+    lc, lw, _ = pb.schema.float_split_cols("label")
+    floats = np.concatenate([pb.floats[:, :lc], pb.floats[:, lc + lw:]],
+                            axis=1)
+    try:
+        futs = [fe.submit(pb.ids[i].astype(np.uint64), pb.mask[i],
+                          floats[i]) for i in range(48)]
+        got = np.asarray([f.result(timeout=60) for f in futs])
+    finally:
+        fe.stop()
+    want = srv.predict(pb.ids.astype(np.uint64), pb.mask, floats)[:48]
+    np.testing.assert_allclose(want, got, rtol=1e-6, atol=1e-7)
+    st = fe.stats()
+    assert st["count"] == 48 and st["failures"] == 0
+    assert st["p99_ms"] >= st["p50_ms"] > 0
+
+
+def test_health_endpoint_http(job):
+    """The runbook surface: /healthz serves the health JSON (503 before a
+    model loads, 200 after), /metrics the Prometheus exposition."""
+    ds, schema, store, model, tr, box, pub, root = job
+    box.end_pass(trainer=tr, publisher=pub)
+    srv = ServingServer(root, health_port=0)
+    try:
+        url = f"http://127.0.0.1:{srv.health_port}/healthz"
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            urllib.request.urlopen(url, timeout=10)
+        assert ei.value.code == 503
+        srv.poll_once()
+        with urllib.request.urlopen(url, timeout=10) as r:
+            h = json.loads(r.read())
+        assert h["status"] == "ok" and h["active_version"] == 1
+        metrics_url = f"http://127.0.0.1:{srv.health_port}/metrics"
+        with urllib.request.urlopen(metrics_url, timeout=10) as r:
+            assert b"pbtpu" in r.read()
+    finally:
+        srv.stop()
+
+
+def test_staleness_reported_when_publishes_stop(job):
+    ds, schema, store, model, tr, box, pub, root = job
+    box.end_pass(trainer=tr, publisher=pub)
+    # donefile ts has 1-second resolution: the age right after a publish
+    # is < 1s, so a 1.5s threshold is deterministic on both sides
+    srv = ServingServer(root, stale_after_s=1.5, stale_pass_lag=99)
+    srv.poll_once()
+    assert srv.health()["status"] == "ok"
+    time.sleep(2.0)
+    h = srv.health()
+    assert h["status"] == "stale" and h["age_seconds"] >= 1.5
+    # a fresh publish clears it
+    box.begin_pass()
+    tr.train_pass(ds)
+    box.end_pass(trainer=tr, publisher=pub)
+    srv.poll_once()
+    assert srv.health()["status"] == "ok"
+
+
+def test_health_tolerates_foreign_tail_entry(job):
+    """A valid-JSON donefile tail line with no 'version' (foreign writer,
+    hand edit) must degrade the report, not 500 /healthz or break every
+    subsequent poll."""
+    ds, schema, store, model, tr, box, pub, root = job
+    box.end_pass(trainer=tr, publisher=pub)
+    srv = ServingServer(root)
+    srv.poll_once()
+    FleetUtil(root).append_donefile(DONEFILE, {"day": 20260801,
+                                               "note": "foreign"})
+    with pytest.warns(UserWarning, match="unusable donefile entry"):
+        srv.poll_once()                 # must not raise
+    h = srv.health()                    # must not raise either
+    assert h["active_version"] == 1 and h["announced_version"] is None
+    # versionless, so _skipped can't remember it: the dedup set must —
+    # the tailer hits this line once per poll_s forever otherwise
+    import warnings as _warnings
+    with _warnings.catch_warnings():
+        _warnings.simplefilter("error")
+        srv.poll_once()
+
+
+def test_cold_start_seeks_newest_base(job):
+    """A fresh server starts from the newest loadable base + trailing
+    deltas instead of replaying the donefile's whole history; a rotted
+    newest base falls back to the previous base chain."""
+    ds, schema, store, model, tr, box, pub, root = job
+    box.end_pass(trainer=tr, publisher=pub)            # v1 base
+    base3_path = None
+    for _ in range(3):                                 # v2 delta, v3 base,
+        box.begin_pass()                               # v4 delta
+        tr.train_pass(ds)
+        info = box.end_pass(trainer=tr, publisher=pub)["publish"]
+        if info["version"] == 3:
+            assert info["kind"] == "base"
+            base3_path = info["path"]
+    srv = ServingServer(root)
+    assert srv.poll_once() == 2                        # v3 + v4 only
+    assert srv.active.version == 4 and srv._swaps == 2
+    pb = next(iter(ds.batches(batch_size=64)))
+    want = _live_predictor(tr, store, model, schema).predict_batch(pb)
+    np.testing.assert_allclose(want, srv.predict_batch(pb),
+                               rtol=1e-6, atol=1e-7)
+    # rot the newest base: the next fresh server must fall back to the
+    # v1 base + v2 delta chain (v4 parents the rotted v3 — dead)
+    with open(os.path.join(base3_path, "sparse.npz"), "r+b") as f:
+        f.seek(16)
+        f.write(b"\xde\xad\xbe\xef")
+    srv2 = ServingServer(root)
+    with pytest.warns(UserWarning):
+        applied = srv2.poll_once()
+    assert applied == 2 and srv2.active.version == 2
+    assert srv2.health()["status"] in ("degraded", "stale")
+
+
+def test_build_rejects_version_mismatch(job):
+    """CRCs only prove an artifact matches ITS manifest — an entry whose
+    path holds a different version's artifact (stale staging, foreign
+    line) must be skipped with a diagnostic, never served as the
+    announced version."""
+    ds, schema, store, model, tr, box, pub, root = job
+    info = box.end_pass(trainer=tr, publisher=pub)["publish"]
+    with open(os.path.join(root, DONEFILE), "a") as f:
+        f.write(json.dumps({"version": 2, "pass": 2, "kind": "base",
+                            "parent": None, "path": info["path"],
+                            "ts": int(time.time())}) + "\n")
+    srv = ServingServer(root)
+    with pytest.warns(UserWarning, match="claims"):
+        srv.poll_once()
+    assert srv.active.version == 1
+    assert 2 in srv.health()["skipped_versions"]
+
+
+def test_frontend_splits_mixed_dense_batch(job):
+    """Dense presence changes the predict signature: requests carrying
+    dense features must score WITH them even when coalesced behind a
+    dense-less request (which previously keyed the whole batch)."""
+    ds, schema, store, model, tr, box, pub, root = job
+    box.end_pass(trainer=tr, publisher=pub)
+    srv = ServingServer(root)
+    srv.poll_once()
+    pb = next(iter(ds.batches(batch_size=64)))
+    lc, lw, _ = pb.schema.float_split_cols("label")
+    floats = np.concatenate([pb.floats[:, :lc], pb.floats[:, lc + lw:]],
+                            axis=1)
+    fe = BatchingFrontend(srv, max_batch=32, max_wait_s=0.05).start()
+    try:
+        f_nd = fe.submit(pb.ids[0].astype(np.uint64), pb.mask[0])
+        futs = [fe.submit(pb.ids[i].astype(np.uint64), pb.mask[i],
+                          floats[i]) for i in range(1, 5)]
+        got = np.asarray([f.result(timeout=60) for f in futs])
+        try:
+            f_nd.result(timeout=60)     # may legitimately error (model
+        except Exception:               # requires dense) — must not
+            pass                        # poison the dense group
+    finally:
+        fe.stop()
+    want = srv.predict(pb.ids[1:5].astype(np.uint64), pb.mask[1:5],
+                       floats[1:5])
+    np.testing.assert_allclose(want, got, rtol=1e-6, atol=1e-7)
+
+
+# -------------------------------------------------- donefile satellites
+
+
+def test_staged_fetch_removed_after_swap(job, tmp_path):
+    """A remote-tailing server stages each download before verify; once
+    the build consumed it the copy must go — a forever-running host
+    accumulating one artifact per publish would fill the staging disk
+    and degrade permanently."""
+    ds, schema, store, model, tr, box, pub, root = job
+    box.end_pass(trainer=tr, publisher=pub)
+    stage = str(tmp_path / "stage")
+    srv = ServingServer(root, staging_dir=stage)
+    srv._remote = True                  # force the staging path (LocalFS.get)
+    assert srv.poll_once() == 1 and srv.active.version == 1
+    assert os.listdir(stage) == []
+    # a version that fails verify must not leave its partial behind either
+    box.begin_pass()
+    tr.train_pass(ds)
+    info = pub.publish(store, tr.eval_params(), pass_id=2)
+    with open(os.path.join(info["path"], "sparse.npz"), "r+b") as f:
+        f.seek(20)
+        f.write(b"\xde\xad\xbe\xef")
+    with pytest.warns(UserWarning, match="v2"):
+        srv.poll_once()
+    assert srv.active.version == 1 and os.listdir(stage) == []
+
+
+def test_frontend_submit_during_stop_never_leaves_pending_future():
+    """submit() racing stop()'s drain: a request put after the queue was
+    drained must still resolve (with an error), never hang the caller's
+    future forever."""
+    fe = BatchingFrontend(server=None, max_batch=4)
+    # emulate the interleaving: submit passed the liveness check, then
+    # stop() set _stopping and drained the queue before the put landed
+    fe._thread = threading.Thread(target=lambda: None)
+    fe._stopping = True
+    f = fe.submit(np.zeros(4, np.uint64), np.zeros(4, bool))
+    with pytest.raises(RuntimeError, match="stopped before dispatch"):
+        f.result(timeout=1)
+
+
+def test_fleet_donefile_skips_malformed_lines(tmp_path):
+    """A half-written/foreign donefile line must not brick model
+    discovery: _entries/latest skip it with a named warning."""
+    fleet = FleetUtil(str(tmp_path))
+    fleet.append_donefile("x.donefile", {"day": 1, "pass": 1, "path": "a"})
+    with open(tmp_path / "x.donefile", "a") as f:
+        f.write('{"day": 2, "pass": 2, "pa')     # torn mid-write
+        f.write("\n[1, 2, 3]\n")                 # valid JSON, not an object
+    # the append's internal latest() does the first parse — that's where
+    # the torn lines are diagnosed, once
+    with pytest.warns(UserWarning, match="malformed line 2"):
+        fleet.append_donefile("x.donefile", {"day": 3, "pass": 3,
+                                             "path": "c"})
+    # a tailer re-reads every poll: the same torn line still skips but
+    # must not re-warn forever (it would drown the alert signal)
+    import warnings as _warnings
+    with _warnings.catch_warnings():
+        _warnings.simplefilter("error")
+        entries = fleet._entries("x.donefile")
+        assert [e["day"] for e in entries] == [1, 3]
+        assert fleet.latest("x.donefile")["day"] == 3
+    # a fresh instance (new process) diagnoses it again
+    with pytest.warns(UserWarning, match="malformed line 2"):
+        FleetUtil(str(tmp_path))._entries("x.donefile")
+
+
+def test_append_donefile_idempotent_on_replay(tmp_path):
+    fleet = FleetUtil(str(tmp_path))
+    e = {"version": 1, "pass": 1, "path": "p1"}
+    assert fleet.append_donefile("s.donefile", e, dedup=("version", "path"))
+    assert not fleet.append_donefile("s.donefile", dict(e, ts=9),
+                                     dedup=("version", "path"))
+    assert len(fleet._entries("s.donefile")) == 1
+
+
+def test_serving_table_duplicate_key_error_names_keys():
+    keys = np.asarray([7, 7, 9, 9, 3], np.uint64)
+    with pytest.raises(ValueError, match=r"2 key\(s\)") as ei:
+        ServingTable(keys, np.zeros((5, 2), np.float32))
+    assert "7" in str(ei.value) and "9" in str(ei.value)
+
+
+# ------------------------------------------------------- slow matrices
+
+
+def _run_worker(root, out, env_extra=None, check=True):
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    env.pop("PBTPU_FAULTPOINT", None)
+    env.update(env_extra or {})
+    proc = subprocess.run(
+        [sys.executable, WORKER, str(root), str(out)],
+        cwd=REPO, env=env, capture_output=True, text=True, timeout=600)
+    if check and proc.returncode != 0:
+        raise AssertionError(
+            f"worker failed ({proc.returncode}):\n{proc.stdout}\n"
+            f"{proc.stderr}")
+    return proc
+
+
+def _assert_announced_all_verify(serve_root):
+    """THE invariant: every donefile-announced version verifies clean."""
+    entries = FleetUtil(serve_root)._entries(DONEFILE)
+    for e in entries:
+        read_artifact(e["path"], verify=True)
+    return entries
+
+
+def _serve_batch(schema=None):
+    from crash_worker import synth
+    ds, schema = synth()
+    return next(iter(ds.batches(batch_size=64)))
+
+
+@pytest.fixture(scope="module")
+def serving_golden(tmp_path_factory):
+    """Uninterrupted train+publish run → final predictor scores."""
+    d = tmp_path_factory.mktemp("serve_golden")
+    out = d / "out.npz"
+    _run_worker(d / "root", out)
+    with np.load(out) as z:
+        return {k: z[k] for k in z.files}
+
+
+# AFTER=0 kills the first (base) publish window, AFTER=1 the second
+# (delta) — both artifact kinds cross every window.
+@pytest.mark.slow
+@pytest.mark.parametrize("after", [0, 1])
+@pytest.mark.parametrize("point", sorted(faultpoint.SERVING_POINTS))
+def test_publish_kill_matrix(point, after, tmp_path, serving_golden):
+    """Kill a real training+publishing subprocess at every publish
+    window: no announced version may ever be torn, a tailing server ends
+    on a verified version, and the resumed run (incl. the catch-up
+    republish) reaches score parity with the uninterrupted golden."""
+    root, out = tmp_path / "root", tmp_path / "out.npz"
+    killed = _run_worker(
+        root, out, check=False,
+        env_extra={"PBTPU_FAULTPOINT": point,
+                   "PBTPU_FAULTPOINT_AFTER": str(after)})
+    assert killed.returncode == 137, (killed.stdout, killed.stderr)
+    assert f"FAULTPOINT KILL {point}" in killed.stderr
+    serve_root = str(root / "serve")
+    # invariant after the kill: announced ⊆ verified
+    entries = _assert_announced_all_verify(serve_root)
+    assert len(entries) == after, \
+        f"the killed publish must not be announced: {entries}"
+    srv = ServingServer(serve_root)
+    srv.poll_once()
+    assert (srv.active.version if srv.active else 0) == after
+    # resume: training continues, serving catches up, scores match golden
+    resumed = _run_worker(root, out)
+    assert "resume cursor=" in resumed.stdout
+    entries = _assert_announced_all_verify(serve_root)
+    assert int(entries[-1]["pass"]) == 3
+    srv.poll_once()
+    assert srv.active.pass_id == 3
+    got = srv.predict_batch(_serve_batch())
+    np.testing.assert_allclose(serving_golden["probs"], got,
+                               rtol=1e-6, atol=1e-7)
+
+
+@pytest.mark.slow
+def test_hot_swap_under_concurrent_load(job):
+    """Requests hammer the server from 8 threads while three new
+    versions publish and swap in: ZERO failed requests, every result is
+    a valid probability vector from one of the published versions, and
+    the recorded swap pause stays bounded (ms-scale, not seconds)."""
+    ds, schema, store, model, tr, box, pub, root = job
+    box.end_pass(trainer=tr, publisher=pub)
+    srv = ServingServer(root, poll_s=0.01).start()
+    deadline = time.time() + 10
+    while srv.active is None and time.time() < deadline:
+        time.sleep(0.01)
+    assert srv.active is not None
+    pb = next(iter(ds.batches(batch_size=64)))
+    ids, mask = pb.ids.astype(np.uint64), pb.mask
+    lc, lw, _ = pb.schema.float_split_cols("label")
+    floats = np.concatenate([pb.floats[:, :lc], pb.floats[:, lc + lw:]],
+                            axis=1)
+    # warm the compile before load starts (the swap itself must not
+    # compile — Predictor.with_model shares the jitted fwd)
+    srv.predict(ids, mask, floats)
+    stop = threading.Event()
+    errors, results = [], []
+    lock = threading.Lock()
+
+    def hammer():
+        while not stop.is_set():
+            try:
+                p = srv.predict(ids, mask, floats)
+                with lock:
+                    results.append((srv.active.version, np.asarray(p)))
+            except Exception as e:   # noqa: BLE001 — the assertion target
+                with lock:
+                    errors.append(e)
+
+    threads = [threading.Thread(target=hammer) for _ in range(8)]
+    [t.start() for t in threads]
+    try:
+        versions = {}
+        versions[1] = _live_predictor(tr, store, model,
+                                      schema).predict_batch(pb)
+        for v in (2, 3, 4):
+            box.begin_pass()
+            tr.train_pass(ds)
+            box.end_pass(trainer=tr, publisher=pub)
+            versions[v] = _live_predictor(tr, store, model,
+                                          schema).predict_batch(pb)
+            time.sleep(0.15)           # let the tailer swap under load
+    finally:
+        stop.set()
+        [t.join(timeout=30) for t in threads]
+        srv.stop()
+    assert not errors, errors[:3]
+    assert srv.active.version == 4 and srv._swaps == 4
+    assert srv.health()["request_failures"] == 0
+    assert len(results) > 50
+    # every served result matches EXACTLY one published version (no torn
+    # tables, no half-swapped states)
+    for _v_seen, p in results[:: max(1, len(results) // 64)]:
+        assert any(np.allclose(p, versions[v], rtol=1e-5, atol=1e-6)
+                   for v in versions), "served scores match no version"
+    assert srv._last_swap_pause_ms < 100.0
+
+
+@pytest.mark.slow
+def test_stale_version_fallback_and_recovery(job, tmp_path):
+    """An ANNOUNCED version corrupted after the fact (storage rot — the
+    publisher's verify passed) must be diagnosed and skipped: the server
+    keeps serving the last good version, reports degraded, and recovers
+    on the next clean base."""
+    ds, schema, store, model, tr, box, _pub, _root = job
+    # base every THREE publishes: v1 base, v2/v3 deltas, v4 base — the
+    # exact shape the parent-gap scenario needs
+    root = str(tmp_path / "serve3")
+    pub = ServingPublisher(root, model, schema, publish_base_every=3,
+                           quant="f32", hot_top_k=16)
+    box.end_pass(trainer=tr, publisher=pub)
+    srv = ServingServer(root, fetch_attempts=2, fetch_backoff_s=0.01)
+    srv.poll_once()
+    pb = next(iter(ds.batches(batch_size=64)))
+    want_v1 = srv.predict_batch(pb)
+    # v2 publishes clean, then rots on disk
+    box.begin_pass()
+    tr.train_pass(ds)
+    info = box.end_pass(trainer=tr, publisher=pub)["publish"]
+    sp = os.path.join(info["path"], "sparse.npz")
+    with open(sp, "r+b") as f:
+        f.seek(max(0, os.path.getsize(sp) // 2))
+        f.write(b"\xde\xad\xbe\xef")
+    with pytest.warns(UserWarning, match="continuing on the last good"):
+        assert srv.poll_once() == 0
+    assert srv.active.version == 1
+    h = srv.health()
+    assert h["status"] == "degraded" and h["skipped_versions"] == [2]
+    assert h["last_error"] and "v2" in h["last_error"]
+    np.testing.assert_array_equal(want_v1, srv.predict_batch(pb))
+    # v3 (delta) parents the rotted v2 → must also be skipped, with the
+    # reason naming the parent gap
+    box.begin_pass()
+    tr.train_pass(ds)
+    assert box.end_pass(trainer=tr, publisher=pub)["publish"][
+        "kind"] == "delta"
+    with pytest.warns(UserWarning, match="waiting for the next base"):
+        assert srv.poll_once() == 0
+    assert srv.active.version == 1
+    # v4 is a base (publish_base_every=2) → full recovery
+    box.begin_pass()
+    tr.train_pass(ds)
+    info = box.end_pass(trainer=tr, publisher=pub)["publish"]
+    assert info["kind"] == "base"
+    assert srv.poll_once() == 1
+    assert srv.active.version == 4
+    assert srv.health()["status"] == "ok"
+    want = _live_predictor(tr, store, model, schema).predict_batch(pb)
+    np.testing.assert_allclose(want, srv.predict_batch(pb),
+                               rtol=1e-6, atol=1e-7)
+
+
+@pytest.mark.slow
+def test_publisher_kill_during_swap_under_load(tmp_path, serving_golden):
+    """The combined drill: a server serves under load while the PUBLISHER
+    process is killed inside the announce window; the server never fails
+    a request, stays on the last good version, and the restarted
+    publisher's catch-up brings it to parity."""
+    root, out = tmp_path / "root", tmp_path / "out.npz"
+    serve_root = str(root / "serve")
+    pb = _serve_batch()
+    srv = ServingServer(serve_root, poll_s=0.02).start()
+    errors, served = [], [0]
+    stop = threading.Event()
+
+    def hammer():
+        while not stop.is_set():
+            if srv.active is None:
+                time.sleep(0.005)
+                continue
+            try:
+                srv.predict_batch(pb)
+                served[0] += 1
+            except Exception as e:   # noqa: BLE001
+                errors.append(e)
+
+    threads = [threading.Thread(target=hammer) for _ in range(4)]
+    [t.start() for t in threads]
+    try:
+        killed = _run_worker(
+            root, out, check=False,
+            env_extra={"PBTPU_FAULTPOINT": "serving.publish.pre_donefile",
+                       "PBTPU_FAULTPOINT_AFTER": "1"})
+        assert killed.returncode == 137
+        time.sleep(0.2)
+        _assert_announced_all_verify(serve_root)
+        resumed = _run_worker(root, out)
+        assert "catch-up republished" in resumed.stdout, resumed.stdout
+        deadline = time.time() + 20
+        while time.time() < deadline and (
+                srv.active is None or srv.active.pass_id < 3):
+            time.sleep(0.05)
+    finally:
+        stop.set()
+        [t.join(timeout=30) for t in threads]
+        srv.stop()
+    assert not errors, errors[:3]
+    assert served[0] > 0
+    assert srv.active is not None and srv.active.pass_id == 3
+    np.testing.assert_allclose(serving_golden["probs"],
+                               srv.predict_batch(pb),
+                               rtol=1e-6, atol=1e-7)
+
+
+def test_serving_points_closed_registry():
+    """The publish kill matrix above parametrizes over
+    faultpoint.SERVING_POINTS — a new publish window cannot be
+    registered without the matrix covering it (the same guard
+    test_crash_safety/test_elastic carry for their point sets)."""
+    assert set(faultpoint.SERVING_POINTS) <= set(faultpoint.POINTS)
+    assert all(p.startswith("serving.publish.")
+               for p in faultpoint.SERVING_POINTS)

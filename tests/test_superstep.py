@@ -105,3 +105,78 @@ def test_superstep_disabled_for_other_modes():
     assert tr._superstep_fn is None
     out = tr.train_pass(ds)
     assert np.isfinite(out["loss_mean"])
+
+
+# ---------------------------------------------------------------------------
+# mid-pass snapshots at steps_per_dispatch > 1 (ISSUE 7 satellite): the
+# cursor exists only BETWEEN dispatches, so the cadence must land on the
+# dispatch boundary — and a resume from such a cursor is bit-exact.
+# ---------------------------------------------------------------------------
+
+def _job(tmp_path, tag, k, n_batches=6, midpass_every=0, seed_data=3):
+    from paddlebox_tpu.fleet import BoxPS
+    from paddlebox_tpu.utils.pass_ckpt import PassCheckpointer
+    ds, schema = _dataset(n_batches * BATCH, seed=seed_data)
+    store = HostEmbeddingStore(EmbeddingConfig(dim=EMB_DIM,
+                                               learning_rate=0.05))
+    tr = Trainer(DeepFMModel(num_slots=NUM_SLOTS, emb_dim=EMB_DIM,
+                             dense_dim=1, hidden=(8,)),
+                 store, schema, make_mesh(1),
+                 TrainerConfig(global_batch_size=BATCH,
+                               steps_per_dispatch=k))
+    box = BoxPS(store)
+    ck = PassCheckpointer(str(tmp_path / tag), keep_last_n=6, base_every=4)
+    if midpass_every:
+        tr.enable_midpass_snapshots(ck, midpass_every, box)
+    return ds, store, tr, box, ck
+
+
+def test_superstep_midpass_dispatch_boundary_resume_bit_exact(tmp_path):
+    """k=2 superstep job snapshots mid-pass every 2 steps (one snapshot
+    per dispatched group); a fresh job restored at (pass 1, mid 2)
+    finishes pass 2 with skip_steps=2 and lands bit-identical dense +
+    sparse planes and global_step."""
+    import jax
+    ds, store, tr, box, ck = _job(tmp_path, "ss_mid", k=2,
+                                  midpass_every=2)
+    assert tr._superstep_fn is not None
+    for _ in range(2):
+        box.begin_pass()
+        tr.train_pass(ds)
+        box.end_pass(checkpointer=ck, trainer=tr)
+    tr.flush_sparse()
+    keys = np.sort(np.asarray(ds.unique_keys(), np.uint64))
+    want_rows = store.get_rows(keys)
+    want_params = jax.tree.map(np.asarray, tr.params)
+    assert (1, 2) in ck.intact_cursors()     # a dispatch-boundary cursor
+
+    from paddlebox_tpu.utils.pass_ckpt import PassCheckpointer
+    ds2, store2, tr2, box2, _ = _job(tmp_path, "ss_mid_unused", k=2)
+    ck2 = PassCheckpointer(str(tmp_path / "ss_mid"), keep_last_n=6,
+                           base_every=4)
+    cursor = ck2.resume(tr2, box=box2, at=(1, 2))
+    assert cursor["pass_id"] == 1 and cursor["mid_steps"] == 2
+    box2.begin_pass()
+    tr2.train_pass(ds2, skip_steps=cursor["mid_steps"])
+    box2.end_pass(checkpointer=ck2, trainer=tr2)
+    tr2.flush_sparse()
+    np.testing.assert_array_equal(want_rows, store2.get_rows(keys))
+    jax.tree_util.tree_map(
+        lambda a, b: np.testing.assert_array_equal(np.asarray(a),
+                                                   np.asarray(b)),
+        want_params, tr2.params)
+    assert tr2.global_step == tr.global_step
+
+
+def test_superstep_midpass_off_boundary_refused(tmp_path):
+    """Cadences and resume cursors OFF the dispatch boundary keep a clear
+    refusal — the k-microbatch program commits k steps atomically."""
+    import pytest
+    ds, store, tr, box, ck = _job(tmp_path, "ss_ref", k=2)
+    with pytest.raises(NotImplementedError, match="dispatch boundary"):
+        tr.enable_midpass_snapshots(ck, 3, box)
+    tr.enable_midpass_snapshots(ck, 4, box)      # multiple of k: accepted
+    assert tr._midpass is not None
+    tr.enable_midpass_snapshots(ck, 0, box)      # off again
+    with pytest.raises(NotImplementedError, match="dispatch boundary"):
+        tr.train_pass(ds, skip_steps=3)
