@@ -1124,6 +1124,17 @@ def dryrun_main() -> int:
         metrics, {"device_kind": None,
                   "metrics": {"headline_eps": eps}}, kind)
     checks["gate_ok_at_parity"] = g3["ok"]
+    # the pblint gate must not be able to rot silently: the linter module
+    # imports and carries its full rule set (the tier-1 lint-clean test
+    # runs the CLI itself; this catches an import-time breakage even if
+    # that test is ever skipped/filtered)
+    try:
+        from paddlebox_tpu.analysis import lint as lint_mod
+        from paddlebox_tpu.analysis.rules import ALL_RULES
+        checks["lint_importable"] = (callable(lint_mod.main)
+                                     and len(ALL_RULES) >= 6)
+    except Exception:
+        checks["lint_importable"] = False
     ok = all(checks.values())
     print(json.dumps({
         "metric": "bench_dryrun", "ok": ok, "checks": checks,

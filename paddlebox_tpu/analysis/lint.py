@@ -1,0 +1,141 @@
+"""pblint CLI.
+
+Usage::
+
+    python -m paddlebox_tpu.analysis.lint [paths...] [options]
+
+Paths default to the package directory. Each finding prints as one
+``file:line rule message`` line on stdout; exit code 0 = clean,
+1 = unwaived findings, 2 = usage error.
+
+Options:
+
+``--rules r1,r2``      run only these rules (waivers for the others
+                       still parse — a narrowed run never misreports
+                       them as unknown)
+``--list-rules``       print ``id  doc`` per rule and exit
+``--json``             machine-readable report on stdout
+``--baseline FILE``    findings recorded in FILE are accepted (reported
+                       in the summary, excluded from the exit code) —
+                       the incremental-adoption path for new rules
+``--write-baseline FILE``  record the current unwaived findings and exit
+                       0 — then land the new rule, and burn the baseline
+                       down over subsequent PRs
+``--show-waived``      also print waived findings with their reasons
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+from paddlebox_tpu.analysis.core import (
+    Linter,
+    Project,
+    load_baseline,
+    write_baseline,
+)
+from paddlebox_tpu.analysis.rules import ALL_RULES
+
+
+def build_arg_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="python -m paddlebox_tpu.analysis.lint",
+        description="pblint: AST-based project-invariant linter")
+    p.add_argument("paths", nargs="*",
+                   help="files/directories to lint (default: the "
+                        "paddlebox_tpu package)")
+    p.add_argument("--rules", default=None,
+                   help="comma-separated rule ids to run (default: all)")
+    p.add_argument("--list-rules", action="store_true")
+    p.add_argument("--json", action="store_true", dest="as_json")
+    p.add_argument("--baseline", default=None, metavar="FILE")
+    p.add_argument("--write-baseline", default=None, metavar="FILE")
+    p.add_argument("--show-waived", action="store_true")
+    p.add_argument("--root", default=None,
+                   help="repo root (default: discovered by walking up "
+                        "from the first path)")
+    return p
+
+
+def main(argv: "list[str] | None" = None) -> int:
+    args = build_arg_parser().parse_args(argv)
+
+    if args.list_rules:
+        for cls in ALL_RULES:
+            print(f"{cls.id:22s} {cls.doc}")
+        return 0
+
+    paths = args.paths
+    if not paths:
+        paths = [os.path.dirname(os.path.dirname(os.path.abspath(
+            __file__)))]
+    project = (Project(root=os.path.abspath(args.root)) if args.root
+               else Project.discover(paths[0]))
+
+    rules = None
+    if args.rules:
+        want = {r.strip() for r in args.rules.split(",") if r.strip()}
+        known = {cls.id for cls in ALL_RULES}
+        bad = want - known
+        if bad:
+            print(f"unknown rule(s): {', '.join(sorted(bad))} "
+                  f"(known: {', '.join(sorted(known))})", file=sys.stderr)
+            return 2
+        rules = [cls() for cls in ALL_RULES if cls.id in want]
+
+    baseline = None
+    if args.baseline:
+        try:
+            baseline = load_baseline(args.baseline)
+        except (OSError, ValueError, KeyError) as e:
+            print(f"cannot load baseline {args.baseline}: {e}",
+                  file=sys.stderr)
+            return 2
+
+    linter = Linter(project, rules)
+    try:
+        result = linter.lint(paths, baseline=baseline)
+    except FileNotFoundError as e:
+        print(str(e), file=sys.stderr)
+        return 2
+
+    if args.write_baseline:
+        write_baseline(args.write_baseline, result.findings,
+                       [r.id for r in linter.rules])
+        print(f"wrote baseline with {len(result.findings)} finding(s) "
+              f"to {args.write_baseline}")
+        return 0
+
+    if args.as_json:
+        json.dump({
+            "files_linted": result.files_linted,
+            "findings": [
+                {"file": f.file, "line": f.line, "rule": f.rule,
+                 "message": f.message} for f in result.findings],
+            "waived": [
+                {"file": f.file, "line": f.line, "rule": f.rule,
+                 "reason": reason} for f, reason in result.waived],
+            "baselined": [
+                {"file": f.file, "line": f.line, "rule": f.rule}
+                for f in result.baselined],
+            "clean": result.clean,
+        }, sys.stdout, indent=1)
+        print()
+        return 0 if result.clean else 1
+
+    for f in result.findings:
+        print(f.render())
+    if args.show_waived:
+        for f, reason in result.waived:
+            print(f"{f.file}:{f.line} {f.rule} [waived: {reason}]")
+    print(f"pblint: {len(result.findings)} finding(s), "
+          f"{len(result.waived)} waived, {len(result.baselined)} "
+          f"baselined across {result.files_linted} file(s)")
+    return 0 if result.clean else 1
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

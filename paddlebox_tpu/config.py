@@ -25,23 +25,33 @@ class Flags:
     """
 
     # --- data pipeline (reference platform/flags.cc:478-483) ---
-    record_pool_max_size: int = 50_000_000  # FLAGS_padbox_record_pool_max_size
-    dataset_shuffle_thread_num: int = 8     # FLAGS_padbox_dataset_shuffle_thread_num
-    dataset_merge_thread_num: int = 8       # FLAGS_padbox_dataset_merge_thread_num
+    # This registry is CLOSED like the reference's flags.cc: every field
+    # must have a live reader somewhere in the tree (the flag-audit pblint
+    # rule enforces it), so knobs that only existed as documentation-by-
+    # dataclass (record_pool_max_size, dataset_shuffle/merge_thread_num,
+    # shuffle_by_searchid, slot_pool_capacity, pull_padding_zero,
+    # embedding_max_keys_per_pass, binding_train_cpu, fix_dayid, and the
+    # TrainerConfig duplicates param_sync_step / sync_dense_moment /
+    # compute_dtype / embedding_dtype) were removed rather than waived —
+    # the surviving reference-gflag citations live on the fields that do
+    # something.
     dataset_load_thread_num: int = 8        # (new) parse/download threads
-    shuffle_by_searchid: bool = False       # FLAGS_enable_shuffle_by_searchid (flags.cc:605)
-    slot_pool_capacity: int = 4096          # channel capacity (new)
 
     # --- embedding engine (role of libbox_ps; flags.cc:603,607) ---
     pullpush_dedup_keys: bool = True        # FLAGS_enable_pullpush_dedup_keys
-    pull_padding_zero: bool = True          # FLAGS_enable_pull_box_padding_zero
+    # FLAGS_use_gpu_replica_cache (flags.cc:486): the trainer-side hot-row
+    # replica tier. ReplicaCache itself ships (embedding/replica_cache.py,
+    # serving hot rows ride it since PR 7); this knob gates the TRAINER
+    # pull path once the multi-replica serving arc (ROADMAP "serving
+    # follow-ups": N servers sharing one staging cache) lands it.
+    # pblint: disable=flag-audit -- reserved for the ROADMAP multi-replica
+    # serving arc: gates the trainer-side ReplicaCache hot tier
     use_replica_cache: bool = False         # FLAGS_use_gpu_replica_cache (flags.cc:486)
     # Pass-boundary transfer compression: embedx crosses host<->device as
     # bf16 (counters/opt state stay f32). TPU-native analogue of the
     # reference's Quant/ShowClk quantized feature types; rounds embedx to
     # 8 mantissa bits once per pass boundary. Opt-in.
     transfer_compress_embedx: bool = False  # (new)
-    embedding_max_keys_per_pass: int = 1 << 26  # (new) working-set capacity guard
     # Routed all_to_all capacity overflow policy (new — the reference sizes
     # buffers dynamically, box_wrapper_impl.h:44-81; fixed lanes are the
     # static-shape trade). Drops are counted per pass and NEVER silent:
@@ -143,13 +153,19 @@ class Flags:
     pack_engine: str = "auto"               # (new)
 
     # --- trainer (trainer_desc.proto:100-108, flags.cc:591-597) ---
-    param_sync_step: int = 1                # BoxPSWorkerParameter.sync_dense_step
-    sync_dense_moment: bool = False         # FLAGS_enable_sync_dense_moment
+    # (param_sync_step / sync_dense_moment live on TrainerConfig — the
+    # per-trainer descriptor, like the reference's TrainerDesc proto —
+    # not here; duplicating them in the global registry proved to be pure
+    # drift: nothing ever read the flag copies.)
     check_nan_inf: bool = False             # FLAGS_check_nan_inf
-    binding_train_cpu: bool = False         # FLAGS_enable_binding_train_cpu
 
-    # --- pass/day (flags.cc:477,492) ---
-    fix_dayid: bool = False                 # FLAGS_fix_dayid
+    # --- pass/day (flags.cc:492) ---
+    # FLAGS_padbox_auc_runner_mode: the feature-ablation AUC-runner mode.
+    # metrics/auc_runner.py ships; this knob turns the trainer's eval loop
+    # into runner mode when the ROADMAP scenario-diversity arc ("the
+    # auc_runner feature-ablation mode at scale") wires it.
+    # pblint: disable=flag-audit -- reserved for the ROADMAP
+    # scenario-diversity arc: trainer-level auc_runner wiring
     auc_runner_mode: bool = False           # FLAGS_padbox_auc_runner_mode
 
     # --- crash-safe checkpoints (new — utils/pass_ckpt.py) ---
@@ -210,10 +226,6 @@ class Flags:
     # JsonlSink bounded queue: a slow/failed writer drops events (counted)
     # instead of ever blocking the training thread.
     telemetry_queue_size: int = 8192        # (new)
-
-    # --- numerics / TPU (new) ---
-    compute_dtype: str = "float32"          # bf16 for matmul-heavy towers
-    embedding_dtype: str = "float32"
 
     def set(self, name: str, value: Any) -> None:
         if not hasattr(self, name):

@@ -58,6 +58,11 @@ def write_archive(path: str, batch: SlotRecordBatch) -> None:
         f.write(hdr)
         for _, a in cols:
             f.write(np.ascontiguousarray(a).tobytes())
+        # fsync before the rename: without it a power loss can leave the
+        # FINAL name pointing at zero-length bytes (rename persisted, data
+        # not) — the same tmp->fsync->replace discipline as atomic_file
+        f.flush()
+        os.fsync(f.fileno())
     os.replace(tmp, path)  # atomic: readers never see partial archives
 
 

@@ -38,7 +38,15 @@ def parse_multislot_lines(
     """Parse MultiSlot text lines into one columnar SlotRecordBatch."""
     native = _maybe_native()
     if native is not None:
-        out = native.parse_lines(lines, schema, with_ins_id=with_ins_id)
+        lines = list(lines)      # re-iterable for the fallback below
+        try:
+            out = native.parse_lines(lines, schema, with_ins_id=with_ins_id)
+        except ValueError:
+            # the native fast path is strict (first bad line raises);
+            # re-parse in Python, which applies the skip-with-a-name
+            # malformed-line treatment (reader.parse_errors) — the
+            # contract must not depend on whether the .so is built
+            out = None
         if out is not None:
             return out
     return _parse_python(lines, schema, with_ins_id)
@@ -53,16 +61,39 @@ def parse_multislot_buffer(
     file reader hands bytes straight to C++, no Python line iteration)."""
     native = _maybe_native()
     if native is not None:
-        out = native.parse_buffer(buf, schema, with_ins_id=with_ins_id)
+        try:
+            out = native.parse_buffer(buf, schema, with_ins_id=with_ins_id)
+        except ValueError:
+            out = None           # strict native parser: fall back (above)
         if out is not None:
             return out
-    return _parse_python(buf.decode("utf-8").splitlines(), schema,
-                         with_ins_id)
+    # errors="replace", not strict: a torn line of binary garbage must
+    # reach the per-line skip logic (reader.parse_errors), not brick the
+    # whole file with an UnicodeDecodeError that names nothing
+    return _parse_python(buf.decode("utf-8", errors="replace").splitlines(),
+                         schema, with_ins_id)
 
 
 _U64_MASK = (1 << 64) - 1
 _U64_WRAP = 1 << 64
 _I64_MAX1 = 1 << 63
+
+
+def _note_malformed_line(lineno: int, line: str, err: Exception,
+                         n_bad: int) -> None:
+    """Malformed-line diagnostics: every skip counts, the first few per
+    parse call carry the line's identity, and the first warns — the
+    skip-with-a-name discipline of FleetUtil._entries (PR-7)."""
+    from paddlebox_tpu import monitor
+    monitor.counter_add("reader.parse_errors")
+    if n_bad <= 5:    # identity for the head; the counter carries the rest
+        monitor.event("reader_malformed_line", lineno=lineno,
+                      error=str(err)[:200], line=line[:120])
+    if n_bad == 1:
+        import warnings
+        warnings.warn(
+            f"malformed MultiSlot line {lineno} (skipped): "
+            f"{line[:120]!r} ({err}); counting under reader.parse_errors")
 
 
 def _wrap_i64(v: str) -> int:
@@ -80,43 +111,76 @@ def _parse_python(lines: Iterable[str], schema: DataFeedSchema,
     float_vals: list[list[float]] = [[] for _ in range(n_float)]
     ins_ids: list[int] = []
     num = 0
+    n_bad = 0
+    lineno = 0
     for line in lines:
+        lineno += 1
         line = line.strip()
         if not line:
             continue
+        # parse into per-LINE buffers and commit to the columns only on
+        # success: a line failing mid-slot leaves no partial state, with
+        # zero happy-path rollback bookkeeping
+        row_ins = 0
+        row_sparse: list[tuple[list[int], int]] = []
+        row_float: list[list[float]] = []
+        try:
+            if with_ins_id:
+                ins_id_str, _, line = line.partition("\t")
+                row_ins = hash64(ins_id_str)
+            toks = line.split()
+            pos = 0
+            for slot in slots:
+                if pos >= len(toks):
+                    raise ValueError(
+                        f"ran out of tokens at slot {slot.name!r}")
+                ln = int(toks[pos]); pos += 1
+                if ln < 0:
+                    # a negative length passes the bounds check below
+                    # (empty slice, pos moves BACKWARDS) and would emit
+                    # negative sparse_lens — silent batch corruption
+                    raise ValueError(
+                        f"slot {slot.name!r} declares negative length {ln}")
+                if pos + ln > len(toks):
+                    raise ValueError(
+                        f"slot {slot.name!r} declares {ln} values but "
+                        f"line ends")
+                vals = toks[pos:pos + ln]; pos += ln
+                if slot.type == SlotType.UINT64:
+                    if slot.is_used:
+                        # Feature signs are full-range uint64; storage is
+                        # int64 bit patterns (reinterpret, like the native
+                        # parser), so signs >= 2^63 wrap instead of
+                        # overflowing.
+                        row_sparse.append(
+                            ([_wrap_i64(v) for v in vals], ln))
+                else:
+                    if slot.is_used:
+                        w = slot.max_len
+                        fv = [float(v) for v in vals[:w]]
+                        fv += [0.0] * (w - len(fv))
+                        row_float.append(fv)
+        except ValueError as err:
+            # A torn/foreign line must not brick the whole file: skip it
+            # WITH A NAME — counter + event carrying the line's identity —
+            # the same treatment PR-7 gave malformed donefile lines. An
+            # input that parses to NOTHING still raises below: dirty data
+            # is survivable, a wrong schema or binary garbage is not.
+            n_bad += 1
+            _note_malformed_line(lineno, line, err, n_bad)
+            continue
+        for i, (vals_i, ln_i) in enumerate(row_sparse):
+            sparse_vals[i].extend(vals_i)
+            sparse_lens[i].append(ln_i)
+        for i, fv_i in enumerate(row_float):
+            float_vals[i].extend(fv_i)
         if with_ins_id:
-            ins_id_str, _, line = line.partition("\t")
-            ins_ids.append(hash64(ins_id_str))
-        toks = line.split()
-        pos = 0
-        si = fi = 0
-        for slot in slots:
-            if pos >= len(toks):
-                raise ValueError(
-                    f"malformed MultiSlot line (ran out of tokens at slot "
-                    f"{slot.name!r}, example {num}): {line[:120]!r}")
-            ln = int(toks[pos]); pos += 1
-            if pos + ln > len(toks):
-                raise ValueError(
-                    f"malformed MultiSlot line (slot {slot.name!r} declares "
-                    f"{ln} values but line ends, example {num}): {line[:120]!r}")
-            vals = toks[pos:pos + ln]; pos += ln
-            if slot.type == SlotType.UINT64:
-                if slot.is_used:
-                    # Feature signs are full-range uint64; storage is int64
-                    # bit patterns (reinterpret, like the native parser), so
-                    # signs >= 2^63 wrap instead of overflowing.
-                    sparse_vals[si].extend(map(_wrap_i64, vals))
-                    sparse_lens[si].append(ln)
-                    si += 1
-            else:
-                if slot.is_used:
-                    w = slot.max_len
-                    fv = [float(v) for v in vals[:w]]
-                    fv += [0.0] * (w - len(fv))
-                    float_vals[fi].extend(fv)
-                    fi += 1
+            ins_ids.append(row_ins)
         num += 1
+    if num == 0 and n_bad:
+        raise ValueError(
+            f"every line was malformed MultiSlot ({n_bad} skipped) — "
+            f"wrong schema or non-MultiSlot input?")
     sparse_values = [np.asarray(v, dtype=np.int64) for v in sparse_vals]
     sparse_offsets = []
     for lens in sparse_lens:

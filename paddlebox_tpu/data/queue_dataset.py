@@ -30,6 +30,7 @@ import numpy as np
 from paddlebox_tpu.data.reader import ParserPlugin, read_file
 from paddlebox_tpu.data.schema import DataFeedSchema
 from paddlebox_tpu.data.slot_record import PackedBatch, SlotRecordBatch
+from paddlebox_tpu.monitor import context as mon_ctx
 from paddlebox_tpu.monitor import counter_add as stat_add
 
 _STOP = object()
@@ -100,8 +101,7 @@ class QueueDataset:
                 _put(_STOP) or q.put(_STOP)  # sentinel must always land
 
         n = min(self.num_threads, max(1, len(files)))
-        threads = [threading.Thread(target=worker, daemon=True)
-                   for _ in range(n)]
+        threads = [mon_ctx.spawn(worker) for _ in range(n)]
         for t in threads:
             t.start()
         done = 0

@@ -21,6 +21,7 @@ import os
 import subprocess
 from typing import Callable, Iterable, Iterator
 
+from paddlebox_tpu import monitor
 from paddlebox_tpu.data.parser import parse_multislot_buffer
 from paddlebox_tpu.data.schema import DataFeedSchema
 from paddlebox_tpu.data.slot_record import SlotRecordBatch
@@ -81,7 +82,8 @@ def read_file(
             # The feed runs on its own thread — writing all of stdin before
             # reading stdout deadlocks once either pipe buffer fills.
             import shutil as _sh
-            import threading as _th
+
+            from paddlebox_tpu.monitor import context as _mon_ctx
             fs, p = fs_lib.resolve(path)
             src = fs.open_read(p)
             proc = subprocess.Popen(pipe_command, shell=True,
@@ -94,19 +96,25 @@ def read_file(
                 try:
                     try:
                         _sh.copyfileobj(src, proc.stdin)
+                    # pblint: disable=silent-except -- consumer exited early
+                    # (head-style sampling commands close the pipe after
+                    # enough bytes); by design not an error, nothing to count
                     except BrokenPipeError:
-                        pass    # consumer exited early (head-style
-                                # sampling commands) — not an error
+                        pass
                     except BaseException as e:  # surfaced after the read
                         feed_err.append(e)
                 finally:
                     for f in (proc.stdin, src):
                         try:
                             f.close()
-                        except Exception:
-                            pass
+                        except Exception as e:
+                            # teardown failures are non-fatal (the pipe may
+                            # already be broken) but never invisible
+                            monitor.counter_add("reader.close_errors")
+                            monitor.event("reader_close_error",
+                                          path=path, error=repr(e)[:200])
 
-            feeder = _th.Thread(target=_feed, daemon=True)
+            feeder = _mon_ctx.spawn(_feed)
             feeder.start()
         else:
             feeder = None

@@ -24,6 +24,7 @@ from typing import Literal, Sequence
 import numpy as np
 
 from paddlebox_tpu.data.slot_record import SlotRecordBatch
+from paddlebox_tpu.monitor import context as mon_ctx
 from paddlebox_tpu.utils.hashing import hash64_array
 
 RoutingMode = Literal["random", "ins_id", "search_id"]
@@ -172,7 +173,7 @@ class TcpShuffleService:
                             received.append(b)
                 done_peers[0] += 1
 
-        server = threading.Thread(target=serve, daemon=True)
+        server = mon_ctx.spawn(serve)
         server.start()
         for peer in range(self.world):
             if peer == self.rank:

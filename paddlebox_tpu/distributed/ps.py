@@ -37,6 +37,7 @@ from typing import Any, Sequence
 import numpy as np
 
 from paddlebox_tpu.embedding import gating
+from paddlebox_tpu.monitor import context as mon_ctx
 from paddlebox_tpu.embedding.config import EmbeddingConfig
 from paddlebox_tpu.embedding.store import HostEmbeddingStore
 from paddlebox_tpu.parallel.dense_sync import AsyncDenseTable
@@ -216,8 +217,7 @@ class PSServer:
 
     # ---- lifecycle ----
     def start(self) -> "PSServer":
-        self._thread = threading.Thread(target=self._srv.serve_forever,
-                                        daemon=True)
+        self._thread = mon_ctx.spawn(self._srv.serve_forever)
         self._thread.start()
         return self
 
@@ -355,7 +355,7 @@ class PSClient:
                 except BaseException as e:
                     errs.append(e)
             return run
-        ts = [threading.Thread(target=guard(fn)) for fn in fns]
+        ts = [mon_ctx.spawn(guard(fn), daemon=False) for fn in fns]
         [t.start() for t in ts]
         [t.join() for t in ts]
         if errs:
@@ -432,7 +432,7 @@ class PSClient:
             self._fanout([lambda i=i: one(i)
                           for i in range(self.n_servers)])
         else:  # PushSparseVarsWithLabelAsync: fire and track for flush()
-            ts = [threading.Thread(target=one, args=(i,))
+            ts = [mon_ctx.spawn(one, args=(i,), daemon=False)
                   for i in range(self.n_servers)]
             [t.start() for t in ts]
             self._async_threads += ts
@@ -508,6 +508,8 @@ class PSClient:
                     s = self._sock(i)
                     s.sendall(_pack({"cmd": "stop"}))
                     _recv_msg(s)
+            # pblint: disable=silent-except -- best-effort shutdown: a
+            # server that is already gone IS the goal state of stop
             except OSError:
                 pass
         self.close()
@@ -517,6 +519,8 @@ class PSClient:
             if s is not None:
                 try:
                     s.close()
+                # pblint: disable=silent-except -- teardown double-close:
+                # the fd is gone either way, nothing to report
                 except OSError:
                     pass
         self._socks = [None] * self.n_servers

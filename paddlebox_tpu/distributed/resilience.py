@@ -169,8 +169,11 @@ class HeartbeatMonitor:
         while not self._stop.is_set():
             try:
                 self.publish()
+            # pblint: disable=silent-except -- store blip: better a late
+            # beat than a dead publisher; a REAL outage surfaces as this
+            # rank's seq freezing on every peer's watchdog
             except OSError:
-                pass             # store blip: better a late beat than death
+                pass
             self._stop.wait(self.interval_s)
 
     def _watchdog(self) -> None:
@@ -184,13 +187,13 @@ class HeartbeatMonitor:
     def start(self) -> None:
         if self._threads:
             return
-        t = threading.Thread(target=self._publisher, daemon=True,
-                             name=f"pbtpu-heartbeat-{self.rank}")
+        t = mon_ctx.spawn(self._publisher,
+                          name=f"pbtpu-heartbeat-{self.rank}")
         t.start()
         self._threads.append(t)
         if self._watch and self.world > 1:
-            w = threading.Thread(target=self._watchdog, daemon=True,
-                                 name=f"pbtpu-watchdog-{self.rank}")
+            w = mon_ctx.spawn(self._watchdog,
+                              name=f"pbtpu-watchdog-{self.rank}")
             w.start()
             self._threads.append(w)
 

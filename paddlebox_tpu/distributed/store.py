@@ -96,6 +96,8 @@ class FileStore:
     def delete(self, key: str) -> None:
         try:
             os.remove(self._path(key))
+        # pblint: disable=silent-except -- delete is idempotent by
+        # contract: absent already means deleted
         except FileNotFoundError:
             pass
 
@@ -214,6 +216,8 @@ class FileStore:
                         and now - os.path.getmtime(p) > max_age_s):
                     os.remove(p)
                     removed += 1
+            # pblint: disable=silent-except -- raced with another sweeper
+            # or a live writer; the other party owns the outcome
             except OSError:
-                pass             # raced with another sweeper / live writer
+                pass
         return removed

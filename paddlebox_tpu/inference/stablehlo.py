@@ -13,6 +13,7 @@ from __future__ import annotations
 
 import json
 import os
+import zlib
 from typing import Any
 
 import jax
@@ -22,6 +23,7 @@ from jax import export as jax_export
 
 from paddlebox_tpu.data.schema import DataFeedSchema
 from paddlebox_tpu.data.slot_record import SparseLayout
+from paddlebox_tpu.utils import checkpoint as ckpt_lib
 from paddlebox_tpu.inference.predictor import make_serving_fn
 
 
@@ -56,20 +58,50 @@ def export_stablehlo(path: str, model: Any, params: Any,
     )
     exported = jax_export.export(jax.jit(fwd))(*args)
     os.makedirs(path, exist_ok=True)
+    # Each file commits atomically (no torn bytes under a final name).
+    # Two files can still pair across exports if a crash lands between
+    # the replaces, so the meta carries the module's CRC32: the module
+    # commits FIRST, the meta naming it second — a crash between them
+    # leaves old meta + new module, which the loader detects by CRC
+    # mismatch and rejects with a named error instead of compiling the
+    # new module against the old static shapes.
+    payload = exported.serialize()
     fname = os.path.join(path, "model.stablehlo")
-    with open(fname, "wb") as f:
-        f.write(exported.serialize())
-    with open(os.path.join(path, "stablehlo_meta.json"), "w") as f:
-        json.dump({"batch_size": B, "total_len": T,
-                   "pull_width": pull_width, "num_dense": num_dense,
-                   "multi_task": multi_task}, f)
+    with ckpt_lib.atomic_file(fname) as tmp:
+        with open(tmp, "wb") as f:
+            f.write(payload)
+    with ckpt_lib.atomic_file(os.path.join(path,
+                                           "stablehlo_meta.json")) as tmp:
+        with open(tmp, "w") as f:
+            json.dump({"batch_size": B, "total_len": T,
+                       "pull_width": pull_width, "num_dense": num_dense,
+                       "multi_task": multi_task,
+                       "module_crc32": zlib.crc32(payload) & 0xFFFFFFFF},
+                      f)
     return fname
 
 
 def load_stablehlo(path: str):
-    """Reload the artifact → callable(pulled, mask, dense) -> probs."""
+    """Reload the artifact → callable(pulled, mask, dense) -> probs.
+
+    Rejects a module/meta pair from DIFFERENT exports (crash between the
+    two commits): the meta's ``module_crc32`` must match the module
+    bytes. Pre-CRC metas (older exports) load without the check."""
     with open(os.path.join(path, "model.stablehlo"), "rb") as f:
-        exported = jax_export.deserialize(f.read())
+        raw = f.read()
+    meta_path = os.path.join(path, "stablehlo_meta.json")
+    if os.path.exists(meta_path):
+        with open(meta_path) as f:
+            meta = json.load(f)
+        want = meta.get("module_crc32")
+        got = zlib.crc32(raw) & 0xFFFFFFFF
+        if want is not None and int(want) != got:
+            raise ckpt_lib.CheckpointCorruptError(
+                meta_path,
+                f"stablehlo module/meta pair mismatch (meta names crc "
+                f"{want}, module bytes hash {got}) — torn export; "
+                "re-export to re-pair")
+    exported = jax_export.deserialize(raw)
     fn = jax.jit(exported.call)  # compile once; serving calls hit the cache
 
     def call(pulled, mask, dense):

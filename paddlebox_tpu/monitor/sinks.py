@@ -82,8 +82,11 @@ class JsonlSink(Sink):
         self.written = 0
         self.error: BaseException | None = None
         self._q: queue.Queue = queue.Queue(maxsize=max(16, queue_size))
-        self._thread = threading.Thread(
-            target=self._drain, daemon=True, name="pbtpu-telemetry-jsonl")
+        # context.spawn, not a bare Thread: records emitted by the drain
+        # itself (the sink_dropped meta line) stay pass-tagged like every
+        # other event this file writes
+        from paddlebox_tpu.monitor.context import spawn
+        self._thread = spawn(self._drain, name="pbtpu-telemetry-jsonl")
         self._thread.start()
 
     def emit(self, record: dict) -> None:
@@ -126,6 +129,9 @@ class JsonlSink(Sink):
         if f is not None:
             try:
                 f.close()
+            # pblint: disable=silent-except -- sink teardown: any write
+            # failure was already latched in self.error above, and the
+            # telemetry writer must never raise into its owner
             except OSError:
                 pass
 

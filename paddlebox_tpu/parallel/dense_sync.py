@@ -32,6 +32,8 @@ from typing import Any, Callable
 import numpy as np
 
 import jax
+
+from paddlebox_tpu.monitor import context as mon_ctx
 from jax.flatten_util import ravel_pytree
 
 
@@ -142,8 +144,7 @@ class AsyncDenseTable:
     def start(self) -> None:
         if self._thread is not None:
             return
-        self._thread = threading.Thread(target=self._run, daemon=True,
-                                        name="async-dense-table")
+        self._thread = mon_ctx.spawn(self._run, name="async-dense-table")
         self._thread.start()
 
     def stop(self) -> None:

@@ -25,6 +25,7 @@ from concurrent.futures import Future
 import numpy as np
 
 from paddlebox_tpu import monitor
+from paddlebox_tpu.monitor import context as mon_ctx
 
 
 class _Request:
@@ -74,7 +75,10 @@ class BatchingFrontend:
             try:
                 r.future.set_exception(
                     RuntimeError("frontend stopped before dispatch"))
-            except Exception:   # noqa: BLE001 — drain/dispatch already resolved it
+            # pblint: disable=silent-except -- lost the resolve race:
+            # drain/dispatch already set this future, which is the
+            # outcome this failsafe exists to guarantee
+            except Exception:   # noqa: BLE001
                 pass
         return r.future
 
@@ -88,8 +92,7 @@ class BatchingFrontend:
         if self._thread is not None:
             return self
         self._stopping = False
-        self._thread = threading.Thread(target=self._run, daemon=True,
-                                        name="serving-frontend")
+        self._thread = mon_ctx.spawn(self._run, name="serving-frontend")
         self._thread.start()
         return self
 
@@ -111,7 +114,10 @@ class BatchingFrontend:
                 try:
                     r.future.set_exception(
                         RuntimeError("frontend stopped before dispatch"))
-                except Exception:   # noqa: BLE001 — submit's failsafe won
+                # pblint: disable=silent-except -- lost the resolve race:
+                # submit()'s failsafe already set this future; either
+                # way the caller is unblocked
+                except Exception:   # noqa: BLE001
                     pass
 
     def _gather(self) -> list[_Request]:

@@ -44,6 +44,7 @@ import numpy as np
 
 from paddlebox_tpu import monitor
 from paddlebox_tpu.embedding.gating import GateSpec
+from paddlebox_tpu.monitor import context as mon_ctx
 from paddlebox_tpu.embedding.replica_cache import ReplicaCache
 from paddlebox_tpu.fleet.fleet_util import FleetUtil
 from paddlebox_tpu.inference import export as export_lib
@@ -470,8 +471,7 @@ class ServingServer:
                     monitor.counter_add("serving.poll_failures")
                 self._stop.wait(self.poll_s)
 
-        self._thread = threading.Thread(target=_run, daemon=True,
-                                        name="serving-tailer")
+        self._thread = mon_ctx.spawn(_run, name="serving-tailer")
         self._thread.start()
         return self
 
@@ -516,8 +516,8 @@ class ServingServer:
         self._http = http.server.ThreadingHTTPServer(("127.0.0.1", port),
                                                      _Handler)
         self.health_port = self._http.server_address[1]
-        threading.Thread(target=self._http.serve_forever, daemon=True,
-                         name="serving-health").start()
+        mon_ctx.spawn(self._http.serve_forever,
+                      name="serving-health").start()
 
 
 def _normalize_cfg(cfg: dict) -> dict:

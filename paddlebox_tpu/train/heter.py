@@ -49,6 +49,7 @@ from paddlebox_tpu.data.slot_record import SparseLayout
 from paddlebox_tpu.embedding import HostEmbeddingStore, gating
 from paddlebox_tpu.embedding.optim import apply_updates
 from paddlebox_tpu.metrics import auc as auc_lib
+from paddlebox_tpu.monitor import context as mon_ctx
 from paddlebox_tpu.train import optimizers
 
 
@@ -191,7 +192,7 @@ class HeterTrainer:
                     except queue.Full:
                         continue
 
-        t = threading.Thread(target=producer, daemon=True)
+        t = mon_ctx.spawn(producer)
         t.start()
         try:
             while True:

@@ -1499,8 +1499,11 @@ class Trainer:
             if n_ex is not None:
                 try:
                     dataset._pbtpu_preplan_need = (memo_key, capf)
+                # pblint: disable=silent-except -- slots-restricted
+                # dataset type: the memo is a pure optimization (skips a
+                # re-scan); a dataset that cannot carry it just re-plans
                 except AttributeError:
-                    pass                  # slots-restricted dataset type
+                    pass
         if for_eval:
             # a skewed EVAL dataset must never inflate the train step's
             # all_to_all padding or force a train recompile — only the
@@ -1721,10 +1724,16 @@ class Trainer:
         if opt_state is not None:
             self.opt_state = jax.device_put(opt_state, repl)
 
-    def enable_midpass_snapshots(self, checkpointer, every_steps: int,
-                                 box, metrics=None) -> None:
+    def enable_midpass_snapshots(self, checkpointer,
+                                 every_steps: "int | None" = None,
+                                 box=None, metrics=None) -> None:
         """Commit a crash-safe snapshot every ``every_steps`` steps INSIDE
-        each training pass (ISSUE 5 mid-pass resume). The snapshot's
+        each training pass (ISSUE 5 mid-pass resume). ``every_steps``
+        defaults to ``flags.ckpt_midpass_every_steps`` (0 there keeps
+        mid-pass snapshots off — pass-boundary snapshots only, the
+        pre-ISSUE-5 behavior), so launchers can set the cadence from the
+        environment (``PBTPU_CKPT_MIDPASS_EVERY_STEPS``) without a code
+        change. The snapshot's
         cursor records the last COMPLETED pass, ``mid_steps`` (steps of
         the open pass already trained), and the shuffle RNG state the
         driver stashed in ``midpass_cursor_extra['shuffle_state']``
@@ -1751,6 +1760,8 @@ class Trainer:
           boundary, though the continued run's grad-merge timing remains
           async-nondeterministic by design.
         """
+        if every_steps is None:
+            every_steps = int(config_flags.ckpt_midpass_every_steps)
         if every_steps <= 0:
             self._midpass = None
             return

@@ -237,6 +237,9 @@ class CommandFS(FileSystem):
                             shutil.rmtree(p)
                         elif os.path.exists(p):
                             os.remove(p)
+                    # pblint: disable=silent-except -- between-attempt
+                    # hygiene: if the partial dst survives, the retried
+                    # -get fails loudly with 'File exists' anyway
                     except OSError:
                         pass
                 time.sleep(backoff * (2 ** (attempt - 1)))

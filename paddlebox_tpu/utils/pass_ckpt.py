@@ -331,6 +331,11 @@ class PassCheckpointer:
                            "save_seq": int(save_seq),
                            "ts": int(time.time())})
         self._repair_donefile(fs)
+        # pblint: disable=donefile-discipline -- snapshots.donefile is the
+        # checkpoint mirror's OWN resume channel (PR 5), not the model-
+        # visibility donefile: it needs reset-line masking and two-phase
+        # compaction, rewrite semantics FleetUtil.append_donefile cannot
+        # express (and must not learn)
         fs.write_text(f"{rroot}/{REMOTE_DONEFILE}", line + "\n",
                       append=True)
         seconds = time.perf_counter() - t0
@@ -369,6 +374,10 @@ class PassCheckpointer:
         tmp = os.path.join(self.root, f".donefile.repair.{os.getpid()}")
         try:
             fs.get(alt, tmp)
+            # pblint: disable=donefile-discipline -- compaction-crash
+            # repair of the mirror's OWN snapshots.donefile: restores the
+            # full history from the .compact staging copy; append-only
+            # FleetUtil semantics cannot repair a half-replaced file
             fs.put(tmp, path)
             fs.rm(alt)
         finally:
@@ -660,6 +669,10 @@ class PassCheckpointer:
                 if fs.exists(f"{self.remote_root}/{REMOTE_DONEFILE}"):
                     line = json.dumps({"reset_after": list(at),
                                        "ts": int(time.time())})
+                    # pblint: disable=donefile-discipline -- timeline-
+                    # reset mask on the mirror's OWN snapshots.donefile
+                    # (PR 5 election rollback); reset_after lines are a
+                    # resume-channel concept FleetUtil does not speak
                     fs.write_text(
                         f"{self.remote_root}/{REMOTE_DONEFILE}",
                         line + "\n", append=True)
@@ -739,13 +752,24 @@ class PassCheckpointer:
             # two-phase donefile rewrite: stage → replace → unstage
             tmp = os.path.join(self.root,
                                f".donefile.compact.{os.getpid()}")
+            # pblint: disable=durable-write,donefile-discipline -- local
+            # STAGING copy of the compacted mirror donefile: durability
+            # comes from the two-phase remote protocol below (stage ->
+            # replace -> unstage), not from this scratch file
             with open(tmp, "w") as f:
                 for e in kept:
                     f.write(json.dumps(e) + "\n")
             try:
                 fs.rm(f"{donefile}.compact")
+                # pblint: disable=donefile-discipline -- two-phase
+                # compaction STAGE upload (readers fall back to .compact
+                # if the replace below is interrupted)
                 fs.put(tmp, f"{donefile}.compact")
                 fs.rm(donefile)
+                # pblint: disable=donefile-discipline -- two-phase
+                # compaction REPLACE of the mirror's own snapshots.
+                # donefile; a rewrite-in-place is exactly what the
+                # append-only FleetUtil API exists to forbid elsewhere
                 fs.put(tmp, donefile)
                 fs.rm(f"{donefile}.compact")
             finally:

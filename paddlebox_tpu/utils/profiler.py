@@ -187,9 +187,13 @@ def export_chrome_trace(path: str) -> int:
     pass-boundary / checkpoint-commit instant markers recorded via
     :func:`record_instant`."""
     evs = profiler_events()
-    os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)
-    with open(path, "w") as f:
-        json.dump({"traceEvents": evs, "displayTimeUnit": "ms"}, f)
+    # atomic tmp->fsync->replace: a crash mid-export must not leave a torn
+    # trace under the final name (Perfetto half-loads truncated JSON, and
+    # a monitoring cron shipping the file would ship the torn copy)
+    from paddlebox_tpu.utils.checkpoint import atomic_file
+    with atomic_file(path) as tmp:
+        with open(tmp, "w") as f:
+            json.dump({"traceEvents": evs, "displayTimeUnit": "ms"}, f)
     return len(evs)
 
 

@@ -59,6 +59,57 @@ def test_parse_exact_values():
     np.testing.assert_allclose(b.float_values[0], [1.0, 0.0])
 
 
+def test_parse_skips_malformed_lines_with_a_name():
+    """PR-8 contract: a torn/foreign line among good ones is SKIPPED with
+    the reader.parse_errors counter + a warning naming it (the PR-7
+    malformed-donefile-line treatment) and must not leave partial columns
+    behind; an ALL-malformed input still raises (wrong schema)."""
+    import warnings
+
+    from paddlebox_tpu import monitor
+
+    schema = DataFeedSchema(
+        [Slot("label", SlotType.FLOAT, max_len=1),
+         Slot("s0", SlotType.UINT64, max_len=3)], batch_size=2)
+    good = ["1 1.0 2 11 22", "1 0.0 3 5 6 7"]
+    hub = monitor.hub()
+    hub.enable(monitor.MemorySink())
+    try:
+        before = hub.summary()["counters"].get("reader.parse_errors", 0)
+        with warnings.catch_warnings(record=True) as w:
+            warnings.simplefilter("always")
+            b = parse_multislot_lines(
+                [good[0], "1 1.0 2 11", good[1]], schema)  # torn mid-slot
+        assert b.num == 2
+        np.testing.assert_array_equal(b.sparse_offsets[0], [0, 2, 5])
+        np.testing.assert_allclose(b.float_values[0], [1.0, 0.0])
+        assert any("malformed MultiSlot line 2" in str(x.message)
+                   for x in w)
+        after = hub.summary()["counters"].get("reader.parse_errors", 0)
+        assert after == before + 1
+    finally:
+        hub.disable()
+    with pytest.raises(ValueError, match="every line was malformed"):
+        parse_multislot_lines(["1 1.0 2 11", "garbage"], schema)
+
+
+def test_parse_negative_slot_length_is_malformed():
+    """ln=-1 used to pass the bounds checks (empty slice, pos moving
+    BACKWARDS) and emit negative sparse_lens — silent batch corruption;
+    it must count as a malformed line like any other."""
+    schema = DataFeedSchema(
+        [Slot("a", SlotType.UINT64, max_len=3),
+         Slot("b", SlotType.UINT64, max_len=3)], batch_size=2)
+    import warnings
+    with warnings.catch_warnings(record=True):
+        warnings.simplefilter("always")
+        b = parse_multislot_lines(["-1 2 7 8", "1 4 1 5"], schema)
+    assert b.num == 1                      # only the good line survives
+    np.testing.assert_array_equal(b.sparse_values[0], [4])
+    np.testing.assert_array_equal(b.sparse_values[1], [5])
+    assert all(np.all(np.diff(off) >= 0) for off in b.sparse_offsets)
+
+
 def test_pack_pads_and_truncates():
     schema = DataFeedSchema(
         [Slot("label", SlotType.FLOAT, max_len=1),

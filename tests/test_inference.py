@@ -155,3 +155,29 @@ def test_stablehlo_roundtrip(trained, tmp_path):
     np.testing.assert_allclose(
         live.predict(pb.ids.astype(np.uint64), pb.mask, dense), probs,
         rtol=1e-5, atol=1e-6)
+
+
+def test_stablehlo_torn_pair_rejected(trained, tmp_path):
+    """A module/meta pair from DIFFERENT exports (crash between the two
+    atomic commits) must be rejected by CRC, not compiled against the
+    other export's static shapes."""
+    import json
+
+    from paddlebox_tpu.utils.checkpoint import CheckpointCorruptError
+    tr, store, ds, schema = trained
+    path = str(tmp_path / "hlo")
+    table = ServingTable.from_store(store)
+    export_stablehlo(path, tr.model, tr.eval_params(), schema,
+                     batch_size=32, pull_width=table.pull_width)
+    meta_p = tmp_path / "hlo" / "stablehlo_meta.json"
+    meta = json.loads(meta_p.read_text())
+    assert "module_crc32" in meta
+    # simulate the torn pair: meta from another export beside this module
+    meta["module_crc32"] = (meta["module_crc32"] + 1) & 0xFFFFFFFF
+    meta_p.write_text(json.dumps(meta))
+    with pytest.raises(CheckpointCorruptError, match="pair mismatch"):
+        load_stablehlo(path)
+    # a pre-CRC meta (older export) still loads
+    del meta["module_crc32"]
+    meta_p.write_text(json.dumps(meta))
+    assert load_stablehlo(path) is not None

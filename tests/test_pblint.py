@@ -1,0 +1,569 @@
+"""pblint's own test suite: per-rule fixture snippets proving each rule
+fires on a violation, stays quiet on the fixed form, and is suppressed by
+a waiver WITH a reason — plus the cross-file checks (unregistered
+faultpoint, registered-but-untested faultpoint, phantom/dead flags), the
+waiver grammar, the CLI surface, and the baseline machinery.
+
+Fixtures build a miniature project in tmp_path with the same shape as
+the real tree (a ``paddlebox_tpu`` package dir with config.py and
+utils/faultpoint.py, a ``tests/`` dir) so the default :class:`Project`
+path conventions apply unchanged.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import textwrap
+
+import pytest
+
+from paddlebox_tpu.analysis import lint as lint_cli
+from paddlebox_tpu.analysis.core import Linter, Project, load_baseline
+from paddlebox_tpu.analysis.rules import ALL_RULES
+
+# ---------------------------------------------------------------------------
+# fixture project scaffolding
+# ---------------------------------------------------------------------------
+
+MINI_CONFIG = '''
+import dataclasses
+
+
+@dataclasses.dataclass
+class Flags:
+    live_flag: int = 1
+    dead_flag: int = 2
+    set_only_flag: int = 3
+    # pblint: disable=flag-audit -- reserved for the frobnicator arc
+    waived_flag: int = 4
+
+
+flags = Flags()
+
+
+def set_flags(**kw):
+    for k, v in kw.items():
+        setattr(flags, k, v)
+'''
+
+MINI_FAULTPOINT = '''
+POINTS: tuple = (
+    "tested.point",
+    "untested.point",
+    "sub.registry.point",
+)
+
+ELASTIC_POINTS: tuple = (
+    "sub.registry.point",
+)
+
+
+def hit(name):
+    pass
+
+
+def arm(name, action="kill"):
+    pass
+'''
+
+MINI_TEST = '''
+from paddlebox_tpu.utils import faultpoint
+
+
+def test_literal_reference():
+    assert faultpoint is not None
+    point = "tested.point"
+
+
+def test_registry_parametrized():
+    for p in faultpoint.ELASTIC_POINTS:
+        assert p
+'''
+
+
+def make_project(tmp_path, files: dict[str, str],
+                 config: str = MINI_CONFIG,
+                 faultpoint: str = MINI_FAULTPOINT,
+                 test_src: str = MINI_TEST) -> Project:
+    """Write a miniature repo; ``files`` maps repo-relative path -> source."""
+    all_files = {
+        "paddlebox_tpu/__init__.py": "",
+        "paddlebox_tpu/config.py": config,
+        "paddlebox_tpu/utils/__init__.py": "",
+        "paddlebox_tpu/utils/faultpoint.py": faultpoint,
+        "tests/test_ref.py": test_src,
+    }
+    all_files.update(files)
+    for rel, src in all_files.items():
+        p = tmp_path / rel
+        p.parent.mkdir(parents=True, exist_ok=True)
+        p.write_text(textwrap.dedent(src))
+    return Project(root=str(tmp_path))
+
+
+def run_lint(project: Project, paths=("paddlebox_tpu",), rules=None,
+             baseline=None):
+    linter = Linter(project, rules)
+    return linter.lint(list(paths), baseline=baseline)
+
+
+def by_rule(result, rule):
+    return [f for f in result.findings if f.rule == rule]
+
+
+# ---------------------------------------------------------------------------
+# durable-write
+# ---------------------------------------------------------------------------
+
+DURABLE_SRC = '''
+import os
+
+from paddlebox_tpu.utils.checkpoint import atomic_file
+
+
+def bad(path):
+    with open(path, "wb") as f:          # VIOLATION
+        f.write(b"x")
+
+
+def good_atomic(path):
+    with atomic_file(path) as tmp:
+        with open(tmp, "wb") as f:       # sanctioned: atomic_file handle
+            f.write(b"x")
+
+
+def good_local_idiom(path):
+    tmp = path + ".tmp"
+    with open(tmp, "wb") as f:           # sanctioned: tmp->fsync->replace
+        f.write(b"x")
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, path)
+
+
+def reads_are_fine(path):
+    with open(path, "rb") as f:
+        return f.read()
+
+
+def waived(path):
+    # pblint: disable=durable-write -- scratch file, durability by caller
+    with open(path, "w") as f:
+        f.write("x")
+'''
+
+
+def test_durable_write_rule(tmp_path):
+    proj = make_project(tmp_path, {
+        "paddlebox_tpu/data/__init__.py": "",
+        "paddlebox_tpu/data/archive.py": DURABLE_SRC,   # durability module
+        "paddlebox_tpu/other.py": 'def f(p):\n    open(p, "w").write("x")\n',
+    })
+    res = run_lint(proj)
+    hits = by_rule(res, "durable-write")
+    # exactly the one raw write in the durability module; the non-
+    # durability module's raw write is out of scope for THIS rule
+    assert len(hits) == 1
+    assert hits[0].file == "paddlebox_tpu/data/archive.py"
+    assert "raw open" in hits[0].message
+    # the waived site is reported as waived, with its reason
+    assert any(f.rule == "durable-write" and "scratch file" in r
+               for f, r in res.waived)
+
+
+def test_durable_write_idiom_sanctions_only_the_replaced_tmp(tmp_path):
+    # a function that carries the tmp->fsync->replace idiom for ONE file
+    # must not get a blanket pass for a second raw write to another path
+    proj = make_project(tmp_path, {
+        "paddlebox_tpu/data/__init__.py": "",
+        "paddlebox_tpu/data/archive.py": '''
+import os
+
+
+def mixed(path, other):
+    tmp = path + ".tmp"
+    with open(tmp, "wb") as f:           # sanctioned: replaced below
+        f.write(b"x")
+        os.fsync(f.fileno())
+    os.replace(tmp, path)
+    with open(other, "w") as f:          # VIOLATION: never replaced
+        f.write("y")
+''',
+    })
+    res = run_lint(proj)
+    hits = by_rule(res, "durable-write")
+    assert len(hits) == 1
+    assert hits[0].line == 11            # the `open(other, ...)` line
+
+
+def test_durable_write_fleet_prefix(tmp_path):
+    proj = make_project(tmp_path, {
+        "paddlebox_tpu/fleet/__init__.py": "",
+        "paddlebox_tpu/fleet/boxps.py":
+            'def f(p):\n    open(p, "w").write("x")\n',
+    })
+    res = run_lint(proj)
+    assert len(by_rule(res, "durable-write")) == 1   # fleet/ is a prefix
+
+
+# ---------------------------------------------------------------------------
+# faultpoint-registry
+# ---------------------------------------------------------------------------
+
+FAULTPOINT_SRC = '''
+from paddlebox_tpu.utils import faultpoint
+
+
+def g(save):
+    faultpoint.hit("tested.point")            # registered + tested
+    faultpoint.hit("not.registered")          # VIOLATION
+    save("x", fault_point="also.not.there")   # VIOLATION (kwarg form)
+    faultpoint.hit(compute_name())            # non-literal: plumbing, skip
+
+
+def compute_name():
+    return "tested.point"
+'''
+
+
+def test_faultpoint_registry_rule(tmp_path):
+    proj = make_project(tmp_path, {
+        "paddlebox_tpu/mod.py": FAULTPOINT_SRC,
+    })
+    res = run_lint(proj)
+    hits = by_rule(res, "faultpoint-registry")
+    msgs = {(f.file, f.line): f.message for f in hits}
+    unregistered = [m for m in msgs.values() if "not in the closed" in m]
+    assert len(unregistered) == 2          # hit-literal + fault_point kwarg
+    # cross-file: untested.point has no literal AND its only registry
+    # (POINTS) is not referenced by a test -> finding at the registry line
+    untested = [f for f in hits if "registered but no test" in f.message]
+    assert [f.file for f in untested] == [
+        "paddlebox_tpu/utils/faultpoint.py"]
+    assert "untested.point" in untested[0].message
+    # sub.registry.point is covered by the ELASTIC_POINTS parametrization;
+    # tested.point by its literal — neither may appear
+    joined = " ".join(f.message for f in untested)
+    assert "sub.registry.point" not in joined
+    assert "'tested.point'" not in joined
+
+
+def test_faultpoint_untested_fires_without_registry_ref(tmp_path):
+    # a test file with no literal and no registry reference: every point
+    # is untested
+    proj = make_project(tmp_path, {"paddlebox_tpu/mod.py": "x = 1\n"},
+                        test_src="def test_nothing():\n    assert True\n")
+    res = run_lint(proj)
+    untested = [f for f in by_rule(res, "faultpoint-registry")
+                if "registered but no test" in f.message]
+    assert len(untested) == 3
+
+
+# ---------------------------------------------------------------------------
+# thread-context
+# ---------------------------------------------------------------------------
+
+THREAD_SRC = '''
+import threading
+from threading import Thread as T
+
+
+def f():
+    a = threading.Thread(target=f)       # VIOLATION
+    b = T(target=f)                      # VIOLATION (aliased import)
+    # pblint: disable=thread-context -- must NOT inherit pass context:
+    # this worker outlives the pass scope by design
+    c = threading.Thread(target=f)
+    return a, b, c
+'''
+
+
+def test_thread_context_rule(tmp_path):
+    proj = make_project(tmp_path, {
+        "paddlebox_tpu/mod.py": THREAD_SRC,
+        "paddlebox_tpu/monitor/__init__.py": "",
+        # the sanctioned wrapper itself is exempt
+        "paddlebox_tpu/monitor/context.py":
+            "import threading\n\n"
+            "def spawn(target):\n"
+            "    return threading.Thread(target=target)\n",
+    })
+    res = run_lint(proj)
+    hits = by_rule(res, "thread-context")
+    assert len(hits) == 2
+    assert all(f.file == "paddlebox_tpu/mod.py" for f in hits)
+    assert any(f.rule == "thread-context" and "NOT inherit" in r
+               for f, r in res.waived)
+
+
+# ---------------------------------------------------------------------------
+# donefile-discipline
+# ---------------------------------------------------------------------------
+
+DONEFILE_SRC = '''
+import os
+
+DONEFILE = "model.donefile"
+
+
+def announce(fs, fleet, tmp):
+    fleet.append_donefile(DONEFILE, {})              # sanctioned API
+    fs.write_text("out/model.donefile", "x")         # VIOLATION
+    path = "root/" + DONEFILE
+    fs.put(tmp, path)                                # VIOLATION (taint)
+    with open("a.donefile", "a") as f:               # VIOLATION
+        f.write("x")
+    os.replace(tmp, "ordinary.txt")                  # unrelated target: ok
+    fs.get("root/model.donefile", tmp)               # reads are fine
+'''
+
+
+def test_donefile_discipline_rule(tmp_path):
+    proj = make_project(tmp_path, {
+        "paddlebox_tpu/mod.py": DONEFILE_SRC,
+        "paddlebox_tpu/fleet/__init__.py": "",
+        # the sanctioned writer may use raw primitives
+        "paddlebox_tpu/fleet/fleet_util.py":
+            'def append_donefile(fs, name, entry):\n'
+            '    fs.write_text(name + ".donefile", "line")\n',
+    })
+    res = run_lint(proj)
+    hits = by_rule(res, "donefile-discipline")
+    assert len(hits) == 3
+    assert all(f.file == "paddlebox_tpu/mod.py" for f in hits)
+
+
+# ---------------------------------------------------------------------------
+# flag-audit
+# ---------------------------------------------------------------------------
+
+FLAGS_SRC = '''
+from paddlebox_tpu.config import flags, set_flags
+
+
+def f():
+    a = flags.live_flag                  # resolves: fine
+    b = flags.phantom_flag               # VIOLATION: no such field
+    set_flags(set_only_flag=9)           # write, not a read
+    return a, b
+'''
+
+
+def test_flag_audit_rule(tmp_path):
+    proj = make_project(tmp_path, {"paddlebox_tpu/mod.py": FLAGS_SRC})
+    res = run_lint(proj)
+    hits = by_rule(res, "flag-audit")
+    phantom = [f for f in hits if "phantom" in f.message]
+    assert len(phantom) == 1 and "phantom_flag" in phantom[0].message
+    dead = {f.message.split("'")[1] for f in hits
+            if "never read" in f.message}
+    # dead_flag: no reference at all; set_only_flag: written but never
+    # READ — both dead. live_flag is read; waived_flag carries a waiver.
+    assert dead == {"dead_flag", "set_only_flag"}
+    assert all(f.file == "paddlebox_tpu/config.py" for f in hits
+               if "never read" in f.message)
+    assert any(f.rule == "flag-audit" and "frobnicator" in r
+               for f, r in res.waived)
+
+
+def test_flag_audit_counts_reads_from_tests(tmp_path):
+    # a flag read ONLY by a test still counts as read (tests are
+    # reference scope), keeping the dead-flag check about the whole tree
+    proj = make_project(
+        tmp_path, {"paddlebox_tpu/mod.py": "x = 1\n"},
+        test_src="from paddlebox_tpu.config import flags\n\n"
+                 "def test_f():\n    assert flags.dead_flag\n")
+    res = run_lint(proj)
+    dead = {f.message.split("'")[1]
+            for f in by_rule(res, "flag-audit") if "never read" in f.message}
+    assert "dead_flag" not in dead
+
+
+# ---------------------------------------------------------------------------
+# silent-except
+# ---------------------------------------------------------------------------
+
+SILENT_SRC = '''
+def f(q, monitor):
+    try:
+        q.get()
+    except KeyError:
+        pass                             # VIOLATION
+
+    try:
+        q.get()
+    except OSError:
+        # a comment does not make it accounted
+        pass                             # VIOLATION
+
+    try:
+        q.get()
+    except ValueError:
+        monitor.counter_add("q.errors")  # counted: fine
+
+    try:
+        q.get()
+    # pblint: disable=silent-except -- the queue owner already latched it
+    except RuntimeError:
+        pass
+'''
+
+
+def test_silent_except_rule(tmp_path):
+    proj = make_project(tmp_path, {"paddlebox_tpu/mod.py": SILENT_SRC})
+    res = run_lint(proj)
+    hits = by_rule(res, "silent-except")
+    assert len(hits) == 2
+    assert any(f.rule == "silent-except" and "latched" in r
+               for f, r in res.waived)
+
+
+# ---------------------------------------------------------------------------
+# waiver grammar
+# ---------------------------------------------------------------------------
+
+def test_waiver_without_reason_is_bad_and_not_honored(tmp_path):
+    proj = make_project(tmp_path, {
+        "paddlebox_tpu/mod.py":
+            "def f(q):\n"
+            "    try:\n"
+            "        q.get()\n"
+            "    except OSError:  # pblint: disable=silent-except\n"
+            "        pass\n",
+    })
+    res = run_lint(proj)
+    rules = {f.rule for f in res.findings}
+    # the reasonless waiver is itself a finding AND suppresses nothing
+    assert "bad-waiver" in rules and "silent-except" in rules
+
+
+def test_waiver_with_unknown_rule_is_bad(tmp_path):
+    proj = make_project(tmp_path, {
+        "paddlebox_tpu/mod.py":
+            "# pblint: disable=no-such-rule -- because\nx = 1\n",
+    })
+    res = run_lint(proj)
+    bad = by_rule(res, "bad-waiver")
+    assert len(bad) == 1 and "no-such-rule" in bad[0].message
+
+
+def test_trailing_waiver_and_multi_rule(tmp_path):
+    proj = make_project(tmp_path, {
+        "paddlebox_tpu/data/__init__.py": "",
+        "paddlebox_tpu/data/archive.py":
+            "def f(p):\n"
+            "    with open(p + '.donefile', 'w') as fh:  "
+            "# pblint: disable=durable-write,donefile-discipline -- "
+            "fixture covers both rules at one site\n"
+            "        fh.write('x')\n",
+    })
+    res = run_lint(proj)
+    assert not by_rule(res, "durable-write")
+    assert not by_rule(res, "donefile-discipline")
+    assert {"durable-write", "donefile-discipline"} <= {
+        f.rule for f, _ in res.waived}
+
+
+def test_unparseable_file_is_a_finding(tmp_path):
+    proj = make_project(tmp_path, {
+        "paddlebox_tpu/mod.py": "def broken(:\n",
+    })
+    res = run_lint(proj)
+    pe = by_rule(res, "parse-error")
+    assert len(pe) == 1 and pe[0].file == "paddlebox_tpu/mod.py"
+
+
+# ---------------------------------------------------------------------------
+# CLI surface + baseline machinery
+# ---------------------------------------------------------------------------
+
+def _cli(tmp_path, *argv):
+    return lint_cli.main(["--root", str(tmp_path), *argv])
+
+
+def test_cli_exit_codes_and_format(tmp_path, capsys):
+    make_project(tmp_path, {"paddlebox_tpu/mod.py": THREAD_SRC})
+    rc = _cli(tmp_path, "paddlebox_tpu", "--rules", "thread-context")
+    out = capsys.readouterr().out
+    assert rc == 1
+    # one `file:line rule message` line per finding + the summary
+    lines = [ln for ln in out.splitlines() if " thread-context " in ln]
+    assert len(lines) == 2
+    fname, line = lines[0].split(":", 1)[0], lines[0].split(":", 2)[1]
+    assert fname == "paddlebox_tpu/mod.py" and line.split()[0].isdigit()
+    assert "2 finding(s), 1 waived" in out
+
+    rc = _cli(tmp_path, "paddlebox_tpu", "--rules", "silent-except")
+    assert rc == 0                       # narrowed run: no thread findings
+    assert _cli(tmp_path, "paddlebox_tpu", "--rules", "nope") == 2
+
+
+def test_cli_json_output(tmp_path, capsys):
+    make_project(tmp_path, {"paddlebox_tpu/mod.py": THREAD_SRC})
+    rc = _cli(tmp_path, "paddlebox_tpu", "--rules", "thread-context",
+              "--json")
+    doc = json.loads(capsys.readouterr().out)
+    assert rc == 1 and doc["clean"] is False
+    assert len(doc["findings"]) == 2 and len(doc["waived"]) == 1
+    assert {"file", "line", "rule", "message"} <= set(doc["findings"][0])
+
+
+def test_cli_list_rules(capsys):
+    assert lint_cli.main(["--list-rules"]) == 0
+    out = capsys.readouterr().out
+    for cls in ALL_RULES:
+        assert cls.id in out
+    assert len(ALL_RULES) >= 6
+
+
+def test_baseline_round_trip(tmp_path, capsys):
+    make_project(tmp_path, {"paddlebox_tpu/mod.py": THREAD_SRC})
+    base = tmp_path / "baseline.json"
+    rc = _cli(tmp_path, "paddlebox_tpu", "--write-baseline", str(base))
+    assert rc == 0
+    assert load_baseline(str(base))      # non-empty accepted set
+    # with the baseline applied the same tree is green...
+    capsys.readouterr()
+    rc = _cli(tmp_path, "paddlebox_tpu", "--baseline", str(base))
+    out = capsys.readouterr().out
+    assert rc == 0 and "0 finding(s)" in out and " baselined" in out
+    # ...but a NEW violation still fails
+    (tmp_path / "paddlebox_tpu" / "mod2.py").write_text(SILENT_SRC)
+    rc = _cli(tmp_path, "paddlebox_tpu", "--baseline", str(base))
+    assert rc == 1
+
+
+def test_baseline_rejects_wrong_version(tmp_path):
+    p = tmp_path / "b.json"
+    p.write_text('{"version": 99, "findings": []}')
+    with pytest.raises(ValueError):
+        load_baseline(str(p))
+
+
+def test_nonexistent_path_is_an_error_not_a_clean_run(tmp_path, capsys):
+    # a typo'd path must never report '0 findings across 0 files' green
+    make_project(tmp_path, {"paddlebox_tpu/mod.py": "x = 1\n"})
+    rc = _cli(tmp_path, "paddlebox_tpu/no/such/dir")
+    assert rc == 2
+    assert "matched no .py files" in capsys.readouterr().err
+
+
+def test_cwd_relative_path_fallback(tmp_path, monkeypatch, capsys):
+    # a path that does not exist under the repo root but does exist
+    # relative to the CWD (e.g. `cd tests && lint ../paddlebox_tpu`)
+    # resolves instead of silently matching nothing
+    make_project(tmp_path, {"paddlebox_tpu/mod.py": THREAD_SRC})
+    sub = tmp_path / "somewhere"
+    sub.mkdir()
+    monkeypatch.chdir(sub)
+    rc = _cli(tmp_path, "../paddlebox_tpu/mod.py",
+              "--rules", "thread-context")
+    assert rc == 1
+    assert "2 finding(s)" in capsys.readouterr().out
+
+
+def test_project_discovery_walks_up(tmp_path):
+    make_project(tmp_path, {"paddlebox_tpu/mod.py": "x = 1\n"})
+    proj = Project.discover(str(tmp_path / "paddlebox_tpu" / "mod.py"))
+    assert os.path.samefile(proj.root, str(tmp_path))
