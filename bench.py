@@ -64,6 +64,19 @@ def _startup_splits() -> int:
     return _STARTUP_SPLITS[0]
 
 
+_STARTUP_FLAGS: dict = {}
+
+
+def _startup_flag(name: str):
+    """A flag's value at bench start, captured before any matrix point
+    overrides it (the _startup_splits discipline, generalized for the
+    sharded-exchange points' table_layout/exchange_wire overrides)."""
+    if name not in _STARTUP_FLAGS:
+        from paddlebox_tpu.config import flags as config_flags
+        _STARTUP_FLAGS[name] = config_flags.get(name)
+    return _STARTUP_FLAGS[name]
+
+
 def _peaks(device_kind: str):
     dk = device_kind.lower()
     for key, val in PEAKS.items():
@@ -224,7 +237,9 @@ def device_step_bench(small: bool, mode: str = "allreduce",
                       batch_per_dev: int | None = None,
                       n_split: int | None = None,
                       emb_dim: int = 8, max_len: int = 1,
-                      return_ctx: bool = False, tiny: bool = False):
+                      return_ctx: bool = False, tiny: bool = False,
+                      table_layout: str | None = None,
+                      exchange_wire: str | None = None):
     import jax
     from paddlebox_tpu.config import flags as config_flags
     from paddlebox_tpu.data import DataFeedSchema
@@ -236,9 +251,15 @@ def device_step_bench(small: bool, mode: str = "allreduce",
 
     # n_split=None keeps the STARTUP value (framework default or the
     # operator's PBTPU_BINNED_PUSH_SPLITS env override) — matrix points
-    # that override it must not leak into later configs
+    # that override it must not leak into later configs; same rule for
+    # the sharded-exchange engine knobs
     config_flags.binned_push_splits = (_startup_splits() if n_split is None
                                        else n_split)
+    config_flags.table_layout = (_startup_flag("table_layout")
+                                 if table_layout is None else table_layout)
+    config_flags.exchange_wire = (_startup_flag("exchange_wire")
+                                  if exchange_wire is None
+                                  else exchange_wire)
     devices = jax.devices()
     n_dev = len(devices)
     # tiny = --dryrun geometry: small enough that the full bench pipeline
@@ -444,6 +465,12 @@ def device_step_bench(small: bool, mode: str = "allreduce",
         # deferred-push pipeline state (flags.push_overlap)
         "push_overlap": "on" if tr.push_overlap else "off",
         "steps_per_dispatch": ksd,
+        # sharded-exchange identity: which table engine the point
+        # compiled with, the push wire format its a2a rode, and the mesh
+        # partition — recorded per point like pull/push/pack_engine
+        "table_layout": tr.table_layout,
+        "exchange_wire": tr.exchange_wire or "-",
+        "table_shards": tr.n_shards,
         "devices": n_dev,
         "global_batch": batch,
         "steps": n_steps,
@@ -1029,6 +1056,73 @@ def serving_drill(small: bool, tiny: bool = False) -> dict:
             "swapped_to_version": srv.active.version}
 
 
+def _run_sharded_probe(small: bool, tiny: bool = False) -> dict:
+    """Run the sharded-exchange matrix points in a 2-virtual-device CPU
+    subprocess (``--sharded-probe``): a single-device environment cannot
+    host an in-process multi-shard mesh, and the backend's device count
+    is fixed at init. The probe's numbers are simulated (CPU), but the
+    FIELDS — table_layout, exchange_wire, table_shards, dedup ratio —
+    are the product, and the eps values gate like-for-like because the
+    probe environment is stable round over round."""
+    import subprocess
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    env["XLA_FLAGS"] = (env.get("XLA_FLAGS", "")
+                        + " --xla_force_host_platform_device_count=2"
+                        ).strip()
+    env.pop("PBTPU_BENCH_SMALL", None)
+    args = [sys.executable, os.path.abspath(__file__), "--sharded-probe"]
+    if tiny:
+        args.append("--tiny")
+    elif small:
+        args.append("--small")
+    try:
+        r = subprocess.run(args, capture_output=True, text=True, env=env,
+                           timeout=1200)
+        if r.returncode != 0:
+            return {"error": r.stderr[-500:]}
+        return json.loads(r.stdout.strip().splitlines()[-1])
+    except Exception as e:
+        return {"error": repr(e)}
+
+
+def sharded_probe_main() -> int:
+    """Subprocess entry for the sharded-exchange matrix points (see
+    _run_sharded_probe). Prints ONE JSON line."""
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    from paddlebox_tpu import monitor
+    tiny = "--tiny" in sys.argv
+    small = "--small" in sys.argv or tiny
+    out: dict = {"simulated": True, "devices": len(jax.devices()),
+                 "points": {}}
+    for mname, w in (("sharded_wire_f32", "f32"),
+                     ("sharded_wire_bf16", "bf16")):
+        snap0 = monitor.STATS.snapshot()
+        try:
+            eps, detail = device_step_bench(
+                small, n_steps=2 if tiny else 3, n_windows=1, tiny=tiny,
+                table_layout="sharded", exchange_wire=w)
+            snap = monitor.STATS.snapshot()
+            toks = snap.get("exchange.tokens", 0.0) - snap0.get(
+                "exchange.tokens", 0.0)
+            uniq = snap.get("exchange.unique_lanes", 0.0) - snap0.get(
+                "exchange.unique_lanes", 0.0)
+            out["points"][mname] = {
+                "examples_per_sec_per_chip": round(eps, 1),
+                "table_layout": detail["table_layout"],
+                "exchange_wire": detail["exchange_wire"],
+                "table_shards": detail["table_shards"],
+                "pull_engine": detail["pull_engine"],
+                "push_engine": detail["push_engine"],
+                "dedup_ratio": (round(uniq / toks, 4) if toks else None),
+                "simulated": True,
+            }
+        except Exception as e:
+            out["points"][mname] = {"error": repr(e)}
+    print(json.dumps(out), flush=True)
+    return 0
+
+
 def dryrun_main() -> int:
     """Fast CPU smoke of the bench's regression-gate, stage-attribution,
     and push-floor code paths (tier-1: exercised on every PR instead of
@@ -1092,6 +1186,28 @@ def dryrun_main() -> int:
         and sdrill.get("p99_ms", 0) > 0
         and sdrill.get("failures") == 0
         and sdrill.get("swapped_to_version") == 2)
+    # sharded-exchange points ride the dryrun too (ISSUE 10): the 2-
+    # virtual-device probe must produce the sharded matrix points with
+    # table_layout / exchange_wire / table_shards recorded and a real
+    # dedup ratio, before a multi-chip run ever records them
+    probe = _run_sharded_probe(True, tiny=True)
+    for pname, p in (probe.get("points") or {}).items():
+        detail.setdefault("matrix", {})[pname] = p
+    sp = probe.get("points") or {}
+    f32p = sp.get("sharded_wire_f32") or {}
+    bfp = sp.get("sharded_wire_bf16") or {}
+    checks["sharded_fields"] = (
+        f32p.get("table_layout") == "sharded"
+        and f32p.get("exchange_wire") == "f32"
+        and bfp.get("exchange_wire") == "bf16"
+        and f32p.get("table_shards") == 2
+        and isinstance(f32p.get("examples_per_sec_per_chip"),
+                       (int, float))
+        and isinstance(bfp.get("examples_per_sec_per_chip"),
+                       (int, float))
+        and (f32p.get("dedup_ratio") or 0) > 0
+        and "table_layout" in detail and "exchange_wire" in detail
+        and "table_shards" in detail)
     g_lat = apply_regression_gate(
         {"serving.p99_ms": 10.0},
         {"device_kind": None, "metrics": {"serving.p99_ms": 5.0}}, "")
@@ -1145,6 +1261,9 @@ def dryrun_main() -> int:
         "push_floor_closed": (detail.get("push_floor") or {}
                               ).get("closed"),
         "world_resize_seconds": detail.get("world_resize_seconds"),
+        "sharded": {k: f32p.get(k) for k in
+                    ("table_layout", "exchange_wire", "table_shards",
+                     "dedup_ratio", "error") if k in f32p},
         "serving": {k: sdrill.get(k) for k in
                     ("publish_seconds", "swap_pause_ms", "p99_ms",
                      "error") if k in sdrill},
@@ -1160,6 +1279,9 @@ def main() -> None:
 
     if "--dryrun" in sys.argv:
         raise SystemExit(dryrun_main())
+
+    if "--sharded-probe" in sys.argv:
+        raise SystemExit(sharded_probe_main())
 
     if "--host" in sys.argv:
         # host-section subprocess entry (see _enrich): CPU backend,
@@ -1385,6 +1507,9 @@ def _enrich(small: bool, detail: dict, ctx: dict,
                     "pull_engine": m_detail["pull_engine"],
                     "pack_engine": m_detail["pack_engine"],
                     "push_overlap": m_detail["push_overlap"],
+                    "table_layout": m_detail["table_layout"],
+                    "exchange_wire": m_detail["exchange_wire"],
+                    "table_shards": m_detail["table_shards"],
                     "push_floor": m_detail.get("push_floor"),
                     # per-point self-audit (VERDICT r4 weak #1): the
                     # headline's founding rule — a number without a
@@ -1425,6 +1550,55 @@ def _enrich(small: bool, detail: dict, ctx: dict,
             except Exception as e:   # a matrix point must not kill the run
                 matrix[mname] = {"error": repr(e)}
             _mark(f"matrix point {mname} done")
+        if os.environ.get("PBTPU_BENCH_SHARDED", "1") != "0":
+            # sharded-exchange points (ISSUE 10): the mesh-partitioned
+            # table with the dedup-plan-keyed a2a, one point per push
+            # wire format — gate-held like every other matrix point,
+            # with table_layout/exchange_wire/table_shards recorded. On
+            # a single-device environment the points run in a 2-virtual-
+            # device CPU subprocess (marked simulated: like-for-like
+            # round over round, since the probe environment is stable).
+            if detail.get("devices", 1) >= 2:
+                from paddlebox_tpu.config import flags as config_flags
+                try:
+                    for mname, w in (("sharded_wire_f32", "f32"),
+                                     ("sharded_wire_bf16", "bf16")):
+                        try:
+                            s_eps, s_detail = device_step_bench(
+                                small, n_steps=3 if small else 50,
+                                n_windows=2, table_layout="sharded",
+                                exchange_wire=w)
+                            matrix[mname] = {
+                                "examples_per_sec_per_chip":
+                                    round(s_eps, 1),
+                                "step_seconds":
+                                    s_detail["audit"]["step_seconds"],
+                                "table_layout": s_detail["table_layout"],
+                                "exchange_wire":
+                                    s_detail["exchange_wire"],
+                                "table_shards": s_detail["table_shards"],
+                                "pull_engine": s_detail["pull_engine"],
+                                "push_engine": s_detail["push_engine"],
+                            }
+                        except Exception as e:
+                            matrix[mname] = {"error": repr(e)}
+                        _mark(f"matrix point {mname} done")
+                finally:
+                    # the forced engine must not leak into the elastic /
+                    # serving drills below — they build 1-device
+                    # trainers, and a leaked 'sharded' would error both
+                    # gate-held points
+                    config_flags.table_layout = \
+                        _startup_flag("table_layout")
+                    config_flags.exchange_wire = \
+                        _startup_flag("exchange_wire")
+            else:
+                probe = _run_sharded_probe(small)
+                for mname, p in (probe.get("points") or {}).items():
+                    matrix[mname] = p
+                if "error" in probe:
+                    matrix["sharded_wire_f32"] = {"error": probe["error"]}
+                _mark("matrix sharded probe done")
         if os.environ.get("PBTPU_BENCH_ELASTIC", "1") != "0":
             # elastic rank-loss drill: world_resize_seconds + the
             # degraded (N−1) throughput point, gate-held like the rest

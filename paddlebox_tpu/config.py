@@ -141,6 +141,28 @@ class Flags:
     # mirroring AsyncDenseTable's dispatch-decoupling semantics);
     # "on"/"off" force. Read at Trainer construction (trace time).
     push_overlap: str = "auto"              # (new)
+    # Sharded table exchange (embedding/exchange.py): which engine the
+    # trainer compiles the embedding traffic with. "auto" = "sharded"
+    # on multi-device TPU meshes (the dedup-plan-keyed all-to-all with
+    # the compressed push wire), "single" elsewhere — CPU test meshes
+    # keep the legacy routed path's exact numerics unless a test opts
+    # in. "sharded" forced on a one-device mesh is an error (there is
+    # nothing to exchange); "single" forced on a multi-device mesh is
+    # the A/B knob against the legacy token-level routed path.
+    table_layout: str = "auto"              # (new)
+    # Push-payload wire format over the exchange all_to_all: grads cross
+    # as f32 (exact — the parity baseline), bf16, or int8 with a
+    # per-lane scale; show/clk increments always stay f32 (counters
+    # must not round). "auto" = bf16 (int8 for int8-storage tables) —
+    # see exchange.select_wire for the rationale.
+    exchange_wire: str = "auto"             # (new)
+    # Initial all_to_all capacity factor for the sharded engine (0 =
+    # keep TrainerConfig.capacity_factor). Overflow is NEVER silent
+    # regardless: drops are counted (exchange.overflow_dropped), evented
+    # (exchange_overflow), preplanned away (routed_capacity_preplan),
+    # adaptively doubled for the next pass, and eval passes re-run
+    # in place at the grown factor (exchange.eval.pre_retry).
+    exchange_capacity_factor: float = 0.0   # (new)
     # _bp_pack width-class engine override for A/B runs: "auto" selects
     # per payload width (narrow < 14 lanes reorders at logical width and
     # pads after; gather-zone 14..63 pads to 64 lanes BEFORE the reorder

@@ -1,0 +1,272 @@
+"""Sparsity-aware sparse exchange for the mesh-partitioned embedding table.
+
+The reference shards its embedding table across devices inside libbox_ps
+(the sharded HashTable behind ``PullSparseGPU``/``PushSparseGPU``) and
+moves batches through hand-built all-to-all pull/push over NCCL
+(box_wrapper_impl.h:44-103). This module is that exchange, grown from the
+``sharded.routed_lookup``/``routed_push`` cores with the two ideas the
+scale-out literature grounds (ROADMAP "Sharded embedding scale-out"):
+
+- **Route only the deduped unique rows** (Parallax's sparsity-aware
+  partitioning, arXiv:1808.02621): the host pack pipeline's dedup plan
+  (``native.key_index.dedup_plan``) already orders tokens by row; the
+  exchange premerges per-token push payloads onto one lane per unique row
+  BEFORE the all_to_all (``sharded.plan_premerge``) and pulls each unique
+  row once, re-expanding after the gather (``plan_dedup_indices`` — no
+  device argsort: the plan's host permutation replaces it). A multi-hot
+  CTR batch dedups ~2.5x, and the wire carries exactly that factor less.
+- **Compress the push wire** (adaptive space-efficient sparse collectives,
+  arXiv:2607.04676): the grad payload crosses ICI as bf16 or int8 with a
+  per-lane scale (``flags.exchange_wire``); show/clk counter increments
+  and the scale ride a small f32 side plane — the same split the
+  quantized-table pull already uses for its a2a payload
+  (``sharded.routed_lookup``). f32 keeps the wire exact (the parity
+  baseline and the ``sharded2_wire_f32`` bench point).
+
+The fused Pallas ``gather_pool`` pull (PR 1) runs **per shard after
+routing**: ``routed_pull_pooled`` routes the unique rows, lands them in a
+local (lanes, pull_width) table, and pools per (example, slot) from THAT
+table — the kernel's gather source is the received lanes, so the
+(B*T, pull_width) token matrix never materializes on the sharded path
+either (CPU meshes and unsupported geometries run the identical jnp math).
+
+Capacity overflow is never silent: every pull reports its exact dropped
+count, the trainer feeds it to named counters/events
+(``exchange.overflow_dropped`` / ``exchange_overflow``) and the
+grow-retry policy (``Trainer._check_dropped`` — preplan sizing, adaptive
+doubling, and the eval-pass in-place retry at the grown factor).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+from jax import lax
+
+from paddlebox_tpu.config import flags as config_flags
+from paddlebox_tpu.embedding import quant
+from paddlebox_tpu.embedding.config import EmbeddingConfig
+from paddlebox_tpu.embedding import sharded
+from paddlebox_tpu.embedding.sharded import (_axis_size, _capacity,
+                                             _normalize_plan, _route,
+                                             dedup_tokens)
+
+# push-payload wire formats (the pull's embedx plane already crosses
+# quantized for quantized tables — sharded.routed_lookup)
+WIRES = ("f32", "bf16", "int8")
+
+
+def select_wire(cfg: EmbeddingConfig) -> str:
+    """Resolve flags.exchange_wire for this table (trace-time static,
+    recorded per bench matrix point as ``exchange_wire``). "auto" =
+    bf16 — the sparse grads reaching the wire already carry bf16-level
+    rounding from the backward matmuls (the same argument as
+    binned_push_splits=2), so the wire halves for free; int8 tables get
+    int8 (their pull payload already crosses at that precision, and the
+    push should not be the wider leg)."""
+    w = config_flags.exchange_wire
+    if w == "auto":
+        return "int8" if cfg.storage == "int8" else "bf16"
+    if w not in WIRES:
+        raise ValueError(
+            f"flags.exchange_wire={w!r} (want auto|f32|bf16|int8)")
+    return w
+
+
+def push_wire_bytes(cfg: EmbeddingConfig, lanes: int, wire: str) -> int:
+    """Per-direction a2a bytes for `lanes` push lanes under `wire`
+    (index plane + grad plane + f32 side plane) — the host-side
+    accounting behind the ``exchange.push_bytes`` counter."""
+    gw = cfg.grad_width
+    gbytes = {"f32": 4 * gw, "bf16": 2 * gw, "int8": gw}[wire]
+    side = 4 * (3 if wire == "int8" else 2)   # show, clk (+ scale)
+    return lanes * (4 + gbytes + side)
+
+
+def pull_wire_bytes(cfg: EmbeddingConfig, lanes: int) -> int:
+    """A2a bytes for `lanes` pull lanes: the index plane out plus the
+    value payload back (quantized tables cross embedx at their storage
+    width plus the fixed f32 head — the routed_lookup quant path)."""
+    if cfg.storage != "f32":
+        qbytes = 1 if cfg.storage == "int8" else 2
+        return lanes * (4 + 4 * (cfg.fixed_cols + 1)
+                        + qbytes * cfg.total_dim)
+    return lanes * (4 + 4 * cfg.pull_width)
+
+
+# ---------------------------------------------------------------------------
+# plan-keyed dedup: the host counting sort replaces the device argsort
+# ---------------------------------------------------------------------------
+
+def plan_dedup_indices(dplan) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """(uniq, inverse) from the host dedup plan — the device-argsort-free
+    form of ``sharded.dedup_tokens`` (the sort already happened on the
+    pack thread, ``native.key_index.dedup_plan``).
+
+    uniq    : (n,) unique row ids, ascending, padded with ascending
+              out-of-range ids (never routed — they fall into the null
+              group like padding).
+    inverse : (n,) unique lane per original token position, so
+              ``pulled_lanes[inverse]`` re-expands a per-lane gather to
+              per-token order. Sorted position i belongs to the segment
+              whose ``segend`` is the first one past i — a vectorized
+              searchsorted, no argsort.
+    """
+    order, _rstart, _endb, uniq, segend = dplan
+    n = order.shape[0]
+    seg_sorted = jnp.searchsorted(
+        segend, jnp.arange(n, dtype=segend.dtype), side="right"
+    ).astype(jnp.int32)
+    seg_sorted = jnp.minimum(seg_sorted, n - 1)
+    inverse = jnp.zeros((n,), jnp.int32).at[order].set(seg_sorted)
+    return uniq, inverse
+
+
+# ---------------------------------------------------------------------------
+# pull
+# ---------------------------------------------------------------------------
+
+def routed_pull(table_shard, idx: jnp.ndarray, cfg: EmbeddingConfig,
+                axis_name, capacity_factor: float = 2.0, plan=None,
+                dedup: bool = False, return_dropped: bool = False):
+    """Dedup-plan-keyed distributed gather: each unique row crosses the
+    wire once; tokens re-expand from the returned lanes. Without a plan
+    this degrades to ``sharded.routed_lookup`` (device dedup per the
+    `dedup` flag — the eval path, which packs no plan)."""
+    D = _axis_size(axis_name)
+    if D == 1:
+        out = sharded.lookup(table_shard, idx, cfg)
+        return (out, jnp.zeros((), jnp.int32)) if return_dropped else out
+    _, dplan = _normalize_plan(plan)
+    if dplan is None:
+        return sharded.routed_lookup(table_shard, idx, cfg, axis_name,
+                                     capacity_factor, dedup=dedup,
+                                     return_dropped=return_dropped)
+    uniq, inverse = plan_dedup_indices(dplan)
+    res = sharded.routed_lookup(table_shard, uniq, cfg, axis_name,
+                                capacity_factor,
+                                return_dropped=return_dropped)
+    if return_dropped:
+        return res[0][inverse], res[1]
+    return res[inverse]
+
+
+def routed_pull_pooled(table_shard, idx: jnp.ndarray, cfg: EmbeddingConfig,
+                       axis_name, num_slots: int, slot_len: int,
+                       capacity_factor: float = 2.0, plan=None,
+                       return_dropped: bool = False):
+    """(B, S*L) indices → (B, S, pull_width): the fused gather-pool pull
+    on the sharded mesh. The unique rows route once (plan-keyed when a
+    plan rides the batch, device dedup otherwise), land in a local
+    (lanes, pull_width) table, and the per-(example, slot) pool gathers
+    FROM THAT local table — on a supported real-TPU geometry through the
+    Pallas ``gather_pool`` kernel, per shard, after routing; elsewhere
+    the identical jnp math. Masked tokens point at the null row's lane,
+    whose routed value is the zero row, so padding contributes zeros
+    exactly like the single-shard fused path."""
+    B = idx.shape[0]
+    flat = idx.reshape(-1)
+    D = _axis_size(axis_name)
+    if D == 1:
+        out = sharded.fused_pull_pool(table_shard, idx, cfg, num_slots,
+                                      slot_len)
+        return (out, jnp.zeros((), jnp.int32)) if return_dropped else out
+    _, dplan = _normalize_plan(plan)
+    if dplan is not None:
+        uniq, inverse = plan_dedup_indices(dplan)
+    else:
+        uniq, inverse = dedup_tokens(flat)
+    rows, dropped = sharded.routed_lookup(table_shard, uniq, cfg,
+                                          axis_name, capacity_factor,
+                                          return_dropped=True)
+    pooled = _pool_lanes(rows, inverse.reshape(B, num_slots * slot_len),
+                         cfg, num_slots, slot_len)
+    return (pooled, dropped) if return_dropped else pooled
+
+
+def _pool_lanes(rows: jnp.ndarray, lane_idx: jnp.ndarray,
+                cfg: EmbeddingConfig, num_slots: int,
+                slot_len: int) -> jnp.ndarray:
+    """Per-(example, slot) sum pool gathering from the received-lane
+    table (the per-shard-after-routing half of fused_pull_pool)."""
+    from paddlebox_tpu.ops import pallas_kernels
+    B = lane_idx.shape[0]
+    if pallas_kernels.gather_pool_supported(cfg, B, num_slots, slot_len,
+                                            rows.shape[1]):
+        return pallas_kernels.gather_pool(rows, lane_idx, cfg, num_slots,
+                                          slot_len)
+    take = jnp.take(rows, lane_idx.reshape(-1), axis=0)
+    return take.reshape(B, num_slots, slot_len, rows.shape[1]).sum(axis=2)
+
+
+# ---------------------------------------------------------------------------
+# push (wire-compressed)
+# ---------------------------------------------------------------------------
+
+def _compress_push(send_pay: jnp.ndarray, gw: int, wire: str) -> tuple:
+    """(D, cap, gw+2) f32 payload → wire planes. Grad columns compress;
+    show/clk increments (exact small counts) and the int8 scale stay in
+    an f32 side plane — counters must never round."""
+    if wire == "f32":
+        return (send_pay,)
+    g, side = send_pay[..., :gw], send_pay[..., gw:]
+    if wire == "bf16":
+        return (g.astype(jnp.bfloat16), side)
+    q, scale = quant.quantize_lanes(g, "int8")
+    return (q, jnp.concatenate([side, scale[..., None]], axis=-1))
+
+
+def _decompress_push(planes: tuple, wire: str) -> jnp.ndarray:
+    if wire == "f32":
+        return planes[0]
+    g, side = planes
+    if wire == "bf16":
+        return jnp.concatenate([g.astype(jnp.float32), side], axis=-1)
+    x = quant.dequantize_lanes(g, side[..., -1])
+    return jnp.concatenate([x, side[..., :-1]], axis=-1)
+
+
+def routed_push(table_shard, idx: jnp.ndarray, grads: jnp.ndarray,
+                shows: jnp.ndarray, clks: jnp.ndarray,
+                cfg: EmbeddingConfig, axis_name,
+                capacity_factor: float = 2.0, wire: str = "f32",
+                plan=None, premerged: bool = False):
+    """Distributed merge-update with a premerged, wire-compressed
+    payload (the exchange's push half; reverse of ``routed_pull``).
+
+    When `plan` carries the host dedup bounds (or `premerged` lanes
+    arrive from a deferred apply), per-token payloads merge onto one
+    lane per unique row BEFORE routing — each row crosses the wire once
+    per source device. The grad plane crosses in `wire` format; the
+    owner shard's ``sharded.push`` then merges cross-device lanes and
+    applies the optimizer exactly as the single-shard engine does."""
+    D = _axis_size(axis_name)
+    if D == 1:
+        return sharded.push(table_shard, idx, grads, shows, clks, cfg,
+                            plan=plan, premerged=premerged)
+    if not premerged:
+        _, dplan = _normalize_plan(plan)
+        if dplan is not None:
+            idx, grads, shows, clks, _ = sharded.plan_premerge(
+                idx, grads, shows, clks, dplan)
+    n = idx.shape[0]
+    rps = quant.table_rows(table_shard)
+    cap = _capacity(n, D, capacity_factor)
+    order, sowner, pos, valid, send_idx = _route(idx, rps, D, cap)
+    gw = cfg.grad_width
+    payload = jnp.concatenate(
+        [grads, shows[:, None], clks[:, None]], axis=1)[order]
+    send_pay = jnp.zeros((D, cap, gw + 2), payload.dtype)
+    send_pay = send_pay.at[sowner, pos].set(payload, mode="drop")
+    recv_idx = lax.all_to_all(send_idx, axis_name, 0, 0, tiled=True)
+    recv = tuple(lax.all_to_all(p, axis_name, 0, 0, tiled=True)
+                 for p in _compress_push(send_pay, gw, wire))
+    recv_pay = _decompress_push(recv, wire)
+    flat_idx = recv_idx.reshape(-1)
+    flat_pay = recv_pay.reshape(-1, gw + 2)
+    empty = flat_idx < 0
+    # empty lanes go out-of-bounds so push's scatter drops them (see
+    # sharded.routed_push on why row 0 would be wrong for adam)
+    local_row = jnp.where(empty, rps, flat_idx % rps).astype(jnp.int32)
+    flat_pay = jnp.where(empty[:, None], 0.0, flat_pay)
+    return sharded.push(table_shard, local_row, flat_pay[:, :gw],
+                        flat_pay[:, gw], flat_pay[:, gw + 1], cfg)

@@ -263,7 +263,7 @@ class FeedPassManager:
         if staged is not None and staged.full_ws is not None:
             ws = staged.full_ws
             self._account_begin(staged.h2d_bytes, 0, staged.n_fresh,
-                                0, t0, table=ws.table)
+                                0, t0, table=ws.table, ws=ws)
             if not self._eager:
                 self._retain(ws)
             return ws
@@ -275,7 +275,7 @@ class FeedPassManager:
             self._account_begin(transfer_bytes(self.store.cfg,
                                                ws.padded_rows), 0,
                                 len(ws.sorted_keys), 0, t0,
-                                table=ws.table)
+                                table=ws.table, ws=ws)
             if not test_mode and not self._eager:
                 self._retain(ws)
             return ws
@@ -288,7 +288,7 @@ class FeedPassManager:
         ws, carried = self._combine(staged, test_mode)
         self._account_begin(staged.h2d_bytes, d2h, staged.n_fresh,
                             len(keys) - staged.n_fresh, t0,
-                            table=ws.table)
+                            table=ws.table, ws=ws)
         if not test_mode:
             self._retain(ws, carried)
         return ws
@@ -480,7 +480,7 @@ class FeedPassManager:
                           else np.zeros_like(ws.touched))
 
     def _account_begin(self, h2d: int, d2h: int, fresh: int, reused: int,
-                       t0: float, table=None) -> None:
+                       t0: float, table=None, ws=None) -> None:
         if table is not None:
             # 4-byte D2H of one element forces every pending H2D/combine
             # on this buffer to land before the clock stops —
@@ -497,3 +497,9 @@ class FeedPassManager:
         stat_add("feed_pass.d2h_bytes", d2h)
         stat_set("feed_pass.last_fresh_rows", fresh)
         stat_set("feed_pass.last_reused_rows", reused)
+        # shard layout of the built working set (flight-record context
+        # for the exchange counters: lanes and wire volume scale off the
+        # per-shard row count)
+        if ws is not None:
+            stat_set("feed_pass.table_shards", ws.n_shards)
+            stat_set("feed_pass.rows_per_shard", ws.rows_per_shard)

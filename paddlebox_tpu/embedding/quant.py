@@ -90,6 +90,23 @@ def dequantize_rows_np(q: np.ndarray, scale: np.ndarray) -> np.ndarray:
     return q.astype(np.float32) * np.asarray(scale, np.float32)[:, None]
 
 
+def quantize_lanes(x: jnp.ndarray, storage: str
+                   ) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """Traced twin of quantize_rows_np over the LAST axis: (..., D) f32
+    → (q int8/int16 (..., D), scale f32 (...,)) with per-lane dynamic
+    scaling. The exchange's push-wire compression rides this so the
+    f32→(q, scale) rule stays in one place."""
+    dt, qm = _QINFO[storage]
+    scale = jnp.maximum(jnp.max(jnp.abs(x), axis=-1) / qm, 1e-12)
+    q = jnp.round(x / scale[..., None]).astype(dt)
+    return q, scale
+
+
+def dequantize_lanes(q: jnp.ndarray, scale: jnp.ndarray) -> jnp.ndarray:
+    """Inverse of quantize_lanes (up to the bounded rounding error)."""
+    return q.astype(jnp.float32) * scale[..., None]
+
+
 # ---------------------------------------------------------------------------
 # plane <-> full-f32-row conversions (host + traced)
 # ---------------------------------------------------------------------------

@@ -315,6 +315,13 @@ class PassWorkingSet:
     def padded_rows(self) -> int:
         return self.rows_per_shard * self.n_shards
 
+    def shard_of(self, idx: np.ndarray) -> np.ndarray:
+        """Owner mesh shard per working-set index (the contiguous
+        partition the exchange routes by: row i lives on shard
+        i // rows_per_shard). Host-side twin of the routing rule inside
+        ``sharded._route`` — the capacity preplan histograms off it."""
+        return np.asarray(idx) // self.rows_per_shard
+
     # ---- lifecycle ----
 
     @classmethod

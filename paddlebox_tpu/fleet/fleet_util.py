@@ -131,7 +131,15 @@ class FleetUtil:
         must skip the append when the last committed line already carries
         the same values for the ``dedup`` keys. Returns False on skip.
         The serving publisher announces versions through this too —
-        donefile discipline lives in ONE place."""
+        donefile discipline lives in ONE place.
+
+        An interrupted compaction (``rewrite_donefile``) is repaired
+        FIRST: a kill between the rewrite's rm and its put leaves only
+        the ``.compact`` staging copy, and appending then would recreate
+        the main file with one line, silently shadowing the whole
+        history (the exact hazard the PR-6 snapshot-mirror compaction
+        closed)."""
+        self._repair_compaction(name)
         last = self.latest(name)
         if last is not None and all(last.get(k) == entry.get(k)
                                     for k in dedup):
@@ -141,10 +149,73 @@ class FleetUtil:
                             json.dumps(entry) + "\n", append=True)
         return True
 
+    def rewrite_donefile(self, name: str,
+                         entries: list[dict[str, Any]]) -> None:
+        """Two-phase compacting rewrite: the full compacted content
+        lands in the ``.compact`` staging copy FIRST, then the main file
+        is replaced and the staging copy removed. Readers
+        (``_entries``) fall back to the staging copy in the rm→write
+        window and ``append_donefile`` repairs an interrupted rewrite
+        before extending — no kill point loses the donefile (the PR-6
+        ``snapshots.donefile`` discipline, exposed here so the serving
+        publisher's delta-chain compaction rides the ONE sanctioned
+        donefile writer)."""
+        path = os.path.join(self.root, name)
+        alt = f"{path}.compact"
+        content = "".join(json.dumps(e) + "\n" for e in entries)
+        self._fs.write_text(alt, content)
+        self._replace_main(path, content)
+        self._fs.rm(alt)
+        monitor.counter_add("fleet.donefile_compactions")
+        monitor.event("donefile_compacted", donefile=name,
+                      entries=len(entries))
+
+    def _replace_main(self, path: str, content: str) -> None:
+        """Land the rewritten main donefile. Local roots replace
+        atomically (tmp → fsync → os.replace: NO torn-main window at
+        all); remote roots keep the PR-6 rm→write sequence, whose only
+        exposure is the window readers cover via the ``.compact``
+        staging fallback."""
+        if self._remote:
+            if self._fs.exists(path):
+                self._fs.rm(path)
+            self._fs.write_text(path, content)
+            return
+        tmp = f"{path}.rewrite.{os.getpid()}"
+        with open(tmp, "w") as f:
+            f.write(content)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, path)
+
+    def _repair_compaction(self, name: str) -> None:
+        """Finish an interrupted rewrite_donefile: main file missing but
+        the ``.compact`` staging copy present → restore main from it."""
+        path = os.path.join(self.root, name)
+        alt = f"{path}.compact"
+        if self._fs.exists(path) or not self._fs.exists(alt):
+            return
+        content = "".join(ln if ln.endswith("\n") else ln + "\n"
+                          for ln in self._fs.read_lines(alt))
+        self._replace_main(path, content)
+        self._fs.rm(alt)
+        monitor.counter_add("fleet.donefile_repairs")
+        monitor.event("donefile_repaired", donefile=name)
+
+    def entries(self, donefile: str) -> list[dict[str, Any]]:
+        """All parseable entries of a donefile, in append order (public
+        form of the discovery walk — compaction policies read this)."""
+        return self._entries(donefile)
+
     def _entries(self, donefile: str) -> list[dict[str, Any]]:
         fname = os.path.join(self.root, donefile)
         if not self._fs.exists(fname):
-            return []
+            # mid-compaction window: the staging copy is the donefile
+            alt = f"{fname}.compact"
+            if self._fs.exists(alt):
+                fname = alt
+            else:
+                return []
         out = []
         for lineno, line in enumerate(self._fs.read_lines(fname), 1):
             line = line.strip()

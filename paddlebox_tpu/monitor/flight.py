@@ -65,6 +65,12 @@ def validate_flight_record(rec: dict) -> list[str]:
         for name, v in (rec.get(k) or {}).items():
             if not isinstance(v, numbers.Real):
                 errs.append(f"{k}[{name!r}] is not a number")
+    # the trainer's engine-identity envelope (pull_engine, table_layout,
+    # exchange_wire, …): optional, but when present it must be a flat
+    # JSON object — dashboards key off these fields verbatim
+    extra = rec.get("extra")
+    if extra is not None and not isinstance(extra, dict):
+        errs.append(f"extra is {type(extra).__name__}, not an object")
     return errs
 
 

@@ -63,7 +63,9 @@ class ServingPublisher:
                  publish_base_every: int | None = None,
                  quant: str = "int8", hot_top_k: int = 1024,
                  label_slot: str = "label", verify_upload: bool = True,
-                 staging_dir: str | None = None):
+                 staging_dir: str | None = None,
+                 compact_after: int = 256, keep_bases: int = 2,
+                 prune_artifacts: bool = True):
         if quant not in ("f32", "int8", "int16"):
             raise ValueError(f"quant must be f32|int8|int16, got {quant!r}")
         self._remote = fs_lib.is_remote(root)
@@ -84,10 +86,23 @@ class ServingPublisher:
         self.hot_top_k = int(hot_top_k)
         self.verify_upload = bool(verify_upload)
         self._staging = staging_dir
+        # delta-chain compaction policy: the donefile grows one line per
+        # pass forever — once it passes compact_after entries, rewrite
+        # it keeping the newest keep_bases bases and every entry after
+        # the oldest kept base (a delta's parent chain must stay
+        # discoverable; the extra base is the rot fallback the server's
+        # cold-start seek relies on). 0 = never auto-compact.
+        self.compact_after = int(compact_after)
+        self.keep_bases = max(1, int(keep_bases))
+        self.prune_artifacts = bool(prune_artifacts)
         # continue the version sequence across restarts: the donefile is
-        # the authority (local state died with the previous process)
-        last = self._fleet.latest(DONEFILE)
-        self._version = int(last["version"]) if last else 0
+        # the authority (local state died with the previous process).
+        # ONE read seeds both the version and the entry count the
+        # auto-compaction threshold tracks locally (re-reading the whole
+        # file per publish would put a full remote cat on the hot path)
+        entries = self._fleet.entries(DONEFILE)
+        self._version = int(entries[-1]["version"]) if entries else 0
+        self._entry_count = len(entries)
         # deltas need the retained previous plane — a restarted publisher
         # has none, so its first publish is always a fresh base
         self._last_pub: tuple[np.ndarray, np.ndarray] | None = None
@@ -179,6 +194,25 @@ class ServingPublisher:
                  "parent": parent, "path": target, "ts": int(time.time())}
         announced = self._fleet.append_donefile(DONEFILE, entry,
                                                 dedup=("version", "path"))
+        if announced:
+            self._entry_count += 1
+        if (is_base and self.compact_after > 0
+                and self._entry_count > self.compact_after):
+            # a compaction failure must not fail the publish — the
+            # donefile is merely longer than the policy wants. The
+            # locally-tracked count keeps the threshold check off the
+            # donefile, and the attempt is gated to BASE publishes:
+            # compaction keeps the newest keep_bases bases plus their
+            # tail, so only a new base can make more entries droppable —
+            # a delta-publish attempt would re-read the file and drop
+            # nothing, forever, whenever bases are sparser than the
+            # threshold (compact_donefile itself reads once and re-seeds
+            # the count; foreign writers skew it only until then).
+            try:
+                self.compact_donefile()
+            except Exception as e:
+                monitor.counter_add("serving.compaction_errors")
+                monitor.event("serving_compaction_error", error=repr(e))
 
         self._version = version
         self._deltas_since_base = 0 if is_base else \
@@ -229,6 +263,62 @@ class ServingPublisher:
 
     def latest_announced(self) -> dict | None:
         return self._fleet.latest(DONEFILE)
+
+    def compact_donefile(self, keep_bases: int | None = None) -> int:
+        """Bound ``serving_model.donefile`` growth: keep the newest
+        ``keep_bases`` base entries and EVERY entry after the oldest
+        kept base, drop the rest (and, with ``prune_artifacts``, their
+        now-unreferenced version directories). The serving semantics are
+        preserved exactly: a cold-starting server seeks the newest
+        loadable base and replays the deltas after it — everything
+        dropped is older than ``keep_bases`` bases, reachable only as a
+        deeper fallback. The rewrite is the two-phase ``.compact``
+        staging discipline (FleetUtil.rewrite_donefile — the PR-6
+        snapshot-mirror compaction): a kill between the main file's rm
+        and its rewrite leaves readers on the staging copy, and the next
+        append repairs it before extending. Returns entries dropped."""
+        keep_bases = (self.keep_bases if keep_bases is None
+                      else max(1, int(keep_bases)))
+        entries = self._fleet.entries(DONEFILE)
+        self._entry_count = len(entries)    # re-seed the local counter
+        base_idx = [i for i, e in enumerate(entries)
+                    if str(e.get("kind", "")) == "base"]
+        if len(base_idx) <= keep_bases:
+            return 0
+        cut = base_idx[-keep_bases]
+        kept, dropped = entries[cut:], entries[:cut]
+        if not dropped:
+            return 0
+        self._fleet.rewrite_donefile(DONEFILE, kept)
+        self._entry_count = len(kept)
+        monitor.counter_add("serving.donefile_compactions")
+        monitor.counter_add("serving.donefile_entries_dropped",
+                            len(dropped))
+        monitor.event("serving_donefile_compacted", type="lifecycle",
+                      kept=len(kept), dropped=len(dropped))
+        if self.prune_artifacts:
+            kept_names = {art.version_name(int(e["version"]))
+                          for e in kept if "version" in e}
+            for e in dropped:
+                try:
+                    name = art.version_name(int(e["version"]))
+                except (KeyError, TypeError, ValueError):
+                    continue            # foreign/malformed entry: leave it
+                if name in kept_names:
+                    continue
+                target = self._artifact_target(name)
+                try:
+                    if self._fs.exists(target):
+                        self._fs.rm(target)
+                        monitor.counter_add("serving.artifacts_pruned")
+                # retention is hygiene, not correctness — a pruning
+                # failure (OSError locally, RuntimeError from a remote
+                # CommandFS) is reported, never fatal, and never stops
+                # the rest of the prune
+                except Exception as err:
+                    monitor.event("serving_artifact_prune_error",
+                                  path=target, error=repr(err))
+        return len(dropped)
 
     def publish_if_behind(self, store, dense_params,
                           pass_id: int) -> dict | None:

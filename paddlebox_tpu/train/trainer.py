@@ -32,7 +32,7 @@ from paddlebox_tpu.config import flags as config_flags
 from paddlebox_tpu.data.schema import DataFeedSchema
 from paddlebox_tpu.data.slot_record import PackedBatch, SparseLayout
 from paddlebox_tpu.embedding import (EmbeddingConfig, HostEmbeddingStore,
-                                     PassWorkingSet, sharded)
+                                     PassWorkingSet, exchange, sharded)
 from paddlebox_tpu.embedding.feed_pass import FeedPassManager
 from paddlebox_tpu.embedding.working_set import PushOperandStager
 from paddlebox_tpu.metrics import auc as auc_lib
@@ -234,6 +234,23 @@ class Trainer:
             raise NotImplementedError(
                 "models with batch_extras support the allreduce "
                 "dense-sync mode only")
+        # Table-layout engine (flags.table_layout): which embedding
+        # exchange the step programs compile with. "sharded" routes the
+        # dedup plan's unique rows through embedding/exchange.py (wire-
+        # compressed push payload, per-shard fused pull after routing);
+        # "single" keeps the legacy token-level routed path. Trace-time
+        # static and recorded per bench point / flight record, like
+        # pull_engine.
+        self.table_layout = self._select_table_layout()
+        self.exchange_wire = (exchange.select_wire(self.store.cfg)
+                              if self.table_layout == "sharded" else None)
+        if (self.table_layout == "sharded"
+                and config_flags.exchange_capacity_factor > 0):
+            # operator-set starting capacity for the exchange lanes (the
+            # overflow policy still preplans/grows — never-silent drops)
+            self.cfg.capacity_factor = max(
+                self.cfg.capacity_factor,
+                float(config_flags.exchange_capacity_factor))
         # Pull engine: multi-hot/wide-dim layouts pool the pulled rows
         # per (example, slot) INSIDE the pull (fused gather-pool) so the
         # (B*T, pull_width) token matrix never crosses the model; the
@@ -241,12 +258,15 @@ class Trainer:
         self.pull_engine = self._select_pull_engine()
         # Host-side binned-push plan (native counting sort in the pack
         # pipeline) replaces the on-device argsort of the scatter-free
-        # push — single-shard TPU tables only (post-all_to_all tokens
-        # have no host plan); quantized storage rides the same merge acc
-        # and uses the plan too. Read at trace time like the kernels.
+        # push — single-shard TPU tables, plus the sharded exchange
+        # engine, whose all_to_all is KEYED off the plan's dedup bounds
+        # (unique lanes premerge before routing; post-a2a tokens carry
+        # no kernel windows). Read at trace time like the kernels.
         self._use_plan = (
-            self.n_shards == 1 and config_flags.binned_push
-            and jax.default_backend() == "tpu")
+            (self.n_shards == 1 and config_flags.binned_push
+             and jax.default_backend() == "tpu")
+            or (self.table_layout == "sharded"
+                and config_flags.pullpush_dedup_keys))
         # eval capacity can grow past the train factor (skewed eval-only
         # datasets) without ever touching the train step's compilation
         self._eval_capacity = self.cfg.capacity_factor
@@ -373,6 +393,10 @@ class Trainer:
         dedup = config_flags.pullpush_dedup_keys and self.n_shards > 1
         fused_pull = self.pull_engine == "fused_gather_pool"
         L_hot = T // num_slots if fused_pull else 0
+        # sharded exchange engine (flags.table_layout): plan-keyed a2a
+        # with the wire-compressed push payload (embedding/exchange.py)
+        sharded_x = self.table_layout == "sharded"
+        wire = self.exchange_wire
 
         def push_tail(tshard, flat_idx, sgrad, mask_l, labels_l, plan):
             """Push stage tail: deferred operands, ablated no-op, or the
@@ -389,6 +413,11 @@ class Trainer:
             show_inc = mask_l.reshape(-1).astype(jnp.float32)
             clk_inc = (mask_l.astype(jnp.float32)
                        * labels_l[:, None]).reshape(-1)
+            if sharded_x:
+                return exchange.routed_push(tshard, flat_idx, sgrad,
+                                            show_inc, clk_inc, emb_cfg,
+                                            axes, capf, wire=wire,
+                                            plan=plan)
             return sharded.routed_push(tshard, flat_idx, sgrad, show_inc,
                                        clk_inc, emb_cfg, axes, capf,
                                        dedup=dedup, plan=plan)
@@ -411,10 +440,17 @@ class Trainer:
                     pooled = lax.optimization_barrier(
                         jnp.zeros((B_l, num_slots, emb_cfg.pull_width),
                                   jnp.float32) + labels_l[0] * 0)
+                    dropped = jnp.zeros((), jnp.int32)
+                elif sharded_x:
+                    # route the unique rows once, pool per shard from
+                    # the received lanes (gather_pool after routing)
+                    pooled, dropped = exchange.routed_pull_pooled(
+                        tshard, idx_l, emb_cfg, axes, num_slots, L_hot,
+                        capf, plan=plan, return_dropped=True)
                 else:
                     pooled = sharded.fused_pull_pool(
                         tshard, idx_l, emb_cfg, num_slots, L_hot)
-                dropped = jnp.zeros((), jnp.int32)
+                    dropped = jnp.zeros((), jnp.int32)
 
                 def loss_fn(p, pooled_in):
                     logits = model.apply(p, PooledSlots(pooled_in), mask_l,
@@ -448,6 +484,10 @@ class Trainer:
                     jnp.zeros((B_l * T, emb_cfg.pull_width), jnp.float32)
                     + labels_l[0] * 0)
                 dropped = jnp.zeros((), jnp.int32)
+            elif sharded_x:
+                pulled, dropped = exchange.routed_pull(
+                    tshard, flat_idx, emb_cfg, axes, capf, plan=plan,
+                    dedup=dedup, return_dropped=True)
             else:
                 pulled, dropped = sharded.routed_lookup(
                     tshard, flat_idx, emb_cfg, axes, capf, dedup=dedup,
@@ -693,6 +733,8 @@ class Trainer:
         axes = tuple(self.mesh.axis_names)
         capf = cfg.capacity_factor
         dedup = config_flags.pullpush_dedup_keys and self.n_shards > 1
+        sharded_x = self.table_layout == "sharded"
+        wire = self.exchange_wire
         batch_spec = P(axes)
         tbl_sh = mesh_lib.table_sharding(self.mesh)
 
@@ -700,7 +742,13 @@ class Trainer:
                  uniq, segb, g0, g1, g2):
             if uniq.shape[0] and g1.shape[0]:
                 # the step already premerged onto the plan's unique lanes
-                # (deferred_push_operands); replay only the engine
+                # (deferred_push_operands); replay only the engine —
+                # through the exchange's wire-compressed route on the
+                # sharded engine, the local merge-update otherwise
+                if sharded_x:
+                    return exchange.routed_push(tshard, uniq, g0, g1, g2,
+                                                emb_cfg, axes, capf,
+                                                wire=wire, premerged=True)
                 kplan = ((None, rstart, endb) if rstart.shape[0]
                          else None)
                 return sharded.push(tshard, uniq, g0, g1, g2, emb_cfg,
@@ -711,6 +759,11 @@ class Trainer:
                        * labels_l[:, None]).reshape(-1)
             plan = ((order, rstart, endb, uniq, segb)
                     if order.shape[0] or uniq.shape[0] else None)
+            if sharded_x:
+                return exchange.routed_push(tshard, flat_idx, g0,
+                                            show_inc, clk_inc, emb_cfg,
+                                            axes, capf, wire=wire,
+                                            plan=plan)
             return sharded.routed_push(tshard, flat_idx, g0, show_inc,
                                        clk_inc, emb_cfg, axes, capf,
                                        dedup=dedup, plan=plan)
@@ -798,19 +851,33 @@ class Trainer:
         n_extras = self._n_extras
         fused_pull = self.pull_engine == "fused_gather_pool"
         L_hot = T // num_slots if fused_pull else 0
+        sharded_x = self.table_layout == "sharded"
 
         def body(tshard, idx_l, mask_l, dense_l, params, *extras_l):
             B_l = idx_l.shape[0]
             if fused_pull:
-                pooled = sharded.fused_pull_pool(tshard, idx_l, emb_cfg,
-                                                 num_slots, L_hot)
+                if sharded_x:
+                    # eval packs no plan: the pooled route dedups on
+                    # device, pools per shard from the received lanes
+                    pooled, fdrop = exchange.routed_pull_pooled(
+                        tshard, idx_l, emb_cfg, axes, num_slots, L_hot,
+                        capf, return_dropped=True)
+                else:
+                    pooled = sharded.fused_pull_pool(tshard, idx_l,
+                                                     emb_cfg, num_slots,
+                                                     L_hot)
+                    fdrop = jnp.zeros((), jnp.int32)
                 logits = model.apply(params, PooledSlots(pooled), mask_l,
                                      dense_l, seg, num_slots, *extras_l)
-                return (jax.nn.sigmoid(logits),
-                        lax.psum(jnp.zeros((), jnp.int32), axes))
-            pulled, dropped = sharded.routed_lookup(
-                tshard, idx_l.reshape(-1), emb_cfg, axes, capf,
-                dedup=dedup, return_dropped=True)
+                return jax.nn.sigmoid(logits), lax.psum(fdrop, axes)
+            pulled, dropped = (
+                exchange.routed_pull(tshard, idx_l.reshape(-1), emb_cfg,
+                                     axes, capf, dedup=dedup,
+                                     return_dropped=True)
+                if sharded_x else
+                sharded.routed_lookup(tshard, idx_l.reshape(-1), emb_cfg,
+                                      axes, capf, dedup=dedup,
+                                      return_dropped=True))
             pulled = pulled.reshape(B_l, T, emb_cfg.pull_width)
             logits = model.apply(params, pulled, mask_l, dense_l, seg,
                                  num_slots, *extras_l)
@@ -999,6 +1066,42 @@ class Trainer:
         empty = (Z,) * PLAN_ARITY
         if not self._use_plan:
             return empty
+        if self.table_layout == "sharded" and self.n_shards > 1:
+            # sharded exchange: the plan's dedup bounds key the a2a —
+            # unique lanes premerge before routing and each row crosses
+            # the wire once. The counting sort runs PER DEVICE over each
+            # device's contiguous batch slice (shard_map splits every
+            # plan array along dim 0, so lane positions must be local);
+            # no kernel windows — post-a2a tokens have no host plan.
+            from paddlebox_tpu.native.key_index import dedup_plan
+            D = self.n_shards
+            flat = idx.reshape(D, -1)
+            parts = [dedup_plan(flat[d], ws.padded_rows,
+                                ws.padded_rows, 1) for d in range(D)]
+            o = np.concatenate([p[0] for p in parts])
+            u = np.concatenate([p[1] for p in parts])
+            s = np.concatenate([p[2] for p in parts])
+            # uniq is ascending with out-of-range pads per device: the
+            # valid count is one searchsorted each, MINUS the NULL row's
+            # lane when present (index 0 sorts first; _route never sends
+            # it, so it must not count as wire traffic) — the dedup-
+            # ratio / wire accounting the flight record surfaces
+            # (exchange.* counter deltas)
+            u_count = int(sum(np.searchsorted(p[1], ws.padded_rows)
+                              - (1 if len(p[1]) and p[1][0] == 0 else 0)
+                              for p in parts))
+            ecfg = self.store.cfg
+            monitor.counter_add("exchange.tokens", idx.size)
+            monitor.counter_add("exchange.unique_lanes", u_count)
+            monitor.counter_add("exchange.pull_bytes",
+                                exchange.pull_wire_bytes(ecfg, u_count))
+            monitor.counter_add(
+                "exchange.push_bytes",
+                exchange.push_wire_bytes(ecfg, u_count,
+                                         self.exchange_wire))
+            monitor.counter_add("trainer.plan_tokens", idx.size)
+            monitor.counter_add("trainer.plan_unique_tokens", u_count)
+            return (o, Z, Z, u, s)
         from paddlebox_tpu.ops import pallas_kernels
         geom = pallas_kernels.binned_push_geometry(
             self.store.cfg, ws.padded_rows)
@@ -1019,6 +1122,37 @@ class Trainer:
         monitor.counter_add("trainer.plan_tokens", idx.size)
         monitor.counter_add("trainer.plan_unique_tokens", len(u))
         return (o, r, e, u, s) if geom is not None else (o, Z, Z, u, s)
+
+    def _select_table_layout(self) -> str:
+        """Which embedding exchange the step programs compile with
+        (flags.table_layout; trace-time static, recorded per bench
+        matrix point as ``table_layout`` — same discipline as
+        pull_engine).
+
+        "sharded" — the embedding/exchange.py subsystem over the mesh-
+        partitioned table: the host dedup plan keys the all_to_all
+        (each unique row crosses the wire once, its push payload
+        premerged BEFORE routing), the push grad plane crosses in
+        ``flags.exchange_wire`` format, and the fused gather-pool pull
+        runs per shard after routing. "auto" selects it on multi-device
+        TPU meshes; CPU test meshes keep the legacy token-level routed
+        path ("single") — its numerics are pinned by existing golden
+        trajectories — unless a test forces the engine.
+        """
+        tl = config_flags.table_layout
+        if tl not in ("auto", "single", "sharded"):
+            raise ValueError(f"table_layout={tl!r}")
+        if tl == "sharded":
+            if self.n_shards == 1:
+                raise ValueError(
+                    "flags.table_layout='sharded' needs a multi-device "
+                    "mesh — on one shard there is nothing to exchange")
+            return "sharded"
+        if tl == "single":
+            return "single"
+        return ("sharded" if (self.n_shards > 1
+                              and jax.default_backend() == "tpu")
+                else "single")
 
     def _select_pull_engine(self) -> str:
         """Which pull engine the step programs compile with (trace-time
@@ -1052,16 +1186,23 @@ class Trainer:
         uniform = (lay.num_slots > 0
                    and len(lay.slot_lens)
                    and np.all(lay.slot_lens == lay.slot_lens[0]))
-        compatible = (uniform and self.n_shards == 1
+        # multi-shard meshes support the fused engine through the
+        # sharded exchange only: the unique rows route once and the pool
+        # gathers from the received lanes (exchange.routed_pull_pooled —
+        # per-shard gather_pool after routing)
+        compatible = (uniform
+                      and (self.n_shards == 1
+                           or self.table_layout == "sharded")
                       and getattr(self.model, "pooled_pull_ok", False)
                       and sharded.fused_pull_supported(cfg))
         if not compatible:
             if fg == "on":
                 raise ValueError(
                     "flags.fused_gather_pool='on' needs a single-shard "
-                    "mesh, a uniform slot layout, a pooled-pull-capable "
-                    "model (pooled_pull_ok), and no create-threshold "
-                    "pull gating")
+                    "mesh (or the sharded exchange engine), a uniform "
+                    "slot layout, a pooled-pull-capable model "
+                    "(pooled_pull_ok), and no create-threshold pull "
+                    "gating")
             return "gather_seqpool"
         if fg == "on":
             return "fused_gather_pool"
@@ -1136,7 +1277,12 @@ class Trainer:
             loss_mean=out.get("loss_mean"), auc=out.get("auc"),
             routed_dropped=out.get("routed_dropped"),
             push_applies=(self.push_applies - applies0) or None,
-            pull_engine=self.pull_engine)
+            pull_engine=self.pull_engine,
+            # sharded exchange identity (the per-pass exchange traffic —
+            # bytes, dedup ratio, overflow drops — rides the flight
+            # record's stats_delta as exchange.* counter deltas)
+            table_layout=self.table_layout,
+            exchange_wire=self.exchange_wire)
         if owned_pass:
             hub.end_pass(metrics=metrics)
         return out
@@ -1463,8 +1609,14 @@ class Trainer:
         # needs no stamp — row assignment is by sorted-key rank, so an
         # unchanged dataset always translates identically.
         # Duck-typed: a dataset without num_examples just rescans.
+        # dedup routing (the sharded exchange's plan-keyed a2a, or the
+        # legacy device dedup) routes each UNIQUE token once per device:
+        # counting unique tokens sizes the lanes the wire actually
+        # carries — the factor (and the static buffers) shrink by the
+        # batch's duplication rate
+        dedup_route = (config_flags.pullpush_dedup_keys and n_dev > 1)
         n_ex = getattr(dataset, "num_examples", None)
-        memo_key = (n_ex, ws.padded_rows, drop_last,
+        memo_key = (n_ex, ws.padded_rows, drop_last, dedup_route,
                     getattr(dataset, "_records_version", None))
         memo = (getattr(dataset, "_pbtpu_preplan_need", None)
                 if n_ex is not None else None)
@@ -1472,7 +1624,6 @@ class Trainer:
             capf = memo[1]
         else:
             bpd = bs // n_dev
-            rps = ws.rows_per_shard
             T = self.layout.total_len
             n_local = bpd * T
             max_c = 0
@@ -1481,9 +1632,19 @@ class Trainer:
                 if len(pb.floats) < bs:   # eval tail: padded, not dropped
                     pb = pb.pad_to(bs)
                 idx = ws.translate(pb.ids, pb.mask)
+                if dedup_route:
+                    per_dev = idx.reshape(n_dev, bpd * T)
+                    for d in range(n_dev):
+                        u = np.unique(per_dev[d])
+                        u = u[u != 0]       # NULL tokens are never routed
+                        if len(u):
+                            c = np.bincount(ws.shard_of(u),
+                                            minlength=n_dev)
+                            max_c = max(max_c, int(c[:n_dev].max()))
+                    continue
                 # NULL tokens are never routed (_route); bucket them at
                 # n_dev so they fall out of the per-destination counts
-                owner = np.where(idx == 0, n_dev, idx // rps)
+                owner = np.where(idx == 0, n_dev, ws.shard_of(idx))
                 flat = (owner.reshape(n_dev, bpd * T) + dev_off).ravel()
                 counts = np.bincount(
                     flat, minlength=n_dev * (n_dev + 1)
@@ -1558,6 +1719,13 @@ class Trainer:
         monitor.event("routed_dropped", total=total, for_eval=for_eval)
         capf = (self._eval_capacity if for_eval
                 else self.cfg.capacity_factor)
+        if self.table_layout == "sharded":
+            # the exchange's own overflow accounting: NAMED counter +
+            # event so a lossy pass is alarmable, never a silent drop
+            # (the acceptance bar of the sharded scale-out issue)
+            monitor.counter_add("exchange.overflow_dropped", total)
+            monitor.event("exchange_overflow", total=total,
+                          capacity_factor=float(capf), for_eval=for_eval)
         msg = (f"{total} tokens exceeded all_to_all capacity this "
                f"{'eval ' if for_eval else ''}pass "
                f"(capacity_factor={capf}); their pulls returned zero "
@@ -1975,10 +2143,37 @@ class Trainer:
 
     def eval_pass(self, dataset) -> dict[str, float]:
         """Test-mode pass: no pushes, no dense updates, and the store is
-        neither grown nor dirtied by unseen keys (SetTestMode)."""
+        neither grown nor dirtied by unseen keys (SetTestMode).
+
+        Routed capacity overflow never poisons the returned numbers:
+        a pass that dropped tokens already grew the eval capacity
+        (``_check_dropped``'s adaptive doubling) and re-runs IN PLACE at
+        the grown factor — eval is pure, so the retry is free of side
+        effects, and the factor caps at n_shards where drops are
+        impossible. The trainer-level half of the exchange's
+        never-silent overflow policy (the train side is preplanned
+        lossless up front and doubles for its next pass)."""
         # flush-before-eval ordering (push_overlap): predictions must see
         # every trained row value; a pending deferred apply lands first
         self.flush_push()
+        out = self._eval_pass_once(dataset)
+        for attempt in range(8):      # capf doubles; n_shards cap ends it
+            if (not out["routed_dropped"]
+                    or config_flags.routed_drop_fatal
+                    or not config_flags.routed_drop_adapt):
+                break
+            faultpoint.hit("exchange.eval.pre_retry")
+            monitor.counter_add("exchange.overflow_retries")
+            monitor.event("exchange_overflow_retry", type="lifecycle",
+                          dropped=int(out["routed_dropped"]),
+                          capacity_factor=float(self._eval_capacity),
+                          attempt=attempt + 1)
+            out = self._eval_pass_once(dataset)
+        monitor.event("eval_pass", auc=float(out.get("auc", float("nan"))),
+                      routed_dropped=out["routed_dropped"])
+        return out
+
+    def _eval_pass_once(self, dataset) -> dict[str, float]:
         bs = self.cfg.global_batch_size
         ws = self.feed_mgr.begin_pass(dataset.unique_keys(), test_mode=True)
         self._preplan_capacity(dataset, ws, drop_last=False,
@@ -2006,9 +2201,8 @@ class Trainer:
             pack_it.close()
         out = auc_acc.compute()
         # drops poison eval predictions too — same non-silent policy,
-        # but adaptation stays on the eval program only
+        # but adaptation stays on the eval program only (and eval_pass
+        # re-runs this whole body at the grown factor)
         out["routed_dropped"] = self._check_dropped(dev_dropped,
                                                    for_eval=True)
-        monitor.event("eval_pass", auc=float(out.get("auc", float("nan"))),
-                      routed_dropped=out["routed_dropped"])
         return out

@@ -100,6 +100,21 @@ POINTS: tuple[str, ...] = (
     "serving.publish.pre_manifest",
     "serving.publish.pre_upload",
     "serving.publish.pre_donefile",
+    # sharded embedding exchange (ISSUE 10). exchange.store.* are the
+    # ShardedEmbeddingStore's save windows: pre_shard_save = about to
+    # write one shard's chain (earlier shards' files landed, the
+    # top-level shards.json still describes the previous save);
+    # pre_manifest = every shard saved, the top manifest not yet
+    # committed. A kill at either must roll the WHOLE save back — the
+    # restore replays each shard to the last committed manifest's seqs
+    # and the orphaned newer files are overwritten by the re-run.
+    "exchange.store.pre_shard_save",
+    "exchange.store.pre_manifest",
+    # trainer eval-overflow retry: a routed eval pass dropped tokens and
+    # is about to re-run at the grown capacity factor — dying here must
+    # leave nothing half-applied (eval is stateless; the point exists so
+    # the never-silent overflow retry path is ioerror-exercisable).
+    "exchange.eval.pre_retry",
 )
 
 # Points that fire only inside the elastic re-formation window: the
@@ -120,6 +135,17 @@ SERVING_POINTS: tuple[str, ...] = (
     "serving.publish.pre_manifest",
     "serving.publish.pre_upload",
     "serving.publish.pre_donefile",
+)
+
+# Points that fire only inside the sharded-exchange subsystem (the
+# ShardedEmbeddingStore save path and the trainer's eval-overflow
+# retry): the single-host training kill→resume matrix never saves a
+# sharded host store or drops routed tokens — they are covered by
+# tests/test_exchange.py instead.
+EXCHANGE_POINTS: tuple[str, ...] = (
+    "exchange.store.pre_shard_save",
+    "exchange.store.pre_manifest",
+    "exchange.eval.pre_retry",
 )
 
 

@@ -174,3 +174,12 @@ def test_bench_dryrun_smoke():
     assert out["serving"]["publish_seconds"] > 0
     assert out["serving"]["swap_pause_ms"] > 0
     assert out["serving"]["p99_ms"] > 0
+    # the sharded-exchange matrix points must exist with their identity
+    # fields (ISSUE 10): table_layout/exchange_wire/shard count recorded,
+    # dedup ratio measured — so sharded points enter the BENCH_BEST gate
+    # from day one
+    assert out["checks"]["sharded_fields"], out.get("sharded")
+    assert out["sharded"]["table_layout"] == "sharded"
+    assert out["sharded"]["exchange_wire"] == "f32"
+    assert out["sharded"]["table_shards"] == 2
+    assert 0 < out["sharded"]["dedup_ratio"] <= 1.0

@@ -139,7 +139,8 @@ def test_kill_resume_smoke(tmp_path, golden):
                           if p not in ("store.save_delta.pre_manifest",
                                        "remote_ckpt.download.pre")
                           and p not in faultpoint.ELASTIC_POINTS
-                          and p not in faultpoint.SERVING_POINTS])
+                          and p not in faultpoint.SERVING_POINTS
+                          and p not in faultpoint.EXCHANGE_POINTS])
 def test_kill_resume_matrix(point, tmp_path, golden):
     """Every registered fault point: kill there, resume, prove bit-identical
     dense params + table rows + metric state vs the uninterrupted run. The
@@ -194,12 +195,16 @@ def test_every_point_has_a_matrix_entry():
     they are covered by the elastic kill matrix (tests/test_elastic.py)
     instead; the serving publish points fire only in the publish path
     and are covered by the publish/swap kill matrix
-    (tests/test_serving.py). Both files carry the same closed-registry
-    guard."""
+    (tests/test_serving.py); the sharded-exchange points fire only in
+    the ShardedEmbeddingStore save / eval-overflow-retry paths and are
+    covered by tests/test_exchange.py. All carry the same
+    closed-registry guard."""
     assert (set(POINT_AFTER) | set(faultpoint.ELASTIC_POINTS)
-            | set(faultpoint.SERVING_POINTS) == set(faultpoint.POINTS))
+            | set(faultpoint.SERVING_POINTS)
+            | set(faultpoint.EXCHANGE_POINTS) == set(faultpoint.POINTS))
     assert not set(POINT_AFTER) & (set(faultpoint.ELASTIC_POINTS)
-                                   | set(faultpoint.SERVING_POINTS))
+                                   | set(faultpoint.SERVING_POINTS)
+                                   | set(faultpoint.EXCHANGE_POINTS))
 
 
 # ---------------------------------------------------------------------------

@@ -434,6 +434,88 @@ def test_serving_table_duplicate_key_error_names_keys():
 # ------------------------------------------------------- slow matrices
 
 
+# ------------------------------------------------- donefile compaction
+
+
+def _publish_passes(job, n):
+    ds, schema, store, model, tr, box, pub, root = job
+    box.end_pass(trainer=tr, publisher=pub)        # pass 1 (already trained)
+    for _ in range(n - 1):
+        box.begin_pass()
+        tr.train_pass(ds)
+        box.end_pass(trainer=tr, publisher=pub)
+    return os.path.join(root, DONEFILE)
+
+
+def test_donefile_compaction_keeps_serving_loadable(job):
+    """Delta-chain compaction: the donefile keeps the newest keep_bases
+    bases + everything after the oldest kept base; a cold-starting
+    server still loads the newest version off the compacted file, pruned
+    version dirs are reclaimed, and the version sequence continues."""
+    ds, schema, store, model, tr, box, pub, root = job
+    df = _publish_passes(job, 7)                   # base_every=2 → 4 bases
+    n_before = len(open(df).read().splitlines())
+    dropped = pub.compact_donefile(keep_bases=2)
+    lines = [json.loads(ln) for ln in open(df).read().splitlines()]
+    assert dropped > 0 and len(lines) == n_before - dropped
+    assert not os.path.exists(df + ".compact")     # two-phase completed
+    assert sum(1 for e in lines if e["kind"] == "base") == 2
+    # pruned artifacts gone, kept ones intact
+    kept_versions = {e["version"] for e in lines}
+    dirs = {n for n in os.listdir(root) if n.startswith("v-")}
+    assert dirs == {f"v-{v:06d}" for v in kept_versions}
+    srv = ServingServer(root, poll_s=0.05)
+    assert srv.poll_once() >= 1
+    assert srv.active is not None and srv.active.version == 7
+    srv.stop()
+    # the sequence continues across the rewrite
+    box.begin_pass()
+    tr.train_pass(ds)
+    info = box.end_pass(trainer=tr, publisher=pub)["publish"]
+    assert info["version"] == 8
+
+
+def test_donefile_compaction_auto_threshold(job):
+    """publish() auto-compacts once the donefile passes compact_after."""
+    ds, schema, store, model, tr, box, pub, root = job
+    pub.compact_after = 4
+    pub.keep_bases = 1
+    df = _publish_passes(job, 6)
+    lines = open(df).read().splitlines()
+    # bounded: at most compact_after + the entries after the kept base
+    assert len(lines) <= pub.compact_after
+    assert json.loads(lines[0])["kind"] == "base"
+
+
+def test_interrupted_compaction_append_repairs_first(job):
+    """A kill between the compaction's rm and rewrite leaves only the
+    .compact staging copy: reads fall back to it, and the NEXT append
+    restores the full history before extending — the main file is never
+    recreated with a single line (the PR-6 hazard, regression-tested on
+    the serving root)."""
+    ds, schema, store, model, tr, box, pub, root = job
+    df = _publish_passes(job, 3)
+    content = open(df).read()
+    # simulate the torn window: staging copy present, main file gone
+    with open(df + ".compact", "w") as f:
+        f.write(content)
+    os.remove(df)
+    # reads fall back to the staging copy
+    assert pub.latest_announced()["version"] == 3
+    srv = ServingServer(root, poll_s=0.05)
+    assert srv.poll_once() >= 1 and srv.active.version == 3
+    srv.stop()
+    # the next publish's append repairs the main file FIRST
+    box.begin_pass()
+    tr.train_pass(ds)
+    info = box.end_pass(trainer=tr, publisher=pub)["publish"]
+    assert info["version"] == 4
+    final = open(df).read().splitlines()
+    assert len(final) == len(content.splitlines()) + 1
+    assert not os.path.exists(df + ".compact")
+    assert json.loads(final[-1])["version"] == 4
+
+
 def _run_worker(root, out, env_extra=None, check=True):
     env = dict(os.environ, JAX_PLATFORMS="cpu")
     env.pop("PBTPU_FAULTPOINT", None)
