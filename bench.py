@@ -130,6 +130,14 @@ def collect_gate_metrics(eps_chip: float, detail: dict) -> dict:
         for k in ("publish_seconds", "swap_pause_ms", "p99_ms"):
             if isinstance(srv.get(k), (int, float)):
                 m[f"serving.{k}"] = srv[k]
+    sp = (detail.get("matrix") or {}).get("spill_10x")
+    if isinstance(sp, dict):
+        # tiered-table point: cold-tier fetch throughput + the hot-tier
+        # hit rate the admission policy holds under the 10x working set
+        # (both higher-is-better; gate-held like every other point)
+        for k in ("fetch_keys_per_s", "hot_hit_rate"):
+            if isinstance(sp.get(k), (int, float)):
+                m[f"spill_10x.{k}"] = sp[k]
     e2e = detail.get("e2e")
     if isinstance(e2e, dict) and "examples_per_sec_per_chip" in e2e:
         m["e2e_eps"] = e2e["examples_per_sec_per_chip"]
@@ -1056,6 +1064,106 @@ def serving_drill(small: bool, tiny: bool = False) -> dict:
             "swapped_to_version": srv.active.version}
 
 
+def spill_drill(small: bool, tiny: bool = False) -> dict:
+    """Tiered-table drill (ISSUE 11): a working set >= 10x the RAM
+    row-cache budget through the sharded+spill path — 2 hash-partitioned
+    shards, each a SpillEmbeddingStore (memmap row file + capped RAM
+    cache), the configuration ``flags.table_tiering=spill`` selects.
+
+    Four passes of skewed traffic (a hot set re-read every pass under a
+    rotating cold scan that floods every direct-mapped slot — the
+    Parallax skew argument) run TWICE on identical key sequences: once
+    under the show-count-weighted admission policy (``freq``, the
+    product) and once under the legacy direct-mapped last-wins install
+    (``direct``, the baseline bench_spill.py records). The drill records
+    both hot-tier hit rates side by side — the acceptance bar is the
+    policy's rate beating the baseline's on the same traffic — plus the
+    admission/eviction counters, the dedup ratio of the simulated token
+    stream, and the cold-tier fetch throughput (gate-held)."""
+    import tempfile as _tf
+    import time as _t
+    from paddlebox_tpu.embedding import (EmbeddingConfig,
+                                         ShardedEmbeddingStore)
+    from paddlebox_tpu.embedding.tiering import (end_pass_rebalance,
+                                                 shard_store_factory,
+                                                 spill_stats)
+
+    n_shards = 2
+    cache_rows = 128 if tiny else (1 << 11 if small else 1 << 15)
+    budget = n_shards * cache_rows          # total RAM hot-tier rows
+    n_keys = budget * 10                    # the >=10x working set
+    n_hot = budget // 2
+    n_cold = budget * 2                     # per pass: floods every slot
+    passes = 4
+    cfg = EmbeddingConfig(dim=8, optimizer="adagrad", learning_rate=0.05)
+
+    def key_window(lo, hi):
+        return (np.arange(lo, hi, dtype=np.uint64)
+                * np.uint64(2654435761) + np.uint64(1))
+
+    hot = key_window(0, n_hot)
+    results: dict = {}
+    with _tf.TemporaryDirectory(prefix="pbtpu_spill_drill_") as td:
+        for policy in ("freq", "direct"):
+            ss = ShardedEmbeddingStore(
+                cfg, n_shards,
+                store_factory=shard_store_factory(
+                    tiering="spill", cache_rows=cache_rows,
+                    spill_dir=os.path.join(td, policy), policy=policy))
+            # build: the whole key space lands on the spill tier first
+            # (LoadSSD2Mem's table, bigger than the hot tier by 10x)
+            chunk = 1 << 18
+            for lo in range(0, n_keys, chunk):
+                ss.lookup_or_init(key_window(lo, min(n_keys, lo + chunk)))
+            hot_hits_last = 0
+            fetch_s = 0.0
+            for p in range(passes):
+                cold_lo = n_hot + (p * n_cold) % (n_keys - n_hot - n_cold)
+                cold = key_window(cold_lo, cold_lo + n_cold)
+                h0 = sum(s.cache_hits for s in ss._shards)
+                t0 = _t.perf_counter()
+                rows = ss.lookup_or_init(hot)
+                hot_hits_last = sum(s.cache_hits
+                                    for s in ss._shards) - h0
+                cr = ss.lookup_or_init(cold)
+                fetch_s = _t.perf_counter() - t0
+                # train-like write-back: hot rows accumulate real shows
+                # (the admission weight), cold ones one impression each
+                rows[:, 0] += 4.0
+                ss.write_back(hot, rows)
+                cr[:, 0] += 1.0
+                ss.write_back(cold, cr)
+                end_pass_rebalance(ss)      # the pass-boundary re-score
+            st = spill_stats(ss)
+            results[policy] = {
+                "hot_hit_rate": round(hot_hits_last / n_hot, 4),
+                "hit_rate": st["hit_rate"],
+                "admitted": st["admitted"], "evicted": st["evicted"],
+                "spill_bytes": st["spill_bytes"],
+                "fetch_keys_per_s": round((n_hot + n_cold) / fetch_s),
+            }
+    f, d = results["freq"], results["direct"]
+    # simulated token stream of the last pass: hot keys appear 4x (their
+    # show increment), cold once — what the exchange would dedup
+    tokens = 4 * n_hot + n_cold
+    return {
+        "table_tiering": "spill", "table_shards": n_shards,
+        "tier_policy": "freq", "cache_rows": int(cache_rows),
+        "cache_budget_rows": int(budget),
+        "working_set_keys": int(n_keys),
+        "ws_over_cache": round(n_keys / budget, 1),
+        "passes": passes,
+        "dedup_ratio": round((n_hot + n_cold) / tokens, 4),
+        "hot_hit_rate": f["hot_hit_rate"],
+        "direct_hot_hit_rate": d["hot_hit_rate"],
+        "hit_rate": f["hit_rate"], "direct_hit_rate": d["hit_rate"],
+        "admitted": f["admitted"], "evicted": f["evicted"],
+        "direct_evicted": d["evicted"],
+        "spill_bytes": f["spill_bytes"],
+        "fetch_keys_per_s": f["fetch_keys_per_s"],
+    }
+
+
 def _run_sharded_probe(small: bool, tiny: bool = False) -> dict:
     """Run the sharded-exchange matrix points in a 2-virtual-device CPU
     subprocess (``--sharded-probe``): a single-device environment cannot
@@ -1186,6 +1294,29 @@ def dryrun_main() -> int:
         and sdrill.get("p99_ms", 0) > 0
         and sdrill.get("failures") == 0
         and sdrill.get("swapped_to_version") == 2)
+    # tiered-table drill rides the dryrun too (ISSUE 11): the spill_10x
+    # point must carry a working set >= 10x the RAM cache budget through
+    # the sharded+spill path, with the tier identity + cache budget +
+    # dedup ratio recorded, and the show-count-weighted admission policy
+    # must beat the direct-mapped baseline's hot-tier hit rate on the
+    # same traffic — before a chip round ever records the point
+    try:
+        spd = spill_drill(True, tiny=True)
+    except Exception as e:
+        spd = {"error": repr(e)}
+    detail.setdefault("matrix", {})["spill_10x"] = spd
+    checks["spill_fields"] = (
+        spd.get("table_tiering") == "spill"
+        and spd.get("table_shards") == 2
+        and isinstance(spd.get("cache_rows"), int)
+        and spd.get("working_set_keys", 0)
+        >= 10 * spd.get("cache_budget_rows", 1 << 30)
+        and isinstance(spd.get("dedup_ratio"), float)
+        and 0 < spd["dedup_ratio"] <= 1
+        and isinstance(spd.get("fetch_keys_per_s"), int)
+        and spd.get("hot_hit_rate", 0.0)
+        > spd.get("direct_hot_hit_rate", 1.0)
+        and spd.get("evicted", 1 << 30) < spd.get("direct_evicted", 0))
     # sharded-exchange points ride the dryrun too (ISSUE 10): the 2-
     # virtual-device probe must produce the sharded matrix points with
     # table_layout / exchange_wire / table_shards recorded and a real
@@ -1267,6 +1398,9 @@ def dryrun_main() -> int:
         "serving": {k: sdrill.get(k) for k in
                     ("publish_seconds", "swap_pause_ms", "p99_ms",
                      "error") if k in sdrill},
+        "spill": {k: spd.get(k) for k in
+                  ("hot_hit_rate", "direct_hot_hit_rate",
+                   "fetch_keys_per_s", "error") if k in spd},
         "overlap_ab": attr.get("overlap_ab"),
         "stages": attr.get("stages"),
         "gate_example_lines": g1.get("lines"),
@@ -1413,6 +1547,12 @@ def main() -> None:
                      if k in detail["matrix"]["serving"]}
                     if isinstance(detail.get("matrix", {}).get("serving"),
                                   dict) else None),
+        "spill": ({k: detail["matrix"]["spill_10x"].get(k) for k in
+                   ("hot_hit_rate", "direct_hot_hit_rate",
+                    "fetch_keys_per_s", "ws_over_cache", "error")
+                   if k in detail["matrix"]["spill_10x"]}
+                  if isinstance(detail.get("matrix", {}).get("spill_10x"),
+                                dict) else None),
         "host_feed_cap_eps": (detail.get("host", {}).get(
             "derived_max_feed_eps_per_chip")
             if isinstance(detail.get("host"), dict) else None),
@@ -1599,6 +1739,15 @@ def _enrich(small: bool, detail: dict, ctx: dict,
                 if "error" in probe:
                     matrix["sharded_wire_f32"] = {"error": probe["error"]}
                 _mark("matrix sharded probe done")
+        if os.environ.get("PBTPU_BENCH_SPILL", "1") != "0":
+            # tiered-table drill: the sharded+spill path under a working
+            # set >= 10x the RAM cache budget, admission policy vs the
+            # direct-mapped baseline — gate-held like every other point
+            try:
+                matrix["spill_10x"] = spill_drill(small)
+            except Exception as e:
+                matrix["spill_10x"] = {"error": repr(e)}
+            _mark("matrix point spill_10x done")
         if os.environ.get("PBTPU_BENCH_ELASTIC", "1") != "0":
             # elastic rank-loss drill: world_resize_seconds + the
             # degraded (N−1) throughput point, gate-held like the rest

@@ -14,7 +14,15 @@ Host-only (tunnel-immune). Writes ONE JSON line (and SPILL_r05.json when
     file's page cache (clean memmap pages are reclaimable OS cache, not
     working memory — the drop shows the floor is real)
 
-Usage: python bench_spill.py [--keys 50000000] [--out SPILL_r05.json]
+``--policy`` selects the RAM-tier admission policy: ``freq`` (the
+show-count-weighted tier manager, embedding/tiering.py — the default)
+or ``direct`` (the legacy direct-mapped last-wins install, kept as the
+measured baseline the gate-held ``spill_10x`` bench point compares
+against). Per-pass hit rates and the admission/eviction counters are
+recorded either way.
+
+Usage: python bench_spill.py [--keys 50000000] [--policy freq|direct]
+                             [--out SPILL_r05.json]
 """
 
 from __future__ import annotations
@@ -82,17 +90,20 @@ def main() -> None:
     ap.add_argument("--keys", type=int, default=50_000_000)
     ap.add_argument("--pass-keys", type=int, default=4_000_000)
     ap.add_argument("--cache-rows", type=int, default=1 << 21)  # ~109MB
+    ap.add_argument("--policy", choices=("freq", "direct"), default="freq")
     ap.add_argument("--out", default=None)
     args = ap.parse_args()
 
     cfg = EmbeddingConfig(dim=8, optimizer="adagrad", learning_rate=0.05)
     store = SpillEmbeddingStore(cfg, cache_rows=args.cache_rows,
-                                initial_capacity=args.keys + 1024)
+                                initial_capacity=args.keys + 1024,
+                                tier_policy=args.policy)
     rng = np.random.default_rng(0)
     out = {
         "metric": "spill_store_50m_key_scale",
         "total_keys": args.keys,
         "row_width": cfg.row_width,
+        "tier_policy": args.policy,
         "ram_cache_rows": args.cache_rows,
         "ram_cache_mb": round(args.cache_rows * cfg.row_width * 4 / 1e6,
                               1),
@@ -139,15 +150,24 @@ def main() -> None:
         t1 = time.perf_counter()
         store.write_back(keys, rows)
         wb_s = time.perf_counter() - t1
+        # the pass-boundary re-evaluation the training loop would run
+        # (decay + cold-slot demotion + counter flush)
+        tier_stats = store.tier_end_pass()
         mb = rows.nbytes / 1e6
+        hits = int(store.cache_hits - h0)
+        misses = int(store.cache_misses - m0)
         passes.append({
             "keys": int(len(keys)),
             "fetch_seconds": round(fetch_s, 2),
             "fetch_keys_per_s": round(len(keys) / fetch_s),
             "fetch_mb_per_s": round(mb / fetch_s, 1),
             "writeback_mb_per_s": round(mb / wb_s, 1),
-            "cache_hits": int(store.cache_hits - h0),
-            "cache_misses": int(store.cache_misses - m0),
+            "cache_hits": hits,
+            "cache_misses": misses,
+            "hit_rate": round(hits / max(1, hits + misses), 4),
+            "tier_admitted": int(tier_stats["admitted"]),
+            "tier_evicted": int(tier_stats["evicted"]),
+            "tier_hot_rows": int(tier_stats["hot_rows"]),
             "pre_pass_cache_drop_ok": bool(drop_ok),
         })
     out["passes"] = passes

@@ -80,6 +80,7 @@ class Project:
         "paddlebox_tpu/utils/pass_ckpt.py",
         "paddlebox_tpu/serving/artifact.py",
         "paddlebox_tpu/embedding/store.py",
+        "paddlebox_tpu/embedding/spill_store.py",
         "paddlebox_tpu/data/archive.py",
         "paddlebox_tpu/fleet/",
     )

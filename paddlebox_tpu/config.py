@@ -163,6 +163,23 @@ class Flags:
     # adaptively doubled for the next pass, and eval passes re-run
     # in place at the grown factor (exchange.eval.pre_retry).
     exchange_capacity_factor: float = 0.0   # (new)
+    # --- tiered table: SSD + host-RAM + HBM (embedding/tiering.py) ---
+    # Storage tier of the host table (and of every shard of a
+    # ShardedEmbeddingStore built through tiering.store_from_flags /
+    # shard_store_factory): "off" = in-RAM HostEmbeddingStore (capacity
+    # bounded by host DRAM), "spill" = SpillEmbeddingStore (memory-mapped
+    # row file — the BoxPS SSD tier, LoadSSD2Mem box_wrapper.h:487-494 —
+    # under a show-count-weighted RAM row cache). The tier is a storage
+    # choice, not a math change: training is bit-identical either way.
+    table_tiering: str = "off"              # (new)
+    # RAM row-cache slots per spill-backed (sub-)store: the host-DRAM hot
+    # tier's budget. Rule of thumb: size it to the per-pass working set's
+    # hot fraction (row bytes = cache_rows * row_width * 4 per shard).
+    spill_cache_rows: int = 1 << 16         # (new)
+    # Root directory for spill row files ("" = a fresh temp dir per
+    # store); sharded stores put shard s under <spill_dir>/shard-SS.
+    spill_dir: str = ""                     # (new)
+
     # _bp_pack width-class engine override for A/B runs: "auto" selects
     # per payload width (narrow < 14 lanes reorders at logical width and
     # pads after; gather-zone 14..63 pads to 64 lanes BEFORE the reorder

@@ -10,47 +10,108 @@ store was a pure in-RAM numpy arena, bounding table capacity by host DRAM.
 file** (the SSD tier — capacity bounded by disk) plus a fixed-size
 **direct-mapped RAM row cache** (the host-DRAM hot tier). Reads come from
 the cache when warm and fault in from the file otherwise; writes go
-through to the file (the authoritative tier) and refresh the cache. The
-pass-granular access pattern does the LoadSSD2Mem job implicitly: a
-working-set build (`lookup_or_init` over the pass's keys) pulls exactly
-the pass's rows through the cache.
+through to the file (the authoritative tier) and install into the cache.
+Cache placement is driven by the tier manager
+(:class:`~paddlebox_tpu.embedding.tiering.TierManager`): admission and
+victim selection are show-count-weighted off the observed per-row
+traffic, re-scored at every pass boundary (``tier_end_pass``), so a cold
+scan can never thrash the hot rows out of RAM — the direct-mapped "last
+wins" install survives only as the measured ``tier_policy="direct"``
+baseline. The pass-granular access pattern does the LoadSSD2Mem job
+implicitly: a working-set build (`lookup_or_init` over the pass's keys)
+pulls exactly the pass's rows through the cache.
 
-Everything else — key index, dirty/tombstone tracking, save_base/
-save_delta/load, shrink, flush hooks — is inherited unchanged from
+Checkpointing: base/delta payloads **stream from the memmap in bounded
+chunks** (``_save_base_payload``/``_save_delta_payload`` — the full row
+plane never materializes in RAM, so a disk-bounded table checkpoints in
+a DRAM-bounded footprint), behind the ``tiering.save.pre_flush``
+faultpoint. Everything else — key index, dirty/tombstone tracking,
+chain manifests, load, shrink, flush hooks — is inherited unchanged from
 HostEmbeddingStore; the two stores are bit-for-bit interchangeable (the
-parity test trains the same model on both and compares trajectories).
+parity tests train the same model on both and compare trajectories).
 
 RAM budget: the key index (~16B/key) and per-row bookkeeping stay in RAM
 by design — same trade as the reference, whose PS keeps its key agent
-resident; the 4-byte/row dirty+cache metadata is small next to the index.
+resident; the 12B/row tier-manager signals + 4B/row dirty metadata are
+small next to the index.
 """
 
 from __future__ import annotations
 
 import os
 import tempfile
+import zipfile
 
 import numpy as np
+from numpy.lib import format as npy_format
 
 from paddlebox_tpu.embedding.config import EmbeddingConfig
 from paddlebox_tpu.embedding.store import HostEmbeddingStore
+from paddlebox_tpu.embedding.tiering import TierManager
+from paddlebox_tpu.monitor import counter_add, gauge_set
+from paddlebox_tpu.utils import faultpoint
+
+# rows per chunk of a streamed base/delta payload: bounds the resident
+# footprint of a checkpoint save to chunk * row_width * 4 bytes
+_STREAM_CHUNK_ROWS = 1 << 16
+
+
+def _write_rows_npz(f, keys: np.ndarray, rows_src, idx: np.ndarray | None,
+                    n_rows: int, removed: np.ndarray | None = None) -> None:
+    """np.savez_compressed-compatible archive (members ``keys.npy`` /
+    ``rows.npy`` [/ ``removed.npy``]) with the row plane streamed from
+    ``rows_src`` (the memmap) in bounded chunks — ``idx=None`` streams
+    the leading ``n_rows`` rows (base), an index vector gathers the
+    dirty rows chunk by chunk (delta). ``np.load`` reads it exactly like
+    the savez output it replaces."""
+    with zipfile.ZipFile(f, "w", zipfile.ZIP_DEFLATED) as zf:
+        small = [("keys.npy", np.ascontiguousarray(keys))]
+        if removed is not None:
+            small.append(("removed.npy", np.ascontiguousarray(removed)))
+        for name, arr in small:
+            # force_zip64 on EVERY member, like np.savez does: a >4GiB
+            # keys plane (~537M uint64 keys — the scale this tier is
+            # for) would otherwise abort the save at member close
+            with zf.open(name, "w", force_zip64=True) as m:
+                npy_format.write_array(m, arr, allow_pickle=False)
+        with zf.open("rows.npy", "w", force_zip64=True) as m:
+            npy_format.write_array_header_1_0(
+                m, {"descr": npy_format.dtype_to_descr(
+                        np.dtype(np.float32)),
+                    "fortran_order": False,
+                    "shape": (int(n_rows), int(rows_src.shape[1]))})
+            for lo in range(0, int(n_rows), _STREAM_CHUNK_ROWS):
+                hi = min(int(n_rows), lo + _STREAM_CHUNK_ROWS)
+                chunk = (rows_src[lo:hi] if idx is None
+                         else rows_src[idx[lo:hi]])
+                m.write(np.ascontiguousarray(
+                    chunk, dtype=np.float32).tobytes())
 
 
 class SpillEmbeddingStore(HostEmbeddingStore):
     _rows_persistent = True    # the row file keeps its bytes across grows
 
     def __init__(self, cfg: EmbeddingConfig, spill_dir: str | None = None,
-                 cache_rows: int = 1 << 16, initial_capacity: int = 1024):
+                 cache_rows: int = 1 << 16, initial_capacity: int = 1024,
+                 tier_policy: str = "freq"):
         self._spill_dir = spill_dir or tempfile.mkdtemp(prefix="pbtpu_spill_")
         os.makedirs(self._spill_dir, exist_ok=True)
         self._rows_path = os.path.join(self._spill_dir, "rows.dat")
         self._cache_slots = max(1, int(cache_rows))
-        # direct-mapped cache: slot = row_id % cache_slots
+        # direct-mapped cache: slot = row_id % cache_slots; WHAT occupies
+        # a slot is the tier manager's call (frequency-aware admission)
         self._ctags = np.full(self._cache_slots, -1, dtype=np.int64)
         self._cdata = np.zeros((self._cache_slots, cfg.row_width),
                                dtype=np.float32)
         self.cache_hits = 0
         self.cache_misses = 0
+        # spill.cache_* counter deltas batched here and flushed once per
+        # pass boundary (tier_end_pass) — the hub never sits on the
+        # per-read hot path
+        self._stat_hits = 0
+        self._stat_misses = 0
+        self.tier = TierManager(max(initial_capacity, 1),
+                                policy=tier_policy)
         super().__init__(cfg, initial_capacity)
 
     # ---- storage hooks -------------------------------------------------
@@ -66,8 +127,30 @@ class SpillEmbeddingStore(HostEmbeddingStore):
         if cur < nbytes:
             with open(self._rows_path, "r+b") as f:
                 f.truncate(nbytes)
+        self.tier.ensure_capacity(capacity)
         return np.memmap(self._rows_path, dtype=np.float32, mode="r+",
                          shape=(capacity, w))
+
+    def _install(self, idx: np.ndarray, slot: np.ndarray,
+                 rows: np.ndarray) -> None:
+        """Frequency-aware cache install: each candidate contests its
+        direct-mapped slot's occupant through the tier manager (ties →
+        the newcomer, a strictly hotter resident stays)."""
+        adm = self.tier.admit(idx, self._ctags[slot])
+        if not adm.any():
+            return
+        s_a, i_a, r_a = slot[adm], idx[adm], rows[adm]
+        if len(s_a) > 1:
+            # batch-internal slot collisions: the LAST admitted
+            # candidate per slot wins, and the counters count each slot
+            # once (not once per colliding candidate)
+            uniq, rev = np.unique(s_a[::-1], return_index=True)
+            pos = len(s_a) - 1 - rev
+            s_a, i_a, r_a = s_a[pos], i_a[pos], r_a[pos]
+        self.tier.count_install(len(s_a),
+                                int((self._ctags[s_a] >= 0).sum()))
+        self._ctags[s_a] = i_a
+        self._cdata[s_a] = r_a
 
     def _read_rows(self, idx: np.ndarray) -> np.ndarray:
         idx = np.asarray(idx, dtype=np.int64)
@@ -76,39 +159,100 @@ class SpillEmbeddingStore(HostEmbeddingStore):
         hit = self._ctags[slot] == idx
         out[hit] = self._cdata[slot[hit]]
         miss = ~hit
+        self.tier.note_access(idx)
         if miss.any():
             mi = idx[miss]
             rows = np.asarray(self._rows[mi])       # disk-tier read
             out[miss] = rows
-            ms = slot[miss]
-            self._ctags[ms] = mi                    # install (last wins)
-            self._cdata[ms] = rows
-        self.cache_hits += int(hit.sum())
-        self.cache_misses += int(miss.sum())
-        # spill-tier activity rolls into the per-pass flight record
-        from paddlebox_tpu.monitor import counter_add
-        counter_add("spill.cache_hits", int(hit.sum()))
-        counter_add("spill.cache_misses", int(miss.sum()))
+            self._install(mi, slot[miss], rows)
+        nh, nm = int(hit.sum()), int(miss.sum())
+        self.cache_hits += nh
+        self.cache_misses += nm
+        self._stat_hits += nh
+        self._stat_misses += nm
         return out
 
     def _write_rows(self, idx: np.ndarray, rows: np.ndarray) -> None:
         idx = np.asarray(idx, dtype=np.int64)
+        rows = np.asarray(rows, dtype=np.float32)
         self._rows[idx] = rows                      # write-through to disk
+        # the write-through hands us each row's show/clk counters
+        # (columns 0/1) for free — the show-count weight of the
+        # admission score, clicks counting on top of impressions
+        self.tier.note_written(idx, rows[:, 0] + rows[:, 1])
         slot = idx % self._cache_slots
-        hit = self._ctags[slot] == idx
+        occ = self._ctags[slot]
+        hit = occ == idx
         if hit.any():
             self._cdata[slot[hit]] = rows[hit]
+        miss = ~hit
+        if miss.any():
+            # a just-written row installs into its slot (it used to only
+            # refresh HITS, so a just-trained hot row faulted back in
+            # from disk on its next read); admission is still
+            # score-contested so cold write-backs cannot thrash the tier
+            self._install(idx[miss], slot[miss], rows[miss])
 
     def _rows_compacted(self) -> None:
-        # shrink/remove reassigned row ids; cached tags are meaningless
+        # shrink/remove reassigned row ids; cached tags and per-row tier
+        # signals are meaningless
         self._ctags[:] = -1
+        self.tier.invalidate()
 
-    # ---- persistence extras -------------------------------------------
+    # ---- pass-boundary re-evaluation (the tier manager's clock) --------
 
-    def save_base(self, path: str) -> str:
-        out = super().save_base(path)
+    def tier_end_pass(self) -> dict:
+        """Re-score placement off this pass's traffic: decay the
+        cross-pass EMA, demote cached rows that went cold (their slot
+        then admits without a contest), and flush the batched tiering
+        telemetry so the deltas land in this pass's flight record.
+        Crash window ``tiering.evict.pre``: the cache is never
+        authoritative, so dying anywhere in here must leave resume
+        bit-exact (kill-matrix proven)."""
+        faultpoint.hit("tiering.evict.pre")
+        stats = self.tier.end_pass()
+        demoted = 0
+        if self.tier.policy == "freq":
+            live = np.flatnonzero(self._ctags >= 0)
+            if len(live):
+                cold = self.tier.score(self._ctags[live]) \
+                    < self.tier.evict_below
+                demoted = int(cold.sum())
+                if demoted:
+                    self._ctags[live[cold]] = -1
+        if demoted:
+            self.tier.total_evicted += demoted
+            stats["evicted"] += demoted
+        if stats["admitted"]:
+            counter_add("tiering.admitted", stats["admitted"])
+        if stats["evicted"]:
+            counter_add("tiering.evicted", stats["evicted"])
+        hot = int((self._ctags >= 0).sum())
+        gauge_set("tiering.hot_rows", hot)
+        gauge_set("tiering.spill_bytes", self.spill_file_bytes)
+        if self._stat_hits:
+            counter_add("spill.cache_hits", self._stat_hits)
+            self._stat_hits = 0
+        if self._stat_misses:
+            counter_add("spill.cache_misses", self._stat_misses)
+            self._stat_misses = 0
+        stats["hot_rows"] = hot
+        stats["spill_bytes"] = int(self.spill_file_bytes)
+        return stats
+
+    # ---- persistence: stream from the memmap ---------------------------
+
+    def _save_base_payload(self, f) -> None:
+        faultpoint.hit("tiering.save.pre_flush")
         self._rows.flush()                          # msync the spill file
-        return out
+        _write_rows_npz(f, self._keys[:self._n], self._rows, None, self._n)
+
+    def _save_delta_payload(self, f, keys: np.ndarray, idx: np.ndarray,
+                            removed: np.ndarray) -> None:
+        faultpoint.hit("tiering.save.pre_flush")
+        self._rows.flush()
+        _write_rows_npz(f, keys, self._rows, idx, len(idx),
+                        removed=removed)
 
     @property
     def spill_dir(self) -> str:

@@ -321,8 +321,7 @@ class HostEmbeddingStore:
                     fname,
                     fault_point="store.save_base.pre_replace") as tmp:
                 with open(tmp, "wb") as f:
-                    np.savez_compressed(f, keys=self._keys[:self._n],
-                                        rows=self._rows[:self._n])
+                    self._save_base_payload(f)
             self._save_seq = 0
             self._save_count += 1
             self._write_meta(path)
@@ -353,8 +352,7 @@ class HostEmbeddingStore:
                     fname,
                     fault_point="store.save_delta.pre_replace") as tmp:
                 with open(tmp, "wb") as f:
-                    np.savez_compressed(f, keys=keys, rows=self._rows[idx],
-                                        removed=removed)
+                    self._save_delta_payload(f, keys, idx, removed)
             self._save_seq = seq
             self._save_count += 1
             self._write_meta(path)
@@ -363,6 +361,39 @@ class HostEmbeddingStore:
             self._dirty[:] = False
             self._tombstones.clear()
         return fname
+
+    # ---- payload hooks (overridden by the disk spill tier, which
+    # streams from its memmap instead of materializing the plane) ----
+
+    def _save_base_payload(self, f) -> None:
+        np.savez_compressed(f, keys=self._keys[:self._n],
+                            rows=self._rows[:self._n])
+
+    def _save_delta_payload(self, f, keys: np.ndarray, idx: np.ndarray,
+                            removed: np.ndarray) -> None:
+        np.savez_compressed(f, keys=keys, rows=self._rows[idx],
+                            removed=removed)
+
+    # ---- chain protocol (consumed by PassCheckpointer: the snapshot
+    # records/verifies exactly these members' CRCs) ----
+
+    def chain_members(self, seq: int) -> list[str]:
+        """Relative names of the immutable chain prefix ``base +
+        deltas[:seq]`` in replay order."""
+        return ["base.npz"] + [_delta_name(i) for i in range(1, seq + 1)]
+
+    def chain_file_entries(self, path: str, seq: int) -> dict[str, dict]:
+        """{relative name: {bytes, crc32}} for the chain prefix, read
+        from the directory's own manifest (nothing is re-hashed)."""
+        manifest = ckpt_lib.read_manifest(path)
+        return {name: manifest["files"][name]
+                for name in self.chain_members(seq)}
+
+    def chain_increment_members(self, seq: int) -> list[str]:
+        """Relative names a single ``save_delta`` at ``seq`` touched —
+        the incremental remote-mirror upload set (the new delta plus
+        the refreshed meta + chain manifest)."""
+        return [_delta_name(seq), "meta.json", ckpt_lib.MANIFEST_NAME]
 
     def _write_meta(self, path: str) -> None:
         meta = dataclasses.asdict(self.cfg)
@@ -388,8 +419,7 @@ class HostEmbeddingStore:
         # dirs carry one delta of a chain whose earlier links live
         # elsewhere); load() enforces completeness for the prefix it
         # actually replays.
-        logical = ["base.npz"] + [_delta_name(i)
-                                  for i in range(1, self._save_seq + 1)]
+        logical = self.chain_members(self._save_seq)
         chain, files = [], {}
         for i, name in enumerate(logical):
             full = os.path.join(path, name)
@@ -429,18 +459,17 @@ class HostEmbeddingStore:
         if removed is not None and len(removed):
             self._remove(removed)
 
-    @staticmethod
-    def _verify_chain(path: str, seq: int) -> None:
-        """Check base + delta-1..delta-seq against the directory MANIFEST
-        (size + CRC32 per member). No manifest (legacy/pre-crash-safety
-        dir) verifies nothing; a manifest that does not cover the needed
-        prefix — or a member that fails its checksum — raises
-        CheckpointCorruptError with the chain position, the reason, and
-        the fallback hint the resume path acts on."""
+    def _verify_chain(self, path: str, seq: int) -> None:
+        """Check the ``chain_members(seq)`` prefix against the directory
+        MANIFEST (size + CRC32 per member). No manifest (legacy/
+        pre-crash-safety dir) verifies nothing; a manifest that does not
+        cover the needed prefix — or a member that fails its checksum —
+        raises CheckpointCorruptError with the chain position, the
+        reason, and the fallback hint the resume path acts on."""
         manifest = ckpt_lib.read_manifest(path)
         if manifest is None:
             return
-        need = ["base.npz"] + [_delta_name(i) for i in range(1, seq + 1)]
+        need = self.chain_members(seq)
         covered = manifest.get("files", {})
         for i, name in enumerate(need):
             if name not in covered:
@@ -604,19 +633,31 @@ class ShardedEmbeddingStore:
     PassWorkingSet's working-set build run unchanged. Per-shard chains
     are the unit a future per-host ownership split hands out — shard s's
     directory is self-contained.
+
+    ``store_factory`` selects each sub-store's STORAGE tier — signature
+    ``(cfg, initial_capacity, shard) -> store`` — so shards can be
+    disk-backed :class:`~paddlebox_tpu.embedding.spill_store.
+    SpillEmbeddingStore`\\ s (the BoxPS SSD tier; see embedding/
+    tiering.py's ``shard_store_factory``, which reads
+    ``flags.table_tiering``). The default keeps the in-RAM
+    HostEmbeddingStore.
     """
 
     _GROW = HostEmbeddingStore._GROW
     supports_resident_reuse = True
 
     def __init__(self, cfg: EmbeddingConfig, n_shards: int,
-                 initial_capacity: int = 1024):
+                 initial_capacity: int = 1024, store_factory=None):
         if n_shards < 1:
             raise ValueError(f"n_shards must be >= 1, got {n_shards}")
         self.cfg = cfg
         self.n_shards = int(n_shards)
-        self._shards = [HostEmbeddingStore(cfg, initial_capacity)
-                        for _ in range(self.n_shards)]
+        if store_factory is None:
+            def store_factory(cfg, cap, shard):
+                return HostEmbeddingStore(cfg, cap)
+        self.store_factory = store_factory
+        self._shards = [store_factory(cfg, initial_capacity, s)
+                        for s in range(self.n_shards)]
         self._save_seq = 0
         self._save_count = 0
         self._flush_hooks: list = []
@@ -764,29 +805,71 @@ class ShardedEmbeddingStore:
         self._commit_manifest(path, pass_id=pass_id)
         return path
 
-    def restore(self, path: str,
+    def chain_members(self, seq: int) -> list[str]:
+        """Shard-prefixed chain prefix: every shard's chain is in
+        lockstep with the top-level seq (save_base/save_delta save every
+        shard every time), so the members at seq N are each shard's
+        ``base + deltas[:N]``."""
+        out = []
+        for s, sub in enumerate(self._shards):
+            out.extend(f"shard-{s:02d}/{name}"
+                       for name in sub.chain_members(seq))
+        return out
+
+    def chain_file_entries(self, path: str, seq: int) -> dict[str, dict]:
+        out: dict[str, dict] = {}
+        for s, sub in enumerate(self._shards):
+            for name, ent in sub.chain_file_entries(
+                    self._shard_dir(path, s), seq).items():
+                out[f"shard-{s:02d}/{name}"] = ent
+        return out
+
+    def chain_increment_members(self, seq: int) -> list[str]:
+        out = []
+        for s, sub in enumerate(self._shards):
+            out.extend(f"shard-{s:02d}/{name}"
+                       for name in sub.chain_increment_members(seq))
+        out.append(_SHARD_MANIFEST)
+        return out
+
+    def restore(self, path: str, upto_seq: int | None = None,
                 verify: bool = True) -> "ShardedEmbeddingStore":
         """Resume from the top-level manifest: each shard replays its
         chain to the seq the LAST COMMITTED manifest records — shard
         delta files written after it (a crashed save) are ignored and
-        later overwritten, exactly like save_delta's own seq commit."""
+        later overwritten, exactly like save_delta's own seq commit.
+
+        ``upto_seq`` pins the horizon instead (the PassCheckpointer
+        flow: the SNAPSHOT is the commit record there, and shard chains
+        stay in lockstep with the top seq, so every shard replays to the
+        pinned value and ``shards.json`` — rewritten by any newer,
+        possibly crashed save — is consulted only for the partition
+        identity)."""
         mpath = os.path.join(path, _SHARD_MANIFEST)
-        with open(mpath) as f:
-            meta = json.load(f)
-        if int(meta["n_shards"]) != self.n_shards:
-            raise CheckpointCorruptError(
-                mpath, f"manifest records {meta['n_shards']} shards, "
-                       f"this store has {self.n_shards} — the partition "
-                       f"is part of the checkpoint identity")
-        for s, (sub, ent) in enumerate(zip(self._shards, meta["shards"])):
-            sub.restore(self._shard_dir(path, s),
-                        upto_seq=int(ent["save_seq"]), verify=verify)
-        self._save_seq = int(meta["save_seq"])
+        meta = None
+        if upto_seq is None or os.path.exists(mpath):
+            with open(mpath) as f:
+                meta = json.load(f)
+            if int(meta["n_shards"]) != self.n_shards:
+                raise CheckpointCorruptError(
+                    mpath, f"manifest records {meta['n_shards']} shards, "
+                           f"this store has {self.n_shards} — the "
+                           f"partition is part of the checkpoint identity")
+        if upto_seq is None:
+            seqs = [int(ent["save_seq"]) for ent in meta["shards"]]
+            self._save_seq = int(meta["save_seq"])
+        else:
+            seqs = [int(upto_seq)] * self.n_shards
+            self._save_seq = int(upto_seq)
+        for s, (sub, seq) in enumerate(zip(self._shards, seqs)):
+            sub.restore(self._shard_dir(path, s), upto_seq=seq,
+                        verify=verify)
         return self
 
     @classmethod
     def load(cls, path: str, cfg: EmbeddingConfig | None = None,
-             verify: bool = True) -> "ShardedEmbeddingStore":
+             verify: bool = True,
+             store_factory=None) -> "ShardedEmbeddingStore":
         with open(os.path.join(path, _SHARD_MANIFEST)) as f:
             meta = json.load(f)
         if cfg is None:
@@ -796,7 +879,7 @@ class ShardedEmbeddingStore:
             fields = {f.name for f in dataclasses.fields(EmbeddingConfig)}
             cfg = EmbeddingConfig(**{k: v for k, v in sm.items()
                                      if k in fields})
-        store = cls(cfg, int(meta["n_shards"]))
+        store = cls(cfg, int(meta["n_shards"]), store_factory=store_factory)
         return store.restore(path, verify=verify)
 
     @staticmethod

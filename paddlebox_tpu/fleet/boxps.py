@@ -22,7 +22,7 @@ import time
 from typing import Any
 
 from paddlebox_tpu import monitor
-from paddlebox_tpu.embedding import HostEmbeddingStore
+from paddlebox_tpu.embedding import HostEmbeddingStore, tiering
 from paddlebox_tpu.metrics.metric import MetricRegistry
 
 JOIN_PHASE = 1
@@ -173,6 +173,13 @@ class BoxPS:
                 warnings.warn(f"serving publish failed for pass "
                               f"{self.pass_id} ({e!r}); serving stays on "
                               f"its last good version")
+        # pass-boundary tier re-evaluation: spill-backed stores re-score
+        # their RAM hot tier off this pass's observed per-row traffic
+        # (embedding/tiering.py) — BEFORE the flight-record commit so the
+        # tiering.* counter deltas land in this pass's stats_delta
+        tier = tiering.end_pass_rebalance(self.store)
+        if tier is not None:
+            out["tiering"] = tier
         # flight-record commit LAST: checkpoint/delta durations and bytes
         # above land in this pass's stats_delta and event stream
         out["flight_record"] = monitor.hub().end_pass(metrics=self.metrics)

@@ -71,6 +71,20 @@ def validate_flight_record(rec: dict) -> list[str]:
     extra = rec.get("extra")
     if extra is not None and not isinstance(extra, dict):
         errs.append(f"extra is {type(extra).__name__}, not an object")
+    # tiered-table telemetry (embedding/tiering.py): the admission/
+    # eviction COUNTERS are monotone, so their per-pass deltas can never
+    # be negative (a negative delta means a consumer double-counted or
+    # the counter was rebuilt mid-pass), and the tier identity is a flat
+    # string like the other engine-identity fields
+    for k in ("tiering.admitted", "tiering.evicted"):
+        v = (rec.get("stats_delta") or {}).get(k)
+        if isinstance(v, numbers.Real) and v < 0:
+            errs.append(f"stats_delta[{k!r}] is negative — tiering "
+                        "counters are monotone")
+    if isinstance(extra, dict):
+        tt = extra.get("table_tiering")
+        if tt is not None and not isinstance(tt, str):
+            errs.append("extra['table_tiering'] is not a string")
     return errs
 
 

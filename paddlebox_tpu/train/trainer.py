@@ -32,7 +32,8 @@ from paddlebox_tpu.config import flags as config_flags
 from paddlebox_tpu.data.schema import DataFeedSchema
 from paddlebox_tpu.data.slot_record import PackedBatch, SparseLayout
 from paddlebox_tpu.embedding import (EmbeddingConfig, HostEmbeddingStore,
-                                     PassWorkingSet, exchange, sharded)
+                                     PassWorkingSet, exchange, sharded,
+                                     tiering)
 from paddlebox_tpu.embedding.feed_pass import FeedPassManager
 from paddlebox_tpu.embedding.working_set import PushOperandStager
 from paddlebox_tpu.metrics import auc as auc_lib
@@ -244,6 +245,11 @@ class Trainer:
         self.table_layout = self._select_table_layout()
         self.exchange_wire = (exchange.select_wire(self.store.cfg)
                               if self.table_layout == "sharded" else None)
+        # Storage-tier identity of the host table ("spill" /
+        # "sharded+spill" / None for the in-RAM store) — flight-record
+        # extra, like table_layout; the tier is a storage choice, never
+        # a math change (embedding/tiering.py)
+        self.table_tiering = tiering.describe(store)
         if (self.table_layout == "sharded"
                 and config_flags.exchange_capacity_factor > 0):
             # operator-set starting capacity for the exchange lanes (the
@@ -1282,8 +1288,14 @@ class Trainer:
             # bytes, dedup ratio, overflow drops — rides the flight
             # record's stats_delta as exchange.* counter deltas)
             table_layout=self.table_layout,
-            exchange_wire=self.exchange_wire)
+            exchange_wire=self.exchange_wire,
+            # storage-tier identity (None filtered out for in-RAM
+            # stores); the tiering.* counter deltas ride stats_delta
+            table_tiering=self.table_tiering)
         if owned_pass:
+            # trainer-owned scope: the BoxPS lifecycle is not driving, so
+            # the pass-boundary tier re-evaluation runs here instead
+            tiering.end_pass_rebalance(self.store)
             hub.end_pass(metrics=metrics)
         return out
 

@@ -115,6 +115,17 @@ POINTS: tuple[str, ...] = (
     # leave nothing half-applied (eval is stateless; the point exists so
     # the never-silent overflow retry path is ioerror-exercisable).
     "exchange.eval.pre_retry",
+    # tiered-table spill stores (ISSUE 11). tiering.save.pre_flush = a
+    # spill-backed store is about to msync its memory-mapped row plane
+    # and stream it into a base/delta payload (the window where the
+    # on-disk spill file and the checkpoint-in-progress could diverge) —
+    # dying here must leave the chain at the previous committed save.
+    # tiering.evict.pre = the pass-boundary RAM-tier re-evaluation is
+    # about to demote cold cached rows; the cache is never authoritative,
+    # so a kill here must resume bit-exact. Both run in the main kill
+    # matrix under PBTPU_TABLE_TIERING=spill (sharded spill sub-stores).
+    "tiering.save.pre_flush",
+    "tiering.evict.pre",
 )
 
 # Points that fire only inside the elastic re-formation window: the

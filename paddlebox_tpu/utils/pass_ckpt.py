@@ -214,12 +214,15 @@ class PassCheckpointer:
             self._deltas_in_chain += 1
         save_seq = trainer.store.save_seq
         self._expect_count = trainer.store.save_count
-        chain_manifest = ckpt_lib.read_manifest(self._chain_path(chain_name))
-        chain_files = {
-            name: chain_manifest["files"][name]
-            for name in (["base.npz"]
-                         + [f"delta-{i:05d}.npz"
-                            for i in range(1, save_seq + 1)])}
+        # the store knows its own chain layout (flat base+deltas, or a
+        # ShardedEmbeddingStore's shard-prefixed members) — the snapshot
+        # records exactly those entries' CRCs and resume verifies them
+        chain_files = trainer.store.chain_file_entries(
+            self._chain_path(chain_name), save_seq)
+        # what a delta save touched — the incremental remote upload set
+        # (per-shard delta + manifests for sharded stores)
+        incr_members = (None if rotate
+                        else trainer.store.chain_increment_members(save_seq))
 
         snap = self.snap_dir(pass_id, mid_steps)
         os.makedirs(snap, exist_ok=True)
@@ -261,7 +264,8 @@ class PassCheckpointer:
         sparse_member = ("base.npz" if rotate
                          else f"delta-{save_seq:05d}.npz")
         nbytes = (sum(e["bytes"] for e in files.values())
-                  + chain_files[sparse_member]["bytes"])
+                  + sum(e["bytes"] for name, e in chain_files.items()
+                        if name.endswith(sparse_member)))
         monitor.counter_add("ckpt.saves")
         monitor.counter_add("ckpt.save_seconds", seconds)
         monitor.counter_add("ckpt.bytes", nbytes)
@@ -277,7 +281,8 @@ class PassCheckpointer:
         profiler.record_instant("checkpoint_commit",
                                 {"snapshot": os.path.basename(snap)})
         if self.remote_root is not None:
-            self._upload(snap, chain_name, rotate, save_seq, cursor)
+            self._upload(snap, chain_name, rotate, save_seq, cursor,
+                         incr_members=incr_members)
         self._prune()
         return snap
 
@@ -288,7 +293,8 @@ class PassCheckpointer:
         return fs
 
     def _upload(self, snap: str, chain_name: str, rotated: bool,
-                save_seq: int, cursor: dict) -> None:
+                save_seq: int, cursor: dict,
+                incr_members: list[str] | None = None) -> None:
         """Mirror the just-committed snapshot to the remote root. Donefile
         line lands ONLY after every byte uploaded — a kill anywhere in
         here leaves the remote donefile naming only complete uploads (the
@@ -303,16 +309,22 @@ class PassCheckpointer:
         remote_chain = f"{rroot}/{chain_name}"
         try:
             fs.makedirs(rroot)
-            if rotated or chain_name not in self._uploaded_chains:
-                # whole-chain upload: fresh rotation, or a chain continued
-                # across a process restart (unknown remote contents —
-                # replace)
+            if (rotated or incr_members is None
+                    or chain_name not in self._uploaded_chains):
+                # whole-chain upload: fresh rotation, or a chain
+                # continued across a process restart (unknown remote
+                # contents — replace)
                 fs_lib.put_replacing(fs, local_chain, remote_chain)
             else:
-                # incremental: only the new delta + the refreshed chain
-                # manifest/meta cross the wire
-                for name in (f"delta-{save_seq:05d}.npz", "meta.json",
-                             ckpt_lib.MANIFEST_NAME):
+                # incremental: only what the delta save touched crosses
+                # the wire — the store's chain_increment_members (per-
+                # shard delta + manifests for sharded stores, whose
+                # subdirs the rotation's whole-chain upload created;
+                # makedirs is the idempotent belt-and-braces)
+                for d in sorted({os.path.dirname(m) for m in incr_members
+                                 if "/" in m}):
+                    fs.makedirs(f"{remote_chain}/{d}")
+                for name in incr_members:
                     fs.put(os.path.join(local_chain, name),
                            f"{remote_chain}/{name}")
             self._uploaded_chains.add(chain_name)
@@ -474,9 +486,15 @@ class PassCheckpointer:
             int(manifest["cursor"]["pass_id"])     # resume depends on it
             int(manifest["cursor"]["global_step"])
             chain_dir = self._chain_path(manifest["chain_dir"])
-            need = (["base.npz"]
-                    + [f"delta-{i:05d}.npz"
-                       for i in range(1, int(manifest["save_seq"]) + 1)])
+            if any("/" in n for n in manifest.get("chain_files", {})):
+                # store-defined layout (a sharded store's shard-prefixed
+                # members): verify exactly what the snapshot recorded
+                need = sorted(manifest["chain_files"])
+            else:
+                need = (["base.npz"]
+                        + [f"delta-{i:05d}.npz"
+                           for i in range(1,
+                                          int(manifest["save_seq"]) + 1)])
         except (KeyError, TypeError, ValueError) as e:
             raise CheckpointCorruptError(
                 os.path.join(snap, ckpt_lib.MANIFEST_NAME),
@@ -488,13 +506,14 @@ class PassCheckpointer:
             ckpt_lib.verify_manifest(chain_dir, {"files": chain_files},
                                      only=need)
         except CheckpointCorruptError as e:
-            name = os.path.basename(e.fname)
-            pos = need.index(name) if name in need else -1
+            # position by chain-relative name: shard-prefixed members
+            # ('shard-NN/delta-…') would never match a bare basename
+            rel = os.path.relpath(e.fname, chain_dir).replace(os.sep, "/")
+            pos = need.index(rel) if rel in need else -1
             raise CheckpointCorruptError(
                 e.fname,
-                f"chain member #{pos} of base+{len(need) - 1} deltas "
-                f"(as recorded by snapshot {os.path.basename(snap)}): "
-                f"{e}") from e
+                f"chain member #{pos} of the {len(need)} recorded in "
+                f"snapshot {os.path.basename(snap)}: {e}") from e
         return manifest
 
     def intact_cursors(self) -> list[tuple[int, int]]:

@@ -49,8 +49,7 @@ import numpy as np  # noqa: E402
 import mockfs  # noqa: E402
 from paddlebox_tpu.data import DataFeedSchema, SlotDataset  # noqa: E402
 from paddlebox_tpu.data.parser import parse_multislot_lines  # noqa: E402
-from paddlebox_tpu.embedding import (EmbeddingConfig,  # noqa: E402
-                                     HostEmbeddingStore)
+from paddlebox_tpu.embedding import EmbeddingConfig, tiering  # noqa: E402
 from paddlebox_tpu.fleet import BoxPS  # noqa: E402
 from paddlebox_tpu.models import DNNCTRModel  # noqa: E402
 from paddlebox_tpu.parallel import make_mesh  # noqa: E402
@@ -104,7 +103,13 @@ def main() -> None:
 
     ds, schema = synth(seed=args.seed)
     base = ds.records                  # pristine order; reshuffled per pass
-    store = HostEmbeddingStore(EmbeddingConfig(dim=4, learning_rate=0.05))
+    # flag-driven tier/partition (PBTPU_TABLE_TIERING / PBTPU_SPILL_* /
+    # PBTPU_CRASH_SHARDS): the default stays the plain in-RAM store, and
+    # the tier is a storage choice, not a math change — the spill-backed
+    # sharded configuration must land the SAME golden planes
+    store = tiering.store_from_flags(
+        EmbeddingConfig(dim=4, learning_rate=0.05),
+        n_shards=int(os.environ.get("PBTPU_CRASH_SHARDS", "1")))
     mesh = make_mesh(1)
     tr = Trainer(DNNCTRModel(num_slots=NUM_SLOTS, emb_dim=4, dense_dim=1,
                              hidden=(8,)),

@@ -183,3 +183,11 @@ def test_bench_dryrun_smoke():
     assert out["sharded"]["exchange_wire"] == "f32"
     assert out["sharded"]["table_shards"] == 2
     assert 0 < out["sharded"]["dedup_ratio"] <= 1.0
+    # the tiered-table point must exist with its acceptance property
+    # (ISSUE 11): a working set >= 10x the RAM cache budget through the
+    # sharded+spill path, and the show-count-weighted policy's hot-tier
+    # hit rate beating the direct-mapped last-wins baseline on the SAME
+    # traffic — so spill_10x enters the BENCH_BEST gate from day one
+    assert out["checks"]["spill_fields"], out.get("spill")
+    assert out["spill"]["hot_hit_rate"] > out["spill"]["direct_hot_hit_rate"]
+    assert out["spill"]["fetch_keys_per_s"] > 0

@@ -52,6 +52,13 @@ POINT_AFTER = {
     # before the dispatch)
     "trainer.pack.pre": 5,
     "trainer.step.pre": 5,
+    # ISSUE 11 tiered-table windows (2 spill shards → 2 hits per save /
+    # per boundary rebalance; AFTER=2 lands both in pass 2): the
+    # streaming memmap save's pre-flush, and the pass-boundary RAM-tier
+    # demotion — the cache is never authoritative, so both must resume
+    # bit-exact
+    "tiering.save.pre_flush": 2,
+    "tiering.evict.pre": 2,
 }
 
 # points that only sit on the mid-pass / remote-mirror code paths run the
@@ -61,12 +68,27 @@ MIDPASS_REMOTE_POINTS = {"trainer.midpass.post_save",
                          "remote_ckpt.upload.pre",
                          "remote_ckpt.download.pre"}
 
+# points that only sit on the spill-tier code paths run the worker with
+# a 2-shard ShardedEmbeddingStore over spill sub-stores (PBTPU_TABLE_
+# TIERING=spill) — which provably does not change the final planes
+# (test_spill_sharded_run_matches_plain_golden)
+SPILL_POINTS = {"tiering.save.pre_flush", "tiering.evict.pre"}
+
 
 def _midpass_remote_env(tmp_path):
     return {"PBTPU_MOCKFS_ROOT": str(tmp_path / "mock_root"),
             "PBTPU_MOCKFS_SCHEME": "hdfs",
             "PBTPU_CRASH_MIDPASS": "2",
             "PBTPU_CRASH_REMOTE": "hdfs://ck"}
+
+
+def _spill_env(tmp_path):
+    # RAM cache far below the ~120-key table: every pass faults through
+    # the disk tier, so the kill windows sit on exercised code
+    return {"PBTPU_TABLE_TIERING": "spill",
+            "PBTPU_SPILL_CACHE_ROWS": "16",
+            "PBTPU_SPILL_DIR": str(tmp_path / "spill"),
+            "PBTPU_CRASH_SHARDS": "2"}
 
 
 @pytest.fixture(autouse=True)
@@ -110,8 +132,12 @@ def _assert_bitwise_equal(golden, out):
 
 def _kill_resume_roundtrip(point, tmp_path, golden):
     root, out = tmp_path / "root", tmp_path / "out.npz"
-    env = (_midpass_remote_env(tmp_path)
-           if point in MIDPASS_REMOTE_POINTS else {})
+    if point in MIDPASS_REMOTE_POINTS:
+        env = _midpass_remote_env(tmp_path)
+    elif point in SPILL_POINTS:
+        env = _spill_env(tmp_path)
+    else:
+        env = {}
     killed = _run_worker(
         root, out, check=False,
         env_extra=dict(env, PBTPU_FAULTPOINT=point,
@@ -186,6 +212,58 @@ def test_midpass_remote_run_matches_plain_golden(tmp_path, golden):
     assert (mock_root / "snapshots.donefile").exists()
     assert any(n.startswith("pass-") for n in os.listdir(mock_root))
     assert any(".mid" in n for n in os.listdir(mock_root))
+
+
+def test_spill_sharded_run_matches_plain_golden(tmp_path, golden):
+    """The tier is a storage choice, not a math change: a full run on a
+    2-shard ShardedEmbeddingStore with SPILL sub-stores (memmap row
+    files, 16-row RAM caches) lands the SAME final planes as the plain
+    in-RAM golden — the license for the kill matrix to flip the tiering
+    points on that configuration. Also proves the spill-backed shards
+    actually ran disk-backed (per-shard row files exist)."""
+    env = _spill_env(tmp_path)
+    out = tmp_path / "out.npz"
+    _run_worker(tmp_path / "root", out, env_extra=env)
+    _assert_bitwise_equal(golden, out)
+    spill_root = tmp_path / "spill"
+    for s in ("shard-00", "shard-01"):
+        assert (spill_root / s / "rows.dat").exists()
+        assert (spill_root / s / "rows.dat").stat().st_size > 0
+
+
+def test_tiering_save_ioerror_rolls_back(tmp_path):
+    """tiering.save.pre_flush: an IO fault before the spill store's
+    memmap flush + streamed payload leaves the chain at the previous
+    committed save (the save_delta seq-commit discipline holds for the
+    streaming writer too), and the store keeps training afterwards."""
+    from paddlebox_tpu.embedding import SpillEmbeddingStore
+    cfg = EmbeddingConfig(dim=2)
+    st = SpillEmbeddingStore(cfg, spill_dir=str(tmp_path / "sp"),
+                             cache_rows=8)
+    keys = np.arange(1, 41, dtype=np.uint64)
+    rows = st.lookup_or_init(keys)
+    rows[:, 0] = 5.0
+    st.write_back(keys, rows)
+    path = str(tmp_path / "chain")
+    st.save_base(path)
+    rows = st.get_rows(keys)
+    rows[:, 2] = 1.0
+    st.write_back(keys, rows)
+    st.save_delta(path)                     # committed: seq 1, col2 = 1.0
+    rows[:, 2] = 2.0
+    st.write_back(keys, rows)
+    faultpoint.arm("tiering.save.pre_flush", action="ioerror")
+    with pytest.raises(faultpoint.FaultInjected):
+        st.save_delta(path)                 # dies before flush + stream
+    faultpoint.disarm()
+    loaded = HostEmbeddingStore.load(path)
+    assert loaded.save_seq == 1
+    np.testing.assert_allclose(loaded.get_rows(keys)[:, 2], 1.0)
+    # the interrupted save burned no seq: the re-run commits seq 2
+    st.save_delta(path)
+    loaded2 = HostEmbeddingStore.load(path)
+    assert loaded2.save_seq == 2
+    np.testing.assert_allclose(loaded2.get_rows(keys)[:, 2], 2.0)
 
 
 def test_every_point_has_a_matrix_entry():
