@@ -666,6 +666,8 @@ def e2e_bench(small: bool):
             "fresh_rows": m.last_fresh_rows,
             "reused_rows": m.last_reused_rows,
             "boundary_seconds": round(bsec, 3),
+            "boundary_split": {k: round(v, 3)
+                               for k, v in m.last_boundary_split.items()},
             "boundary_h2d_mbps": round(
                 m.last_h2d_bytes / bsec / 1e6, 1) if bsec > 0.01 else None,
             "stage_seconds": stage,
@@ -1349,10 +1351,27 @@ def dryrun_main() -> int:
             {"device_kind": None,
              "metrics": {"serving.p99_ms": 5.0}}, "")["ok"])
     detail["telemetry"] = monitor.hub().summary()
+    # the run-doctor verdict rides the dryrun too (ISSUE 12): the
+    # artifact must embed a schema-valid report with the boundary-wall
+    # rule evaluated and the dryrun's own push_floor fed to the
+    # push-floor rule — asserted like telemetry_embedded
+    from paddlebox_tpu.monitor import doctor as doctor_lib
+    detail["doctor"] = doctor_lib.diagnose_hub(
+        monitor.hub(), detail={"push_floor": detail.get("push_floor")})
     monitor.hub().disable()
     checks["telemetry_embedded"] = (
         isinstance(detail["telemetry"], dict)
         and bool(detail["telemetry"].get("counters")))
+    checks["doctor_embedded"] = (
+        doctor_lib.validate_report(detail["doctor"]) == []
+        and isinstance(detail["doctor"].get("verdict"), str)
+        and any(r["rule"] == "boundary-wall"
+                for r in detail["doctor"]["rules"])
+        # the dryrun's push_floor must have reached the rule: its status
+        # is fired/quiet/no-data depending on closure, but an evaluated
+        # entry must exist
+        and any(r["rule"] == "push-floor"
+                for r in detail["doctor"]["rules"]))
     metrics = collect_gate_metrics(eps, detail)
     kind = detail.get("device_kind", "")
     committed = load_bench_best()
@@ -1391,6 +1410,7 @@ def dryrun_main() -> int:
         "push_overlap": detail.get("push_overlap"),
         "push_floor_closed": (detail.get("push_floor") or {}
                               ).get("closed"),
+        "doctor": detail["doctor"].get("verdict"),
         "world_resize_seconds": detail.get("world_resize_seconds"),
         "sharded": {k: f32p.get(k) for k in
                     ("table_layout", "exchange_wire", "table_shards",
@@ -1472,6 +1492,16 @@ def main() -> None:
         detail["telemetry"] = _monitor.hub().summary()
     except Exception as e:
         detail["telemetry"] = {"error": repr(e)}
+
+    # the run-doctor verdict rides every artifact (ISSUE 12): critical-
+    # path attribution over the e2e passes' flight records + the rule
+    # set, with this round's push_floor closing the push-floor rule
+    try:
+        from paddlebox_tpu.monitor import doctor as _doctor
+        detail["doctor"] = _doctor.diagnose_hub(
+            _monitor.hub(), detail={"push_floor": detail.get("push_floor")})
+    except Exception as e:
+        detail["doctor"] = {"error": repr(e)}
 
     # round-over-round regression gate: every recorded number vs the best
     # recorded value for this hardware (BENCH_BEST.json); an unwaived

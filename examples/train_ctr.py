@@ -340,10 +340,16 @@ def main() -> int:
         with open(os.path.join(telemetry_dir, "metrics.prom"), "w") as f:
             f.write(monitor.hub().prometheus_text())
         flights = monitor.hub().flight_records()
+        # run-doctor verdict over this run's records (the same analysis
+        # `python -m paddlebox_tpu.monitor.doctor <dir>` runs offline —
+        # README "Run doctor")
+        from paddlebox_tpu.monitor import doctor as doctor_lib
+        verdict = doctor_lib.diagnose_hub(monitor.hub())["verdict"]
         monitor.hub().disable()
         profiler.disable_profiler()
         print(f"telemetry: {len(flights)} flight records, {n_spans} trace "
               f"events -> {telemetry_dir}")
+        print(f"doctor: {verdict}")
     print("example complete:", work)
     return 0
 

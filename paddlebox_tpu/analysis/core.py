@@ -91,7 +91,8 @@ class Project:
     flags_class: str = "Flags"
     faultpoint_module: str = "paddlebox_tpu/utils/faultpoint.py"
     faultpoint_registries: tuple[str, ...] = (
-        "POINTS", "ELASTIC_POINTS", "SERVING_POINTS", "EXCHANGE_POINTS")
+        "POINTS", "ELASTIC_POINTS", "SERVING_POINTS", "EXCHANGE_POINTS",
+        "MONITOR_POINTS")
     tests_dir: str = "tests"
     # extra trees indexed for *references* (flag reads, faultpoint names)
     # but never linted themselves

@@ -265,6 +265,19 @@ class Flags:
     # JsonlSink bounded queue: a slow/failed writer drops events (counted)
     # instead of ever blocking the training thread.
     telemetry_queue_size: int = 8192        # (new)
+    # JsonlSink size-based rotation: when a segment exceeds this many
+    # MB the writer thread closes it and opens the next numbered
+    # segment (events.jsonl -> events.00001.jsonl -> ...). 0 = off (one
+    # unbounded file — fine for bounded runs, not for streaming/day-
+    # scale ones). Segments stay schema-clean; monitor/aggregate.py
+    # reads them back in order.
+    telemetry_rotate_mb: int = 0            # (new)
+    # Run doctor live mode (monitor/doctor.py): evaluate the incident
+    # rule set against the in-memory flight records at every end_pass
+    # and emit `doctor.finding` events into the hub (BoxPS.end_pass
+    # also returns the findings). Off by default: the rules read only
+    # committed records, but day-scale operators opt in explicitly.
+    doctor_live: bool = False               # (new)
 
     def set(self, name: str, value: Any) -> None:
         if not hasattr(self, name):

@@ -48,7 +48,7 @@ import jax.numpy as jnp
 import weakref
 
 from paddlebox_tpu.config import flags
-from paddlebox_tpu.embedding import quant
+from paddlebox_tpu.embedding import quant, tiering
 from paddlebox_tpu.embedding.store import HostEmbeddingStore
 from paddlebox_tpu.embedding.working_set import (PassWorkingSet, bucket_size,
                                                  fetch_rows, transfer_bytes,
@@ -90,7 +90,7 @@ class _Staging:
     """Result of one feed pass: fresh rows staged on device + the diff."""
 
     __slots__ = ("keys", "pos_prev", "fresh_dev", "n_fresh", "h2d_bytes",
-                 "prev", "store_gen", "full_ws")
+                 "prev", "store_gen", "full_ws", "timings")
 
     def __init__(self, **kw):
         for k in self.__slots__:
@@ -145,6 +145,14 @@ class FeedPassManager:
         self.last_reused_rows = 0
         self.last_boundary_seconds = 0.0     # begin_pass side (the build)
         self.last_end_seconds = 0.0          # end_pass side (lazy: ~0)
+        # component costs of the last boundary (flight-record extra
+        # boundary_split): host-side working-set build (key diff + store
+        # fetch + table assembly), device H2D staging, and — a subset of
+        # build — the disk-tier fault-in of spill-backed stores. Costs
+        # are charged where the work RAN: a staged (overlapped) feed's
+        # components exceed the boundary wall by design.
+        self.last_boundary_split = {"build": 0.0, "h2d": 0.0,
+                                    "spill_fault_in": 0.0}
 
     # -- helpers -----------------------------------------------------------
 
@@ -202,16 +210,22 @@ class FeedPassManager:
         With prev=None, stages the full build instead. Runs on the feed
         thread (train semantics) or synchronously (incl. eval peek)."""
         cfg = self.store.cfg
+        fault0 = tiering.fault_in_seconds(self.store)
         if prev is None:
             # nothing to diff against: stage the FULL build (still overlaps
             # the whole host fetch + H2D with whatever the caller is doing)
+            timing: dict = {}
             ws = PassWorkingSet.begin_pass(
                 self.store, keys, self.mesh,
                 min_rows_per_shard=self.min_rows_per_shard,
-                test_mode=test_mode, bucket_rows=True)
+                test_mode=test_mode, bucket_rows=True, timing_out=timing)
+            timing["spill_fault_in"] = (tiering.fault_in_seconds(self.store)
+                                        - fault0)
             return _Staging(keys=ws.sorted_keys, prev=None, store_gen=gen,
                             full_ws=ws, n_fresh=len(ws.sorted_keys),
-                            h2d_bytes=transfer_bytes(cfg, ws.padded_rows))
+                            h2d_bytes=transfer_bytes(cfg, ws.padded_rows),
+                            timings=timing)
+        t0 = time.perf_counter()
         pos = prev._tindex.lookup(keys)            # -1 = fresh
         fresh_keys = keys[pos < 0]
         fresh_rows = (self.store.peek_rows(fresh_keys) if test_mode
@@ -220,6 +234,7 @@ class FeedPassManager:
         n_fresh_pad = bucket_size(max(1, n_fresh))
         staged = np.zeros((n_fresh_pad, cfg.row_width), np.float32)
         staged[:n_fresh] = fresh_rows
+        t1 = time.perf_counter()
         repl = self._repl_sharding()
         if cfg.storage != "f32":
             fresh_dev = quant.device_table(staged, cfg, repl)
@@ -229,6 +244,15 @@ class FeedPassManager:
             fresh_dev = jax.device_put(staged, repl)
         else:
             fresh_dev = jnp.asarray(staged)
+        # barrier before the clock stops: device_put is async and the
+        # h2d component must carry the transfer, not the dispatch (this
+        # runs on the feed thread under begin_feed_pass, so blocking
+        # here never stalls training)
+        jax.block_until_ready(fresh_dev)
+        timing = {"build": t1 - t0,
+                  "h2d": time.perf_counter() - t1,
+                  "spill_fault_in": (tiering.fault_in_seconds(self.store)
+                                     - fault0)}
         # emitted from the feed thread when staging ran via
         # begin_feed_pass (background-thread events carry the pass tag)
         mon_event("feed_pass_staged", n_fresh=int(n_fresh),
@@ -237,7 +261,8 @@ class FeedPassManager:
         return _Staging(keys=keys, pos_prev=pos, fresh_dev=fresh_dev,
                         n_fresh=n_fresh,
                         h2d_bytes=transfer_bytes(cfg, n_fresh_pad),
-                        prev=prev, store_gen=gen, full_ws=None)
+                        prev=prev, store_gen=gen, full_ws=None,
+                        timings=timing)
 
     # -- pass lifecycle ----------------------------------------------------
 
@@ -263,19 +288,24 @@ class FeedPassManager:
         if staged is not None and staged.full_ws is not None:
             ws = staged.full_ws
             self._account_begin(staged.h2d_bytes, 0, staged.n_fresh,
-                                0, t0, table=ws.table, ws=ws)
+                                0, t0, table=ws.table, ws=ws,
+                                split=staged.timings)
             if not self._eager:
                 self._retain(ws)
             return ws
         if prev is None:
+            timing: dict = {}
+            fault0 = tiering.fault_in_seconds(self.store)
             ws = PassWorkingSet.begin_pass(
                 self.store, keys, self.mesh,
                 min_rows_per_shard=self.min_rows_per_shard,
-                test_mode=test_mode, bucket_rows=True)
+                test_mode=test_mode, bucket_rows=True, timing_out=timing)
+            timing["spill_fault_in"] = (tiering.fault_in_seconds(self.store)
+                                        - fault0)
             self._account_begin(transfer_bytes(self.store.cfg,
                                                ws.padded_rows), 0,
                                 len(ws.sorted_keys), 0, t0,
-                                table=ws.table, ws=ws)
+                                table=ws.table, ws=ws, split=timing)
             if not test_mode and not self._eager:
                 self._retain(ws)
             return ws
@@ -288,7 +318,7 @@ class FeedPassManager:
         ws, carried = self._combine(staged, test_mode)
         self._account_begin(staged.h2d_bytes, d2h, staged.n_fresh,
                             len(keys) - staged.n_fresh, t0,
-                            table=ws.table, ws=ws)
+                            table=ws.table, ws=ws, split=staged.timings)
         if not test_mode:
             self._retain(ws, carried)
         return ws
@@ -480,7 +510,8 @@ class FeedPassManager:
                           else np.zeros_like(ws.touched))
 
     def _account_begin(self, h2d: int, d2h: int, fresh: int, reused: int,
-                       t0: float, table=None, ws=None) -> None:
+                       t0: float, table=None, ws=None,
+                       split: dict | None = None) -> None:
         if table is not None:
             # 4-byte D2H of one element forces every pending H2D/combine
             # on this buffer to land before the clock stops —
@@ -493,10 +524,24 @@ class FeedPassManager:
         self.last_d2h_bytes = d2h
         self.last_fresh_rows = fresh
         self.last_reused_rows = reused
+        # boundary split (working-set build vs H2D vs spill fault-in) —
+        # the flight-record extra the critical-path attributor reads;
+        # mirrored as gauges so the stats_delta carries it too
+        self.last_boundary_split = {
+            k: float((split or {}).get(k, 0.0))
+            for k in ("build", "h2d", "spill_fault_in")}
         stat_add("feed_pass.h2d_bytes", h2d)
         stat_add("feed_pass.d2h_bytes", d2h)
         stat_set("feed_pass.last_fresh_rows", fresh)
         stat_set("feed_pass.last_reused_rows", reused)
+        stat_set("feed_pass.boundary_seconds",
+                 round(self.last_boundary_seconds, 6))
+        stat_set("feed_pass.boundary_build_s",
+                 round(self.last_boundary_split["build"], 6))
+        stat_set("feed_pass.boundary_h2d_s",
+                 round(self.last_boundary_split["h2d"], 6))
+        stat_set("feed_pass.boundary_spill_fault_in_s",
+                 round(self.last_boundary_split["spill_fault_in"], 6))
         # shard layout of the built working set (flight-record context
         # for the exchange counters: lanes and wire volume scale off the
         # per-shard row count)

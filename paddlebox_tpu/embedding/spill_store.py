@@ -40,6 +40,7 @@ from __future__ import annotations
 
 import os
 import tempfile
+import time
 import zipfile
 
 import numpy as np
@@ -105,6 +106,11 @@ class SpillEmbeddingStore(HostEmbeddingStore):
                                dtype=np.float32)
         self.cache_hits = 0
         self.cache_misses = 0
+        # cumulative wall seconds spent faulting rows in from the disk
+        # tier (the memmap read below) — the feed-pass stager reads the
+        # delta per boundary for the flight record's boundary_seconds
+        # split (working-set build vs H2D vs spill fault-in)
+        self.fault_in_seconds = 0.0
         # spill.cache_* counter deltas batched here and flushed once per
         # pass boundary (tier_end_pass) — the hub never sits on the
         # per-read hot path
@@ -162,7 +168,9 @@ class SpillEmbeddingStore(HostEmbeddingStore):
         self.tier.note_access(idx)
         if miss.any():
             mi = idx[miss]
+            t0 = time.perf_counter()
             rows = np.asarray(self._rows[mi])       # disk-tier read
+            self.fault_in_seconds += time.perf_counter() - t0
             out[miss] = rows
             self._install(mi, slot[miss], rows)
         nh, nm = int(hit.sum()), int(miss.sum())

@@ -281,6 +281,15 @@ def describe(store) -> str | None:
     return "spill"
 
 
+def fault_in_seconds(store) -> float:
+    """Cumulative disk-tier fault-in wall seconds across a store's
+    spill-backed (sub-)stores (0.0 for untiered stores). The feed-pass
+    stager diffs this across a boundary to attribute the spill share of
+    the working-set build (the flight record's boundary split)."""
+    return float(sum(getattr(s, "fault_in_seconds", 0.0)
+                     for s in _spill_subs(store)))
+
+
 def spill_stats(store) -> dict | None:
     """Aggregate hot-tier statistics across a store's spill-backed
     (sub-)stores — the operator view the bench/runbook read. None when

@@ -329,15 +329,22 @@ class PassWorkingSet:
                    mesh: jax.sharding.Mesh | None = None,
                    min_rows_per_shard: int = 8,
                    test_mode: bool = False,
-                   bucket_rows: bool = False) -> "PassWorkingSet":
+                   bucket_rows: bool = False,
+                   timing_out: dict | None = None) -> "PassWorkingSet":
         """Build the pass working set on device (BeginFeedPass/EndFeedPass).
 
         test_mode=True reads rows without inserting unseen keys into the
         store (eval passes must not grow or dirty it). bucket_rows=True
         rounds the per-shard row count up to a size bucket so consecutive
-        passes of similar size share compiled step shapes.
+        passes of similar size share compiled step shapes. ``timing_out``
+        (mutated in place) receives the boundary split the flight record
+        carries: ``build`` = host-side key dedup + store fetch + table
+        assembly seconds, ``h2d`` = device transfer (+ on-device pad)
+        seconds — the critical-path attributor needs the two apart.
         """
+        import time as _time
         cfg = store.cfg
+        t0 = _time.perf_counter()
         keys = np.unique(np.asarray(keys).astype(np.uint64))
         rows = (store.peek_rows(keys) if test_mode
                 else store.lookup_or_init(keys))
@@ -361,6 +368,7 @@ class PassWorkingSet:
         n_pad = rps * n_shards
         host_table = np.zeros((n_pad, cfg.row_width), dtype=np.float32)
         host_table[1:1 + len(keys)] = rows
+        t1 = _time.perf_counter()
         sharding = (mesh_lib.table_sharding(mesh) if mesh is not None
                     else None)
         if cfg.storage != "f32":
@@ -381,6 +389,15 @@ class PassWorkingSet:
         W = device_width(cfg)
         if cfg.storage == "f32" and W > cfg.row_width:
             table = _pad_width_jit(W - cfg.row_width, sharding)(table)
+        if timing_out is not None:
+            # device_put returns before bytes move; without this barrier
+            # the h2d component would read near-zero and the transfer
+            # would land silently in the caller's sync (the same trap
+            # _account_begin's D2H sync exists for)
+            jax.block_until_ready(table)
+            t2 = _time.perf_counter()
+            timing_out["build"] = timing_out.get("build", 0.0) + (t1 - t0)
+            timing_out["h2d"] = timing_out.get("h2d", 0.0) + (t2 - t1)
         return cls(cfg, keys, table, rps, n_shards)
 
     def translate(self, ids: np.ndarray, mask: np.ndarray | None = None

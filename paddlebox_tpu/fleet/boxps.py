@@ -183,6 +183,13 @@ class BoxPS:
         # flight-record commit LAST: checkpoint/delta durations and bytes
         # above land in this pass's stats_delta and event stream
         out["flight_record"] = monitor.hub().end_pass(metrics=self.metrics)
+        # live doctor (flags.doctor_live): end_pass above ran the rule
+        # set over the committed records and emitted doctor.finding
+        # events; surface the findings to the driver too — the operator
+        # loop reads the end_pass dict, not the event stream
+        findings = monitor.hub().last_doctor_findings
+        if findings:
+            out["doctor"] = findings
         if self._heartbeat is not None:
             self._heartbeat.publish()
         if self._col is not None:

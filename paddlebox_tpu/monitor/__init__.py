@@ -19,5 +19,6 @@ from paddlebox_tpu.monitor.flight import (  # noqa: F401
     EVENT_REQUIRED_KEYS, FLIGHT_REQUIRED_FIELDS, validate_event,
     validate_events_file, validate_flight_record)
 from paddlebox_tpu.monitor.hub import (TelemetryHub, counter_add,  # noqa: F401
-                                       event, gauge_set, hub, span)
+                                       event, gauge_set, hub, span,
+                                       start_metrics_endpoint)
 from paddlebox_tpu.monitor.timers import StageTimers  # noqa: F401

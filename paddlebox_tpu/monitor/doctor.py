@@ -1,0 +1,712 @@
+"""Run doctor — named, evidence-carrying diagnoses over the telemetry
+the hub emits.
+
+The reference's operators kept day-scale CTR runs healthy by reading
+per-pass stats and AUC logs (SURVEY.md; log_for_profile) and pattern-
+matching against incidents they had seen before. This module is that
+pattern-matching, written down: every rule is grounded in a PRIOR
+INCIDENT recorded in this repo (ROADMAP/VERDICT/BENCH rounds), reads
+only committed telemetry (flight records, counter deltas, retained
+evidence events, sink health), and returns a **named finding** carrying
+the evidence that fired it and the flag/runbook step that addresses it.
+A rule that cannot see its inputs says ``no-data`` — an absent signal is
+not a healthy signal.
+
+Three entry points:
+
+- **CLI** — ``python -m paddlebox_tpu.monitor.doctor <telemetry_dir>…
+  [--json] [--rank-names 4,5,7]``: aggregates the per-rank streams
+  (monitor/aggregate.py — local dirs or hdfs:// roots), attributes the
+  critical path per pass (monitor/critical_path.py), evaluates every
+  rule, prints the report (human text, or one JSON object with
+  ``--json``). Exit 0 = report produced (findings included); 2 = inputs
+  unreadable.
+- **Live** — ``flags.doctor_live``: the hub calls :func:`run_live` at
+  every ``end_pass``; findings are emitted as ``doctor.finding`` events
+  into the event stream (tagged with the pass that produced them) and
+  returned through ``BoxPS.end_pass``.
+- **Embedded** — bench.py embeds :func:`diagnose`'s report in every
+  artifact (``detail["doctor"]``) and ``--dryrun`` asserts it, like
+  ``telemetry_embedded``.
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+
+from paddlebox_tpu.monitor import critical_path as cp_lib
+from paddlebox_tpu.monitor.registry import STATS
+
+REPORT_VERSION = 1
+
+RULE_STATUSES = ("fired", "quiet", "no-data")
+
+
+class Finding(dict):
+    """A named diagnosis: plain dict subclass so reports JSON-serialize
+    verbatim; constructor enforces the required fields."""
+
+    def __init__(self, rule: str, severity: str, summary: str,
+                 evidence: dict, suggestion: str):
+        super().__init__(rule=rule, severity=severity, summary=summary,
+                         evidence=evidence, suggestion=suggestion)
+
+
+class DoctorContext:
+    """Everything a rule may read. ``flights`` are schema-shaped flight
+    records (sorted by pass); ``counters`` the cumulative registry view
+    (live: STATS snapshot; offline: summed per-pass deltas);
+    ``evidence`` retained event samples by name; ``world`` the
+    aggregate's per-pass world view when multiple ranks were read;
+    ``detail`` artifact extras (the bench's push_floor analysis);
+    ``sink_health`` the hub's per-sink account."""
+
+    def __init__(self, flights=None, counters=None, evidence=None,
+                 world=None, detail=None, sink_health=None):
+        self.flights = sorted(flights or [],
+                              key=lambda fr: (fr.get("pass_id") or 0))
+        self.counters = dict(counters or {})
+        self.evidence = dict(evidence or {})
+        self.world = world
+        self.detail = dict(detail or {})
+        self.sink_health = list(sink_health or [])
+        self.attribution = cp_lib.attribute_records(self.flights)
+
+    def pass_deltas(self, key: str) -> "list[tuple[int, float]]":
+        """(pass_id, stats_delta[key]) per pass, SUMMED across records
+        sharing a pass id — merged multi-rank streams carry one record
+        per (pass, rank), and a last-wins collapse would make every
+        trend rule depend on the order the rank roots were listed in
+        (the world totals are what the rules reason over)."""
+        acc: dict[int, float] = {}
+        for fr in self.flights:
+            v = (fr.get("stats_delta") or {}).get(key)
+            if v is not None and fr.get("pass_id") is not None:
+                p = int(fr["pass_id"])
+                acc[p] = acc.get(p, 0.0) + float(v)
+        return sorted(acc.items())
+
+    def counter(self, key: str) -> float:
+        return float(self.counters.get(key, 0.0))
+
+
+class Rule:
+    """One diagnosis. ``id`` names the finding; ``incident`` is the
+    prior incident that grounds it (docs/PARITY.md table); ``evaluate``
+    returns (status, finding-or-None)."""
+
+    id: str = ""
+    doc: str = ""
+    incident: str = ""
+
+    def evaluate(self, ctx: DoctorContext):
+        raise NotImplementedError
+
+
+# ---------------------------------------------------------------------------
+# the rules
+# ---------------------------------------------------------------------------
+
+class BoundaryWallRule(Rule):
+    id = "boundary-wall"
+    doc = "pass-boundary build+H2D dominates the pass wall"
+    incident = ("ROADMAP 'Kill the pass-boundary wall': recorded e2e "
+                "rounds show boundary_seconds 23-68s against 39-115s "
+                "train per pass — up to half the wall is working-set "
+                "build + H2D")
+    SHARE = 0.25
+
+    def evaluate(self, ctx):
+        passes = [p for p in ctx.attribution.get("passes", [])
+                  if p["stages"].get("boundary", 0.0) > 0.0]
+        if not passes:
+            return "no-data", None
+        worst = max(passes, key=lambda p: p["boundary_share"])
+        if worst["boundary_share"] < self.SHARE:
+            return "quiet", None
+        summary = ctx.attribution["summary"]
+        ev = {
+            "worst_pass": worst["pass_id"],
+            "boundary_seconds": worst["stages"]["boundary"],
+            "train_seconds": worst["stages"].get("train", 0.0),
+            "boundary_share": worst["boundary_share"],
+            "boundary_share_per_pass":
+                summary.get("boundary_share_per_pass"),
+            "trend": summary.get("boundary_share_trend"),
+            "overlap_headroom_seconds":
+                summary.get("overlap_headroom_seconds"),
+        }
+        if "boundary_split" in worst:
+            ev["boundary_split"] = worst["boundary_split"]
+        if ctx.world:
+            for pv in ctx.world.get("passes", []):
+                if pv.get("pass_id") == worst["pass_id"] \
+                        and "straggler" in pv:
+                    ev["straggler_rank"] = pv["straggler"]
+        return "fired", Finding(
+            self.id, "warn",
+            f"pass {worst['pass_id']}: boundary work is "
+            f"{worst['boundary_share']:.0%} of the pass wall "
+            f"({worst['stages']['boundary']:.2f}s of "
+            f"{worst['wall_seconds']:.2f}s)", ev,
+            "overlap the next pass's build with this pass's tail: "
+            "train_pass(preload_keys=next_pass_keys); the boundary_split "
+            "says whether build (host fetch / spill fault-in) or H2D is "
+            "the heavy half — spill fault-in responds to "
+            "flags.spill_cache_rows, H2D to resident-row reuse "
+            "(ROADMAP: incremental feeds + per-host shard ownership)")
+
+
+class ExchangeOverflowRule(Rule):
+    id = "exchange-overflow"
+    doc = "all_to_all capacity overflow retries growing across passes"
+    incident = ("PR 9: exchange overflow is never silent — drops are "
+                "counted, eval passes re-run at a grown factor "
+                "(exchange.eval.pre_retry); sustained retry growth means "
+                "the adaptive doubling is chasing a skewed key "
+                "distribution every pass")
+
+    def evaluate(self, ctx):
+        retries = ctx.pass_deltas("exchange.overflow_retries")
+        dropped = ctx.pass_deltas("exchange.overflow_dropped")
+        if not retries and not dropped \
+                and ctx.counter("exchange.overflow_retries") == 0 \
+                and ctx.counter("exchange.overflow_dropped") == 0:
+            # no exchange traffic at all -> the rule has nothing to read
+            if not ctx.pass_deltas("exchange.tokens") \
+                    and ctx.counter("exchange.tokens") == 0:
+                return "no-data", None
+            return "quiet", None
+        total_r = sum(v for _, v in retries) \
+            or ctx.counter("exchange.overflow_retries")
+        total_d = sum(v for _, v in dropped) \
+            or ctx.counter("exchange.overflow_dropped")
+        growing = (len(retries) >= 2 and retries[-1][1] >= retries[0][1]
+                   and retries[-1][1] > 0)
+        if total_d <= 0 and not growing and total_r <= 0:
+            return "quiet", None
+        sev = "critical" if total_d > 0 else "warn"
+        return "fired", Finding(
+            self.id, sev,
+            (f"exchange overflow: {int(total_r)} retries"
+             + (f", {int(total_d)} dropped tokens" if total_d else "")
+             + (" — retries are not decaying across passes"
+                if growing else "")),
+            {"retries_per_pass": retries, "dropped_per_pass": dropped,
+             "total_retries": int(total_r), "total_dropped": int(total_d)},
+            "raise flags.exchange_capacity_factor so lanes start sized "
+            "for the observed skew (routed_capacity_preplan covers train "
+            "passes; eval retries re-run whole passes), and check the "
+            "per-pass dedup ratio — a duplication shift changes the "
+            "per-destination histogram the preplan sized for")
+
+
+class SpillThrashRule(Rule):
+    id = "spill-thrash"
+    doc = "RAM hot-tier hit rate collapsed / admission-eviction thrash"
+    incident = ("PR 10: the direct-mapped 'last wins' install thrashed "
+                "hot rows out of RAM on cold scans — the show-count-"
+                "weighted policy replaced it; a collapsed hit rate or "
+                "admitted~evicted churn is that failure shape returning")
+    COLLAPSE = 0.6      # latest rate below this fraction of the best
+    ABS_LOW = 0.5       # ...or absolutely below this with churn
+
+    def evaluate(self, ctx):
+        hits = dict(ctx.pass_deltas("spill.cache_hits"))
+        misses = dict(ctx.pass_deltas("spill.cache_misses"))
+        rates = []
+        for p in sorted(set(hits) | set(misses)):
+            seen = hits.get(p, 0.0) + misses.get(p, 0.0)
+            if seen:
+                rates.append((p, hits.get(p, 0.0) / seen))
+        if not rates:
+            return "no-data", None
+        adm = dict(ctx.pass_deltas("tiering.admitted"))
+        evc = dict(ctx.pass_deltas("tiering.evicted"))
+        last_p, last_rate = rates[-1]
+        best = max(r for _, r in rates)
+        churn = (adm.get(last_p, 0.0) > 0
+                 and evc.get(last_p, 0.0) >= 0.9 * adm.get(last_p, 0.0))
+        collapsed = len(rates) >= 2 and last_rate < self.COLLAPSE * best
+        thrash = last_rate < self.ABS_LOW and churn
+        if not collapsed and not thrash:
+            return "quiet", None
+        return "fired", Finding(
+            self.id, "warn",
+            (f"pass {last_p}: spill hot-tier hit rate "
+             f"{last_rate:.0%}" +
+             (f" (was {best:.0%})" if collapsed else "") +
+             (" with admission/eviction churn" if churn else "")),
+            {"hit_rate_per_pass": [(p, round(r, 4)) for p, r in rates],
+             "admitted_last_pass": adm.get(last_p),
+             "evicted_last_pass": evc.get(last_p)},
+            "raise flags.spill_cache_rows toward the pass working set's "
+            "hot fraction (rows x row_width x 4B per shard is the RAM "
+            "bill); if the budget is right, the geometry is the suspect "
+            "— direct-mapped conflict misses cap the hit rate on "
+            "adversarial slot collisions (ROADMAP tiered-table "
+            "follow-ups)")
+
+
+class DedupDriftRule(Rule):
+    id = "dedup-drift"
+    doc = "per-pass dedup ratio drifted — duplication profile shifted"
+    incident = ("PR 2/PR 9: pack/push engine selection and exchange lane "
+                "sizing were tuned against a measured duplication "
+                "profile (multihot4 ~2.6x); a drifted ratio silently "
+                "invalidates push_dedup_premerge A/Bs and capacity "
+                "preplans")
+    REL = 0.25
+
+    def _ratios(self, ctx, num, den):
+        n, d = dict(ctx.pass_deltas(num)), dict(ctx.pass_deltas(den))
+        return [(p, n.get(p, 0.0) / d[p]) for p in sorted(d) if d.get(p)]
+
+    def evaluate(self, ctx):
+        ratios = self._ratios(ctx, "exchange.unique_lanes",
+                              "exchange.tokens")
+        if not ratios:
+            ratios = self._ratios(ctx, "trainer.plan_unique_tokens",
+                                  "trainer.plan_tokens")
+        if len(ratios) < 2:
+            return "no-data", None
+        first, last = ratios[0][1], ratios[-1][1]
+        drift = abs(last - first) / max(first, 1e-9)
+        if drift <= self.REL:
+            return "quiet", None
+        return "fired", Finding(
+            self.id, "warn",
+            f"dedup ratio drifted {drift:.0%} across passes "
+            f"({first:.3f} -> {last:.3f})",
+            {"dedup_ratio_per_pass": [(p, round(r, 4))
+                                      for p, r in ratios]},
+            "the duplication profile the engines were tuned on has "
+            "moved: re-check upstream merge (dataset merge_by_ins_id / "
+            "feed dedup) and re-A/B flags.push_dedup_premerge and the "
+            "exchange capacity preplan against the new ratio")
+
+
+class PushFloorRule(Rule):
+    id = "push-floor"
+    doc = "sparse push measured off its analytic floor"
+    incident = ("ROADMAP 'Close the recorded push floors': an 11ms push "
+                "can pass an MFU audit while sitting 10x above its own "
+                "physics — step_probe.push_floor_analysis closes each "
+                "bench point against the floor, and a non-closed floor "
+                "is the alarm line")
+
+    def evaluate(self, ctx):
+        floor = ctx.detail.get("push_floor")
+        if not isinstance(floor, dict) or "closed" not in floor:
+            return "no-data", None
+        closed = floor["closed"]
+        if closed is True:
+            return "quiet", None
+        if isinstance(closed, str) and not closed.startswith("measured"):
+            return "no-data", None      # abstained (no peaks/measurement)
+        return "fired", Finding(
+            self.id, "warn",
+            f"push engine {floor.get('engine')} is off its recorded "
+            f"floor: {closed}",
+            {"engine": floor.get("engine"),
+             "floor_seconds": floor.get("floor_seconds"),
+             "measured_push_seconds": floor.get("measured_push_seconds")},
+            "A/B flags.push_engine (kernel vs scatter) and "
+            "flags.pack_engine at this geometry before trusting the "
+            "step; the floor statement names which sub-stage "
+            "(kernel DMA / one-hot dots / fused update) carries the gap")
+
+
+class NanGuardRule(Rule):
+    id = "nan-guard"
+    doc = "the nan/inf guard tripped"
+    incident = ("PR 4 nan-guard wiring: flags.check_nan_inf aborts the "
+                "pass on non-finite leaves and dumps the step scope — a "
+                "trip is never noise; the PR-3 'pass-2 loss worse' "
+                "investigation began as exactly this signature")
+
+    def evaluate(self, ctx):
+        trips = sum(v for _, v in ctx.pass_deltas("trainer.nan_trips")) \
+            or ctx.counter("trainer.nan_trips")
+        events = ctx.evidence.get("nan_guard") or []
+        if trips <= 0 and not events:
+            return "quiet", None
+        ev: dict = {"trips": int(trips) or len(events)}
+        if events:
+            f0 = events[0].get("fields") or {}
+            ev["first_trip"] = {"pass_id": events[0].get("pass_id"),
+                                "step": events[0].get("step"),
+                                "paths": f0.get("paths"),
+                                "n_bad": f0.get("n_bad")}
+        return "fired", Finding(
+            self.id, "critical",
+            f"nan/inf guard tripped {ev['trips']} time(s)", ev,
+            "inspect the nan_step scope dump next to the error "
+            "(TrainerConfig.nan_dump_dir) — the dumped paths name the "
+            "first non-finite plane; keep flags.check_nan_inf on until "
+            "the source batch/plane is identified")
+
+
+class ServingStalenessRule(Rule):
+    id = "serving-staleness"
+    doc = "serving is falling behind training (stale model / failed "\
+          "publishes)"
+    incident = ("PR 7: a publish failure degrades instead of killing "
+                "the pass loop — serving stays on its last good version "
+                "and the STALENESS gauges are the alarm; silent-stale "
+                "serving is the failure the donefile protocol exists to "
+                "prevent")
+    PASS_LAG = 2
+    STALE_S = 600.0
+
+    def evaluate(self, ctx):
+        # per-pass deltas first, cumulative counter as the FALLBACK —
+        # never both (the CLI's counters ARE the summed deltas, so
+        # counter + deltas would double-count every failure)
+        def total(key):
+            return sum(v for _, v in ctx.pass_deltas(key)) \
+                or ctx.counter(key)
+
+        def peak(key):
+            # GAUGE reconstruction: stats_delta carries change-per-pass
+            # (last minus first), so a staleness that grows a little
+            # every pass shows tiny deltas — the absolute value is the
+            # running SUM of the deltas (gauges start at 0 in a fresh
+            # process); take its max across passes, falling back to the
+            # live snapshot when no deltas were recorded
+            deltas = ctx.pass_deltas(key)
+            if not deltas:
+                return ctx.counter(key)
+            run = mx = 0.0
+            for _, v in deltas:
+                run += v
+                mx = max(mx, run)
+            return mx
+
+        failures = total("serving.publish_failures") \
+            or len(ctx.evidence.get("serving_publish_failed") or [])
+        lag = peak("serving.pass_lag")
+        stale = peak("serving.staleness_seconds")
+        publishes = total("serving.publishes")
+        if failures == 0 and lag == 0 and stale == 0 and publishes == 0 \
+                and not ctx.evidence.get("serving_publish_failed"):
+            return "no-data", None
+        if failures <= 0 and lag < self.PASS_LAG and stale < self.STALE_S:
+            return "quiet", None
+        sev = "critical" if failures > 0 else "warn"
+        return "fired", Finding(
+            self.id, sev,
+            (f"serving staleness: {int(failures)} failed publish(es), "
+             f"pass lag {lag:g}, staleness {stale:g}s"),
+            {"publish_failures": int(failures), "pass_lag": lag,
+             "staleness_seconds": stale,
+             "failed_events": [
+                 (e.get("fields") or {}).get("error")
+                 for e in (ctx.evidence.get("serving_publish_failed")
+                           or [])][:4]},
+            "serving keeps its last good version by design — check the "
+            "publisher's error (serving.publish_failures counter / "
+            "serving_publish_failed events), the donefile root, and the "
+            "server's serving.poll_failures; shed-on-stale belongs at "
+            "the frontend if staleness persists")
+
+
+class HeartbeatGapRule(Rule):
+    id = "heartbeat-gap"
+    doc = "a peer's heartbeat stopped or its progress stalled"
+    incident = ("PR 5/6: the watchdog names lost/stalled peers by "
+                "ORIGINAL launcher rank; a heartbeat gap precedes every "
+                "elastic shrink — seeing it in telemetry before the "
+                "barrier timeout is the operator's head start")
+
+    def evaluate(self, ctx):
+        lost = int(ctx.counter("resilience.peer_lost")
+                   or sum(v for _, v in
+                          ctx.pass_deltas("resilience.peer_lost")))
+        stalled = int(ctx.counter("resilience.peer_stalled")
+                      or sum(v for _, v in
+                             ctx.pass_deltas("resilience.peer_stalled")))
+        events = (ctx.evidence.get("peer_lost") or []) \
+            + (ctx.evidence.get("peer_stalled") or [])
+        if lost + stalled <= 0 and not events:
+            # quiet only when the resilience plane provably exists in
+            # this telemetry (any resilience.* series, or an election
+            # event) — a single-host run without heartbeats is no-data,
+            # never "heartbeats checked, all healthy"
+            plane = (any(k.startswith("resilience.")
+                         for k in ctx.counters)
+                     or ctx.evidence.get("resume_election"))
+            return ("quiet" if plane else "no-data"), None
+        ranks = sorted({(e.get("fields") or {}).get("rank")
+                        for e in events
+                        if (e.get("fields") or {}).get("rank")
+                        is not None})
+        return "fired", Finding(
+            self.id, "critical",
+            (f"heartbeat gaps: {lost} lost, {stalled} stalled"
+             + (f" (ranks {ranks})" if ranks else "")),
+            {"peer_lost": lost, "peer_stalled": stalled,
+             "ranks": ranks,
+             "events": [{"name": e.get("name"),
+                         "rank": (e.get("fields") or {}).get("rank"),
+                         "after_s": (e.get("fields") or {}).get("after_s")}
+                        for e in events[:8]]},
+            "inspect the named rank's host (OOM/preemption for lost, "
+            "hung collective or dead remote FS for stalled); "
+            "flags.elastic_min_world governs whether the world shrinks "
+            "past it or checkpoints and exits")
+
+
+class SinkHealthRule(Rule):
+    id = "sink-health"
+    doc = "a telemetry sink dropped events, latched an error, or was "\
+          "detached"
+    incident = ("ISSUE 12 satellite: a silently-detached JSONL sink "
+                "used to manifest as a mysteriously short stream — the "
+                "hub's 3-strike detach and the queue-full drop counter "
+                "must be VISIBLE, because every other rule reads the "
+                "stream this one audits")
+
+    def evaluate(self, ctx):
+        bad = [s for s in ctx.sink_health
+               if s.get("dropped") or s.get("error")
+               or s.get("state") == "detached"]
+        meta_drops = sum((e.get("fields") or {}).get("dropped", 0)
+                         for e in (ctx.evidence.get("sink_dropped") or []))
+        if not ctx.sink_health and not ctx.evidence.get("sink_dropped"):
+            return "no-data", None
+        # fire only on SESSION-scoped evidence (unhealthy sink entries,
+        # in-stream drop records) — the process-cumulative
+        # monitor.sink_errors counter survives hub sessions and a single
+        # recovered blip would latch the rule fired forever; it rides
+        # along as evidence only
+        if not bad and meta_drops == 0:
+            return "quiet", None
+        return "fired", Finding(
+            self.id, "warn",
+            (f"telemetry sink trouble: {len(bad)} unhealthy sink(s), "
+             f"{int(meta_drops)} dropped events recorded in-stream"),
+            {"sinks": bad[:4], "stream_dropped": int(meta_drops),
+             "sinks_detached": int(ctx.counter("monitor.sinks_detached")),
+             "sink_errors": int(ctx.counter("monitor.sink_errors"))},
+            "the streams every other diagnosis reads are incomplete: "
+            "raise flags.telemetry_queue_size (queue-full drops), turn "
+            "on flags.telemetry_rotate_mb (unbounded single file on "
+            "day-scale runs), and check the latched sink error "
+            "(full disk / dead path)")
+
+
+ALL_RULES: "tuple[type[Rule], ...]" = (
+    BoundaryWallRule,
+    ExchangeOverflowRule,
+    SpillThrashRule,
+    DedupDriftRule,
+    PushFloorRule,
+    NanGuardRule,
+    ServingStalenessRule,
+    HeartbeatGapRule,
+    SinkHealthRule,
+)
+
+_SEV_ORDER = {"critical": 0, "warn": 1, "info": 2}
+
+
+# ---------------------------------------------------------------------------
+# diagnosis + report schema
+# ---------------------------------------------------------------------------
+
+def diagnose(flights=None, counters=None, evidence=None, world=None,
+             detail=None, sink_health=None, inputs=None) -> dict:
+    """Evaluate every rule over the given telemetry; returns the report
+    (validate with :func:`validate_report`)."""
+    ctx = DoctorContext(flights=flights, counters=counters,
+                        evidence=evidence, world=world, detail=detail,
+                        sink_health=sink_health)
+    rules = []
+    findings = []
+    for rule_cls in ALL_RULES:
+        rule = rule_cls()
+        try:
+            status, finding = rule.evaluate(ctx)
+        except Exception as e:   # a broken rule must not mask the others
+            status, finding = "no-data", None
+            rules.append({"rule": rule.id, "status": status,
+                          "error": repr(e)[:200]})
+            continue
+        rules.append({"rule": rule.id, "status": status})
+        if finding is not None:
+            findings.append(finding)
+    findings.sort(key=lambda f: _SEV_ORDER.get(f["severity"], 9))
+    report = {
+        "type": "doctor_report",
+        "version": REPORT_VERSION,
+        "inputs": list(inputs or []),
+        "passes": [fr.get("pass_id") for fr in ctx.flights],
+        "critical_path": ctx.attribution,
+        "rules": rules,
+        "findings": findings,
+        "verdict": ("healthy" if not findings
+                    else f"findings:{len(findings)}"),
+    }
+    if world is not None:
+        report["world"] = {
+            "world_size": world.get("world_size"),
+            "ranks": [r.get("rank") for r in world.get("ranks", [])],
+            "passes": world.get("passes"),
+            "stream_errors": sum(r.get("error_count", 0)
+                                 for r in world.get("ranks", []))}
+    return report
+
+
+def validate_report(report: dict) -> "list[str]":
+    """Schema errors for a doctor report (empty = valid) — the report is
+    a machine contract like the flight record (bench asserts it)."""
+    errs: list[str] = []
+    if not isinstance(report, dict):
+        return ["report is not an object"]
+    if report.get("type") != "doctor_report":
+        errs.append(f"type is {report.get('type')!r}")
+    if report.get("version") != REPORT_VERSION:
+        errs.append(f"version is {report.get('version')!r}")
+    if not isinstance(report.get("verdict"), str):
+        errs.append("verdict missing")
+    cp = report.get("critical_path")
+    if not isinstance(cp, dict) or "passes" not in cp:
+        errs.append("critical_path.passes missing")
+    else:
+        for p in cp["passes"]:
+            for k in ("pass_id", "stages", "limiter", "wall_seconds"):
+                if k not in p:
+                    errs.append(f"critical_path pass missing {k!r}")
+    rules = report.get("rules")
+    if not isinstance(rules, list) or not rules:
+        errs.append("rules missing")
+    else:
+        seen = {r.get("rule") for r in rules}
+        for rule_cls in ALL_RULES:
+            if rule_cls.id not in seen:
+                errs.append(f"rule {rule_cls.id!r} was not evaluated")
+        for r in rules:
+            if r.get("status") not in RULE_STATUSES:
+                errs.append(f"rule {r.get('rule')!r} has status "
+                            f"{r.get('status')!r}")
+    for f in report.get("findings", []):
+        for k in ("rule", "severity", "summary", "evidence", "suggestion"):
+            if k not in f:
+                errs.append(f"finding missing {k!r}")
+    return errs
+
+
+# ---------------------------------------------------------------------------
+# live mode (flags.doctor_live — called by TelemetryHub.end_pass)
+# ---------------------------------------------------------------------------
+
+def diagnose_hub(hub, detail=None) -> dict:
+    """Diagnose a live hub's in-memory state (flight-record ring, the
+    cumulative counter registry, this session's sink health) — the ONE
+    assembly run_live, the bench artifact embed, and the example all
+    share."""
+    return diagnose(flights=hub.flight_records(),
+                    counters=STATS.snapshot(),
+                    sink_health=hub.sink_health(),
+                    detail=detail)
+
+
+def run_live(hub) -> "list[dict]":
+    """Evaluate the rules against the hub's in-memory state; emit one
+    ``doctor.finding`` event per finding (pass-tagged — end_pass calls
+    this before the scope closes) and return the findings."""
+    findings = diagnose_hub(hub)["findings"]
+    for f in findings:
+        hub.event("doctor.finding", type="doctor", rule=f["rule"],
+                  severity=f["severity"], summary=f["summary"],
+                  suggestion=f["suggestion"])
+    if findings:
+        STATS.add("doctor.findings", len(findings))
+    return findings
+
+
+# ---------------------------------------------------------------------------
+# CLI
+# ---------------------------------------------------------------------------
+
+def render_text(report: dict) -> str:
+    lines = [f"run doctor — verdict: {report['verdict']}"]
+    world = report.get("world")
+    if world:
+        lines.append(f"world: {world.get('world_size')} rank(s) "
+                     f"{world.get('ranks')}, "
+                     f"{world.get('stream_errors', 0)} stream error(s)")
+    for p in report["critical_path"].get("passes", []):
+        stages = " ".join(f"{k}={v:.3f}s"
+                          for k, v in sorted(p["stages"].items()))
+        lines.append(
+            f"pass {p['pass_id']}: wall={p['wall_seconds']:.3f}s "
+            f"limiter={p['limiter']} ({p['limiter_share']:.0%}) {stages}")
+    summary = report["critical_path"].get("summary") or {}
+    if summary:
+        lines.append(
+            f"limiter: {summary.get('limiter')} "
+            f"(boundary share trend: "
+            f"{summary.get('boundary_share_trend')}, overlap headroom "
+            f"{summary.get('overlap_headroom_seconds', 0):.1f}s)")
+    lines.append("rules: " + " ".join(
+        f"{r['rule']}={r['status']}" for r in report["rules"]))
+    for f in report["findings"]:
+        lines.append(f"[{f['severity'].upper()}] {f['rule']}: "
+                     f"{f['summary']}")
+        ev = json.dumps(f["evidence"], default=str)[:400]
+        lines.append(f"  evidence: {ev}")
+        lines.append(f"  suggestion: {f['suggestion']}")
+    if not report["findings"]:
+        lines.append("no findings — every fired rule stayed quiet")
+    return "\n".join(lines)
+
+
+def main(argv: "list[str] | None" = None) -> int:
+    argv = list(sys.argv[1:] if argv is None else argv)
+    as_json = "--json" in argv
+    argv = [a for a in argv if a != "--json"]
+    rank_names = None
+    if "--rank-names" in argv:
+        i = argv.index("--rank-names")
+        try:
+            rank_names = [int(x) for x in argv[i + 1].split(",") if x]
+        except (IndexError, ValueError):
+            print("--rank-names wants a comma-separated int list",
+                  file=sys.stderr)
+            return 2
+        del argv[i:i + 2]
+    roots = [a for a in argv if not a.startswith("-")]
+    if not roots:
+        print("usage: python -m paddlebox_tpu.monitor.doctor "
+              "<telemetry_dir>... [--json] [--rank-names 4,5,7]",
+              file=sys.stderr)
+        return 2
+    from paddlebox_tpu.monitor import aggregate as agg_lib
+    try:
+        world = agg_lib.aggregate(roots, rank_names=rank_names)
+    except (OSError, ValueError) as e:
+        print(f"doctor: cannot read telemetry roots: {e}",
+              file=sys.stderr)
+        return 2
+    if not any(r["events"] for r in world["ranks"]):
+        print(f"doctor: no events found under {roots}", file=sys.stderr)
+        return 2
+    report = diagnose(flights=world["flight_records"],
+                      counters=world["counters"],
+                      evidence=world["evidence"],
+                      world=world if len(roots) > 1 else None,
+                      inputs=roots)
+    errs = validate_report(report)
+    if errs:                      # the contract guards itself
+        print(f"doctor: internal schema errors: {errs}", file=sys.stderr)
+        return 2
+    print(json.dumps(report, default=str) if as_json
+          else render_text(report), flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -85,6 +85,23 @@ def validate_flight_record(rec: dict) -> list[str]:
         tt = extra.get("table_tiering")
         if tt is not None and not isinstance(tt, str):
             errs.append("extra['table_tiering'] is not a string")
+        # the pass-boundary account (trainer extra): the wall is a
+        # non-negative number and the split is a flat object of
+        # non-negative component seconds — the critical-path attributor
+        # (monitor/critical_path.py) consumes both verbatim
+        bs = extra.get("boundary_seconds")
+        if bs is not None and (not isinstance(bs, numbers.Real) or bs < 0):
+            errs.append("extra['boundary_seconds'] is not a non-negative "
+                        "number")
+        split = extra.get("boundary_split")
+        if split is not None:
+            if not isinstance(split, dict):
+                errs.append("extra['boundary_split'] is not an object")
+            else:
+                for name, v in split.items():
+                    if not isinstance(v, numbers.Real) or v < 0:
+                        errs.append(f"boundary_split[{name!r}] is not a "
+                                    "non-negative number")
     return errs
 
 

@@ -93,7 +93,8 @@ class _Span:
 
 class _OpenPass:
     __slots__ = ("handle", "t0", "stats0", "owner", "stage_seconds",
-                 "steps", "examples", "train_seconds", "extra")
+                 "steps", "examples", "train_seconds", "extra",
+                 "boundary_seconds", "boundary_split")
 
     def __init__(self, handle, stats0, owner):
         self.handle = handle
@@ -105,6 +106,12 @@ class _OpenPass:
         self.examples = 0
         self.train_seconds = 0.0
         self.extra: dict = {}
+        # pass-boundary account: ACCUMULATES like stage_seconds — phased
+        # programs run several train_passes per pass, and last-write-wins
+        # extras would keep only the cheap rebuild's boundary (dropping
+        # the expensive first build the boundary-wall rule exists for)
+        self.boundary_seconds = 0.0
+        self.boundary_split: dict[str, float] | None = None
 
 
 class TelemetryHub:
@@ -122,6 +129,14 @@ class TelemetryHub:
         self._flight_records: collections.deque = collections.deque(
             maxlen=self.FLIGHT_KEEP)
         self.sink_errors = 0
+        # sinks detached by the 3-strike rule / closed by disable(), kept
+        # for summary(): a silently-detached JSONL sink must be VISIBLE
+        # in artifacts instead of manifesting as a short stream
+        self._detached: collections.deque = collections.deque(maxlen=8)
+        self._closed: collections.deque = collections.deque(maxlen=8)
+        # findings of the last live-doctor evaluation (flags.doctor_live;
+        # BoxPS.end_pass embeds them in its return value)
+        self.last_doctor_findings: list | None = None
 
     # ---- sinks / enablement ---------------------------------------------
 
@@ -131,23 +146,33 @@ class TelemetryHub:
 
     def enable(self, *sinks: Sink) -> None:
         """Attach sinks and turn the event stream on. Idempotent; extra
-        calls add sinks."""
+        calls add sinks. Turning on from disabled starts a fresh sink-
+        health session (the previous session's detached/closed sinks
+        drop out of summary())."""
         with self._lock:
+            if not self._enabled:
+                self._detached.clear()
+                self._closed.clear()
             self._sinks = self._sinks + tuple(sinks)
             self._enabled = True
 
     def disable(self) -> None:
         """Turn the event stream off and close every sink (joins the JSONL
-        writer thread). Counters/gauges stay live."""
+        writer thread). Counters/gauges stay live; the closed sinks'
+        final health stats stay readable through :meth:`summary` until
+        the next :meth:`enable` starts a fresh session."""
         with self._lock:
             sinks, self._sinks = self._sinks, ()
-            self._enabled = False
+            was_enabled, self._enabled = self._enabled, False
+            if was_enabled:
+                self._closed.clear()
         for s in sinks:
             try:
                 s.flush()
                 s.close()
             except Exception:
                 self.sink_errors += 1
+            self._closed.append(s)
 
     def sinks(self) -> tuple:
         return self._sinks
@@ -200,6 +225,8 @@ class TelemetryHub:
                     with self._lock:
                         self._sinks = tuple(x for x in self._sinks
                                             if x is not s)
+                        self._detached.append(s)
+                    STATS.add("monitor.sinks_detached", 1)
 
     # ---- pass lifecycle --------------------------------------------------
 
@@ -231,10 +258,15 @@ class TelemetryHub:
 
     def record_train(self, stage_seconds: dict | None = None,
                      steps: int = 0, examples: int = 0,
-                     seconds: float = 0.0, **extra) -> None:
+                     seconds: float = 0.0,
+                     boundary_seconds: float = 0.0,
+                     boundary_split: dict | None = None,
+                     **extra) -> None:
         """Trainer contribution to the open pass's flight record (stage
-        split, throughput inputs, loss/auc extras). Accumulates — phased
-        programs run several train_passes per pass."""
+        split, throughput inputs, boundary account, loss/auc extras).
+        Accumulates — phased programs run several train_passes per pass;
+        the boundary account sums like the stage split (extras are
+        last-write-wins, which would drop the first phase's build)."""
         p = self._pass
         if p is None:
             return
@@ -243,6 +275,13 @@ class TelemetryHub:
         p.steps += int(steps)
         p.examples += int(examples)
         p.train_seconds += float(seconds)
+        p.boundary_seconds += float(boundary_seconds or 0.0)
+        if boundary_split is not None:
+            split = p.boundary_split
+            if split is None:
+                split = p.boundary_split = {}
+            for k, v in boundary_split.items():
+                split[k] = split.get(k, 0.0) + float(v)
         p.extra.update({k: v for k, v in extra.items() if v is not None})
 
     def end_pass(self, metrics=None, **extra) -> dict | None:
@@ -285,11 +324,31 @@ class TelemetryHub:
         })
         merged = dict(p.extra)
         merged.update(extra)
+        # the accumulated boundary account wins over anything a caller
+        # put in extras under the same names
+        if p.boundary_seconds or p.boundary_split is not None:
+            merged["boundary_seconds"] = round(p.boundary_seconds, 6)
+        if p.boundary_split is not None:
+            merged["boundary_split"] = {k: round(v, 6) for k, v
+                                        in p.boundary_split.items()}
         if merged:
             rec["extra"] = {k: v for k, v in merged.items()}
         self._flight_records.append(rec)
         if self._enabled:
             self._dispatch(rec)
+        # live doctor (flags.doctor_live): evaluate the incident rules
+        # against the committed records BEFORE the pass scope closes, so
+        # the doctor.finding events carry this pass's tag. Lazy imports:
+        # doctor imports this module, and the analysis layer must never
+        # take down the training it observes.
+        self.last_doctor_findings = None
+        try:
+            from paddlebox_tpu.config import flags as _flags
+            if _flags.doctor_live:
+                from paddlebox_tpu.monitor import doctor as _doctor
+                self.last_doctor_findings = _doctor.run_live(self)
+        except Exception:
+            STATS.add("doctor.errors", 1)
         _profiler().record_instant("pass_end", {"pass_id": c.pass_id})
         context.exit_pass(p.handle)
         return rec
@@ -310,12 +369,37 @@ class TelemetryHub:
 
     # ---- exposition / embed ----------------------------------------------
 
+    # Alert series the run doctor's rules key off (monitor/doctor.py) —
+    # always exported, zero-filled when untouched, so a scrape target at
+    # training or serving /metrics never gains/loses series depending on
+    # which subsystem has fired yet (an alert on a missing series is
+    # undefined; an alert on a zero series is quiet).
+    ALERT_COUNTERS = ("exchange.overflow_retries",
+                      "exchange.overflow_dropped",
+                      "tiering.admitted", "tiering.evicted",
+                      "spill.cache_hits", "spill.cache_misses",
+                      "trainer.nan_trips", "doctor.findings",
+                      "resilience.peer_lost", "resilience.peer_stalled",
+                      "serving.publish_failures")
+    ALERT_GAUGES = ("tiering.hot_rows",)
+
     def prometheus_text(self, prefix: str = "pbtpu") -> str:
         """Prometheus text exposition of the counter/gauge registry (names
         sanitized to the metric charset; gauges are the names set through
-        :meth:`gauge_set`, everything else a counter)."""
+        :meth:`gauge_set`, everything else a counter). The doctor's alert
+        series (ALERT_COUNTERS/ALERT_GAUGES) are always present, and the
+        derived ``tiering.hot_hit_rate`` gauge — RAM-tier hits over total
+        reads — is computed here so the same signal the spill rules
+        diagnose on is directly scrapeable."""
         snap = STATS.snapshot()
-        gauges = set(self._gauges)
+        gauges = set(self._gauges) | set(self.ALERT_GAUGES)
+        for k in self.ALERT_COUNTERS + self.ALERT_GAUGES:
+            snap.setdefault(k, 0.0)
+        seen = snap.get("spill.cache_hits", 0.0) \
+            + snap.get("spill.cache_misses", 0.0)
+        snap["tiering.hot_hit_rate"] = (
+            snap.get("spill.cache_hits", 0.0) / seen if seen else 0.0)
+        gauges.add("tiering.hot_hit_rate")
         out: list[str] = []
         for k in sorted(snap):
             n = prefix + "_" + re.sub(r"[^a-zA-Z0-9_:]", "_", k)
@@ -324,14 +408,45 @@ class TelemetryHub:
             out.append(f"{n} {snap[k]:g}")
         return "\n".join(out) + "\n"
 
+    @staticmethod
+    def _sink_info(s, state: str) -> dict:
+        info = {"type": type(s).__name__, "state": state,
+                "strikes": int(getattr(s, "_hub_errors", 0) or 0),
+                "dropped": int(getattr(s, "dropped", 0) or 0)}
+        for k in ("written", "rotations"):
+            v = getattr(s, k, None)
+            if v is not None:
+                info[k] = int(v)
+        err = getattr(s, "error", None)
+        if err is not None:
+            info["error"] = repr(err)[:200]
+        path = getattr(s, "path", None)
+        if path:
+            info["path"] = path
+            info["segments"] = len(getattr(s, "segments", None) or ())
+        return info
+
+    def sink_health(self) -> list[dict]:
+        """Per-sink health for this telemetry session: live sinks, sinks
+        the 3-strike rule detached, and sinks disable() closed — with
+        queue-drop counts, latched write errors, and rotation state. The
+        bench artifact embeds this, so a silently-detached or erroring
+        JSONL sink reads as exactly that instead of as a mysteriously
+        short event stream."""
+        return ([self._sink_info(s, "attached") for s in self._sinks]
+                + [self._sink_info(s, "detached") for s in self._detached]
+                + [self._sink_info(s, "closed") for s in self._closed])
+
     def summary(self) -> dict:
         """Compact snapshot for artifact embeds (bench.py detail)."""
-        dropped = sum(getattr(s, "dropped", 0) for s in self._sinks)
+        sinks = self.sink_health()
+        dropped = sum(i["dropped"] for i in sinks)
         return {"enabled": self._enabled,
                 "counters": STATS.snapshot(),
                 "gauges": sorted(self._gauges),
                 "sink_errors": self.sink_errors,
                 "events_dropped": dropped,
+                "sinks": sinks,
                 "flight_records": list(self._flight_records)[-8:]}
 
 
@@ -340,6 +455,41 @@ _HUB = TelemetryHub()
 
 def hub() -> TelemetryHub:
     return _HUB
+
+
+def start_metrics_endpoint(port: int = 0, host: str = "127.0.0.1"):
+    """Training-side ``/metrics``: a tiny stdlib HTTP endpoint serving
+    the hub's Prometheus exposition — the twin of ServingServer's
+    ``/metrics`` (serving/server.py), so the doctor's alert series
+    (``exchange.overflow_retries``, ``tiering.hot_rows``, the derived
+    hit rate) are scrapeable from a TRAINING process too. port=0 binds
+    an ephemeral port; read it off the returned server's
+    ``server_address[1]``; call ``.shutdown()`` to stop."""
+    import http.server
+
+    class _Handler(http.server.BaseHTTPRequestHandler):
+        def do_GET(self):  # noqa: N802 (stdlib API)
+            if self.path.startswith("/metrics"):
+                body = _HUB.prometheus_text().encode()
+                self.send_response(200)
+                self.send_header("Content-Type",
+                                 "text/plain; version=0.0.4")
+            else:
+                body = b"not found\n"
+                self.send_response(404)
+                self.send_header("Content-Type", "text/plain")
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+        def log_message(self, *a):   # quiet: telemetry is the log
+            pass
+
+    srv = http.server.ThreadingHTTPServer((host, int(port)), _Handler)
+    t = context.spawn(srv.serve_forever, name="pbtpu-metrics-http")
+    t.start()
+    srv._pbtpu_thread = t        # joinable after shutdown()
+    return srv
 
 
 # module-level conveniences (the instrumented call-site surface)

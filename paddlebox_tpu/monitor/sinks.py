@@ -61,6 +61,17 @@ class MemorySink(Sink):
         return [r for r in self._ring if r.get("name") == name]
 
 
+def segment_path(path: str, n: int) -> str:
+    """Path of rotation segment ``n`` of a JSONL stream: segment 0 is
+    ``path`` itself, segment k>0 inserts a zero-padded ordinal before the
+    extension (``events.jsonl`` -> ``events.00001.jsonl``) so a plain
+    lexical sort of the numbered siblings is chronological."""
+    if n == 0:
+        return path
+    root, ext = os.path.splitext(path)
+    return f"{root}.{n:05d}{ext}"
+
+
 class JsonlSink(Sink):
     """Background-thread JSONL event stream (the dump-channel shape,
     boxps_trainer.cc:96-108: producers enqueue, one writer thread owns the
@@ -71,15 +82,34 @@ class JsonlSink(Sink):
     final ``sink_dropped`` record), and a write failure latches ``error``
     while the drain keeps consuming so producers never wedge. The file is
     opened lazily on the writer thread, so a bad path is an ``error``, not
-    an exception at construction."""
+    an exception at construction.
 
-    def __init__(self, path: str, queue_size: int | None = None):
+    Rotation (``flags.telemetry_rotate_mb`` or the ``rotate_mb`` arg):
+    when the current segment exceeds the budget the writer closes it —
+    after a ``sink_rotated`` meta line naming the successor — and opens
+    the next numbered segment (:func:`segment_path`). Every segment is
+    whole lines only, so each stays independently schema-clean, and
+    ``monitor/aggregate.py`` stitches them back in order. A failed
+    rotation latches ``error`` like any other write failure (behind the
+    ``telemetry.rotate.pre`` faultpoint): telemetry stops, training does
+    not."""
+
+    def __init__(self, path: str, queue_size: int | None = None,
+                 rotate_mb: int | None = None):
+        from paddlebox_tpu.config import flags
         if queue_size is None:
-            from paddlebox_tpu.config import flags
             queue_size = flags.telemetry_queue_size
+        if rotate_mb is None:
+            rotate_mb = flags.telemetry_rotate_mb
         self.path = path
+        # the flag is whole MB; the constructor arg accepts fractions so
+        # tests can exercise rotation without megabyte fixtures
+        self.rotate_bytes = (int(float(rotate_mb) * (1 << 20))
+                             if rotate_mb else 0)
+        self.segments: list[str] = [path]   # written, in order
         self.dropped = 0
         self.written = 0
+        self.rotations = 0
         self.error: BaseException | None = None
         self._q: queue.Queue = queue.Queue(maxsize=max(16, queue_size))
         # context.spawn, not a bare Thread: records emitted by the drain
@@ -95,12 +125,37 @@ class JsonlSink(Sink):
         except queue.Full:
             self.dropped += 1
 
+    def _meta(self, name: str, **fields) -> str:
+        return json.dumps({
+            "ts": time.time(), "type": "meta", "name": name,
+            "pass_id": None, "step": None, "phase": None,
+            "thread": threading.current_thread().name,
+            "fields": fields}) + "\n"
+
+    def _rotate(self, f, seg_bytes: int):
+        """Close the full segment and open the successor (writer thread
+        only — it owns the handle). The old segment ends with a meta line
+        naming the next segment so a reader can assert continuity."""
+        from paddlebox_tpu.utils import faultpoint
+        faultpoint.hit("telemetry.rotate.pre")
+        nxt = segment_path(self.path, len(self.segments))
+        f.write(self._meta("sink_rotated", next=os.path.basename(nxt),
+                           segment_bytes=seg_bytes))
+        f.flush()
+        f.close()
+        f = open(nxt, "a")
+        self.segments.append(nxt)
+        self.rotations += 1
+        return f
+
     def _drain(self) -> None:
         f = None
+        seg_bytes = 0
         try:
             d = os.path.dirname(os.path.abspath(self.path))
             os.makedirs(d, exist_ok=True)
             f = open(self.path, "a")
+            seg_bytes = f.tell()
         except BaseException as e:
             self.error = e
         while True:
@@ -110,19 +165,20 @@ class JsonlSink(Sink):
             if self.error is not None:
                 continue              # keep consuming; producers never block
             try:
-                f.write(json.dumps(job, default=str) + "\n")
+                line = json.dumps(job, default=str) + "\n"
+                f.write(line)
                 self.written += 1
+                seg_bytes += len(line)
+                if self.rotate_bytes and seg_bytes >= self.rotate_bytes:
+                    f = self._rotate(f, seg_bytes)
+                    seg_bytes = 0
             except BaseException as e:
                 self.error = e
         if f is not None and self.error is None:
             try:
                 if self.dropped:
-                    f.write(json.dumps({
-                        "ts": time.time(), "type": "meta",
-                        "name": "sink_dropped", "pass_id": None,
-                        "step": None, "phase": None,
-                        "thread": threading.current_thread().name,
-                        "fields": {"dropped": self.dropped}}) + "\n")
+                    f.write(self._meta("sink_dropped",
+                                       dropped=self.dropped))
                 f.flush()
             except BaseException as e:
                 self.error = e

@@ -1276,6 +1276,7 @@ class Trainer:
             raise
         stage_delta = {k: self.timers.total.get(k, 0.0) - stage0.get(k, 0.0)
                        for k in self.timers.total}
+        fm = self.feed_mgr
         hub.record_train(
             stage_seconds=stage_delta, steps=out["steps"],
             examples=out["steps"] * self.cfg.global_batch_size,
@@ -1284,6 +1285,11 @@ class Trainer:
             routed_dropped=out.get("routed_dropped"),
             push_applies=(self.push_applies - applies0) or None,
             pull_engine=self.pull_engine,
+            # pass-boundary cost (this pass's working-set build) + its
+            # split — the run doctor's boundary-wall rule reads both
+            boundary_seconds=round(fm.last_boundary_seconds, 6),
+            boundary_split={k: round(v, 6) for k, v
+                            in fm.last_boundary_split.items()},
             # sharded exchange identity (the per-pass exchange traffic —
             # bytes, dedup ratio, overflow drops — rides the flight
             # record's stats_delta as exchange.* counter deltas)

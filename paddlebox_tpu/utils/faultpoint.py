@@ -126,6 +126,14 @@ POINTS: tuple[str, ...] = (
     # matrix under PBTPU_TABLE_TIERING=spill (sharded spill sub-stores).
     "tiering.save.pre_flush",
     "tiering.evict.pre",
+    # monitor/sinks.JsonlSink._rotate (ISSUE 12): the telemetry writer
+    # thread is about to close a full JSONL segment and open its numbered
+    # successor. An injected failure here must latch the sink's error
+    # (telemetry stops, training does NOT — the hub's isolation contract)
+    # and leave every already-written segment schema-clean; covered
+    # in-process by tests/test_doctor.py, not by the kill matrices
+    # (rotation never fires in the crash workers' small streams).
+    "telemetry.rotate.pre",
 )
 
 # Points that fire only inside the elastic re-formation window: the
@@ -157,6 +165,14 @@ EXCHANGE_POINTS: tuple[str, ...] = (
     "exchange.store.pre_shard_save",
     "exchange.store.pre_manifest",
     "exchange.eval.pre_retry",
+)
+
+# Points that fire only inside the telemetry plane (the JSONL writer
+# thread): the kill→resume matrices never rotate an event stream, and a
+# telemetry fault must by contract never perturb training state — they
+# are covered by the ioerror tests in tests/test_doctor.py instead.
+MONITOR_POINTS: tuple[str, ...] = (
+    "telemetry.rotate.pre",
 )
 
 

@@ -166,7 +166,8 @@ def test_kill_resume_smoke(tmp_path, golden):
                                        "remote_ckpt.download.pre")
                           and p not in faultpoint.ELASTIC_POINTS
                           and p not in faultpoint.SERVING_POINTS
-                          and p not in faultpoint.EXCHANGE_POINTS])
+                          and p not in faultpoint.EXCHANGE_POINTS
+                          and p not in faultpoint.MONITOR_POINTS])
 def test_kill_resume_matrix(point, tmp_path, golden):
     """Every registered fault point: kill there, resume, prove bit-identical
     dense params + table rows + metric state vs the uninterrupted run. The
@@ -275,14 +276,18 @@ def test_every_point_has_a_matrix_entry():
     and are covered by the publish/swap kill matrix
     (tests/test_serving.py); the sharded-exchange points fire only in
     the ShardedEmbeddingStore save / eval-overflow-retry paths and are
-    covered by tests/test_exchange.py. All carry the same
-    closed-registry guard."""
+    covered by tests/test_exchange.py; the telemetry-plane points fire
+    only on the JSONL writer thread — telemetry must never perturb
+    training state — and are covered by tests/test_doctor.py. All carry
+    the same closed-registry guard."""
     assert (set(POINT_AFTER) | set(faultpoint.ELASTIC_POINTS)
             | set(faultpoint.SERVING_POINTS)
-            | set(faultpoint.EXCHANGE_POINTS) == set(faultpoint.POINTS))
+            | set(faultpoint.EXCHANGE_POINTS)
+            | set(faultpoint.MONITOR_POINTS) == set(faultpoint.POINTS))
     assert not set(POINT_AFTER) & (set(faultpoint.ELASTIC_POINTS)
                                    | set(faultpoint.SERVING_POINTS)
-                                   | set(faultpoint.EXCHANGE_POINTS))
+                                   | set(faultpoint.EXCHANGE_POINTS)
+                                   | set(faultpoint.MONITOR_POINTS))
 
 
 # ---------------------------------------------------------------------------

@@ -155,10 +155,20 @@ def test_failing_sink_never_kills_training_and_is_detached():
         for i in range(10):
             monitor.event("tick", i=i)   # must never raise
     finally:
+        # read health BEFORE disable so both live and detached states
+        # are visible (disable moves live sinks to "closed")
+        health = h.summary()["sinks"]
         h.disable()
     assert boom.calls == 3               # detached after 3 failures
     assert len(ms.find("tick")) == 10    # healthy sink got everything
     assert h.sink_errors >= 3
+    # satellite: the detached sink is VISIBLE in the summary with its
+    # strike count — not a mysteriously short stream
+    detached = [s for s in health if s["state"] == "detached"]
+    assert detached and detached[0]["type"] == "_BoomSink"
+    assert detached[0]["strikes"] == 3
+    assert any(s["state"] == "attached" and s["type"] == "MemorySink"
+               for s in health)
 
 
 def test_jsonl_sink_bad_path_never_blocks(tmp_path):
@@ -211,12 +221,39 @@ def test_prometheus_exposition_format():
     assert "pbtpu_t_prom_count:er 7" in lines
     assert "# TYPE pbtpu_t_prom_gauge gauge" in lines
     assert "pbtpu_t_prom_gauge 2.5" in lines
+    # the doctor's alert series are ALWAYS exported (zero-filled when
+    # untouched) so training/serving /metrics never gain or lose series
+    assert "# TYPE pbtpu_exchange_overflow_retries counter" in lines
+    assert "# TYPE pbtpu_tiering_hot_rows gauge" in lines
+    assert "# TYPE pbtpu_tiering_hot_hit_rate gauge" in lines
     for line in lines:
         if not line or line.startswith("#"):
             continue
         name, val = line.rsplit(" ", 1)
         float(val)                       # every sample parses
         assert " " not in name
+
+
+def test_training_metrics_endpoint_scrapes_alert_series():
+    """The training-side /metrics twin of the serving endpoint: the
+    doctor's alert series are scrapeable from a bare training process."""
+    import urllib.request
+
+    srv = monitor.start_metrics_endpoint(port=0)
+    try:
+        port = srv.server_address[1]
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/metrics", timeout=10) as r:
+            body = r.read().decode()
+        assert "pbtpu_exchange_overflow_retries" in body
+        assert "pbtpu_tiering_hot_hit_rate" in body
+        with pytest.raises(urllib.error.HTTPError):
+            urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/nope", timeout=10)
+    finally:
+        srv.shutdown()
+        srv.server_close()
+        srv._pbtpu_thread.join(timeout=10)
 
 
 # ---------------------------------------------------------------------------
@@ -439,6 +476,13 @@ def test_two_pass_train_flight_records_and_schema(tmp_path):
         assert "auc" in fr["metrics"] and "auc" in fr["metrics"]["auc"]
         assert fr["extra"]["loss_mean"] == pytest.approx(
             out["loss_mean"], abs=1.0)   # same field, last pass exact
+        # pass-boundary account (ISSUE 12): wall + component split, the
+        # critical-path attributor's input
+        assert fr["extra"]["boundary_seconds"] >= 0
+        split = fr["extra"]["boundary_split"]
+        assert set(split) == {"build", "h2d", "spill_fault_in"}
+        assert all(v >= 0 for v in split.values())
+        assert split["build"] + split["h2d"] > 0
     # every event in the stream carries the tag keys; events emitted
     # while a pass was open carry its id
     with open(tmp_path / "events.jsonl") as f:

@@ -180,7 +180,8 @@ def test_two_host_election_smoke(tmp_path, golden):
                           if p not in ("pass_ckpt.pre_manifest",
                                        "remote_ckpt.download.pre")
                           and p not in faultpoint.ELASTIC_POINTS
-                          and p not in faultpoint.SERVING_POINTS])
+                          and p not in faultpoint.SERVING_POINTS
+                          and p not in faultpoint.MONITOR_POINTS])
 def test_multihost_kill_resume_matrix(point, tmp_path, golden):
     """Every registered fault point, multi-host: kill rank 1 there
     (mid-pass snapshots + hdfs:// remote mirror ON so every point is on

@@ -1,14 +1,17 @@
 """Tier-1 observability smoke: the example workflow's --short path with
 the telemetry hub enabled must emit a schema-clean JSONL event stream,
 one flight record per pass, and a chrome trace that reads in pass units
-(pass-boundary + checkpoint-commit instant markers)."""
+(pass-boundary + checkpoint-commit instant markers) — and the run
+doctor CLI over the produced telemetry dir must exit 0 with a
+schema-valid report carrying per-pass critical-path attribution
+(ISSUE 12 acceptance)."""
 
 import json
 import os
 import subprocess
 import sys
 
-from paddlebox_tpu.monitor import flight
+from paddlebox_tpu.monitor import doctor, flight
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
@@ -19,6 +22,10 @@ def test_short_example_emits_valid_telemetry(tmp_path):
                PYTHONPATH=REPO,
                JAX_PLATFORMS="cpu",
                PBTPU_TELEMETRY_DIR=tele,
+               # live doctor rides the smoke: findings (if any) land in
+               # the stream as doctor.finding events and the stream must
+               # stay schema-clean with them
+               PBTPU_DOCTOR_LIVE="1",
                # same child-process hygiene as test_example.py: pin the
                # child's XLA host pools so two JAX processes don't
                # oversubscribe a small host
@@ -79,3 +86,36 @@ def test_short_example_emits_valid_telemetry(tmp_path):
     for line in lines:
         if line and not line.startswith("#"):
             float(line.rsplit(" ", 1)[1])
+    # the doctor's alert series are present even when untouched
+    assert any("pbtpu_exchange_overflow_retries" in line
+               for line in lines)
+    assert any("pbtpu_tiering_hot_hit_rate" in line for line in lines)
+
+    # ---- run doctor CLI over the real run (acceptance) ----
+    assert "doctor:" in last.stdout      # the example printed a verdict
+    out = subprocess.run(
+        [sys.executable, "-m", "paddlebox_tpu.monitor.doctor",
+         tele, "--json"],
+        env=env, capture_output=True, text=True, timeout=120)
+    assert out.returncode == 0, out.stdout + out.stderr[:2000]
+    rep = json.loads(out.stdout)
+    assert doctor.validate_report(rep) == []
+    cp = rep["critical_path"]["passes"]
+    assert [p["pass_id"] for p in cp] == [1, 2]
+    for p in cp:
+        # per-pass attribution names a limiter and carries the boundary
+        # account with its split
+        assert p["limiter"] in p["stages"]
+        assert "boundary" in p["stages"]
+        assert set(p["boundary_split"]) == {"build", "h2d",
+                                            "spill_fault_in"}
+    # the boundary-wall rule was evaluated against real data (fired or
+    # quiet — never no-data on a run that carries boundary extras)
+    status = {r["rule"]: r["status"] for r in rep["rules"]}
+    assert status["boundary-wall"] in ("fired", "quiet")
+    # human rendering runs too
+    out2 = subprocess.run(
+        [sys.executable, "-m", "paddlebox_tpu.monitor.doctor", tele],
+        env=env, capture_output=True, text=True, timeout=120)
+    assert out2.returncode == 0
+    assert "run doctor — verdict:" in out2.stdout
