@@ -444,21 +444,23 @@ def device_step_bench(small: bool, mode: str = "allreduce",
     # each shard pushes its local tokens, so the floor must model the
     # pass one chip actually performs (global rows would overstate the
     # update bytes n_shards-fold and could even flip the engine)
+    premerged = tr.push_premerged(ws)
     push_floor = push_floor_analysis(
         emb_cfg, ws.rows_per_shard, batch * T // n_dev,
-        n_split=config_flags.binned_push_splits, peaks=peaks)
+        n_split=config_flags.binned_push_splits, peaks=peaks,
+        premerged=premerged,
+        table_width=(int(ws.table.shape[1]) if storage == "f32"
+                     else None))
     detail = {
         "device_kind": kind,
         "storage": storage,
         "dense_sync_mode": mode,
-        # which merge engine the step compiled with (the per-width
-        # crossover rule — binned_push_supported docstring). The kernel
-        # engages per SHARD, so the per-shard row count decides.
-        "push_engine": ("binned_kernel"
-                        if (config_flags.binned_push
-                            and _pk.binned_acc_supported(
-                                emb_cfg, ws.rows_per_shard))
-                        else "xla_scatter"),
+        # which merge engine the step compiled with — THE resolver's
+        # verdict (resolve_push_engine), the same call the compiled
+        # dispatch makes, so the record can never name an engine the
+        # program does not contain. The engine dispatches per SHARD, so
+        # the per-shard row count decides.
+        "push_engine": tr.resolved_push_engine(ws),
         # which pull engine the step compiled with (trainer heuristic:
         # fused gather-pool for multi-hot/wide layouts — the mh4d32 and
         # d128 envelope points — unfused lookup+seqpool elsewhere)
@@ -1262,6 +1264,18 @@ def dryrun_main() -> int:
         finalize_push_floor(detail["push_floor"],
                             (attr.get("stages") or {}).get("sparse_push"))
     checks["floor_ok"] = "closed" in (detail.get("push_floor") or {})
+    # the per-point push-engine record (ISSUE 13): every training point
+    # must name the resolver's engine, and the floor must carry the
+    # per-candidate-engine closure statements the doctor's push-floor
+    # rule names concrete flags.push_engine forces from
+    from paddlebox_tpu.ops import pallas_kernels as _pk_chk
+    _pf = detail.get("push_floor") or {}
+    checks["push_engine_recorded"] = (
+        detail.get("push_engine") in _pk_chk.PUSH_ENGINES
+        and isinstance(_pf.get("engines"), dict)
+        and all(e in _pk_chk.PUSH_ENGINES for e in _pf["engines"])
+        and all("closed" in v for v in _pf["engines"].values())
+        and _pf.get("engine") == detail.get("push_engine"))
     ctx.clear()
     # elastic drill rides the dryrun too: the artifact schema must carry
     # world_resize_seconds and the degraded matrix point, and tier-1 must
@@ -1333,6 +1347,7 @@ def dryrun_main() -> int:
         f32p.get("table_layout") == "sharded"
         and f32p.get("exchange_wire") == "f32"
         and bfp.get("exchange_wire") == "bf16"
+        and f32p.get("push_engine") in _pk_chk.PUSH_ENGINES
         and f32p.get("table_shards") == 2
         and isinstance(f32p.get("examples_per_sec_per_chip"),
                        (int, float))

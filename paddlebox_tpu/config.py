@@ -122,10 +122,18 @@ class Flags:
     # capable model, uniform slot layout); "on"/"off" force. Read at
     # Trainer construction (trace time), like binned_push.
     fused_gather_pool: str = "auto"         # (new)
-    # Merge-engine override for A/B runs: "auto" picks per width
-    # (binned kernel at G>=2 lane groups, XLA scatter at G=1 — the
-    # measured crossover, binned_push_supported); "kernel"/"scatter"
-    # force one engine everywhere the geometry allows.
+    # Push merge-engine override for A/B runs (resolve_push_engine —
+    # ONE resolver shared by the compiled dispatch and the per-point
+    # bench record). "auto" picks per (width class, lane contract,
+    # storage): premerged f32 unique lanes take the fused
+    # "scatter_accumulate" (row-wise gather→update→write-back, no
+    # O(table) pass — the dim64/dim128/multihot4 floor closer), narrow
+    # raw token streams take the "binned_kernel" one-hot MXU merge (the
+    # headline winner), everything else "xla_scatter". Forcing
+    # "scatter_accumulate" also forces the dedup premerge on (the fused
+    # engine consumes unique lanes) and runs the identical-math jnp
+    # fallback off-TPU — the CPU-parity/A/B knob. Legacy spellings
+    # "kernel"/"scatter"/"fused" normalize.
     push_engine: str = "auto"               # (new)
     # Deferred sparse-push apply (the reference hides push latency behind
     # the next pass's work — boxps_worker per-card push timers overlap

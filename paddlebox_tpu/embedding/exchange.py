@@ -26,9 +26,18 @@ scale-out literature grounds (ROADMAP "Sharded embedding scale-out"):
 The fused Pallas ``gather_pool`` pull (PR 1) runs **per shard after
 routing**: ``routed_pull_pooled`` routes the unique rows, lands them in a
 local (lanes, pull_width) table, and pools per (example, slot) from THAT
-table — the kernel's gather source is the received lanes, so the
-(B*T, pull_width) token matrix never materializes on the sharded path
-either (CPU meshes and unsupported geometries run the identical jnp math).
+table — the kernel's gather source is the received lanes (the retuned
+``lanes_table`` tile geometry), so the (B*T, pull_width) token matrix
+never materializes on the sharded path either (CPU meshes and
+unsupported geometries run the identical jnp math).
+
+The push side mirrors it: when ``resolve_push_engine`` selects the
+fused ``scatter_accumulate`` engine, ``routed_push``'s apply tail
+merges the received lanes (unique per source device, at most one lane
+per (source, row)) onto one lane per unique row with a compact
+lane-grade scatter and updates exactly those shard rows in place — the
+same kernel the single-shard premerged path runs, so the O(shard-table)
+update pass disappears from the routed apply too.
 
 Capacity overflow is never silent: every pull reports its exact dropped
 count, the trainer feeds it to named counters/events
@@ -190,10 +199,15 @@ def _pool_lanes(rows: jnp.ndarray, lane_idx: jnp.ndarray,
     table (the per-shard-after-routing half of fused_pull_pool)."""
     from paddlebox_tpu.ops import pallas_kernels
     B = lane_idx.shape[0]
+    # lanes_table: the gather source is the received-lane array
+    # (cap*D x pull_width), not the HBM row_width table — the retuned
+    # tile geometry (bigger batch tiles, scratch sized off the actual
+    # lane width; see gather_pool_geometry)
     if pallas_kernels.gather_pool_supported(cfg, B, num_slots, slot_len,
-                                            rows.shape[1]):
+                                            rows.shape[1],
+                                            lanes_table=True):
         return pallas_kernels.gather_pool(rows, lane_idx, cfg, num_slots,
-                                          slot_len)
+                                          slot_len, lanes_table=True)
     take = jnp.take(rows, lane_idx.reshape(-1), axis=0)
     return take.reshape(B, num_slots, slot_len, rows.shape[1]).sum(axis=2)
 
@@ -268,5 +282,29 @@ def routed_push(table_shard, idx: jnp.ndarray, grads: jnp.ndarray,
     # sharded.routed_push on why row 0 would be wrong for adam)
     local_row = jnp.where(empty, rps, flat_idx % rps).astype(jnp.int32)
     flat_pay = jnp.where(empty[:, None], 0.0, flat_pay)
+    from paddlebox_tpu.ops import pallas_kernels
+    s_f32 = not quant.is_quant(table_shard)
+    if pallas_kernels.resolve_push_engine(
+            cfg, rps, premerged=True, storage_f32=s_f32,
+            table_width=table_shard.shape[1] if s_f32 else None) \
+            == "scatter_accumulate":
+        # The received lanes are unique per SOURCE device (each source
+        # premerged before routing), so a row arrives on at most D
+        # lanes. Merge those onto ONE lane per unique row with a
+        # compact lane-grade scatter — the cross-device half of the
+        # premerge, over D*cap lanes, never over the shard table — and
+        # hand the fused row-wise engine unique lanes: each touched row
+        # is gathered, updated in VMEM, and written back exactly once
+        # (the O(shard-table) update pass never runs). Empty lanes
+        # merge onto the out-of-range rps lane and dedup's capacity
+        # pads carry a zero touch count, so neither ever writes.
+        uniq, inverse = dedup_tokens(local_row)
+        real = (~empty).astype(flat_pay.dtype)
+        payload = jnp.concatenate([flat_pay, real[:, None]], axis=1)
+        merged = jnp.zeros((local_row.shape[0], gw + 3),
+                           payload.dtype).at[inverse].add(payload)
+        return pallas_kernels.scatter_accumulate(
+            table_shard, uniq, merged[:, :gw], merged[:, gw],
+            merged[:, gw + 1], cfg, touched=merged[:, gw + 2])
     return sharded.push(table_shard, local_row, flat_pay[:, :gw],
                         flat_pay[:, gw], flat_pay[:, gw + 1], cfg)

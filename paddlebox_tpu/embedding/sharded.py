@@ -300,16 +300,22 @@ def push(table: jnp.ndarray, idx: jnp.ndarray, grads: jnp.ndarray,
             (order_or_None, rstart, end) or None, not a caller plan.
     Returns the updated table.
 
-    Implementation note (TPU): duplicates are merged with ONE fused
-    scatter-add into a per-row accumulator, then the optimizer applies
-    vectorized over the whole table, masked to touched rows. This preserves
-    the reference's merge-then-update semantics (PushMergeCopy,
-    box_wrapper.cu:630-830) with a single scatter op — sort-based dedup costs
-    several gather/scatter/sort ops per step, and on TPU each of those
-    carries a large fixed cost while an elementwise pass over the table is
-    bandwidth-cheap. O(table) work per step is the deliberate trade; for
-    very large working sets pick a sharded mesh (each shard scans only its
-    rows).
+    Implementation note (TPU): the merge engine is selected by
+    pallas_kernels.resolve_push_engine — ONE resolver shared with the
+    bench record (flags.push_engine forces for A/Bs). Premerged f32
+    lanes take the fused scatter_accumulate (each touched row gathered,
+    updated in VMEM, written back once — no full-table pass); narrow
+    raw token streams take the binned one-hot MXU merge; otherwise
+    duplicates are merged with ONE fused scatter-add into a per-row
+    accumulator and the optimizer applies vectorized over the whole
+    table, masked to touched rows. All three preserve the reference's
+    merge-then-update semantics (PushMergeCopy, box_wrapper.cu:630-830)
+    — sort-based dedup costs several gather/scatter/sort ops per step,
+    and on TPU each of those carries a large fixed cost. The scatter
+    engines' O(table) pass per step is the deliberate trade where they
+    run; for very large working sets pick a sharded mesh (each shard
+    scans only its rows) — whose routed apply now rides the fused
+    engine too (exchange.routed_push).
     """
     if premerged:
         kplan, dplan = plan, None
@@ -323,7 +329,19 @@ def push(table: jnp.ndarray, idx: jnp.ndarray, grads: jnp.ndarray,
             idx, grads, shows, clks, dplan)
         premerged = True
     n = idx.shape[0]
-    if (config_flags.binned_push and not quant.is_quant(table)
+    n_rows = quant.table_rows(table)
+    is_q = quant.is_quant(table)
+    engine = pallas_kernels.resolve_push_engine(
+        cfg, n_rows, premerged=premerged, storage_f32=not is_q,
+        table_width=None if is_q else table.shape[1])
+    if engine == "scatter_accumulate":
+        # fused row-wise merge-apply over the premerged unique lanes:
+        # each touched row gathers once, updates in VMEM, writes back
+        # once — no full-table accumulator, no O(table) update pass
+        # (the Pallas kernel on real TPU; identical jnp math elsewhere)
+        return pallas_kernels.scatter_accumulate(table, idx, grads,
+                                                 shows, clks, cfg)
+    if (engine == "binned_kernel" and not is_q
             and pallas_kernels.binned_push_supported(table, cfg)):
         # scatter-free merge+update for narrow rows: the binned kernel
         # streams the merge through the MXU and measures ~2x the XLA
@@ -333,17 +351,17 @@ def push(table: jnp.ndarray, idx: jnp.ndarray, grads: jnp.ndarray,
             table, idx, grads, shows, clks, cfg,
             n_split=config_flags.binned_push_splits, plan=kplan)
     gw = cfg.grad_width
-    n_rows = quant.table_rows(table)
-    if (config_flags.binned_push
-            and pallas_kernels.binned_acc_supported(cfg, n_rows)):
-        # quantized tables reuse the scatter-free merge: the kernel's
-        # acc contract is storage-agnostic, and the in-step scatter it
-        # replaces measured ~13ms of the 20.8ms int16 step (dim 8,
-        # batch 8192, one v5e — same win as the f32 path)
+    if engine == "binned_kernel":
+        # quantized tables (and other storage variants) reuse the
+        # scatter-free merge: the kernel's acc contract is
+        # storage-agnostic, and the in-step scatter it replaces measured
+        # ~13ms of the 20.8ms int16 step (dim 8, batch 8192, one v5e —
+        # same win as the f32 path)
         acc = pallas_kernels.binned_merge_acc(
             idx, grads, shows, clks, cfg, n_rows,
             n_split=config_flags.binned_push_splits, plan=kplan,
-            vma=getattr(jax.typeof(table.fp), "vma", frozenset()))
+            vma=getattr(jax.typeof(table.fp if is_q else table), "vma",
+                        frozenset()))
     else:
         payload = jnp.concatenate(
             [grads, shows[:, None], clks[:, None],

@@ -305,17 +305,38 @@ class PushFloorRule(Rule):
             return "quiet", None
         if isinstance(closed, str) and not closed.startswith("measured"):
             return "no-data", None      # abstained (no peaks/measurement)
+        # name the concrete engine to force: the per-candidate-engine
+        # closure statements (push_floor_analysis `engines`) carry each
+        # engine's bound at this geometry, and the per-point record
+        # (detail push_engine — the resolver's verdict) names what ran
+        engine = ctx.detail.get("push_engine") or floor.get("engine")
+        engines = floor.get("engines") if isinstance(
+            floor.get("engines"), dict) else {}
+        best = floor.get("best_engine")
+        if best and best != engine:
+            note = (engines.get(best) or {}).get("note")
+            suggestion = (
+                f"force flags.push_engine={best!r} (candidate floor "
+                f"{(engines.get(best) or {}).get('floor_seconds')}s vs "
+                f"the recorded {engine} run"
+                + (f"; {note}" if note else "") + ") and re-record the "
+                "point; flags.pack_engine is the companion A/B knob")
+        else:
+            suggestion = (
+                f"the resolver already picked the lowest-floor engine "
+                f"({engine}) — A/B flags.pack_engine and the plan "
+                "staging at this geometry before trusting the step; the "
+                "floor statement names which sub-stage (kernel DMA / "
+                "one-hot dots / fused update) carries the gap")
         return "fired", Finding(
             self.id, "warn",
-            f"push engine {floor.get('engine')} is off its recorded "
-            f"floor: {closed}",
-            {"engine": floor.get("engine"),
+            f"push engine {engine} is off its recorded floor: {closed}",
+            {"engine": engine,
              "floor_seconds": floor.get("floor_seconds"),
-             "measured_push_seconds": floor.get("measured_push_seconds")},
-            "A/B flags.push_engine (kernel vs scatter) and "
-            "flags.pack_engine at this geometry before trusting the "
-            "step; the floor statement names which sub-stage "
-            "(kernel DMA / one-hot dots / fused update) carries the gap")
+             "measured_push_seconds": floor.get("measured_push_seconds"),
+             "engine_floors": {n: e.get("floor_seconds")
+                               for n, e in engines.items()}},
+            suggestion)
 
 
 class NanGuardRule(Rule):

@@ -1,12 +1,23 @@
 """Pallas TPU kernels for the embedding-table hot path.
 
-Three kernel families live here:
+Four kernel families live here:
 
 - ``gather_pool`` (the fused pull for multi-hot/wide layouts): gathers
   rows from the HBM device table and sum-pools them per (example, slot)
   segment in VMEM, so the (tokens, pull_width) pulled matrix never
   materializes — the pull-side dual of ``binned_push`` (see its section
   comment for the rationale and measurements).
+
+- ``scatter_accumulate`` (the fused push for premerged unique lanes):
+  the mirror image of ``gather_pool`` — DMA-gathers exactly the table
+  rows the premerged cotangent lanes touch, applies the optimizer
+  row-wise in VMEM, and DMA-writes each row back once. Neither the
+  (tokens, pull_width) cotangent matrix nor the (n_rows, grad_width+3)
+  full-table accumulator ever materializes, and the O(table) update
+  pass of the scatter/binned engines disappears (see its section
+  comment). Engine selection across the three push engines is owned by
+  ``resolve_push_engine`` — ONE resolver shared by the compiled
+  dispatch and the per-point bench record.
 
 - ``binned_push`` (the production path, flags.binned_push): replaces the
   XLA token scatter-add with block-binned one-hot MXU matmuls that build
@@ -522,22 +533,139 @@ def _bp_pack(idx, grads, shows, clks, geom, TILE: int, n_rows: int,
 
 def binned_push_geometry(cfg: EmbeddingConfig, n_rows: int):
     """(super_block, n_blocks) for host-side plan building, or None when
-    the dispatch keeps the scatter (no geometry, or wide rows where the
-    scatter measures faster — see binned_push_supported) and a plan
-    would be wasted host work + H2D.
+    the dispatch keeps another engine (no geometry; wide rows where the
+    scatter measures faster — see binned_push_supported; or a forced
+    non-binned flags.push_engine) and a plan would be wasted host work
+    + H2D.
 
     flags.push_engine overrides the per-width dispatch for A/B runs:
-    "kernel" keeps the kernel at G=1, "scatter" disables it everywhere.
+    "binned_kernel" keeps the kernel at G=1, "xla_scatter" /
+    "scatter_accumulate" disable the binned kernel everywhere (the
+    fused engine consumes premerged lanes, not block windows).
     """
     geom = _bp_geometry(cfg, n_rows)
     if geom is None:
         return None
-    from paddlebox_tpu.config import flags as config_flags
-    eng = config_flags.push_engine
-    if eng == "scatter" or (geom[2] == 1 and eng != "kernel"):
+    eng = _push_engine_flag()
+    if eng in ("xla_scatter", "scatter_accumulate") \
+            or (geom[2] == 1 and eng != "binned_kernel"):
         return None
     _, _, _, SB = geom
     return SB, n_rows // SB
+
+
+# ---------------------------------------------------------------------------
+# Push merge-engine registry + resolver.
+#
+# Three engines cover the push dispatch envelope:
+#
+#   xla_scatter        scatter-add merge into a full-table accumulator +
+#                      one fused XLA update pass over the table. The
+#                      no-geometry fallback, and the measured winner for
+#                      wide NON-premerged token streams.
+#   binned_kernel      the block-binned one-hot MXU merge above + the
+#                      fused XLA update pass — the narrow-row (G >= 2)
+#                      winner for raw token streams (the headline path).
+#   scatter_accumulate the fused row-wise engine below: premerged unique
+#                      lanes gather exactly their table rows, the
+#                      optimizer applies in VMEM, each row writes back
+#                      once — no full-table accumulator, no O(table)
+#                      update pass. Serves both the single-shard
+#                      premerged path and the routed exchange's
+#                      post-all_to_all apply.
+#
+# The resolver below is THE one selection function (the PR-2 pack_engine
+# discipline): the compiled dispatch (sharded.push / exchange.routed_push)
+# and the per-point bench record both call it, so the record can never
+# name an engine the program does not contain.
+# ---------------------------------------------------------------------------
+
+PUSH_ENGINES = ("xla_scatter", "binned_kernel", "scatter_accumulate")
+
+# legacy flag spellings from the pre-fused rounds (the VERDICT r5 A/B
+# notes used them); normalized so recorded run commands keep working
+_PUSH_ENGINE_ALIASES = {"kernel": "binned_kernel",
+                        "scatter": "xla_scatter",
+                        "fused": "scatter_accumulate"}
+
+
+def normalize_push_engine(eng: str) -> str:
+    """Canonical engine name for a flags.push_engine value ("auto" and
+    already-canonical names pass through; legacy aliases map)."""
+    return _PUSH_ENGINE_ALIASES.get(eng, eng)
+
+
+def _push_engine_flag() -> str:
+    from paddlebox_tpu.config import flags as config_flags
+    eng = normalize_push_engine(config_flags.push_engine)
+    if eng != "auto" and eng not in PUSH_ENGINES:
+        raise ValueError(
+            f"push_engine={config_flags.push_engine!r} (want 'auto', one "
+            f"of {PUSH_ENGINES}, or the legacy 'kernel'/'scatter'/'fused' "
+            f"aliases)")
+    return eng
+
+
+def resolve_push_engine(cfg: EmbeddingConfig, n_rows: int, *,
+                        premerged: bool, storage_f32: bool = True,
+                        table_width: int | None = None) -> str:
+    """THE push merge-engine resolver — returns the PUSH_ENGINES member
+    the push compiles with for this (cfg, rows, lane contract, storage)
+    class. Both the compiled dispatch (sharded.push, exchange.
+    routed_push's apply tail) and the per-point bench record call this
+    one function, so the record can never name a code path the program
+    does not contain (the round-5 unattributable-regression failure
+    mode, and the PR-2 pack_engine discipline). Raises on a typo'd
+    forced engine: the flag exists for trustworthy A/Bs.
+
+    premerged : the lanes reaching the engine are one-lane-per-unique-row
+        (plan_premerge output, a deferred premerged replay, or the routed
+        apply's cross-device lane merge). The fused engine REQUIRES this
+        contract — without it a forced "scatter_accumulate" falls back to
+        the scatter and the record says so.
+    storage_f32 : quantized tables keep the binned/scatter engines (the
+        fused engine updates f32 rows in place; quant planes dequant →
+        update → requant around the storage-agnostic merge acc instead).
+    table_width : physical device-table columns (>= cfg.row_width when
+        padded); bounds the fused engine's per-row DMA geometry.
+
+    Auto heuristic per (row width class, lane contract, storage, shard):
+    premerged f32 lanes on a supported geometry take the fused engine
+    (the dim64/dim128/multihot4 floor points — every one of them rides
+    premerged lanes); narrow raw token streams keep the binned kernel
+    (the measured headline winner); everything else (quant without
+    binned geometry, wide raw tokens, off-TPU) scatters. Forced engines
+    engage wherever their contract allows — "scatter_accumulate" off-TPU
+    runs the identical-math jnp fallback (the A/B and CPU-parity knob),
+    and a forced "binned_kernel" bypasses the flags.binned_push enable
+    knob — geometry + backend are the contract; an enable flag must not
+    silently void an explicit force.
+    """
+    eng = _push_engine_flag()
+    width = int(table_width) if table_width is not None else cfg.row_width
+    sa_ok = (premerged and storage_f32
+             and scatter_accumulate_geometry(n_rows, width) is not None)
+    if eng == "xla_scatter":
+        return "xla_scatter"
+    if eng == "scatter_accumulate":
+        return "scatter_accumulate" if sa_ok else "xla_scatter"
+    if eng == "binned_kernel":
+        # forced: geometry + backend are the contract — the
+        # flags.binned_push enable knob must not be a second SILENT
+        # gate on an explicit force (the A/B would measure nothing)
+        return ("binned_kernel" if binned_acc_supported(cfg, n_rows)
+                else "xla_scatter")
+    from paddlebox_tpu.config import flags as config_flags
+    binned = (config_flags.binned_push
+              and binned_acc_supported(cfg, n_rows))
+    # auto: the fused engine first — wherever premerged f32 lanes exist
+    # it replaces BOTH the binned kernel's one-hot dots (the multi-hot
+    # ~10x overhead) and the scatter's full-table pass (the wide-row
+    # floor), on real TPU only (the jnp fallback is a parity tool, not
+    # a CPU production win)
+    if sa_ok and jax.default_backend() == "tpu":
+        return "scatter_accumulate"
+    return "binned_kernel" if binned else "xla_scatter"
 
 
 def lane_groups(cfg: EmbeddingConfig, n_rows: int):
@@ -672,17 +800,29 @@ _GP_MAX_WIDTH = 512         # table row lanes past this: fall back
 _GP_SEMS = 8                # in-flight row DMAs
 
 
-def gather_pool_geometry(B: int, S: int, L: int, table_width: int):
+def gather_pool_geometry(B: int, S: int, L: int, table_width: int,
+                         lanes_table: bool = False):
     """Batch-tile size BB for the gather-pool kernel, or None when the
     (batch, slots, slot_len, width) combination doesn't fit its layout
-    needs. BB is the largest power of two <= 64 dividing B whose
-    gathered scratch (L * BB * S rows at the table's padded lane width)
-    fits the VMEM budget — bigger tiles amortize the grid prologue,
-    smaller ones keep wide rows resident."""
+    needs. BB is the largest power of two <= the tile cap dividing B
+    whose gathered scratch (L * BB * S rows at the table's padded lane
+    width) fits the VMEM budget — bigger tiles amortize the grid
+    prologue, smaller ones keep wide rows resident.
+
+    lanes_table: the gather source is a RECEIVED-LANE table (the routed
+    path pools per shard from the all_to_all's unique lanes — a
+    cap*D x pull_width array, not the n_rows x row_width HBM table the
+    64-row cap was tuned on). Lane tables are VMEM-scale and
+    pull_width-narrow, so per-row DMA latency amortizes and the grid
+    prologue dominates instead: the tile cap doubles to 128 (bounded by
+    the idx SMEM block, which grows with BB*S*L) and the same budget
+    rule sizes the scratch off the ACTUAL lane width — the retune the
+    PR-9 routing deferred (geometry used to inherit the full-table
+    tuning wholesale)."""
     if B <= 0 or S <= 0 or L <= 0 or table_width > _GP_MAX_WIDTH:
         return None
     lanes = -(-table_width // 128) * 128
-    BB = 64
+    BB = 128 if lanes_table else 64
     while BB > 1 and (B % BB or L * BB * S * lanes * 4 > _GP_VMEM_BUDGET):
         BB //= 2
     if B % BB or L * BB * S * lanes * 4 > _GP_VMEM_BUDGET:
@@ -691,21 +831,24 @@ def gather_pool_geometry(B: int, S: int, L: int, table_width: int):
 
 
 def gather_pool_supported(cfg: EmbeddingConfig, B: int, S: int, L: int,
-                          table_width: int) -> bool:
+                          table_width: int,
+                          lanes_table: bool = False) -> bool:
     """Whether the fused gather-pool kernel engages for this geometry on
     the current backend. Real-TPU f32 tables only: quantized storage
     gathers two planes (the jnp reference handles it), and the pull
     gating masks (mf/expand create thresholds) are applied by lookup —
     the kernel skips both, so it must not engage where they matter.
     CPU callers get the jnp reference in sharded.fused_pull_pool; tests
-    drive the kernel directly in interpret mode."""
+    drive the kernel directly in interpret mode. lanes_table: the
+    received-lane geometry (see gather_pool_geometry)."""
     if jax.default_backend() != "tpu":
         return False
     if cfg.storage != "f32":
         return False
     if cfg.mf_create_threshold > 0 or cfg.expand_create_threshold > 0:
         return False
-    return gather_pool_geometry(B, S, L, table_width) is not None
+    return gather_pool_geometry(B, S, L, table_width,
+                                lanes_table=lanes_table) is not None
 
 
 def _gather_pool_kernel(idx_ref, thr_ref, table_ref, out_ref, gathered, sem,
@@ -784,7 +927,7 @@ def gather_pool(table: jnp.ndarray, idx: jnp.ndarray, cfg: EmbeddingConfig,
                 need_filter: bool = False, show_coeff: float = 0.2,
                 clk_coeff: float = 1.0, threshold=0.96,
                 embed_threshold: float = 0.0, quant_ratio: int = 0,
-                cvm_offset: int = 2,
+                cvm_offset: int = 2, lanes_table: bool = False,
                 interpret: bool | None = None) -> jnp.ndarray:
     """Fused gather + per-(example, slot) sum pool over the device table.
 
@@ -798,12 +941,14 @@ def gather_pool(table: jnp.ndarray, idx: jnp.ndarray, cfg: EmbeddingConfig,
     threshold may be a scalar or a per-slot (S,) vector (the diff-thres
     variant). Returns (B, S, pull_width) pooled rows; the CVM transform
     applies downstream on this small output (seqpool_cvm.PooledSlots).
+    lanes_table selects the received-lane tile geometry (the routed
+    path's per-shard pool — see gather_pool_geometry).
     """
     B, T = idx.shape
     S, L = num_slots, slot_len
     assert T == S * L, (T, S, L)
     n_rows, W = table.shape
-    BB = gather_pool_geometry(B, S, L, W)
+    BB = gather_pool_geometry(B, S, L, W, lanes_table=lanes_table)
     assert BB is not None, "caller must check gather_pool geometry support"
     if interpret is None:
         interpret = jax.default_backend() != "tpu"
@@ -876,3 +1021,228 @@ def binned_merge_acc(idx: jnp.ndarray, grads: jnp.ndarray,
     # untangle the grouped layout (fuses into the consumer's update pass)
     return acc_g[:, :G * PP].reshape(NB, RB, G, PP).transpose(
         0, 2, 1, 3).reshape(n_rows, PP)[:, :P]
+
+
+# ---------------------------------------------------------------------------
+# Fused scatter-accumulate: the push-side mirror image of gather_pool.
+#
+# The scatter and binned engines both end in ONE fused XLA pass over the
+# WHOLE table (read + update + where(touched) + write), so their cost has
+# an O(table) term that dominates exactly where the recorded floors sit:
+# at dim 128 the 528k x ~134 f32 bench table moves ~0.6GB per step through
+# that pass while only ~200k unique rows changed, and the binned kernel
+# additionally pays one-hot dots that grow ~10x on the multi-hot points
+# (BENCH_BEST: dim128 252k, dim64 567k, multihot4_dim32 106k ex/s/chip
+# against a 1.2M headline). This kernel takes the premerged unique lanes
+# the dedup plan already produces (sharded.plan_premerge — one lane per
+# touched row, pads out-of-range) and touches ONLY those rows: per lane,
+# DMA the table row into VMEM (n_sem-deep pipelined, the gather_pool
+# pattern), apply ``embedding.optim.apply_updates`` row-wise on the tile
+# in VMEM — the identical update the XLA pass runs, so numerics match
+# bit-for-bit — and DMA the updated row back in place
+# (input_output_aliases keeps the table buffer donated). Traffic is
+# O(unique rows x row bytes x 2) instead of O(table): the analytic floor
+# step_probe.push_floor_analysis holds per bench point.
+#
+# Lane contract (the premerged form everywhere in this codebase): row ids
+# UNIQUE among touched lanes; pad lanes carry out-of-range ids or a zero
+# touch flag and are skipped — their write-back DMA never issues, so a
+# pad can never clobber a real row's update (the failure mode a clamped
+# unconditional write-back exhibits when a real row-0 lane and clamped
+# pads interleave). The same kernel serves the single-shard premerged
+# push and the routed exchange's post-all_to_all apply: received lanes
+# are unique per SOURCE device, so the routed tail merges the <= D lanes
+# per row with one compact lane-grade scatter (exchange.routed_push) and
+# hands the kernel unique lanes again.
+#
+# Off-TPU the identical math runs as the jnp reference (gather → row-wise
+# apply_updates → one masked scatter write) — the CPU production path and
+# the bit-parity baseline; interpret=True drives the Pallas interpreter
+# for the hardware-free kernel tests (SURVEY.md §4), except under a
+# check_vma shard_map where interpret mode cannot run nontrivial kernels
+# (see merge_update) and the jnp reference takes over.
+# ---------------------------------------------------------------------------
+
+_SA_TILE = 256          # lanes per grid step ((TILE, W) f32 scratch <= 512KB)
+_SA_MAX_WIDTH = 512     # table row lanes past this: fall back
+_SA_SEMS = 8            # in-flight row DMAs per direction
+
+
+def scatter_accumulate_geometry(n_rows: int, table_width: int):
+    """Lane-tile size for the fused scatter-accumulate, or None when the
+    table doesn't fit the kernel's per-row-DMA layout (rows past the
+    width cap stream whole rows the row buffer can't hold)."""
+    if n_rows <= 0 or table_width <= 0 or table_width > _SA_MAX_WIDTH:
+        return None
+    return _SA_TILE
+
+
+def _scatter_accumulate_kernel(idx_ref, tch_ref, pay_ref, table_ref,
+                               out_ref, gathered, sem_in, sem_out, *,
+                               TILE: int, n_rows: int, n_sem: int,
+                               cfg: EmbeddingConfig):
+    """One lane tile: pipelined row gather → row-wise optimizer in VMEM
+    → predicated pipelined row write-back.
+
+    idx_ref : (TILE,) int32 SMEM — table row per lane (out-of-range =
+              pad; reads clamp to row 0, whose gathered bits are
+              discarded because the pad's write never issues).
+    tch_ref : (TILE,) int32 SMEM — touch flag per lane; 0 skips the
+              write-back entirely (untouched rows keep their exact bits,
+              the push contract).
+    pay_ref : (TILE, grad_width+2) f32 — [merged grads | show | clk].
+    table_ref / out_ref : the (n_rows, W) device table, aliased — rows
+              update in place; rows no valid lane names are never
+              touched. Lanes are unique among touched lanes, so write
+              DMAs never collide and tile order cannot matter.
+    """
+    def _row(t):
+        r = idx_ref[t]
+        return jnp.where((r >= 0) & (r < n_rows), r, 0)
+
+    def _valid(t):
+        r = idx_ref[t]
+        return (r >= 0) & (r < n_rows) & (tch_ref[t] > 0)
+
+    def copy_in(t):
+        return pltpu.make_async_copy(
+            table_ref.at[pl.ds(_row(t), 1), :],
+            gathered.at[pl.ds(t, 1), :], sem_in.at[lax.rem(t, n_sem)])
+
+    for k in range(n_sem):
+        copy_in(k).start()
+
+    def gbody(t, _):
+        copy_in(t).wait()
+
+        @pl.when(t + n_sem < TILE)
+        def _prefetch():
+            copy_in(t + n_sem).start()
+
+        return 0
+
+    lax.fori_loop(0, TILE, gbody, 0)
+    rows = gathered[...]
+    pay = pay_ref[...]
+    gw = cfg.grad_width
+    # the identical row-wise update the scatter engine's full-table pass
+    # runs — elementwise per row, so gather→apply ≡ apply→gather bitwise
+    gathered[...] = apply_updates(rows, pay[:, :gw], pay[:, gw],
+                                  pay[:, gw + 1], cfg)
+
+    def copy_out(t):
+        return pltpu.make_async_copy(
+            gathered.at[pl.ds(t, 1), :],
+            out_ref.at[pl.ds(_row(t), 1), :], sem_out.at[lax.rem(t, n_sem)])
+
+    # predicated pipeline: lane t's start AND wait share one predicate,
+    # and slot t % n_sem is reused only after t's wait ran (or never
+    # started) — at most one outstanding copy per slot in every
+    # valid/invalid interleaving
+    for k in range(n_sem):
+        @pl.when(_valid(k))
+        def _start(k=k):
+            copy_out(k).start()
+
+    def sbody(t, _):
+        @pl.when(_valid(t))
+        def _wait():
+            copy_out(t).wait()
+
+        @pl.when((t + n_sem < TILE) & _valid(t + n_sem))
+        def _next():
+            copy_out(t + n_sem).start()
+
+        return 0
+
+    lax.fori_loop(0, TILE, sbody, 0)
+
+
+def scatter_accumulate(table: jnp.ndarray, idx: jnp.ndarray,
+                       grads: jnp.ndarray, shows: jnp.ndarray,
+                       clks: jnp.ndarray, cfg: EmbeddingConfig,
+                       touched: jnp.ndarray | None = None,
+                       interpret: bool | None = None) -> jnp.ndarray:
+    """Row-wise fused merge-apply over premerged unique lanes.
+
+    table : (n_rows, W) f32 device table (W >= cfg.row_width; pad
+            columns pass through apply_updates untouched).
+    idx   : (n,) int32 — ONE lane per touched row (plan_premerge's
+            contract: ascending unique with out-of-range pads, or any
+            unique-among-touched order — the routed apply's lanes).
+    grads/shows/clks : merged per-row payload (exact counters included).
+    touched : optional per-lane touch flag; default = in-range(idx).
+            The routed apply passes the cross-device lane count so its
+            dedup-capacity pads (in-range row 0, zero payload) skip the
+            write entirely instead of leaning on the null-row fixed
+            point.
+    interpret : None = jnp reference off-TPU / Mosaic kernel on TPU;
+            True = the Pallas interpreter (hardware-free kernel tests).
+
+    Semantics match sharded.push's scatter path bit-for-bit: the same
+    apply_updates runs on the same merged values; untouched rows keep
+    their exact bits (their row is never DMA'd back). Returns the
+    updated table (aliased in place under jit donation).
+    """
+    n_rows, W = table.shape
+    TILE = scatter_accumulate_geometry(n_rows, W)
+    assert TILE is not None, \
+        "caller must check scatter_accumulate geometry support"
+    gw = cfg.grad_width
+    idx = idx.astype(jnp.int32)
+    if touched is None:
+        tch = ((idx >= 0) & (idx < n_rows)).astype(jnp.int32)
+    else:
+        tch = (touched > 0).astype(jnp.int32)
+    pay = jnp.concatenate(
+        [grads, shows[:, None], clks[:, None]], axis=1)
+    vma = getattr(jax.typeof(table), "vma", frozenset())
+    use_kernel = interpret is True or (interpret is None
+                                       and jax.default_backend() == "tpu")
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    if not use_kernel or (interpret and vma):
+        # the jnp reference: identical math (same gather, same row-wise
+        # apply_updates, one masked unique scatter write) — the CPU
+        # production path, and the only form interpret mode can run
+        # inside a check_vma shard_map (see merge_update)
+        safe = jnp.where((idx >= 0) & (idx < n_rows), idx, 0)
+        rows = jnp.take(table, safe, axis=0)
+        new_rows = apply_updates(rows, pay[:, :gw], pay[:, gw],
+                                 pay[:, gw + 1], cfg)
+        keep = (tch > 0) & (idx >= 0) & (idx < n_rows)
+        # dropped lanes leave the scatter entirely (out-of-range +
+        # mode="drop") — a pad must never write a real row's old bits
+        # over another lane's update
+        wr = jnp.where(keep, idx, n_rows)
+        return table.at[wr].set(new_rows, mode="drop")
+    n = idx.shape[0]
+    pad = (-n) % TILE
+    if pad:
+        idx = jnp.concatenate(
+            [idx, jnp.full((pad,), n_rows, jnp.int32)])
+        tch = jnp.concatenate([tch, jnp.zeros((pad,), tch.dtype)])
+        pay = jnp.concatenate(
+            [pay, jnp.zeros((pad, pay.shape[1]), pay.dtype)])
+    n_sem = min(_SA_SEMS, TILE)
+    kernel = functools.partial(_scatter_accumulate_kernel, TILE=TILE,
+                               n_rows=n_rows, n_sem=n_sem, cfg=cfg)
+    return pl.pallas_call(
+        kernel,
+        out_shape=shape_struct((n_rows, W), table.dtype, vma=vma),
+        grid=(idx.shape[0] // TILE,),
+        in_specs=[
+            pl.BlockSpec((TILE,), lambda i: (i,),
+                         memory_space=pltpu.SMEM),
+            pl.BlockSpec((TILE,), lambda i: (i,),
+                         memory_space=pltpu.SMEM),
+            pl.BlockSpec((TILE, gw + 2), lambda i: (i, 0)),
+            pl.BlockSpec(memory_space=pl.ANY),
+        ],
+        out_specs=pl.BlockSpec(memory_space=pl.ANY),
+        scratch_shapes=[pltpu.VMEM((TILE, W), jnp.float32),
+                        pltpu.SemaphoreType.DMA((n_sem,)),
+                        pltpu.SemaphoreType.DMA((n_sem,))],
+        input_output_aliases={3: 0},
+        interpret=interpret,
+    )(idx, tch, pay, table)

@@ -267,10 +267,17 @@ class Trainer:
         # push — single-shard TPU tables, plus the sharded exchange
         # engine, whose all_to_all is KEYED off the plan's dedup bounds
         # (unique lanes premerge before routing; post-a2a tokens carry
-        # no kernel windows). Read at trace time like the kernels.
+        # no kernel windows), plus a FORCED fused push engine on any
+        # backend (scatter_accumulate consumes the plan's premerged
+        # unique lanes; off-TPU it runs the identical jnp math — the
+        # CPU-parity/A/B knob). Read at trace time like the kernels.
+        from paddlebox_tpu.ops import pallas_kernels
+        fused_forced = (pallas_kernels.normalize_push_engine(
+            config_flags.push_engine) == "scatter_accumulate")
         self._use_plan = (
-            (self.n_shards == 1 and config_flags.binned_push
-             and jax.default_backend() == "tpu")
+            (self.n_shards == 1
+             and ((config_flags.binned_push
+                   and jax.default_backend() == "tpu") or fused_forced))
             or (self.table_layout == "sharded"
                 and config_flags.pullpush_dedup_keys))
         # eval capacity can grow past the train factor (skewed eval-only
@@ -1231,10 +1238,39 @@ class Trainer:
         if dd != "auto":
             return dd == "on"
         from paddlebox_tpu.ops import pallas_kernels
+        if (pallas_kernels.normalize_push_engine(config_flags.push_engine)
+                == "scatter_accumulate"):
+            # the forced fused engine consumes premerged unique lanes —
+            # without the premerge it would silently fall back to the
+            # scatter and the A/B would measure nothing
+            return True
         multi_hot = self.layout.total_len > self.layout.num_slots
         wide = pallas_kernels.lane_groups(
             self.store.cfg, ws.padded_rows) == 1
         return multi_hot or wide
+
+    def push_premerged(self, ws: PassWorkingSet) -> bool:
+        """Whether the push merge engine sees one-lane-per-unique-row
+        operands for this working set: the sharded exchange always
+        premerges at the engine (per-source premerge before routing +
+        the apply tail's cross-device lane merge), the single-shard
+        path iff the host plan carries dedup bounds."""
+        return (self.table_layout == "sharded"
+                or (self._use_plan and self._dedup_premerge(ws)))
+
+    def resolved_push_engine(self, ws: PassWorkingSet) -> str:
+        """Which push merge engine the step programs compile with for
+        this working set — THE resolver's verdict at the per-shard
+        geometry (the engine dispatches on rows_per_shard after
+        routing). Trace-time static; recorded per bench matrix point
+        and in the flight record, like pull_engine."""
+        from paddlebox_tpu.ops import pallas_kernels
+        f32 = self.store.cfg.storage == "f32"
+        width = int(ws.table.shape[1]) if f32 else None
+        return pallas_kernels.resolve_push_engine(
+            self.store.cfg, ws.rows_per_shard,
+            premerged=self.push_premerged(ws), storage_f32=f32,
+            table_width=width)
 
     def train_pass(self, dataset, metrics: Any = None,
                    preload_keys: np.ndarray | None = None,
@@ -1285,6 +1321,11 @@ class Trainer:
             routed_dropped=out.get("routed_dropped"),
             push_applies=(self.push_applies - applies0) or None,
             pull_engine=self.pull_engine,
+            # which push merge engine this pass's steps compiled with
+            # (THE resolver's verdict — the doctor's push-floor rule
+            # names it when suggesting a forced A/B)
+            push_engine=(self.resolved_push_engine(self._last_ws)
+                         if self._last_ws is not None else None),
             # pass-boundary cost (this pass's working-set build) + its
             # split — the run doctor's boundary-wall rule reads both
             boundary_seconds=round(fm.last_boundary_seconds, 6),

@@ -449,88 +449,156 @@ def attribute_step(trainer, ws, staged, step_seconds: float,
 def push_floor_analysis(emb_cfg, n_rows: int, tokens: int,
                         n_split: int = 2, peaks=None,
                         measured_push: float | None = None,
-                        slack: float = 3.0) -> dict:
-    """Per-stage analytic bounds of one sparse push + closure statement.
+                        slack: float = 3.0, premerged: bool = False,
+                        table_width: int | None = None,
+                        unique_lanes: int | None = None) -> dict:
+    """Per-stage analytic bounds of one sparse push + closure statements.
 
     peaks : (peak_bf16_flops, peak_hbm_bytes) or None (unknown hardware —
             bounds are reported as bytes/FLOPs only, closure abstains).
     measured_push : the attribution's sparse_push seconds, if available.
-    closed : True when the measured push sits within `slack` x the floor;
-            otherwise a reason string naming the gap — the alarm line.
+    premerged / table_width : the lane contract + physical table width
+            the engine resolver keys on — pass what the step compiled
+            with so `engine` names the real code path.
+    unique_lanes : rows the premerged lanes actually touch (defaults to
+            tokens — an upper bound; the fused engine's floor scales
+            with THIS, which is the whole point of that engine).
+    closed : True when the measured push sits within `slack` x the
+            active engine's floor; otherwise a reason string naming the
+            gap — the alarm line. `engines` carries the same statement
+            per CANDIDATE engine at this geometry, so a non-closed
+            point names the concrete flags.push_engine to force
+            (best_engine) instead of a bare alarm.
     """
     from paddlebox_tpu.ops import pallas_kernels as pk
 
     geom = pk._bp_geometry(emb_cfg, n_rows)
-    # backend-aware: must name the engine the step actually compiles with
-    # (bench detail's push_engine) — CPU smoke runs the scatter
-    engine = ("binned_kernel"
-              if pk.binned_acc_supported(emb_cfg, n_rows)
-              else "xla_scatter")
+    storage_f32 = emb_cfg.storage == "f32"
+    width = int(table_width) if table_width is not None \
+        else emb_cfg.row_width
+    # THE resolver names the engine the step actually compiles with
+    # (the same call the bench's per-point push_engine record makes)
+    engine = pk.resolve_push_engine(emb_cfg, n_rows, premerged=premerged,
+                                    storage_f32=storage_f32,
+                                    table_width=width)
     gw = emb_cfg.grad_width
     rw = emb_cfg.row_width
-    stages: dict = {}
+    lanes = int(unique_lanes) if unique_lanes is not None else tokens
+    peak_f, peak_b = peaks if peaks is not None else (None, None)
+
+    def _bw_stage(nbytes, note):
+        return {"bytes": int(nbytes),
+                "bound_seconds": (round(nbytes / peak_b, 6)
+                                  if peak_b else None),
+                "note": note}
+
+    def _engine_stages(name):
+        """The three floor stages (constant keys across engines) for one
+        candidate engine at this geometry, or None when the engine
+        cannot engage here."""
+        st: dict = {}
+        if name == "binned_kernel":
+            if geom is None:
+                return None
+            P, PP, G, SB = geom
+            W = -(-(PP + 2) // 128) * 128
+            TILE = pk._bp_tile(SB, G)
+            RB = SB // G
+            AW = pk._bp_acc_width(G, PP)
+            tok_pad = tokens + TILE
+            st["kernel_dma"] = _bw_stage(
+                tok_pad * W * 4 * 2          # packed build write + DMA read
+                + (n_rows // SB) * RB * AW * 4,   # grouped acc write
+                "packed-operand build + double-buffered tile DMA + acc "
+                "write")
+            dot_flops = 2.0 * n_split * tokens * RB * AW
+            st["onehot_dots"] = {
+                "flops": dot_flops,
+                "bound_seconds": (round(dot_flops / peak_f, 6)
+                                  if peak_f else None),
+                "note": f"{n_split}-plane one-hot MXU merge, RB={RB} "
+                        f"AW={AW}"}
+            st["fused_update"] = _bw_stage(
+                n_rows * (rw * 4 * 2 + PP * 4),
+                "one full-width XLA pass: table read+write + acc read")
+            return st
+        if name == "scatter_accumulate":
+            if not storage_f32 \
+                    or pk.scatter_accumulate_geometry(n_rows, width) \
+                    is None:
+                return None
+            st["kernel_dma"] = _bw_stage(
+                lanes * (width * 4 * 2 + (gw + 3) * 4),
+                f"per-unique-row DMA read + write-back at the physical "
+                f"table width ({width} lanes) + merged payload read — "
+                f"{lanes} lanes, O(unique rows), no full-table term")
+            st["onehot_dots"] = {
+                "flops": 0.0,
+                "bound_seconds": 0.0 if peak_b else None,
+                "note": "fused engine — row-wise VMEM update, no MXU "
+                        "merge"}
+            st["fused_update"] = _bw_stage(
+                0,
+                "optimizer applied in-kernel on the gathered rows — the "
+                "O(table) update pass never runs")
+            return st
+        st["kernel_dma"] = _bw_stage(
+            tokens * (gw + 3) * 4 * 2,
+            "scatter payload write + read (XLA scatter engine)")
+        st["onehot_dots"] = {
+            "flops": 0.0, "bound_seconds": 0.0 if peak_b else None,
+            "note": "scatter engine — no MXU merge"}
+        st["fused_update"] = _bw_stage(
+            n_rows * (rw * 4 * 2 + (gw + 3) * 4 * 2),
+            "scatter-add accumulate + fused update pass over the table")
+        return st
+
+    def _floor_of(st):
+        bounded = [s["bound_seconds"] for s in st.values()]
+        return (round(sum(b for b in bounded if b is not None), 6)
+                if any(b is not None for b in bounded) else None)
+
+    stages = _engine_stages(engine)
+    assert stages is not None, engine    # the resolver only names engageable engines
     # plan staging: order + block windows (+ dedup lanes at worst)
-    plan_bytes = tokens * 4 * 3 + 1024
-    stages["plan_h2d"] = {
-        "bytes": plan_bytes,
+    stages = {"plan_h2d": {
+        "bytes": tokens * 4 * 3 + 1024,
         "bound_seconds": None,
         "note": "host plan staged by the pack pipeline, overlapped with "
                 "device compute — off the step's critical path; counted "
                 "for completeness, excluded from the floor",
-    }
-    peak_f, peak_b = peaks if peaks is not None else (None, None)
-
-    def _bw(name, nbytes, note):
-        stages[name] = {
-            "bytes": int(nbytes),
-            "bound_seconds": (round(nbytes / peak_b, 6)
-                              if peak_b else None),
-            "note": note,
-        }
-
-    if engine == "binned_kernel" and geom is not None:
-        P, PP, G, SB = geom
-        W = -(-(PP + 2) // 128) * 128
-        TILE = pk._bp_tile(SB, G)
-        RB = SB // G
-        AW = pk._bp_acc_width(G, PP)
-        tok_pad = tokens + TILE
-        _bw("kernel_dma",
-            tok_pad * W * 4 * 2          # packed build write + DMA read
-            + (n_rows // SB) * RB * AW * 4,   # grouped acc write
-            "packed-operand build + double-buffered tile DMA + acc write")
-        dot_flops = 2.0 * n_split * tokens * RB * AW
-        stages["onehot_dots"] = {
-            "flops": dot_flops,
-            "bound_seconds": (round(dot_flops / peak_f, 6)
-                              if peak_f else None),
-            "note": f"{n_split}-plane one-hot MXU merge, RB={RB} AW={AW}",
-        }
-        _bw("fused_update",
-            n_rows * (rw * 4 * 2 + PP * 4),
-            "one full-width XLA pass: table read+write + acc read")
-    else:
-        _bw("kernel_dma",
-            tokens * (gw + 3) * 4 * 2,
-            "scatter payload write + read (no kernel geometry: "
-            "XLA scatter engine)")
-        stages["onehot_dots"] = {
-            "flops": 0.0, "bound_seconds": 0.0 if peak_b else None,
-            "note": "scatter engine — no MXU merge"}
-        _bw("fused_update",
-            n_rows * (rw * 4 * 2 + (gw + 3) * 4 * 2),
-            "scatter-add accumulate + fused update pass over the table")
-
-    bounded = [s["bound_seconds"] for name, s in stages.items()
-               if name != "plan_h2d"]
-    floor = (round(sum(b for b in bounded if b is not None), 6)
-             if any(b is not None for b in bounded) else None)
+    }, **stages}
+    # candidate-engine floors: every engine that COULD engage at this
+    # geometry gets its own bound, so the closure statements below can
+    # name the concrete engine to force when the active one is off its
+    # physics (the doctor's push-floor rule consumes exactly this)
+    engines: dict = {}
+    for name in pk.PUSH_ENGINES:
+        st = _engine_stages(name)
+        if st is None:
+            continue
+        e = {"floor_seconds": _floor_of(st)}
+        if name == "scatter_accumulate" and not premerged:
+            e["note"] = ("requires premerged unique lanes "
+                         "(flags.push_dedup_premerge)")
+        if name == "binned_kernel":
+            from paddlebox_tpu.config import flags as _flags
+            if not _flags.binned_push:
+                # auto skips it while the enable knob is off; a forced
+                # flags.push_engine=binned_kernel bypasses the knob
+                e["note"] = ("flags.binned_push is off — engages only "
+                             "when forced")
+        engines[name] = e
     out = {
         "engine": engine,
+        "premerged": bool(premerged),
         "tokens": tokens,
+        "unique_lanes": lanes,
         "table_rows": n_rows,
         "stages": stages,
-        "floor_seconds": floor,
+        "floor_seconds": _floor_of(
+            {k: v for k, v in stages.items() if k != "plan_h2d"}),
+        "engines": engines,
         "measured_push_seconds": (round(measured_push, 6)
                                   if measured_push is not None else None),
     }
@@ -542,18 +610,39 @@ def finalize_push_floor(floor: dict, measured_push: float | None,
                         slack: float = 3.0) -> None:
     """(Re)close a push_floor_analysis result once the attribution has
     measured the real push stage — mutates `floor` in place (the bench
-    computes the floor before attribution runs and finalizes after)."""
+    computes the floor before attribution runs and finalizes after).
+    Closes the active engine's statement AND the per-candidate-engine
+    statements, and names `best_engine` — the lowest-floor candidate —
+    so an off-floor point suggests a concrete flags.push_engine force.
+    """
     f = floor.get("floor_seconds")
     if measured_push is not None:
         floor["measured_push_seconds"] = round(measured_push, 6)
-    if f is None:
-        floor["closed"] = "no peak table for this hardware (CPU smoke?)"
-    elif measured_push is None:
-        floor["closed"] = "no measured push stage (attribution absent)"
-    elif measured_push <= slack * max(f, 1e-9):
-        floor["closed"] = True
-    else:
-        floor["closed"] = (
-            f"measured {measured_push*1e3:.2f}ms > {slack:.0f}x floor "
-            f"{f*1e3:.2f}ms — push is off its physics; check the "
-            f"pack engine and plan staging before trusting the step")
+
+    def _close(bound, label):
+        if bound is None:
+            return "no peak table for this hardware (CPU smoke?)"
+        if measured_push is None:
+            return "no measured push stage (attribution absent)"
+        if measured_push <= slack * max(bound, 1e-9):
+            return True
+        return (f"measured {measured_push*1e3:.2f}ms > {slack:.0f}x "
+                f"{label} {bound*1e3:.2f}ms")
+
+    closed = _close(f, "floor")
+    floor["closed"] = (closed if closed is True or f is None
+                       or measured_push is None else closed +
+                       " — push is off its physics; check the pack "
+                       "engine and plan staging before trusting the "
+                       "step")
+    engines = floor.get("engines") or {}
+    best = None
+    for name, e in engines.items():
+        e["closed"] = _close(e.get("floor_seconds"),
+                             f"{name} floor")
+        fs = e.get("floor_seconds")
+        if fs is not None and (best is None
+                               or fs < engines[best]["floor_seconds"]):
+            best = name
+    if best is not None:
+        floor["best_engine"] = best

@@ -163,6 +163,13 @@ def test_bench_dryrun_smoke():
     assert out["checks"]["waiver_untrips"]
     assert out["checks"]["attribution_ok"]
     assert out["checks"]["floor_ok"]
+    # the per-point push-engine record (ISSUE 13): the resolver's
+    # verdict is recorded per matrix point and the floor carries the
+    # per-candidate-engine closure statements the doctor names concrete
+    # flags.push_engine forces from
+    assert out["checks"]["push_engine_recorded"]
+    assert out["push_engine"] in ("xla_scatter", "binned_kernel",
+                                  "scatter_accumulate")
     assert out["push_overlap"] == "on"
     assert "stages" in out and "sparse_push" in out["stages"]
     assert out["gate_example_lines"]["headline_eps"].startswith("REGRESS")

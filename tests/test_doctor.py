@@ -104,13 +104,27 @@ RULE_FIXTURES: dict = {
                                   "exchange.unique_lanes": 780})]),
     ),
     "push-floor": (
-        dict(detail={"push_floor": {
-            "engine": "binned_kernel", "floor_seconds": 0.001,
-            "measured_push_seconds": 0.02,
-            "closed": "measured 20.00ms > 3x floor 1.00ms"}}),
-        dict(detail={"push_floor": {
-            "engine": "binned_kernel", "floor_seconds": 0.001,
-            "measured_push_seconds": 0.002, "closed": True}}),
+        dict(detail={"push_engine": "binned_kernel",
+                     "push_floor": {
+                         "engine": "binned_kernel",
+                         "floor_seconds": 0.001,
+                         "measured_push_seconds": 0.02,
+                         "closed": "measured 20.00ms > 3x floor 1.00ms",
+                         "engines": {
+                             "binned_kernel": {"floor_seconds": 0.001,
+                                               "closed": "measured ..."},
+                             "scatter_accumulate": {
+                                 "floor_seconds": 0.0004,
+                                 "closed": "measured ...",
+                                 "note": "requires premerged unique "
+                                         "lanes"}},
+                         "best_engine": "scatter_accumulate"}}),
+        dict(detail={"push_engine": "binned_kernel",
+                     "push_floor": {
+                         "engine": "binned_kernel",
+                         "floor_seconds": 0.001,
+                         "measured_push_seconds": 0.002,
+                         "closed": True}}),
     ),
     "nan-guard": (
         dict(flights=[make_flight(1, stats={"trainer.nan_trips": 1})],
@@ -169,6 +183,34 @@ def test_every_rule_fires_and_stays_quiet(rule_cls):
     status_q = {r["rule"]: r["status"] for r in rep_q["rules"]}
     assert status_q[rule_cls.id] == "quiet", (rule_cls.id, status_q)
     assert all(f["rule"] != rule_cls.id for f in rep_q["findings"])
+
+
+def test_push_floor_suggestion_names_concrete_engine():
+    """ISSUE 13: the push-floor finding consumes the per-point engine
+    record + the per-candidate-engine closure statements and names the
+    CONCRETE flags.push_engine to force — never a bare 'A/B the knobs'."""
+    rep = doctor.diagnose(**RULE_FIXTURES["push-floor"][0])
+    f = next(f for f in rep["findings"] if f["rule"] == "push-floor")
+    assert "flags.push_engine='scatter_accumulate'" in f["suggestion"]
+    assert "premerged" in f["suggestion"]       # the note rides along
+    assert f["evidence"]["engine"] == "binned_kernel"
+    assert f["evidence"]["engine_floors"]["scatter_accumulate"] == 0.0004
+    # the resolver already on the best engine: no force to suggest —
+    # the suggestion pivots to the companion knobs instead
+    fire = dict(detail={"push_engine": "scatter_accumulate",
+                        "push_floor": {
+                            "engine": "scatter_accumulate",
+                            "floor_seconds": 0.001,
+                            "measured_push_seconds": 0.02,
+                            "closed": "measured 20.00ms > 3x floor "
+                                      "1.00ms",
+                            "engines": {"scatter_accumulate":
+                                        {"floor_seconds": 0.001,
+                                         "closed": "measured ..."}},
+                            "best_engine": "scatter_accumulate"}})
+    rep2 = doctor.diagnose(**fire)
+    f2 = next(f for f in rep2["findings"] if f["rule"] == "push-floor")
+    assert "lowest-floor engine" in f2["suggestion"]
 
 
 def test_doctor_report_verdict_and_severity_order():

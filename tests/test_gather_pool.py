@@ -220,6 +220,43 @@ def test_gather_pool_geometry_bounds():
     assert bb is not None and 4096 % bb == 0
 
 
+def test_gather_pool_geometry_lanes_table_retune():
+    """The routed path's received-lane geometry (ISSUE 13 satellite):
+    the gather source is the cap*D x pull_width lane array, not the
+    n_rows x row_width HBM table the 64-row cap was tuned on — narrow
+    lane sources take bigger batch tiles (fewer grid prologues), the
+    same VMEM budget rule still bounds wide ones."""
+    # narrow received lanes: the tile cap doubles past the HBM tuning
+    bb_hbm = pallas_kernels.gather_pool_geometry(256, 3, 2, 13)
+    bb_lan = pallas_kernels.gather_pool_geometry(256, 3, 2, 13,
+                                                 lanes_table=True)
+    assert bb_hbm == 64 and bb_lan == 128
+    # the budget rule is unchanged: wide lane sources shrink the tile
+    wide = pallas_kernels.gather_pool_geometry(4096, 26, 4, 128,
+                                               lanes_table=True)
+    assert wide is not None and wide <= 64
+    assert pallas_kernels.gather_pool_geometry(8, 3, 2, 1024,
+                                               lanes_table=True) is None
+
+
+def test_gather_pool_kernel_parity_at_lanes_table_tile():
+    """Kernel parity at a lanes-table tile the HBM cap would never pick
+    (BB=128): the retuned geometry must change only the tiling, never
+    the pooled sums."""
+    cfg, table, idx, mask, seg = _mk(B=128, S=1, L=1, dim=4, n=64,
+                                     seed=9)
+    idx0 = np.where(mask, idx, 0).astype(np.int32)
+    assert pallas_kernels.gather_pool_geometry(
+        128, 1, 1, int(table.shape[1]), lanes_table=True) == 128
+    out = pallas_kernels.gather_pool(table, jnp.asarray(idx0), cfg, 1, 1,
+                                     lanes_table=True, interpret=True)
+    P = cfg.pull_width
+    ref = np.asarray(table)[idx0.reshape(-1), :P].reshape(
+        128, 1, 1, P).sum(axis=2)
+    np.testing.assert_allclose(np.asarray(out), ref, rtol=1e-6,
+                               atol=1e-6)
+
+
 def _trainer_fixture(engine_flag, seed=3):
     from paddlebox_tpu.data import DataFeedSchema, SlotDataset
     from paddlebox_tpu.data.parser import parse_multislot_lines
