@@ -138,6 +138,14 @@ def collect_gate_metrics(eps_chip: float, detail: dict) -> dict:
         for k in ("fetch_keys_per_s", "hot_hit_rate"):
             if isinstance(sp.get(k), (int, float)):
                 m[f"spill_10x.{k}"] = sp[k]
+    bd = (detail.get("matrix") or {}).get("boundary_incremental")
+    if isinstance(bd, dict):
+        # pass-boundary point: the incremental+overlapped boundary wall
+        # (lower-is-better off the _seconds suffix) and the speedup it
+        # holds over the full-rebuild baseline on the same key stream
+        for k in ("boundary_seconds", "speedup"):
+            if isinstance(bd.get(k), (int, float)):
+                m[f"boundary_incremental.{k}"] = bd[k]
     e2e = detail.get("e2e")
     if isinstance(e2e, dict) and "examples_per_sec_per_chip" in e2e:
         m["e2e_eps"] = e2e["examples_per_sec_per_chip"]
@@ -303,6 +311,13 @@ def device_step_bench(small: bool, mode: str = "allreduce",
 
     n_staged = 4
     host_batches = []
+    # measured dedup: the pack-side plan emits the per-batch unique-lane
+    # counters (trainer.plan_unique_tokens single-shard, exchange.
+    # unique_lanes sharded) while these batches stage — their mean feeds
+    # the push floor the measured lanes instead of the tokens upper
+    # bound (ROADMAP PR-12 follow-up #3)
+    from paddlebox_tpu import monitor as _mon
+    _plan0 = _mon.STATS.snapshot()
     for _ in range(n_staged):
         raw = rng.choice(keys, size=(batch, T))
         if max_len > 1 and T == num_slots * max_len:
@@ -320,6 +335,15 @@ def device_step_bench(small: bool, mode: str = "allreduce",
         # device compute in train_pass); staged here like the batch itself
         plan = tr._host_plan(ws, idx)
         host_batches.append((idx, mask, dense, labels, *plan))
+    _plan1 = _mon.STATS.snapshot()
+    _udelta = (_plan1.get("exchange.unique_lanes", 0.0)
+               - _plan0.get("exchange.unique_lanes", 0.0)) \
+        or (_plan1.get("trainer.plan_unique_tokens", 0.0)
+            - _plan0.get("trainer.plan_unique_tokens", 0.0))
+    # per-shard per-step mean (the floor models ONE chip's pass; the
+    # counters sum the whole world's lanes per batch)
+    measured_lanes = (int(round(_udelta / n_staged / n_dev))
+                      if _udelta > 0 else None)
     staged = [tuple(jax.device_put(a, sh) for a in hb)
               for hb in host_batches]
     # superstep operands: the same batches stacked for k-per-dispatch
@@ -449,6 +473,12 @@ def device_step_bench(small: bool, mode: str = "allreduce",
         emb_cfg, ws.rows_per_shard, batch * T // n_dev,
         n_split=config_flags.binned_push_splits, peaks=peaks,
         premerged=premerged,
+        # the RECORDED per-batch dedup counters, not the tokens upper
+        # bound: on premerged engines the fused floor scales with the
+        # rows the lanes actually touch (capped at tokens — a foreign
+        # counter bump can only tighten toward truth, never past it)
+        unique_lanes=(min(measured_lanes, batch * T // n_dev)
+                      if premerged and measured_lanes else None),
         table_width=(int(ws.table.shape[1]) if storage == "f32"
                      else None))
     detail = {
@@ -461,6 +491,10 @@ def device_step_bench(small: bool, mode: str = "allreduce",
         # program does not contain. The engine dispatches per SHARD, so
         # the per-shard row count decides.
         "push_engine": tr.resolved_push_engine(ws),
+        # measured per-batch unique lanes (per shard) from the recorded
+        # dedup counters — what the floor above consumed (None = no
+        # plan ran, floors fall back to the tokens bound)
+        "unique_lanes_measured": measured_lanes,
         # which pull engine the step compiled with (trainer heuristic:
         # fused gather-pool for multi-hot/wide layouts — the mh4d32 and
         # d128 envelope points — unfused lookup+seqpool elsewhere)
@@ -1168,6 +1202,117 @@ def spill_drill(small: bool, tiny: bool = False) -> dict:
     }
 
 
+def boundary_drill(small: bool, tiny: bool = False) -> dict:
+    """boundary_incremental point (ISSUE 14): the same key stream through
+    (a) the incremental + overlapped feed — resident reuse, background
+    staging consumed at the boundary, stale-delta patching after a
+    shrink, spill-tier madvise prefetch — and (b) the full-rebuild feed
+    (``flags.incremental_feed=False``, no staging, the resident set
+    dropped every boundary), with a pure-eviction ``shrink`` between
+    passes so every boundary crosses a store mutation (the case that
+    used to force the full rebuild even with reuse on). Records
+    boundary_seconds + the build/h2d/spill_fault_in split for both
+    variants and proves the two land bit-identical store bytes."""
+    import tempfile as _tf
+    import jax.numpy as jnp
+    from paddlebox_tpu.config import flags as config_flags
+    from paddlebox_tpu.embedding import EmbeddingConfig
+    from paddlebox_tpu.embedding.feed_pass import FeedPassManager
+    from paddlebox_tpu.embedding.spill_store import SpillEmbeddingStore
+
+    # tiny keeps the SMALL working set: below ~20k rows the full-rebuild
+    # baseline costs less than the combine's fixed jit dispatch on CPU
+    # and the point would measure dispatch overhead, not the feed
+    n_keys = 40_000 if (tiny or small) else 200_000
+    churn = n_keys // 10                 # 90% overlap pass to pass
+    passes = 5
+    timed_from = 2        # pass-1 boundary compiles the combine/patch
+    #                       jits once; steady-state boundaries gate
+    cfg = EmbeddingConfig(dim=8, optimizer="adagrad", learning_rate=0.05)
+
+    def key_window(lo, hi):
+        return np.sort(np.arange(lo, hi, dtype=np.uint64)
+                       * np.uint64(2654435761) + np.uint64(1))
+
+    def run(incremental: bool, spill_dir: str) -> dict:
+        config_flags.incremental_feed = incremental
+        store = SpillEmbeddingStore(cfg, spill_dir=spill_dir,
+                                    cache_rows=max(256, n_keys // 8))
+        mgr = FeedPassManager(store)
+        bsec, split = 0.0, {"build": 0.0, "h2d": 0.0,
+                            "spill_fault_in": 0.0}
+        stats = {"fresh_rows": 0, "reused_rows": 0, "patched_rows": 0,
+                 "stale_rows": 0}
+        for p in range(passes):
+            keys = key_window(p * churn, p * churn + n_keys)
+            ws = mgr.begin_pass(keys)
+            if p >= timed_from:          # steady state (see timed_from)
+                bsec += mgr.last_boundary_seconds
+                for k in split:
+                    split[k] += mgr.last_boundary_split.get(k, 0.0)
+            if p:                        # pass-1 full build is identical
+                stats["fresh_rows"] += mgr.last_fresh_rows
+                stats["reused_rows"] += mgr.last_reused_rows
+                stats["patched_rows"] += mgr.last_patched_rows
+                stats["stale_rows"] += mgr.last_stale_rows
+            # train: touch every key; the cold tail (keys absent from
+            # the next pass) zeroes its show counter so the boundary
+            # shrink evicts exactly it — a pure store-side mutation
+            # every single boundary crosses
+            idx = ws.translate(keys)
+            t = np.asarray(ws.table).copy()
+            staying = np.isin(keys, key_window((p + 1) * churn,
+                                               (p + 1) * churn + n_keys),
+                              assume_unique=True)
+            t[idx[staying], 0] += 1.0
+            t[idx[~staying], 0] = 0.0
+            t[idx, 2] += 0.5
+            mgr.end_pass(ws, jnp.asarray(t))
+            if incremental:
+                # overlap: stage the next pass BEFORE the shrink, so the
+                # boundary exercises the staged-patch delta plane
+                mgr.begin_feed_pass(key_window((p + 1) * churn,
+                                               (p + 1) * churn + n_keys))
+            # pure-eviction hygiene shrink (decay=1.0): flushes the
+            # device tier, then evicts this pass's cold tail — a
+            # mutation whose reach the stale log can prove
+            store.shrink(min_show=0.5, decay=1.0)
+        mgr.drop()
+        all_keys = key_window(passes * churn, passes * churn + n_keys
+                              - churn)
+        rows = store.peek_rows(all_keys)
+        return {"bsec": bsec, "split": split, "stats": stats,
+                "rows": rows, "prefetched": int(store.prefetched_rows)}
+
+    with _tf.TemporaryDirectory(prefix="pbtpu_boundary_drill_") as td:
+        startup = config_flags.incremental_feed
+        try:
+            inc = run(True, os.path.join(td, "inc"))
+            full = run(False, os.path.join(td, "full"))
+        finally:
+            config_flags.incremental_feed = startup
+    parity = bool(np.array_equal(inc["rows"], full["rows"]))
+    return {
+        "working_set_keys": int(n_keys), "passes": passes,
+        "overlap_frac": round(1 - churn / n_keys, 2),
+        "boundary_seconds": round(inc["bsec"], 4),
+        "full_rebuild_seconds": round(full["bsec"], 4),
+        "speedup": round(full["bsec"] / inc["bsec"], 2)
+        if inc["bsec"] > 0 else None,
+        "boundary_split": {k: round(v, 4)
+                           for k, v in inc["split"].items()},
+        "full_boundary_split": {k: round(v, 4)
+                                for k, v in full["split"].items()},
+        # the incremental variant fetches almost nothing from disk, so
+        # the readahead shows on the FULL-rebuild side (its every
+        # boundary re-faults the working set through the spill tier)
+        "prefetched_rows": inc["prefetched"],
+        "full_prefetched_rows": full["prefetched"],
+        "parity": parity,
+        **{k: int(v) for k, v in inc["stats"].items()},
+    }
+
+
 def _run_sharded_probe(small: bool, tiny: bool = False) -> dict:
     """Run the sharded-exchange matrix points in a 2-virtual-device CPU
     subprocess (``--sharded-probe``): a single-device environment cannot
@@ -1333,6 +1478,39 @@ def dryrun_main() -> int:
         and spd.get("hot_hit_rate", 0.0)
         > spd.get("direct_hot_hit_rate", 1.0)
         and spd.get("evicted", 1 << 30) < spd.get("direct_evicted", 0))
+    # pass-boundary drill rides the dryrun too (ISSUE 14): the
+    # incremental + overlapped feed must land bit-identical store bytes
+    # AND a boundary wall strictly below the full-rebuild baseline on
+    # the same key stream (with the 3-way split + the fresh/reused/
+    # patched accounting recorded) — before a chip round ever records it
+    try:
+        bdrill = boundary_drill(True, tiny=True)
+        if not (0 < bdrill.get("boundary_seconds", 0.0)
+                < bdrill.get("full_rebuild_seconds", 0.0)):
+            # the only wall-clock comparison in the dryrun: one
+            # scheduler stall on a loaded runner can invert a ~1.5x
+            # margin, so the timing race gets one retry — the
+            # deterministic fields (parity, row accounting) never do
+            bdrill = boundary_drill(True, tiny=True)
+    except Exception as e:
+        bdrill = {"error": repr(e)}
+    detail.setdefault("matrix", {})["boundary_incremental"] = bdrill
+    checks["boundary_fields"] = (
+        bdrill.get("parity") is True
+        and isinstance(bdrill.get("boundary_seconds"), float)
+        and isinstance(bdrill.get("full_rebuild_seconds"), float)
+        and 0 < bdrill["boundary_seconds"]
+        < bdrill["full_rebuild_seconds"]
+        and set(bdrill.get("boundary_split", {}))
+        == {"build", "h2d", "spill_fault_in"}
+        and bdrill.get("reused_rows", 0) > 0
+        and bdrill.get("fresh_rows", 0) > 0
+        and bdrill.get("patched_rows", 0) > 0
+        # the readahead is advisory BY CONTRACT: require it only where
+        # the platform has madvise at all (elsewhere the documented
+        # fallback is the synchronous fault-in)
+        and (bdrill.get("full_prefetched_rows", 0) > 0
+             or not hasattr(__import__("mmap"), "MADV_WILLNEED")))
     # sharded-exchange points ride the dryrun too (ISSUE 10): the 2-
     # virtual-device probe must produce the sharded matrix points with
     # table_layout / exchange_wire / table_shards recorded and a real
@@ -1436,6 +1614,9 @@ def dryrun_main() -> int:
         "spill": {k: spd.get(k) for k in
                   ("hot_hit_rate", "direct_hot_hit_rate",
                    "fetch_keys_per_s", "error") if k in spd},
+        "boundary": {k: bdrill.get(k) for k in
+                     ("boundary_seconds", "full_rebuild_seconds",
+                      "speedup", "parity", "error") if k in bdrill},
         "overlap_ab": attr.get("overlap_ab"),
         "stages": attr.get("stages"),
         "gate_example_lines": g1.get("lines"),
@@ -1793,6 +1974,14 @@ def _enrich(small: bool, detail: dict, ctx: dict,
             except Exception as e:
                 matrix["spill_10x"] = {"error": repr(e)}
             _mark("matrix point spill_10x done")
+            # pass-boundary drill: incremental + overlapped feeds vs the
+            # full-rebuild baseline on one key stream — gate-held
+            # (boundary_seconds is lower-is-better off the suffix)
+            try:
+                matrix["boundary_incremental"] = boundary_drill(small)
+            except Exception as e:
+                matrix["boundary_incremental"] = {"error": repr(e)}
+            _mark("matrix point boundary_incremental done")
         if os.environ.get("PBTPU_BENCH_ELASTIC", "1") != "0":
             # elastic rank-loss drill: world_resize_seconds + the
             # degraded (N−1) throughput point, gate-held like the rest

@@ -187,6 +187,34 @@ class Flags:
     # Root directory for spill row files ("" = a fresh temp dir per
     # store); sharded stores put shard s under <spill_dir>/shard-SS.
     spill_dir: str = ""                     # (new)
+    # Autotune spill_cache_rows from the hit-rate/eviction telemetry the
+    # flight record already carries: at each pass boundary the tier
+    # re-evaluation doubles a thrashing (sub-)store's RAM row cache
+    # (low hit rate + heavy eviction churn) and halves a mostly-idle
+    # one, bounded by [256, 1<<22] slots; the chosen value lands in
+    # the flight-record extras (spill_cache_rows) and the tiering.
+    # cache_rows gauge. Opt-in: resizing drops the cache contents (the
+    # spill file stays authoritative — a resize is never a math change).
+    spill_cache_autotune: bool = False      # (new)
+    # madvise(WILLNEED)-style async prefetch of the NEXT pass's spill
+    # rows on the feed-pass stager thread: the working-set build issues
+    # the disk-tier readahead for every row it is ABOUT to fault in
+    # before the first read, so the kernel pages the spill file in
+    # parallel with the host-side build instead of serially inside it
+    # (the LoadSSD2Mem pairing — box_wrapper.h:487-494 pulls the pass's
+    # range up BEFORE the working-set build reads it).
+    spill_prefetch: bool = True             # (new)
+    # Incremental delta feeds (embedding/feed_pass.py): when the host
+    # store mutates between passes (shrink / delta replay) the feed
+    # manager re-fetches ONLY the rows the mutation touched (the store's
+    # bounded stale-key log) and keeps every other resident device row,
+    # instead of discarding the working set and re-transferring the full
+    # table; a background staging invalidated by such a mutation is
+    # PATCHED with a compact delta plane rather than thrown away. Off =
+    # the pre-incremental behavior (any mutation forces a full rebuild)
+    # — the A/B knob the boundary_incremental bench point measures and
+    # the doctor's boundary-wall rule names when reuse is off.
+    incremental_feed: bool = True           # (new)
 
     # _bp_pack width-class engine override for A/B runs: "auto" selects
     # per payload width (narrow < 14 lanes reorders at logical width and

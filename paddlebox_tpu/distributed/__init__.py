@@ -25,6 +25,7 @@ over the mesh inside jit.
 """
 
 from paddlebox_tpu.distributed.store import FileStore  # noqa: F401
+from paddlebox_tpu.distributed.ownership import ShardOwnership  # noqa: F401
 from paddlebox_tpu.distributed.collectives import HostCollectives  # noqa: F401
 from paddlebox_tpu.distributed.role_maker import RoleMaker  # noqa: F401
 from paddlebox_tpu.distributed.resilience import (  # noqa: F401

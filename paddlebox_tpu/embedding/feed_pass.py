@@ -32,6 +32,26 @@ pass cycle (shrink / load / delta replay — ``store.mutation_count``): a
 shrunk-away key must not resurrect from a stale device row. On such a
 mutation any not-yet-flushed device rows are discarded (the external
 restore/shrink wins), matching pass-granularity recovery semantics.
+
+Incremental delta feeds (``flags.incremental_feed``): a mutation whose
+reach the store can PROVE (its bounded stale-key log —
+``store.stale_keys_since``) no longer discards the working set. The
+stale resident keys are simply re-fetched with the fresh rows (the
+store wins for exactly the rows the mutation touched; every other
+resident row stays on device), and a background staging overtaken by a
+mutation is PATCHED with a compact delta plane (``_apply_patch``: one
+row-scatter of the re-fetched rows) instead of being thrown away — the
+boundary scales with the CHANGE, not the table. A mutation the log
+cannot bound (restore/replay reset) still forces the full rebuild, so
+crash recovery semantics are unchanged; the ``feed_pass.delta_stage.
+pre`` faultpoint covers the delta path in the kill matrix.
+
+Per-host shard ownership: bind a
+:class:`~paddlebox_tpu.distributed.ownership.ShardOwnership` and every
+feed builds only the keys hash-partitioned onto THIS host's shards of a
+``ShardedEmbeddingStore`` — build cost divides by world size, and an
+elastic re-formation rebinds ownership so a host rebuilds exactly its
+(new) shards' set.
 """
 
 from __future__ import annotations
@@ -58,6 +78,9 @@ from paddlebox_tpu.monitor import counter_add as stat_add
 from paddlebox_tpu.monitor import event as mon_event
 from paddlebox_tpu.monitor import gauge_set as stat_set
 from paddlebox_tpu.parallel import mesh as mesh_lib
+from paddlebox_tpu.utils import faultpoint
+
+_EMPTY_KEYS = np.zeros(0, dtype=np.uint64)
 
 
 @functools.lru_cache(maxsize=8)
@@ -86,11 +109,32 @@ def _combine_jit(out_sharding, donate: bool):
     return jax.jit(combine, **kw)
 
 
+@functools.lru_cache(maxsize=8)
+def _patch_jit(out_sharding):
+    """table.at[idx] <- rows: the compact post-staging delta plane (rows
+    the store mutated AFTER a background staging fetched them). Rows
+    arrive at logical width; resident planes may carry zero pad
+    columns. Cached per sharding; shapes retrace inside jit and are
+    bounded by bucket_size."""
+    def patch(table, rows, idx):
+        def one(t, r):
+            if r.shape[1] < t.shape[1]:
+                r = jnp.pad(r, ((0, 0), (0, t.shape[1] - r.shape[1])))
+            return t.at[idx].set(r)
+        return jax.tree.map(one, table, rows)
+
+    kw: dict = {"donate_argnums": (0,)}
+    if out_sharding is not None:
+        kw["out_shardings"] = out_sharding
+    return jax.jit(patch, **kw)
+
+
 class _Staging:
     """Result of one feed pass: fresh rows staged on device + the diff."""
 
     __slots__ = ("keys", "pos_prev", "fresh_dev", "n_fresh", "h2d_bytes",
-                 "prev", "store_gen", "full_ws", "timings")
+                 "prev", "store_gen", "full_ws", "timings", "marker",
+                 "patch_keys", "n_stale")
 
     def __init__(self, **kw):
         for k in self.__slots__:
@@ -102,15 +146,20 @@ class FeedPassManager:
 
     def __init__(self, store: HostEmbeddingStore,
                  mesh: jax.sharding.Mesh | None = None,
-                 min_rows_per_shard: int = 8):
+                 min_rows_per_shard: int = 8, ownership=None):
         self.store = store
         self.mesh = mesh
         self.min_rows_per_shard = min_rows_per_shard
+        # per-host shard ownership (distributed/ownership.ShardOwnership
+        # or None = this host builds the whole key space): every key set
+        # entering a feed is filtered to the owned shards' keys first
+        self.ownership = ownership
         # stores shared between trainers (RemoteEmbeddingStore) forbid
         # resident reuse/lazy write-back — rebuild + eager write-back
         self._eager = not getattr(store, "supports_resident_reuse", True)
         self._current: PassWorkingSet | None = None
         self._gen = -1                    # store.mutation_count at retain
+        self._marker = None               # store.mutation_marker at retain
         # rows of _current whose device values are fresher than the store
         # (flushed on retirement / save / shrink — lazy write-back)
         self._unsynced: np.ndarray | None = None
@@ -143,6 +192,11 @@ class FeedPassManager:
         self.last_d2h_bytes = 0
         self.last_fresh_rows = 0
         self.last_reused_rows = 0
+        # incremental-feed deltas of the last boundary: resident rows
+        # re-fetched because a store mutation touched them (stale), and
+        # staged rows patched because the mutation landed AFTER staging
+        self.last_stale_rows = 0
+        self.last_patched_rows = 0
         self.last_boundary_seconds = 0.0     # begin_pass side (the build)
         self.last_end_seconds = 0.0          # end_pass side (lazy: ~0)
         # component costs of the last boundary (flight-record extra
@@ -171,6 +225,42 @@ class FeedPassManager:
         return (not self._eager and self._current is not None
                 and self.store.mutation_count == self._gen)
 
+    def _filter_owned(self, keys: np.ndarray) -> np.ndarray:
+        o = self.ownership
+        if o is None or o.owns_all():
+            return keys
+        return o.filter_keys(self.store, keys)
+
+    def _stale_since(self, marker) -> np.ndarray | None:
+        """Keys whose STORE bytes changed since ``marker`` (empty =
+        clean); None = unknowable → full rebuild. Gated by
+        ``flags.incremental_feed`` (the A/B / escape hatch)."""
+        if not flags.incremental_feed or marker is None:
+            return None
+        fn = getattr(self.store, "stale_keys_since", None)
+        if fn is None:
+            return None
+        return fn(marker)
+
+    def _marker_now(self):
+        fn = getattr(self.store, "mutation_marker", None)
+        return fn() if fn is not None else None
+
+    def _resolve_reuse(self):
+        """(prev, stale): the resident working set to diff the next pass
+        against, plus the resident keys whose STORE bytes changed since
+        it was retained (empty when the store is clean). prev=None →
+        full rebuild (nothing resident, reuse forbidden, or a mutation
+        whose reach the stale log cannot prove)."""
+        if self._eager or self._current is None:
+            return None, None
+        if self.store.mutation_count == self._gen:
+            return self._current, _EMPTY_KEYS
+        stale = self._stale_since(self._marker)
+        if stale is None:
+            return None, None
+        return self._current, stale
+
     # -- feed pass (BeginFeedPass / WaitFeedPassDone) ----------------------
 
     def begin_feed_pass(self, keys: np.ndarray) -> None:
@@ -180,12 +270,15 @@ class FeedPassManager:
         the store lock), and dispatches async H2D of the fresh rows."""
         self.wait_feed_pass_done()        # one feed in flight at a time
         keys = np.unique(np.asarray(keys).astype(np.uint64))
-        prev = self._current if self._reuse_valid() else None
+        keys = self._filter_owned(keys)
+        prev, stale = self._resolve_reuse()
         gen = self.store.mutation_count
+        marker = self._marker_now()
 
         def run():
             try:
-                self._staged = self._stage(keys, prev, gen)
+                self._staged = self._stage(keys, prev, gen, marker=marker,
+                                           stale_keys=stale)
             except BaseException as e:    # re-raised at the join
                 self._feed_error = e
 
@@ -205,10 +298,15 @@ class FeedPassManager:
             raise e
 
     def _stage(self, keys: np.ndarray, prev: PassWorkingSet | None,
-               gen: int, test_mode: bool = False) -> _Staging:
+               gen: int, marker=None, stale_keys: np.ndarray | None = None,
+               test_mode: bool = False) -> _Staging:
         """Diff `keys` against `prev` and put the fresh rows on device.
-        With prev=None, stages the full build instead. Runs on the feed
-        thread (train semantics) or synchronously (incl. eval peek)."""
+        With prev=None, stages the full build instead. ``stale_keys``
+        (the incremental delta feed) are resident keys whose STORE bytes
+        changed since retain — they re-fetch with the fresh rows so the
+        store wins for exactly the rows a mutation touched. Runs on the
+        feed thread (train semantics) or synchronously (incl. eval
+        peek)."""
         cfg = self.store.cfg
         fault0 = tiering.fault_in_seconds(self.store)
         if prev is None:
@@ -222,12 +320,34 @@ class FeedPassManager:
             timing["spill_fault_in"] = (tiering.fault_in_seconds(self.store)
                                         - fault0)
             return _Staging(keys=ws.sorted_keys, prev=None, store_gen=gen,
+                            marker=marker,
                             full_ws=ws, n_fresh=len(ws.sorted_keys),
                             h2d_bytes=transfer_bytes(cfg, ws.padded_rows),
                             timings=timing)
         t0 = time.perf_counter()
         pos = prev._tindex.lookup(keys)            # -1 = fresh
+        n_stale = 0
+        if stale_keys is not None and len(stale_keys):
+            # resident keys a store mutation touched re-fetch as fresh —
+            # their device copy is void, everything else stays resident
+            # (the boundary ships the CHANGE, not the table)
+            sp = np.searchsorted(stale_keys, keys)
+            sp[sp >= len(stale_keys)] = 0
+            is_stale = (stale_keys[sp] == keys) & (pos >= 0)
+            n_stale = int(is_stale.sum())
+            if n_stale:
+                pos = np.where(is_stale, -1, pos).astype(pos.dtype)
+        # the delta-stage crash window: fresh/stale rows are about to
+        # leave the host store for the staging plane (kill-matrix
+        # covered — a kill here must resume to the full-rebuild state)
+        faultpoint.hit("feed_pass.delta_stage.pre")
         fresh_keys = keys[pos < 0]
+        if flags.spill_prefetch:
+            # async disk-tier readahead BEFORE the fetch: the kernel
+            # pages the spill rows in while the fetch assembles rows
+            prefetch = getattr(self.store, "prefetch_rows", None)
+            if prefetch is not None:
+                prefetch(fresh_keys)
         fresh_rows = (self.store.peek_rows(fresh_keys) if test_mode
                       else self.store.lookup_or_init(fresh_keys))
         n_fresh = len(fresh_keys)
@@ -259,10 +379,10 @@ class FeedPassManager:
                   n_keys=int(len(keys)),
                   h2d_bytes=int(transfer_bytes(cfg, n_fresh_pad)))
         return _Staging(keys=keys, pos_prev=pos, fresh_dev=fresh_dev,
-                        n_fresh=n_fresh,
+                        n_fresh=n_fresh, n_stale=n_stale,
                         h2d_bytes=transfer_bytes(cfg, n_fresh_pad),
-                        prev=prev, store_gen=gen, full_ws=None,
-                        timings=timing)
+                        prev=prev, store_gen=gen, marker=marker,
+                        full_ws=None, timings=timing)
 
     # -- pass lifecycle ----------------------------------------------------
 
@@ -277,19 +397,37 @@ class FeedPassManager:
         """
         t0 = time.perf_counter()
         keys = np.unique(np.asarray(keys).astype(np.uint64))
-        staged = self._take_staging(keys, test_mode)
-        prev = self._current if self._reuse_valid() else None
+        keys = self._filter_owned(keys)
+        # join + resolve ONCE: mutations only happen on this thread, so
+        # the stale set cannot change between here and the consume below
+        # (and a large provable mutation's log union is not free)
+        self.wait_feed_pass_done()
+        prev, stale = self._resolve_reuse()
+        staged = self._take_staging(keys, test_mode, prev)
         if prev is None and self._current is not None:
-            # store mutated under us (shrink/restore) — the external state
-            # wins; stale device rows must not leak back (pass-granularity
-            # recovery semantics)
+            # store mutated beyond what the stale log can prove (restore/
+            # replay reset, oversized event, or incremental feeds off) —
+            # the external state wins; stale device rows must not leak
+            # back (pass-granularity recovery semantics)
             self._current = None
             self._unsynced = None
+        if (prev is not None and stale is not None and stale.size
+                and self._unsynced is not None and self._unsynced.any()):
+            # rows the mutation touched: the STORE wins — void their
+            # unsynced marks before retirement/flush could ship a stale
+            # device copy over the mutated value
+            pos_stale = prev._tindex.lookup(stale)
+            live = pos_stale >= 0
+            if live.any():
+                self._unsynced[pos_stale[live] + 1] = False
         if staged is not None and staged.full_ws is not None:
             ws = staged.full_ws
-            self._account_begin(staged.h2d_bytes, 0, staged.n_fresh,
-                                0, t0, table=ws.table, ws=ws,
-                                split=staged.timings)
+            n_patch, patch_bytes = self._apply_patch(
+                ws, staged.patch_keys, None)
+            self._account_begin(staged.h2d_bytes + patch_bytes, 0,
+                                staged.n_fresh, 0, t0, table=ws.table,
+                                ws=ws, split=staged.timings,
+                                patched=n_patch)
             if not self._eager:
                 self._retain(ws)
             return ws
@@ -311,17 +449,63 @@ class FeedPassManager:
             return ws
         if staged is None:
             staged = self._stage(keys, prev, self.store.mutation_count,
-                                 test_mode=test_mode)
+                                 stale_keys=stale, test_mode=test_mode)
         d2h = 0
         if not test_mode:
             d2h = self._writeback_retiring(prev, keys)
         ws, carried = self._combine(staged, test_mode)
-        self._account_begin(staged.h2d_bytes, d2h, staged.n_fresh,
+        n_patch, patch_bytes = self._apply_patch(ws, staged.patch_keys,
+                                                 carried)
+        self._account_begin(staged.h2d_bytes + patch_bytes, d2h,
+                            staged.n_fresh,
                             len(keys) - staged.n_fresh, t0,
-                            table=ws.table, ws=ws, split=staged.timings)
+                            table=ws.table, ws=ws, split=staged.timings,
+                            patched=n_patch,
+                            stale=int(staged.n_stale or 0))
         if not test_mode:
             self._retain(ws, carried)
         return ws
+
+    def _apply_patch(self, ws: PassWorkingSet,
+                     patch_keys: np.ndarray | None,
+                     carried: np.ndarray | None) -> tuple[int, int]:
+        """Scatter the compact delta plane over a staged working set:
+        rows the store mutated AFTER the background staging fetched them
+        re-fetch from the live store and overwrite their device slots,
+        so the staged transfer survives the mutation instead of being
+        discarded. Returns (rows_patched, h2d_bytes)."""
+        if patch_keys is None or len(patch_keys) == 0:
+            return 0, 0
+        pos = ws._tindex.lookup(patch_keys)
+        live = pos >= 0
+        pk = patch_keys[live]
+        if len(pk) == 0:
+            return 0, 0
+        # the staged-patch arm of the delta-stage crash window
+        faultpoint.hit("feed_pass.delta_stage.pre")
+        rows = self.store.lookup_or_init(pk)
+        idx = (pos[live] + 1).astype(np.int32)
+        cfg = self.store.cfg
+        k = len(pk)
+        k_pad = bucket_size(k)
+        rows_p = np.empty((k_pad, cfg.row_width), np.float32)
+        rows_p[:k] = rows
+        rows_p[k:] = rows[k - 1]       # pads repeat the last real row...
+        idx_p = np.full(k_pad, idx[k - 1], np.int32)
+        idx_p[:k] = idx                # ...so duplicate writes are benign
+        repl = self._repl_sharding()
+        if cfg.storage != "f32":
+            rows_dev = quant.device_table(rows_p, cfg, repl)
+        elif repl is not None:
+            rows_dev = jax.device_put(rows_p, repl)
+        else:
+            rows_dev = jnp.asarray(rows_p)
+        ws.table = _patch_jit(self._tbl_sharding())(ws.table, rows_dev,
+                                                    idx_p)
+        if carried is not None:
+            carried[idx] = False       # store value is authoritative now
+        stat_add("feed_pass.patched_rows", k)
+        return k, transfer_bytes(cfg, k_pad)
 
     def _writeback_retiring(self, prev: PassWorkingSet,
                             new_keys: np.ndarray) -> int:
@@ -373,11 +557,23 @@ class FeedPassManager:
                 "sparse flush (store save/export/shrink/get_rows) while a "
                 "training pass is open — finish the pass first")
         if self.store.mutation_count != self._gen:
-            # the store was externally rewritten (restore/replay) since we
-            # retained — stale device rows must not overwrite it
-            self._unsynced[:] = False
-            return 0
-        from paddlebox_tpu.utils import faultpoint
+            stale = self._stale_since(self._marker)
+            if stale is None:
+                # the store was externally rewritten beyond the stale
+                # log (restore/replay) — stale device rows must not
+                # overwrite it
+                self._unsynced[:] = False
+                return 0
+            if stale.size:
+                # the mutation's rows lose their marks (the store wins
+                # for exactly those); every other unsynced device row is
+                # still the freshest copy and flushes below
+                pos = ws._tindex.lookup(stale)
+                live = pos >= 0
+                if live.any():
+                    self._unsynced[pos[live] + 1] = False
+            if not self._unsynced.any():
+                return 0
         faultpoint.hit("feed_pass.flush.pre")
         k = ws.num_keys
         row_ids = np.flatnonzero(self._unsynced[1:1 + k]) + 1
@@ -391,9 +587,11 @@ class FeedPassManager:
                   d2h_bytes=int(nbytes))
         return nbytes
 
-    def _take_staging(self, keys: np.ndarray,
-                      test_mode: bool) -> _Staging | None:
-        self.wait_feed_pass_done()
+    def _take_staging(self, keys: np.ndarray, test_mode: bool,
+                      prev: PassWorkingSet | None) -> _Staging | None:
+        """Consume the background staging if it matches `keys` against
+        the caller-resolved resident set (the caller joined the feed
+        thread and ran ``_resolve_reuse`` already)."""
         staged, self._staged = self._staged, None
         if staged is None:
             return None
@@ -402,12 +600,22 @@ class FeedPassManager:
             # keep it for the next train pass instead of consuming it
             self._staged = staged
             return None
-        if (staged.store_gen != self.store.mutation_count
-                or staged.prev is not (self._current
-                                       if self._reuse_valid() else None)
-                or len(staged.keys) != len(keys)
+        if (len(staged.keys) != len(keys)
                 or not np.array_equal(staged.keys, keys)):
             return None                   # preloaded keys don't match
+        if staged.prev is not prev:
+            # the resident set the staging diffed against is gone (a
+            # full staging pairs with prev=None the same way)
+            return None
+        if staged.store_gen != self.store.mutation_count:
+            # the store mutated while the staging was in flight: patch
+            # exactly the rows dirtied since staging (the compact delta
+            # plane) instead of discarding the staged transfer; a
+            # mutation the log cannot bound makes the staging unusable
+            patch = self._stale_since(staged.marker)
+            if patch is None:
+                return None
+            staged.patch_keys = patch
         return staged
 
     def _combine(self, staged: _Staging, test_mode: bool
@@ -488,6 +696,24 @@ class FeedPassManager:
         self._current = None
         self._unsynced = None
         self._gen = -1
+        self._marker = None
+
+    def set_ownership(self, ownership) -> None:
+        """Bind (or rebind — the elastic grow/shrink hook) the per-host
+        shard ownership. On a REBIND the pending device rows flush and
+        the resident working set drops, so the next ``begin_pass``
+        rebuilds exactly the newly-owned shards' key set — a replacement
+        host joining a re-formed world fetches its shards' rows and
+        nothing else."""
+        if ownership is self.ownership or ownership == self.ownership:
+            # equivalent partition (a re-formation that resolved to the
+            # same world shape): keep the resident set
+            self.ownership = ownership
+            return
+        self.wait_feed_pass_done()
+        if self._current is not None or self._staged is not None:
+            self.drop()
+        self.ownership = ownership
 
     def close(self) -> None:
         """Flush, release the device tier, and detach from the store's
@@ -506,12 +732,14 @@ class FeedPassManager:
                 carried: np.ndarray | None = None) -> None:
         self._current = ws
         self._gen = self.store.mutation_count
+        self._marker = self._marker_now()
         self._unsynced = (carried if carried is not None
                           else np.zeros_like(ws.touched))
 
     def _account_begin(self, h2d: int, d2h: int, fresh: int, reused: int,
                        t0: float, table=None, ws=None,
-                       split: dict | None = None) -> None:
+                       split: dict | None = None, patched: int = 0,
+                       stale: int = 0) -> None:
         if table is not None:
             # 4-byte D2H of one element forces every pending H2D/combine
             # on this buffer to land before the clock stops —
@@ -524,6 +752,8 @@ class FeedPassManager:
         self.last_d2h_bytes = d2h
         self.last_fresh_rows = fresh
         self.last_reused_rows = reused
+        self.last_patched_rows = patched
+        self.last_stale_rows = stale
         # boundary split (working-set build vs H2D vs spill fault-in) —
         # the flight-record extra the critical-path attributor reads;
         # mirrored as gauges so the stats_delta carries it too
@@ -532,8 +762,18 @@ class FeedPassManager:
             for k in ("build", "h2d", "spill_fault_in")}
         stat_add("feed_pass.h2d_bytes", h2d)
         stat_add("feed_pass.d2h_bytes", d2h)
+        # COUNTERS (not just gauges) so the per-pass flight-record
+        # stats_delta carries the fresh/reused balance — the doctor's
+        # boundary-wall rule reads it to tell reuse-off from reuse-on
+        stat_add("feed_pass.fresh_rows", fresh)
+        if reused:
+            stat_add("feed_pass.reused_rows", reused)
+        if stale:
+            stat_add("feed_pass.stale_rows", stale)
         stat_set("feed_pass.last_fresh_rows", fresh)
         stat_set("feed_pass.last_reused_rows", reused)
+        stat_set("feed_pass.last_patched_rows", patched)
+        stat_set("feed_pass.last_stale_rows", stale)
         stat_set("feed_pass.boundary_seconds",
                  round(self.last_boundary_seconds, 6))
         stat_set("feed_pass.boundary_build_s",

@@ -38,6 +38,7 @@ small next to the index.
 
 from __future__ import annotations
 
+import mmap
 import os
 import tempfile
 import time
@@ -111,11 +112,17 @@ class SpillEmbeddingStore(HostEmbeddingStore):
         # delta per boundary for the flight record's boundary_seconds
         # split (working-set build vs H2D vs spill fault-in)
         self.fault_in_seconds = 0.0
+        # cumulative rows handed to the kernel readahead (prefetch_rows)
+        # and the wall spent issuing the advise calls — the overlap side
+        # of the fault-in clock above
+        self.prefetched_rows = 0
+        self.prefetch_seconds = 0.0
         # spill.cache_* counter deltas batched here and flushed once per
         # pass boundary (tier_end_pass) — the hub never sits on the
         # per-read hot path
         self._stat_hits = 0
         self._stat_misses = 0
+        self._stat_prefetched = 0
         self.tier = TierManager(max(initial_capacity, 1),
                                 policy=tier_policy)
         super().__init__(cfg, initial_capacity)
@@ -180,6 +187,70 @@ class SpillEmbeddingStore(HostEmbeddingStore):
         self._stat_misses += nm
         return out
 
+    def prefetch_rows(self, keys: np.ndarray) -> int:
+        """madvise(WILLNEED)-style async readahead of `keys`' spill-file
+        rows that are NOT already in the RAM cache: the kernel starts
+        paging the ranges in immediately and returns, so the fault-in of
+        the following working-set build overlaps the build's host work
+        instead of serializing inside it (the LoadSSD2Mem pairing — the
+        reference pulls a pass's SSD range up BEFORE the build reads it,
+        box_wrapper.h:487-494). Never inserts; unknown keys are skipped.
+        Returns the number of rows advised (0 where the platform has no
+        madvise — the build then faults in synchronously as before)."""
+        keys = np.asarray(keys).astype(np.uint64)
+        if len(keys) == 0:
+            return 0
+        with self._lock:
+            # slot geometry + tags read under the lock: a concurrent
+            # resize_cache (the autotune) swaps both together
+            idx = self._index.lookup(keys)
+            idx = idx[idx >= 0].astype(np.int64)
+            if len(idx) == 0:
+                return 0
+            slot = idx % self._cache_slots
+            idx = np.unique(idx[self._ctags[slot] != idx])  # misses only
+        if len(idx) == 0:
+            return 0
+        mm = getattr(self._rows, "_mmap", None)
+        adv = getattr(mmap, "MADV_WILLNEED", None)
+        if mm is None or adv is None or not hasattr(mm, "madvise"):
+            return 0
+        row_b = self.cfg.row_width * 4
+        page = mmap.ALLOCATIONGRANULARITY
+        t0 = time.perf_counter()
+        n = 0
+        # coalesce contiguous row runs into one page-aligned advise each
+        for run in np.split(idx, np.flatnonzero(np.diff(idx) > 1) + 1):
+            start = int(run[0]) * row_b
+            length = (int(run[-1]) - int(run[0]) + 1) * row_b
+            aligned = (start // page) * page
+            try:
+                mm.madvise(adv, aligned, start + length - aligned)
+            except (OSError, ValueError):
+                break                     # advisory only — never fatal
+            n += len(run)
+        self.prefetch_seconds += time.perf_counter() - t0
+        self.prefetched_rows += n
+        self._stat_prefetched += n
+        return n
+
+    def resize_cache(self, cache_rows: int) -> None:
+        """Re-budget the RAM hot tier (the spill_cache_rows autotune).
+        Contents drop — the spill file is authoritative, rows re-fault
+        and re-contest admission off their persisted tier signals — so
+        a resize is a budget change, never a math change."""
+        n = max(1, int(cache_rows))
+        if n == self._cache_slots:
+            return
+        # under the store lock: a background feed staging may be inside
+        # lookup_or_init/_read_rows (which hold it) — the slot count and
+        # the tag/data arrays must swap atomically against those reads
+        with self._lock:
+            self._cache_slots = n
+            self._ctags = np.full(n, -1, dtype=np.int64)
+            self._cdata = np.zeros((n, self.cfg.row_width),
+                                   dtype=np.float32)
+
     def _write_rows(self, idx: np.ndarray, rows: np.ndarray) -> None:
         idx = np.asarray(idx, dtype=np.int64)
         rows = np.asarray(rows, dtype=np.float32)
@@ -238,14 +309,23 @@ class SpillEmbeddingStore(HostEmbeddingStore):
         hot = int((self._ctags >= 0).sum())
         gauge_set("tiering.hot_rows", hot)
         gauge_set("tiering.spill_bytes", self.spill_file_bytes)
+        # pass-window hit/miss view handed back for the cache-budget
+        # autotune (the same deltas the counters below flush — the
+        # caller need not re-diff the registry)
+        stats["pass_hits"] = int(self._stat_hits)
+        stats["pass_misses"] = int(self._stat_misses)
         if self._stat_hits:
             counter_add("spill.cache_hits", self._stat_hits)
             self._stat_hits = 0
         if self._stat_misses:
             counter_add("spill.cache_misses", self._stat_misses)
             self._stat_misses = 0
+        if self._stat_prefetched:
+            counter_add("spill.prefetched_rows", self._stat_prefetched)
+            self._stat_prefetched = 0
         stats["hot_rows"] = hot
         stats["spill_bytes"] = int(self.spill_file_bytes)
+        stats["cache_rows"] = int(self._cache_slots)
         return stats
 
     # ---- persistence: stream from the memmap ---------------------------

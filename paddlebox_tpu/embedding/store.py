@@ -30,6 +30,7 @@ mid-chain delta fails loudly instead of silently resurrecting stale rows.
 
 from __future__ import annotations
 
+import collections
 import dataclasses
 import json
 import os
@@ -46,6 +47,18 @@ from paddlebox_tpu.utils.checkpoint import CheckpointCorruptError
 
 def _delta_name(seq: int) -> str:
     return f"delta-{seq:05d}.npz"
+
+
+# Bounded stale-key log (the incremental-feed contract): each mutation
+# event records WHICH keys' stored bytes it changed/removed, so a
+# device-resident consumer (FeedPassManager) can re-fetch exactly those
+# rows instead of discarding its whole working set. Bounds keep the log
+# O(1) against table size: more events than the ring holds, or a single
+# event touching more keys than the cap, degrades to "unknown" (None)
+# — the consumer then falls back to the pre-incremental full rebuild.
+_STALE_LOG_EVENTS = 64
+_STALE_LOG_MAX_KEYS = 1 << 21
+_EMPTY_KEYS = np.zeros(0, dtype=np.uint64)
 
 
 class HostEmbeddingStore:
@@ -74,6 +87,12 @@ class HostEmbeddingStore:
         # (shrink/remove/delta replay) — consumers holding device-resident
         # copies of rows (FeedPassManager) use it to invalidate reuse
         self._mutations = 0
+        # (seq, affected-keys | None) per mutation event — None means the
+        # event touched an unknowable set (restore reset / oversized).
+        # EVERY _mutations bump must append exactly one entry (the
+        # stale_keys_since completeness check counts on it).
+        self._stale_log: collections.deque = collections.deque(
+            maxlen=_STALE_LOG_EVENTS)
 
         # called before any operation that READS row values for persistence
         # or hygiene (save/export/shrink): lets a device-resident hot tier
@@ -84,6 +103,48 @@ class HostEmbeddingStore:
     @property
     def mutation_count(self) -> int:
         return self._mutations
+
+    # ---- stale-key log (the incremental-feed delta contract) ----
+
+    def _log_mutation(self, keys: np.ndarray | None) -> None:
+        """Record one mutation event's affected keys (call under the
+        lock, right after the ``_mutations`` bump). ``None`` = the event
+        invalidated an unknowable set (restore reset)."""
+        if keys is not None:
+            keys = np.unique(np.asarray(keys).astype(np.uint64))
+            if len(keys) > _STALE_LOG_MAX_KEYS:
+                keys = None
+        self._stale_log.append((self._mutations, keys))
+
+    def mutation_marker(self):
+        """Opaque marker for :meth:`stale_keys_since` (pairs with
+        ``mutation_count`` the way a cursor pairs with a length)."""
+        return int(self._mutations)
+
+    def stale_keys_since(self, marker) -> np.ndarray | None:
+        """Keys whose STORED bytes changed or vanished since ``marker``
+        (sorted unique uint64; empty = nothing mutated). None = the log
+        cannot prove completeness (ring rolled over, an event's key set
+        was unknowable, or the union outgrew the cap) — the caller must
+        fall back to a full rebuild."""
+        marker = int(marker)
+        with self._lock:
+            if self._mutations == marker:
+                return _EMPTY_KEYS
+            events = [e for e in self._stale_log if e[0] > marker]
+            if len(events) != self._mutations - marker:
+                return None               # ring rolled past the marker
+            parts, total = [], 0
+            for _, k in events:
+                if k is None:
+                    return None
+                parts.append(k)
+                total += len(k)
+                if total > _STALE_LOG_MAX_KEYS:
+                    return None
+            if not parts:
+                return _EMPTY_KEYS
+        return np.unique(np.concatenate(parts))
 
     @property
     def save_seq(self) -> int:
@@ -266,6 +327,7 @@ class HostEmbeddingStore:
                 self._rows_compacted()
             keep = self._rows[:self._n, 0] >= min_show
             evicted = int((~keep).sum())
+            gone = _EMPTY_KEYS
             if evicted:
                 gone = self._keys[:self._n][~keep]
                 kept_keys = self._keys[:self._n][keep]
@@ -281,6 +343,11 @@ class HostEmbeddingStore:
                 # resurrect them
                 self._tombstones.update(int(k) for k in gone.tolist())
                 self._rows_compacted()   # row ids changed
+            # decay != 1.0 rewrote every surviving row's show counter —
+            # the whole key space is stale; pure eviction touches only
+            # the evicted keys (the incremental-feed win: shrink-without-
+            # decay between passes no longer forces a full rebuild)
+            self._log_mutation(gone if decay == 1.0 else None)
             return evicted
 
     # ---- checkpoint (SaveBase/SaveDelta/Load, box_wrapper.cc:1387-1420) ----
@@ -518,6 +585,9 @@ class HostEmbeddingStore:
             self._verify_chain(path, seq)
         with self._lock:
             self._mutations += 1
+            # a restore resets the whole key space — no delta can
+            # describe it (and resume must discard device rows anyway)
+            self._log_mutation(None)
             self._index = KeyIndex(max(1024, len(self._keys)))
             self._n = 0
             self._dirty[:] = False
@@ -559,6 +629,7 @@ class HostEmbeddingStore:
         with self._lock:
             self._mutations += 1
             present = self._index.lookup(keys) >= 0
+            self._log_mutation(keys[present])
             if not present.any():
                 return
             keep = ~np.isin(self._keys[:self._n], keys[present])
@@ -577,6 +648,7 @@ class HostEmbeddingStore:
         with self._lock:
             self._mutations += 1
             keys = np.asarray(keys).astype(np.uint64)
+            self._log_mutation(keys)
             idx, added = self._index.lookup_or_insert(keys)
             if added:
                 self._append_new_keys(idx, keys, added)
@@ -694,6 +766,37 @@ class ShardedEmbeddingStore:
     @property
     def mutation_count(self) -> int:
         return sum(s.mutation_count for s in self._shards)
+
+    def mutation_marker(self):
+        """Per-shard marker tuple — a summed count cannot be decomposed
+        back into shard cursors, so the marker carries each one."""
+        return tuple(s.mutation_marker() for s in self._shards)
+
+    def stale_keys_since(self, marker) -> np.ndarray | None:
+        """Union of every shard's stale keys since its marker; None if
+        any shard's log cannot prove completeness (full rebuild)."""
+        if not isinstance(marker, tuple) or len(marker) != self.n_shards:
+            return None
+        parts = []
+        for sub, m in zip(self._shards, marker):
+            k = sub.stale_keys_since(m)
+            if k is None:
+                return None
+            if len(k):
+                parts.append(k)
+        if not parts:
+            return _EMPTY_KEYS
+        return np.unique(np.concatenate(parts))
+
+    def prefetch_rows(self, keys: np.ndarray) -> int:
+        """Fan the madvise(WILLNEED)-style readahead out to spill-backed
+        shards (no-op rows for shards without a disk tier)."""
+        n = 0
+        for s, pos, sk in self._fan_out(keys):
+            fn = getattr(self._shards[s], "prefetch_rows", None)
+            if fn is not None:
+                n += fn(sk)
+        return n
 
     @property
     def save_seq(self) -> int:

@@ -50,6 +50,16 @@ from paddlebox_tpu.embedding.store import (HostEmbeddingStore,
 TIER_MODES = ("off", "spill")
 POLICIES = ("freq", "direct")
 
+# spill_cache_rows autotune bounds (flags.spill_cache_autotune): the
+# re-budget never leaves this window, whatever the telemetry says
+CACHE_MIN_ROWS = 256
+CACHE_MAX_ROWS = 1 << 22
+# thrash = the pass missed more than it hit AND eviction churn covered
+# at least half the slots; idle = nearly-all-hits with a mostly-empty
+# cache — the two signals the flight record already carries
+_GROW_BELOW_HIT_RATE = 0.5
+_SHRINK_ABOVE_HIT_RATE = 0.9
+
 
 class TierManager:
     """Row-placement policy for one spill store's RAM hot tier.
@@ -253,19 +263,61 @@ def _spill_subs(store) -> list:
     return [s for s in subs if hasattr(s, "tier_end_pass")]
 
 
+def autotune_cache_rows(sub, stats: dict) -> int | None:
+    """One spill store's cache-budget decision off its pass telemetry
+    (``tier_end_pass``'s returned hit/miss/eviction window): a thrashing
+    cache (hit rate < 0.5, eviction churn >= half the slots) doubles; a
+    mostly-idle one (hit rate > 0.9, occupancy < a quarter of the slots)
+    halves. Bounded by [CACHE_MIN_ROWS, CACHE_MAX_ROWS]; returns the new
+    slot count when a resize happened, None otherwise."""
+    seen = stats.get("pass_hits", 0) + stats.get("pass_misses", 0)
+    if not seen:
+        return None
+    hit_rate = stats.get("pass_hits", 0) / seen
+    slots = int(sub._cache_slots)
+    if (hit_rate < _GROW_BELOW_HIT_RATE
+            and stats.get("evicted", 0) >= slots // 2):
+        target = min(max(slots * 2, CACHE_MIN_ROWS), CACHE_MAX_ROWS)
+    elif (hit_rate > _SHRINK_ABOVE_HIT_RATE
+            and stats.get("hot_rows", 0) < slots // 4):
+        target = max(slots // 2, CACHE_MIN_ROWS)
+    else:
+        return None
+    if target == slots:
+        return None
+    sub.resize_cache(target)
+    return target
+
+
 def end_pass_rebalance(store) -> dict | None:
     """Re-evaluate RAM-tier placement for every spill-backed (sub-)store
     at a pass boundary: decay + re-score off the pass's observed per-row
     traffic, demote cold cached rows, and flush the tiering counters so
-    they land in THIS pass's flight-record ``stats_delta``. No-op (None)
-    for untiered stores."""
+    they land in THIS pass's flight-record ``stats_delta``. Under
+    ``flags.spill_cache_autotune`` the same telemetry re-budgets each
+    store's RAM cache (``autotune_cache_rows``) and the chosen total
+    lands in the flight-record extras (``spill_cache_rows``) + the
+    ``tiering.cache_rows`` gauge. No-op (None) for untiered stores."""
     subs = _spill_subs(store)
     if not subs:
         return None
+    from paddlebox_tpu.monitor import gauge_set, hub
     agg: dict[str, int] = {}
+    resized = 0
     for sub in subs:
-        for k, v in sub.tier_end_pass().items():
+        stats = sub.tier_end_pass()
+        if config_flags.spill_cache_autotune:
+            if autotune_cache_rows(sub, stats) is not None:
+                resized += 1
+            stats["cache_rows"] = int(sub._cache_slots)
+        for k, v in stats.items():
             agg[k] = agg.get(k, 0) + int(v)
+    if config_flags.spill_cache_autotune:
+        agg["cache_resized"] = resized
+        gauge_set("tiering.cache_rows", agg["cache_rows"])
+        # the chosen budget rides THIS pass's flight record (the extras
+        # merge runs at hub.end_pass, after every boundary hook)
+        hub().record_train(spill_cache_rows=int(agg["cache_rows"]))
     return agg
 
 

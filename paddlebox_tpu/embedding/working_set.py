@@ -346,6 +346,14 @@ class PassWorkingSet:
         cfg = store.cfg
         t0 = _time.perf_counter()
         keys = np.unique(np.asarray(keys).astype(np.uint64))
+        if flags.spill_prefetch:
+            # madvise(WILLNEED)-style readahead of the disk-tier rows
+            # about to fault in (spill-backed stores only): the kernel
+            # pages them in while the fetch below assembles the table,
+            # instead of serializing the fault-in inside it
+            prefetch = getattr(store, "prefetch_rows", None)
+            if prefetch is not None:
+                prefetch(keys)
         rows = (store.peek_rows(keys) if test_mode
                 else store.lookup_or_init(keys))
         n_shards = mesh_lib.num_shards(mesh) if mesh is not None else 1

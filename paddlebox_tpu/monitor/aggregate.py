@@ -227,6 +227,24 @@ def _pass_view(pass_id: int, by_rank: "dict[int, dict]",
                     .get("boundary_seconds"))
     if bnd:
         view["boundary_seconds"] = _dist(bnd)
+    # per-component boundary skew (build / h2d / spill_fault_in): the
+    # overlap-aware boundary-wall rule names the slowest-BUILDING host
+    # off boundary_split.build's max_rank, not just the overall
+    # straggler — per-host ownership makes build the component that
+    # should divide by world size, so its skew is the diagnosis
+    bsplit: dict = {}
+    comps = sorted({c for fr in by_rank.values()
+                    for c in ((fr.get("extra") or {})
+                              .get("boundary_split") or {})})
+    for comp in comps:
+        vals = _per_rank(by_rank,
+                         lambda fr: ((fr.get("extra") or {})
+                                     .get("boundary_split") or {})
+                         .get(comp))
+        if vals:
+            bsplit[comp] = _dist(vals)
+    if bsplit:
+        view["boundary_split"] = bsplit
     # exchange traffic imbalance across shards (per-pass counter deltas)
     exch: dict = {}
     for key in ("exchange.tokens", "exchange.unique_lanes",

@@ -117,6 +117,25 @@ class BoundaryWallRule(Rule):
                 "build + H2D")
     SHARE = 0.25
 
+    # suggestion arm per dominant residual component — the overlap-aware
+    # attribution names the concrete knob, not a menu
+    _COMPONENT_FIX = {
+        "build": ("host-side build dominates: bind per-host shard "
+                  "ownership (Trainer.set_shard_ownership / distributed."
+                  "ownership.ShardOwnership) so each host fetches only "
+                  "its shards' rows — build divides by world size"),
+        "h2d": ("H2D dominates: resident-row reuse is the lever — keep "
+                "flags.incremental_feed=True so store mutations "
+                "(shrink/replay) re-ship only the touched rows instead "
+                "of the full table"),
+        "spill_fault_in": ("disk fault-in dominates: raise "
+                           "flags.spill_cache_rows (or turn on "
+                           "flags.spill_cache_autotune) and keep "
+                           "flags.spill_prefetch=True so the stager "
+                           "thread's madvise(WILLNEED) readahead "
+                           "overlaps the build"),
+    }
+
     def evaluate(self, ctx):
         passes = [p for p in ctx.attribution.get("passes", [])
                   if p["stages"].get("boundary", 0.0) > 0.0]
@@ -137,25 +156,56 @@ class BoundaryWallRule(Rule):
             "overlap_headroom_seconds":
                 summary.get("overlap_headroom_seconds"),
         }
+        residual = None
         if "boundary_split" in worst:
             ev["boundary_split"] = worst["boundary_split"]
+            split = worst["boundary_split"]
+            if split:
+                residual = max(split, key=lambda k: split[k])
+                ev["residual_component"] = residual
+        # reuse balance from the per-pass counter deltas: fresh rows
+        # flowing with NO reused rows means every boundary re-ships the
+        # working set — the concrete incremental-feed suggestion
+        fresh = sum(v for _, v in ctx.pass_deltas("feed_pass.fresh_rows"))
+        reused = sum(v for _, v in
+                     ctx.pass_deltas("feed_pass.reused_rows"))
+        reuse_off = fresh > 0 and reused == 0
+        ev["fresh_rows"] = int(fresh)
+        ev["reused_rows"] = int(reused)
         if ctx.world:
             for pv in ctx.world.get("passes", []):
-                if pv.get("pass_id") == worst["pass_id"] \
-                        and "straggler" in pv:
+                if pv.get("pass_id") != worst["pass_id"]:
+                    continue
+                if "straggler" in pv:
                     ev["straggler_rank"] = pv["straggler"]
+                # the slowest-BUILDING host, per component skew — the
+                # rank whose host fetch sets the world's boundary wall
+                wb = (pv.get("boundary_split") or {}).get("build")
+                if wb:
+                    ev["slowest_build_rank"] = wb["max_rank"]
+                    ev["build_skew"] = wb.get("skew")
+        fix = ["overlap the next pass's build with this pass's tail: "
+               "train_pass(preload_keys=next_pass_keys)"]
+        if residual in self._COMPONENT_FIX:
+            fix.append(self._COMPONENT_FIX[residual])
+        if reuse_off:
+            fix.append(
+                "resident reuse is OFF (fresh rows every pass, zero "
+                "reused): set flags.incremental_feed=True so mutations "
+                "ship deltas instead of invalidating the working set, "
+                "and check for per-pass store restores/replays that "
+                "reset it")
+        if "slowest_build_rank" in ev:
+            fix.append(f"rank {ev['slowest_build_rank']} builds "
+                       "slowest — check its shard ownership balance "
+                       "and spill tier")
         return "fired", Finding(
             self.id, "warn",
             f"pass {worst['pass_id']}: boundary work is "
             f"{worst['boundary_share']:.0%} of the pass wall "
             f"({worst['stages']['boundary']:.2f}s of "
             f"{worst['wall_seconds']:.2f}s)", ev,
-            "overlap the next pass's build with this pass's tail: "
-            "train_pass(preload_keys=next_pass_keys); the boundary_split "
-            "says whether build (host fetch / spill fault-in) or H2D is "
-            "the heavy half — spill fault-in responds to "
-            "flags.spill_cache_rows, H2D to resident-row reuse "
-            "(ROADMAP: incremental feeds + per-host shard ownership)")
+            "; ".join(fix))
 
 
 class ExchangeOverflowRule(Rule):

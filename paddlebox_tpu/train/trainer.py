@@ -1858,6 +1858,14 @@ class Trainer:
         """Join the background feed pass (BoxHelper::WaitFeedPassDone)."""
         self.feed_mgr.wait_feed_pass_done()
 
+    def set_shard_ownership(self, ownership) -> None:
+        """Bind per-host shard ownership (distributed/ownership.
+        ShardOwnership): every feed builds only the keys hash-
+        partitioned onto THIS host's shards of the sharded store, so
+        working-set build cost divides by world size. Re-bound
+        automatically on elastic re-formation (``recover_world``)."""
+        self.feed_mgr.set_ownership(ownership)
+
     def _dispatch_pending_apply(self, table):
         """Dispatch the pending deferred table apply (if any) against
         `table` and return the applied table. The caller owns sequencing:
@@ -2139,6 +2147,15 @@ class Trainer:
                               survivors=e.survivors, floor=e.floor)
                 return None, None
             self.peer_check = new_world.check
+            own = self.feed_mgr.ownership
+            if own is not None:
+                # elastic resize of the per-host build partition: the
+                # re-formed world re-deals the store shards, and this
+                # host's next begin_pass rebuilds exactly its (new)
+                # shards' working set — the replacement-host /
+                # degraded-world grow-and-shrink hook
+                self.feed_mgr.set_ownership(
+                    own.with_world(new_world.world, new_world.rank))
             if box is not None:
                 box.attach_collectives(new_world.collectives,
                                        heartbeat=new_world.heartbeat)

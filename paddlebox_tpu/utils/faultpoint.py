@@ -49,6 +49,13 @@ POINTS: tuple[str, ...] = (
     # D2H into the host store (the materialization that precedes every
     # save) — dying here must leave the previous snapshot untouched.
     "feed_pass.flush.pre",
+    # embedding/feed_pass._stage/_apply_patch: the incremental delta
+    # feed is about to fetch fresh/stale rows from the host store (or
+    # patch a background staging with rows mutated after it) — the
+    # boundary work of pass N+1. A kill mid-delta-stage must resume to
+    # the exact state a full rebuild would produce: nothing is applied
+    # yet, so the previous pass's snapshot is the recovery point.
+    "feed_pass.delta_stage.pre",
     # train/trainer._dispatch_pending_apply: a deferred sparse-push apply
     # (flags.push_overlap) is about to dispatch mid-pass.
     "trainer.push_apply.pre",

@@ -36,6 +36,10 @@ POINT_AFTER = {
     "store.save_delta.pre_replace": 0,  # pass-2 delta (pass 1 is a base)
     "store.save_delta.pre_manifest": 0,
     "feed_pass.flush.pre": 1,           # pass-2 save's D2H flush
+    # ISSUE 14: the incremental delta feed's fetch window fires at every
+    # reuse boundary (pass >= 2's begin_pass) — AFTER=1 kills the
+    # pass-3 boundary, after the pass-2 snapshot committed
+    "feed_pass.delta_stage.pre": 1,
     "trainer.push_apply.pre": 6,        # mid pass-2 deferred apply
     "pass_ckpt.pre_manifest": 1,        # pass-2 snapshot uncommitted
     "pass_ckpt.post_manifest": 1,       # pass-2 snapshot committed

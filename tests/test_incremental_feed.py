@@ -1,0 +1,464 @@
+"""Incremental delta feeds, per-host shard ownership, and overlapped
+spill staging (ISSUE 14).
+
+The acceptance bar: every incremental path — stale-resident re-fetch
+after a shrink/replay, the staged-feed patch plane, ownership-filtered
+builds — must land BIT-IDENTICAL state to the full-rebuild feed on the
+same key/mutation stream, across all four row classes (fresh / dirty /
+evicted / reused), including eval peeks and flushes at pass/eval/save
+boundaries.
+"""
+
+import mmap
+import os
+import tempfile
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from paddlebox_tpu.config import flags
+from paddlebox_tpu.distributed.ownership import ShardOwnership
+from paddlebox_tpu.embedding import (EmbeddingConfig, HostEmbeddingStore,
+                                     ShardedEmbeddingStore)
+from paddlebox_tpu.embedding.feed_pass import FeedPassManager
+from paddlebox_tpu.embedding.spill_store import SpillEmbeddingStore
+from paddlebox_tpu.embedding.tiering import end_pass_rebalance
+from paddlebox_tpu.utils import faultpoint
+
+
+def cfg_small(**kw):
+    kw.setdefault("dim", 4)
+    kw.setdefault("optimizer", "adagrad")
+    kw.setdefault("learning_rate", 0.1)
+    return EmbeddingConfig(**kw)
+
+
+def _keys(lo, hi):
+    return np.sort(np.arange(lo, hi, dtype=np.uint64)
+                   * np.uint64(2654435761) + 1)
+
+
+@pytest.fixture(autouse=True)
+def _restore_flags():
+    inc, pre, auto = (flags.incremental_feed, flags.spill_prefetch,
+                      flags.spill_cache_autotune)
+    yield
+    flags.incremental_feed = inc
+    flags.spill_prefetch = pre
+    flags.spill_cache_autotune = auto
+    faultpoint.disarm()
+
+
+# ---------------------------------------------------------------------------
+# store-side stale-key log
+# ---------------------------------------------------------------------------
+
+def test_stale_log_pure_eviction_shrink():
+    c = cfg_small()
+    store = HostEmbeddingStore(c)
+    keys = _keys(0, 100)
+    rows = store.lookup_or_init(keys)
+    rows[:50, 0] = 5.0                       # half stay warm
+    store.write_back(keys, rows)
+    m = store.mutation_marker()
+    assert np.array_equal(store.stale_keys_since(m),
+                          np.zeros(0, np.uint64))
+    store.shrink(min_show=1.0, decay=1.0)    # evicts the cold half
+    stale = store.stale_keys_since(m)
+    assert stale is not None
+    assert set(stale.tolist()) == set(keys[50:].tolist())
+
+
+def test_stale_log_decay_shrink_is_unknowable():
+    store = HostEmbeddingStore(cfg_small())
+    keys = _keys(0, 10)
+    rows = store.lookup_or_init(keys)
+    rows[:, 0] = 5.0
+    store.write_back(keys, rows)
+    m = store.mutation_marker()
+    store.shrink(min_show=1.0, decay=0.5)    # decays EVERY row
+    assert store.stale_keys_since(m) is None
+
+
+def test_stale_log_ingest_and_remove_and_restore():
+    c = cfg_small()
+    store = HostEmbeddingStore(c)
+    store.lookup_or_init(_keys(0, 50))
+    m = store.mutation_marker()
+    # a foreign delta replay names its keys
+    donor = HostEmbeddingStore(c)
+    dk = _keys(10, 20)
+    dr = donor.lookup_or_init(dk)
+    dr[:, 2] = 7.0
+    donor.write_back(dk, dr)
+    with tempfile.TemporaryDirectory() as d:
+        f = donor.save_delta(os.path.join(d, "delta"))
+        store.apply_delta_file(f)
+    stale = store.stale_keys_since(m)
+    assert stale is not None and set(stale.tolist()) == set(dk.tolist())
+    # a restore resets the space — unknowable from any older marker
+    with tempfile.TemporaryDirectory() as d:
+        donor.save_base(os.path.join(d, "base"))
+        store.restore(os.path.join(d, "base"))
+    assert store.stale_keys_since(m) is None
+
+
+def test_stale_log_ring_rollover_degrades_to_unknown():
+    from paddlebox_tpu.embedding import store as store_mod
+    store = HostEmbeddingStore(cfg_small())
+    store.lookup_or_init(_keys(0, 100))
+    m = store.mutation_marker()
+    donor = HostEmbeddingStore(cfg_small())
+    dk = _keys(0, 1)
+    dr = donor.lookup_or_init(dk)
+    donor.write_back(dk, dr)
+    with tempfile.TemporaryDirectory() as d:
+        f = donor.save_delta(os.path.join(d, "delta"))
+        for _ in range(store_mod._STALE_LOG_EVENTS + 1):
+            store.apply_delta_file(f)
+        assert store.stale_keys_since(m) is None
+        # but a marker INSIDE the retained window still resolves
+        m2 = store.mutation_marker()
+        store.apply_delta_file(f)
+        assert store.stale_keys_since(m2) is not None
+
+
+def test_sharded_stale_log_union():
+    c = cfg_small()
+    ss = ShardedEmbeddingStore(c, 4)
+    keys = _keys(0, 200)
+    rows = ss.lookup_or_init(keys)
+    rows[:100, 0] = 5.0
+    ss.write_back(keys, rows)
+    m = ss.mutation_marker()
+    assert isinstance(m, tuple) and len(m) == 4
+    ss.shrink(min_show=1.0, decay=1.0)
+    stale = ss.stale_keys_since(m)
+    assert stale is not None
+    assert set(stale.tolist()) == set(keys[100:].tolist())
+    assert ss.stale_keys_since((0,) * 3) is None     # foreign marker
+
+
+# ---------------------------------------------------------------------------
+# bit-parity: incremental vs full-rebuild feeds on one stream
+# ---------------------------------------------------------------------------
+
+def _scenario(incremental: bool, store_factory=None, replay: bool = True):
+    """One mutation-heavy stream exercising every row class: reused
+    (stay resident), dirty (trained on device), evicted (shrink),
+    stale (foreign delta replay — host stores only), fresh (new keys +
+    re-added evicted), with an eval peek and flushes at pass/eval/save
+    boundaries. Returns every comparable plane for bitwise assertion."""
+    flags.incremental_feed = incremental
+    c = cfg_small()
+    store = (store_factory or HostEmbeddingStore)(c)
+    mgr = FeedPassManager(store)
+    k1 = _keys(0, 400)
+    ws1 = mgr.begin_pass(k1)
+    idx = ws1.translate(k1)
+    t = np.asarray(ws1.table).copy()
+    t[idx, 0] = 3.0                          # all warm...
+    t[idx[:80], 0] = 0.0                     # ...except an evictable tail
+    t[idx, 2] += 1.0                         # trained w (dirty rows)
+    mgr.end_pass(ws1, jnp.asarray(t))
+    # pure-eviction hygiene between passes (flushes the device tier
+    # first via the store's flush hooks, then mutates)
+    evicted = store.shrink(min_show=1.0, decay=1.0)
+    assert evicted == 80
+    # foreign delta replay dirties a handful of RESIDENT keys (the
+    # stale class: their device copy is void, the store wins)
+    stale_keys = k1[100:110]
+    if replay:
+        donor = HostEmbeddingStore(c)
+        dr = donor.lookup_or_init(stale_keys)
+        dr[:, 2] = 42.0
+        donor.write_back(stale_keys, dr)
+        with tempfile.TemporaryDirectory() as d:
+            store.apply_delta_file(
+                donor.save_delta(os.path.join(d, "dd")))
+    # pass 2: drop 100 resident keys, add fresh ones, re-add 10 evicted
+    k2 = np.unique(np.concatenate([k1[180:], _keys(5000, 5100),
+                                   k1[:10]]))
+    # eval peek BETWEEN the mutation and the next train pass must see
+    # store-authoritative bytes without inserting or flushing
+    ev = mgr.begin_pass(k2, test_mode=True)
+    ev_idx = ev.translate(stale_keys)
+    eval_rows = np.asarray(ev.table)[ev_idx].copy()
+    ws2 = mgr.begin_pass(k2)
+    table2 = np.asarray(ws2.table).copy()
+    mgr.end_pass(ws2, ws2.table)
+    mgr.flush()                              # save-boundary flush
+    with tempfile.TemporaryDirectory() as d:
+        store.save_delta(os.path.join(d, "save"))
+    rows = store.get_rows(np.unique(np.concatenate([k1[80:], k2])))
+    mgr.close()
+    return {"eval_rows": eval_rows, "table2": table2, "rows": rows,
+            "fresh": mgr.last_fresh_rows, "reused": mgr.last_reused_rows}
+
+
+def test_incremental_bit_parity_with_full_rebuild():
+    a = _scenario(True)
+    b = _scenario(False)
+    np.testing.assert_array_equal(a["eval_rows"], b["eval_rows"])
+    np.testing.assert_array_equal(a["table2"], b["table2"])
+    np.testing.assert_array_equal(a["rows"], b["rows"])
+    # and the incremental run actually reused resident rows across the
+    # mutation while the full rebuild re-fetched everything
+    assert a["reused"] > 0
+    assert b["reused"] == 0
+    assert a["fresh"] < b["fresh"]
+
+
+def test_incremental_bit_parity_sharded_spill():
+    def factory(c):
+        from paddlebox_tpu.embedding.tiering import shard_store_factory
+        td = tempfile.mkdtemp(prefix="pbtpu_incfeed_")
+        return ShardedEmbeddingStore(
+            c, 2, store_factory=shard_store_factory(
+                tiering="spill", cache_rows=64, spill_dir=td))
+    a = _scenario(True, store_factory=factory, replay=False)
+    b = _scenario(False, store_factory=factory, replay=False)
+    np.testing.assert_array_equal(a["table2"], b["table2"])
+    np.testing.assert_array_equal(a["rows"], b["rows"])
+    assert a["reused"] > 0
+
+
+def test_staged_feed_survives_mutation_via_patch():
+    """begin_feed_pass stages pass 2, THEN the store mutates: the staged
+    transfer must be patched with the mutated rows (compact delta
+    plane), not discarded — and land bit-identical to a full rebuild."""
+    flags.incremental_feed = True
+    c = cfg_small()
+    store = HostEmbeddingStore(c)
+    mgr = FeedPassManager(store)
+    k1 = _keys(0, 300)
+    ws1 = mgr.begin_pass(k1)
+    ws1.translate(k1)
+    mgr.end_pass(ws1, ws1.table)
+    k2 = np.unique(np.concatenate([k1[50:], _keys(9000, 9050)]))
+    mgr.begin_feed_pass(k2)
+    mgr.wait_feed_pass_done()
+    # mutate AFTER staging: a foreign delta rewrites rows that are (a)
+    # resident, (b) freshly staged, and (c) absent from pass 2
+    donor = HostEmbeddingStore(c)
+    mut = np.unique(np.concatenate([k1[60:70], _keys(9000, 9010),
+                                    k1[:5]]))
+    dr = donor.lookup_or_init(mut)
+    dr[:, 2] = 13.0
+    donor.write_back(mut, dr)
+    with tempfile.TemporaryDirectory() as d:
+        delta = donor.save_delta(os.path.join(d, "dd"))
+        store.apply_delta_file(delta)
+        ws2 = mgr.begin_pass(k2)
+        assert mgr.last_fresh_rows == 50     # the staging was CONSUMED
+        assert mgr.last_patched_rows == 20   # resident + staged, not (c)
+        idx = ws2.translate(np.concatenate([k1[60:70],
+                                            _keys(9000, 9010)]))
+        np.testing.assert_array_equal(np.asarray(ws2.table)[idx, 2],
+                                      np.full(20, 13.0, np.float32))
+        # reference: the same stream through a full rebuild
+        flags.incremental_feed = False
+        store_b = HostEmbeddingStore(c)
+        mgr_b = FeedPassManager(store_b)
+        wb1 = mgr_b.begin_pass(k1)
+        wb1.translate(k1)
+        mgr_b.end_pass(wb1, wb1.table)
+        store_b.lookup_or_init(k2)           # staging inserted k2 fresh
+        store_b.apply_delta_file(delta)
+        wb2 = mgr_b.begin_pass(k2)
+    np.testing.assert_array_equal(np.asarray(ws2.table),
+                                  np.asarray(wb2.table))
+
+
+def test_flush_after_known_mutation_keeps_unstale_rows():
+    """A flush crossing a provable mutation drops ONLY the stale marks;
+    every other unsynced device row still reaches the store (it used to
+    drop them all)."""
+    flags.incremental_feed = True
+    c = cfg_small()
+    store = HostEmbeddingStore(c)
+    mgr = FeedPassManager(store)
+    keys = _keys(0, 100)
+    ws = mgr.begin_pass(keys)
+    idx = ws.translate(keys)
+    t = np.asarray(ws.table).copy()
+    t[idx, 2] = 9.0
+    mgr.end_pass(ws, jnp.asarray(t))
+    donor = HostEmbeddingStore(c)
+    dr = donor.lookup_or_init(keys[:10])
+    dr[:, 2] = 77.0
+    donor.write_back(keys[:10], dr)
+    with tempfile.TemporaryDirectory() as d:
+        store.apply_delta_file(donor.save_delta(os.path.join(d, "dd")))
+    mgr.flush()
+    # mutated rows kept the REPLAYED value; the rest flushed the device
+    np.testing.assert_array_equal(store.get_rows(keys[:10])[:, 2],
+                                  np.full(10, 77.0, np.float32))
+    np.testing.assert_array_equal(store.get_rows(keys[10:])[:, 2],
+                                  np.full(90, 9.0, np.float32))
+
+
+def test_delta_stage_ioerror_leaves_manager_usable():
+    flags.incremental_feed = True
+    store = HostEmbeddingStore(cfg_small())
+    mgr = FeedPassManager(store)
+    k1 = _keys(0, 100)
+    ws1 = mgr.begin_pass(k1)
+    ws1.translate(k1)
+    mgr.end_pass(ws1, ws1.table)
+    faultpoint.arm("feed_pass.delta_stage.pre", action="ioerror")
+    k2 = _keys(50, 150)
+    with pytest.raises(OSError):
+        mgr.begin_pass(k2)
+    faultpoint.disarm()
+    ws2 = mgr.begin_pass(k2)
+    assert set(ws2.sorted_keys.tolist()) == set(k2.tolist())
+
+
+# ---------------------------------------------------------------------------
+# per-host shard ownership
+# ---------------------------------------------------------------------------
+
+def test_two_host_ownership_disjoint_cover():
+    """The required 2-host split proof: the two ranks' filtered key sets
+    partition the key space — disjoint, and their union is everything."""
+    ss = ShardedEmbeddingStore(cfg_small(), 4)
+    keys = _keys(0, 5000)
+    o0 = ShardOwnership.for_store(ss, 2, 0)
+    o1 = ShardOwnership.for_store(ss, 2, 1)
+    k0 = o0.filter_keys(ss, keys)
+    k1 = o1.filter_keys(ss, keys)
+    assert len(np.intersect1d(k0, k1)) == 0
+    assert set(np.concatenate([k0, k1]).tolist()) == set(keys.tolist())
+    # hash partition is host-stable: both ranks agree who owns what
+    assert np.array_equal(o0.owned, np.array([0, 2]))
+    assert np.array_equal(o1.owned, np.array([1, 3]))
+    # unsharded stores have no partition to split
+    assert ShardOwnership.for_store(HostEmbeddingStore(cfg_small()),
+                                    2, 0) is None
+
+
+def test_feed_builds_only_owned_shards():
+    ss = ShardedEmbeddingStore(cfg_small(), 4)
+    keys = _keys(0, 1000)
+    own = ShardOwnership.for_store(ss, 2, 0)
+    mgr = FeedPassManager(ss, ownership=own)
+    ws = mgr.begin_pass(keys)
+    expect = own.filter_keys(ss, keys)
+    assert np.array_equal(ws.sorted_keys, expect)
+    assert 0 < len(expect) < len(keys)
+    # the background feed filters identically, so staging matches
+    k2 = _keys(100, 1100)
+    mgr.begin_feed_pass(k2)
+    mgr.end_pass(ws, ws.table)
+    ws2 = mgr.begin_pass(k2)
+    assert np.array_equal(ws2.sorted_keys, own.filter_keys(ss, k2))
+    assert mgr.last_reused_rows > 0          # staging was consumed
+    mgr.close()
+
+
+def test_ownership_rebind_rebuilds_new_shards_only():
+    """The elastic-grow hook: a world resize re-deals the shards and the
+    next begin_pass builds exactly the NEW owned set (a replacement
+    host fetches its shards' rows, nothing else)."""
+    ss = ShardedEmbeddingStore(cfg_small(), 4)
+    keys = _keys(0, 1000)
+    own2 = ShardOwnership.for_store(ss, 2, 0)
+    mgr = FeedPassManager(ss, ownership=own2)
+    ws = mgr.begin_pass(keys)
+    idx = ws.translate(ws.sorted_keys)
+    t = np.asarray(ws.table).copy()
+    t[idx, 2] = 4.0
+    mgr.end_pass(ws, jnp.asarray(t))
+    # world shrinks to 1: this host now owns every shard; the rebind
+    # flushes pending rows and drops the resident set
+    mgr.set_ownership(own2.with_world(1, 0))
+    np.testing.assert_array_equal(
+        ss.get_rows(own2.filter_keys(ss, keys))[:, 2], 4.0)
+    ws_all = mgr.begin_pass(keys)
+    assert np.array_equal(ws_all.sorted_keys, keys)
+    mgr.close()
+
+
+def test_ownership_validation():
+    with pytest.raises(ValueError):
+        ShardOwnership(4, 2, 2)
+    with pytest.raises(ValueError):
+        ShardOwnership(0, 1, 0)
+    with pytest.raises(TypeError):
+        ShardOwnership(4, 2, 0).filter_keys(
+            HostEmbeddingStore(cfg_small()), _keys(0, 10))
+
+
+# ---------------------------------------------------------------------------
+# overlapped spill staging: madvise prefetch + cache autotune
+# ---------------------------------------------------------------------------
+
+@pytest.mark.skipif(not hasattr(mmap, "MADV_WILLNEED"),
+                    reason="platform has no madvise")
+def test_spill_prefetch_advises_misses_only():
+    flags.spill_prefetch = True
+    store = SpillEmbeddingStore(cfg_small(), cache_rows=32)
+    keys = _keys(0, 500)
+    rows = store.lookup_or_init(keys)
+    rows[:, 2] = 5.0
+    store.write_back(keys, rows)
+    before = store.prefetched_rows
+    n = store.prefetch_rows(keys)
+    assert n > 0 and store.prefetched_rows == before + n
+    # unknown keys never insert, cached rows never re-advise
+    assert store.prefetch_rows(_keys(9000, 9100)) == 0
+    assert len(store) == 500
+    # a prefetch is advisory: the values are untouched
+    np.testing.assert_array_equal(store.get_rows(keys)[:, 2], 5.0)
+
+
+def test_feed_pass_prefetches_spill_rows():
+    flags.spill_prefetch = True
+    store = SpillEmbeddingStore(cfg_small(), cache_rows=16)
+    keys = _keys(0, 400)
+    store.lookup_or_init(keys)               # the table exists on disk
+    mgr = FeedPassManager(store)
+    mgr.begin_pass(keys)                     # full build → prefetch
+    if hasattr(mmap, "MADV_WILLNEED"):
+        assert store.prefetched_rows > 0
+    flags.spill_prefetch = False
+    p0 = store.prefetched_rows
+    mgr.drop()
+    mgr.begin_pass(keys)
+    assert store.prefetched_rows == p0       # flag gates the readahead
+
+
+def test_spill_cache_autotune_grows_on_thrash_and_records():
+    from paddlebox_tpu import monitor
+    flags.spill_cache_autotune = True
+    store = SpillEmbeddingStore(cfg_small(), cache_rows=256)
+    keys = _keys(0, 4000)
+    store.lookup_or_init(keys)
+    hub = monitor.hub()
+    hub.begin_pass(1)
+    store.lookup_or_init(keys)               # thrash: 4000 keys, 256 slots
+    agg = end_pass_rebalance(store)
+    rec = hub.end_pass()
+    assert agg["cache_resized"] == 1
+    assert agg["cache_rows"] == 512          # doubled, bounded
+    assert store._cache_slots == 512
+    assert rec["extra"]["spill_cache_rows"] == 512
+    # quiet telemetry → no resize
+    hub.begin_pass(2)
+    agg2 = end_pass_rebalance(store)
+    hub.end_pass()
+    assert agg2["cache_resized"] == 0
+
+
+def test_spill_cache_autotune_off_by_default():
+    flags.spill_cache_autotune = False
+    store = SpillEmbeddingStore(cfg_small(), cache_rows=256)
+    keys = _keys(0, 4000)
+    store.lookup_or_init(keys)
+    store.lookup_or_init(keys)
+    agg = end_pass_rebalance(store)
+    assert store._cache_slots == 256
+    assert "cache_resized" not in agg

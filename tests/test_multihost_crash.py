@@ -42,6 +42,10 @@ POINT_AFTER = {
     "store.save_delta.pre_replace": 1,
     "store.save_delta.pre_manifest": 1,
     "feed_pass.flush.pre": 3,
+    # ISSUE 14: the incremental delta feed fires at every reuse
+    # boundary (pass >= 2 begin_pass); AFTER=1 kills rank 1 at its
+    # pass-3 boundary, after a pass-2 snapshot exists
+    "feed_pass.delta_stage.pre": 1,
     "trainer.push_apply.pre": 6,
     "pass_ckpt.pre_manifest": 3,
     "pass_ckpt.post_manifest": 3,
@@ -181,7 +185,16 @@ def test_two_host_election_smoke(tmp_path, golden):
                                        "remote_ckpt.download.pre")
                           and p not in faultpoint.ELASTIC_POINTS
                           and p not in faultpoint.SERVING_POINTS
-                          and p not in faultpoint.MONITOR_POINTS])
+                          and p not in faultpoint.MONITOR_POINTS
+                          # the multi-host worker trains a plain
+                          # 1-shard in-RAM store: the sharded-save and
+                          # spill-tier windows never execute here —
+                          # they are covered (incl. kill→resume) by
+                          # test_exchange.py and the single-host matrix
+                          # under PBTPU_TABLE_TIERING=spill
+                          and p not in faultpoint.EXCHANGE_POINTS
+                          and p not in ("tiering.save.pre_flush",
+                                        "tiering.evict.pre")])
 def test_multihost_kill_resume_matrix(point, tmp_path, golden):
     """Every registered fault point, multi-host: kill rank 1 there
     (mid-pass snapshots + hdfs:// remote mirror ON so every point is on
