@@ -1397,7 +1397,9 @@ def dryrun_main() -> int:
 
     # telemetry rides the dryrun too: the artifact must embed the hub
     # summary (counters + any flight records) — asserted as a check below
-    monitor.hub().enable(monitor.MemorySink())
+    # (the sink is kept: the world-trace embed merges its record ring)
+    dryrun_sink = monitor.MemorySink()
+    monitor.hub().enable(dryrun_sink)
     checks: dict = {}
     eps, detail, ctx = device_step_bench(True, n_steps=2, n_windows=1,
                                          tiny=True, return_ctx=True)
@@ -1543,6 +1545,27 @@ def dryrun_main() -> int:
             {"serving.p99_ms": 4.0},
             {"device_kind": None,
              "metrics": {"serving.p99_ms": 5.0}}, "")["ok"])
+    # the world trace rides the dryrun too (ISSUE 15): a traced probe
+    # pass whose publish flow pair must merge into a Chrome-trace summary
+    # embedded in the artifact — asserted like doctor_embedded. The probe
+    # runs the REAL machinery end to end (sampled begin_pass -> stamped
+    # span -> flow points -> in-memory merge), not a synthetic dict.
+    from paddlebox_tpu.config import flags as _flags
+    from paddlebox_tpu.monitor import trace as trace_lib
+    _prev_trace = _flags.trace
+    try:
+        _flags.trace = True
+        hub = monitor.hub()
+        hub.begin_pass(9001, owner="bench")
+        with monitor.span("publish"):
+            trace_lib.flow("publish", "v9001", role="src")
+        trace_lib.flow("publish", "v9001", role="dst")
+        hub.end_pass()
+    finally:
+        _flags.trace = _prev_trace
+    _stream = trace_lib.records_to_stream(dryrun_sink.records)
+    detail["world_trace"] = trace_lib.summarize(
+        trace_lib.merge_streams([_stream], [0]))
     detail["telemetry"] = monitor.hub().summary()
     # the run-doctor verdict rides the dryrun too (ISSUE 12): the
     # artifact must embed a schema-valid report with the boundary-wall
@@ -1550,7 +1573,8 @@ def dryrun_main() -> int:
     # push-floor rule — asserted like telemetry_embedded
     from paddlebox_tpu.monitor import doctor as doctor_lib
     detail["doctor"] = doctor_lib.diagnose_hub(
-        monitor.hub(), detail={"push_floor": detail.get("push_floor")})
+        monitor.hub(), detail={"push_floor": detail.get("push_floor"),
+                               "world_trace": detail["world_trace"]})
     monitor.hub().disable()
     checks["telemetry_embedded"] = (
         isinstance(detail["telemetry"], dict)
@@ -1564,6 +1588,16 @@ def dryrun_main() -> int:
         # is fired/quiet/no-data depending on closure, but an evaluated
         # entry must exist
         and any(r["rule"] == "push-floor"
+                for r in detail["doctor"]["rules"]))
+    checks["trace_embedded"] = (
+        detail["world_trace"].get("spans", 0) >= 1
+        and any(e.get("kind") == "publish"
+                for e in detail["world_trace"].get("flow_edges", []))
+        and isinstance(detail["world_trace"].get("clock_offsets_s"),
+                       dict)
+        # the span-level data must have reached the doctor's cross-rank
+        # rule (any status but an evaluated entry — like push-floor)
+        and any(r["rule"] == "cross-rank-flow"
                 for r in detail["doctor"]["rules"]))
     metrics = collect_gate_metrics(eps, detail)
     kind = detail.get("device_kind", "")
@@ -1689,13 +1723,27 @@ def main() -> None:
     except Exception as e:
         detail["telemetry"] = {"error": repr(e)}
 
+    # the merged world-trace summary rides every artifact (ISSUE 15):
+    # the hub's in-memory flight records render as per-rank pass slices
+    # (flow points live only in the JSONL streams — the offline
+    # `python -m paddlebox_tpu.monitor.trace` merge reads those)
+    try:
+        from paddlebox_tpu.monitor import trace as _trace
+        detail["world_trace"] = _trace.summarize(_trace.merge_streams(
+            [_trace.records_to_stream(_monitor.hub().flight_records())],
+            [0]))
+    except Exception as e:
+        detail["world_trace"] = {"error": repr(e)}
+
     # the run-doctor verdict rides every artifact (ISSUE 12): critical-
     # path attribution over the e2e passes' flight records + the rule
     # set, with this round's push_floor closing the push-floor rule
     try:
         from paddlebox_tpu.monitor import doctor as _doctor
         detail["doctor"] = _doctor.diagnose_hub(
-            _monitor.hub(), detail={"push_floor": detail.get("push_floor")})
+            _monitor.hub(),
+            detail={"push_floor": detail.get("push_floor"),
+                    "world_trace": detail.get("world_trace")})
     except Exception as e:
         detail["doctor"] = {"error": repr(e)}
 

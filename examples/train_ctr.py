@@ -350,6 +350,19 @@ def main() -> int:
         print(f"telemetry: {len(flights)} flight records, {n_spans} trace "
               f"events -> {telemetry_dir}")
         print(f"doctor: {verdict}")
+        from paddlebox_tpu.config import flags as _flags
+        if _flags.trace:
+            # world trace (PBTPU_TRACE=1): merge this rank's stream into
+            # the Perfetto timeline — multi-rank runs merge every rank's
+            # dir with `python -m paddlebox_tpu.monitor.trace` instead
+            from paddlebox_tpu.monitor import trace as trace_lib
+            wt = trace_lib.merge_roots([telemetry_dir])
+            trace_lib.write_trace(
+                wt, os.path.join(telemetry_dir, "world_trace.json"))
+            s = trace_lib.summarize(wt)
+            print(f"world trace: {s['spans']} spans, "
+                  f"{len(s['flow_edges'])} flow edges -> "
+                  f"{telemetry_dir}/world_trace.json")
     print("example complete:", work)
     return 0
 

@@ -93,6 +93,11 @@ class Project:
     faultpoint_registries: tuple[str, ...] = (
         "POINTS", "ELASTIC_POINTS", "SERVING_POINTS", "EXCHANGE_POINTS",
         "MONITOR_POINTS")
+    # closed hub event/span NAME registry (monitor/names.py) — the
+    # event-registry rule checks every literal monitor.event/span site
+    # against the union of these tuples
+    event_registry_module: str = "paddlebox_tpu/monitor/names.py"
+    event_registries: tuple[str, ...] = ("EVENT_NAMES", "SPAN_NAMES")
     tests_dir: str = "tests"
     # extra trees indexed for *references* (flag reads, faultpoint names)
     # but never linted themselves
@@ -427,6 +432,7 @@ class ProjectIndex:
         self.flag_reads: dict[str, list[tuple[str, int]]] = {}
         self.faultpoint_registries: dict[str, dict[str, int]] = {}
         self.faultpoint_sites: dict[str, list[tuple[str, int]]] = {}
+        self.event_registries: dict[str, dict[str, int]] = {}
         self.test_literals: set[str] = set()
         self.test_registry_refs: set[str] = set()
 
@@ -486,6 +492,36 @@ class ProjectIndex:
             if tgt in project.faultpoint_registries and isinstance(
                     value, (ast.Tuple, ast.List)):
                 reg = self.faultpoint_registries.setdefault(tgt, {})
+                for el in value.elts:
+                    lit = str_const(el)
+                    if lit is not None:
+                        reg[lit] = el.lineno
+
+    @property
+    def all_event_names(self) -> "set[str]":
+        out: set = set()
+        for reg in self.event_registries.values():
+            out.update(reg)
+        return out
+
+    def add_event_registry_module(self, ctx: FileContext,
+                                  project: Project) -> None:
+        """Collect the closed hub event/span name registry — the same
+        module-level-tuple shape as the faultpoint registries."""
+        for node in ctx.tree.body if isinstance(
+                ctx.tree, ast.Module) else []:
+            tgt = None
+            value = None
+            if isinstance(node, ast.AnnAssign) and isinstance(
+                    node.target, ast.Name):
+                tgt, value = node.target.id, node.value
+            elif isinstance(node, ast.Assign) and len(
+                    node.targets) == 1 and isinstance(
+                    node.targets[0], ast.Name):
+                tgt, value = node.targets[0].id, node.value
+            if tgt in project.event_registries and isinstance(
+                    value, (ast.Tuple, ast.List, ast.Set)):
+                reg = self.event_registries.setdefault(tgt, {})
                 for el in value.elts:
                     lit = str_const(el)
                     if lit is not None:
@@ -636,6 +672,9 @@ class Linter:
         pctx = _ref_ctx(project.faultpoint_module)
         if pctx is not None:
             index.add_faultpoint_module(pctx, project)
+        ectx = _ref_ctx(project.event_registry_module)
+        if ectx is not None:
+            index.add_event_registry_module(ectx, project)
 
         for ctx in contexts.values():
             index.add_reference_file(ctx, project)

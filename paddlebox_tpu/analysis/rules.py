@@ -364,6 +364,60 @@ class FlagAuditRule(Rule):
 
 
 # ---------------------------------------------------------------------------
+# event-registry
+# ---------------------------------------------------------------------------
+
+class EventRegistryRule(Rule):
+    id = "event-registry"
+    doc = ("every hub event/span name emitted in the tree must be in the "
+           "closed registry (monitor/names.py) — no forked telemetry "
+           "namespace")
+
+    def visit_file(self, ctx: FileContext, index: ProjectIndex,
+                   project: Project) -> list[Finding]:
+        if ctx.relpath == project.event_registry_module:
+            return []               # the registry itself
+        names = index.all_event_names
+        if not names:
+            return []               # no registry in this project: no rule
+        fn_aliases = (
+            import_aliases(ctx, "paddlebox_tpu.monitor",
+                           ("event", "span"))
+            | import_aliases(ctx, "paddlebox_tpu.monitor.hub",
+                             ("event", "span")))
+        out = []
+        for call in iter_calls(ctx.tree):
+            f = call.func
+            is_emit = (isinstance(f, ast.Attribute)
+                       and f.attr in ("event", "span")) or (
+                isinstance(f, ast.Name) and f.id in fn_aliases)
+            if not is_emit:
+                continue
+            arg = call.args[0] if call.args else call_kwarg(call, "name")
+            lit = str_const(arg) if arg is not None else None
+            if lit is None:
+                out.append(Finding(
+                    ctx.relpath, call.lineno, self.id,
+                    "event/span name is not a string literal — the "
+                    "registry check cannot see it (dashboards, doctor "
+                    "rules, and the world-trace merger key off names "
+                    "verbatim); emit a literal registered in "
+                    f"{project.event_registry_module}, or waive naming "
+                    "the registered names the expression takes"))
+            elif lit not in names:
+                regs = ", ".join(project.event_registries)
+                out.append(Finding(
+                    ctx.relpath, call.lineno, self.id,
+                    f"event/span name {lit!r} is not in the closed "
+                    f"registry ({regs} in "
+                    f"{project.event_registry_module}) — an unregistered "
+                    "name silently forks the telemetry namespace every "
+                    "consumer greps (register it next to the consumer "
+                    "that reads it)"))
+        return out
+
+
+# ---------------------------------------------------------------------------
 # silent-except
 # ---------------------------------------------------------------------------
 
@@ -400,5 +454,6 @@ ALL_RULES: tuple[type[Rule], ...] = (
     ThreadContextRule,
     DonefileDisciplineRule,
     FlagAuditRule,
+    EventRegistryRule,
     SilentExceptRule,
 )

@@ -314,6 +314,32 @@ class Flags:
     # also returns the findings). Off by default: the rules read only
     # committed records, but day-scale operators opt in explicitly.
     doctor_live: bool = False               # (new)
+    # --- world trace (new — monitor/trace.py) ---
+    # Distributed tracing: every hub record emitted inside a sampled
+    # pass carries a trace context (trace_id / span_id / parent links),
+    # flow points mark the cross-rank edges (the exchange all_to_all,
+    # publish -> serving swap), and `python -m paddlebox_tpu.monitor.
+    # trace <rank_dirs...>` merges the per-rank streams into ONE
+    # clock-corrected Chrome-trace JSON (Perfetto). Off by default; the
+    # disabled cost is one module-flag check per scope.
+    trace: bool = False                     # (new)
+    # Trace every Nth pass (1 = every pass). Sampling keeps day-scale
+    # streams bounded: an unsampled pass emits NO trace records and
+    # pays only the begin_pass sampling decision.
+    trace_sample_passes: int = 1            # (new)
+    # Stable run identity baked into every trace_id so two runs sharing
+    # a telemetry root can never interleave ("" = "run"). All ranks of
+    # one run must agree (set it from the launcher like the FileStore
+    # namespace).
+    trace_run_id: str = ""                  # (new)
+    # Per-pass-window DEVICE capture: start a jax.profiler trace at
+    # every sampled begin_pass and stop it at end_pass, dumping under
+    # trace_device_dir/pass-NNNNN — linked to the host spans by the
+    # pass/step markers both carry. No-op off TPU (and any profiler
+    # failure is counted, never raised: tracing must not take down
+    # training).
+    trace_device: bool = False              # (new)
+    trace_device_dir: str = ""              # (new) "" = <tmp>/pbtpu_device_trace
 
     def set(self, name: str, value: Any) -> None:
         if not hasattr(self, name):

@@ -145,9 +145,14 @@ class HeartbeatMonitor:
         self._stop = threading.Event()
         self._failure: PeerFailureError | None = None
         self._reported: set[tuple[str, int]] = set()
-        # per-peer observation state: (last_seq, seq_seen_mono,
-        #                              last_progress, progress_seen_mono)
+        # per-peer observation state: [last_seq, seq_seen_mono,
+        #   last_progress, progress_seen_mono,
+        #   last_payload_ts, payload_read_wall]  (the last two feed the
+        #   clock-probe echo — see publish())
         self._obs: dict[int, list] = {}
+        # injectable wall clock (tests prove skew recovery by skewing
+        # one monitor's wall); liveness never reads it
+        self._wall = time.time
         self._watch = watch
         self._threads: list[threading.Thread] = []
         if start:
@@ -157,12 +162,32 @@ class HeartbeatMonitor:
 
     def publish(self) -> None:
         """Write one heartbeat for this rank (also called by the
-        publisher thread every ``interval_s``)."""
+        publisher thread every ``interval_s``).
+
+        The payload carries the publish wall-clock (``ts``) and an
+        ``echo`` of every peer observation this rank holds
+        (``{peer: [peer_seq, peer_payload_ts, my_wall_at_read]}``) —
+        one heartbeat each way closes an NTP-style round trip, and
+        :meth:`scan` turns the closed loop into a ``trace.clock_probe``
+        telemetry event the world-trace merger uses to align skewed
+        hosts (monitor/trace.py). Staleness detection itself still
+        never compares wall clocks — ``seq`` against the local
+        monotonic clock remains the only liveness signal."""
         self._seq += 1
         ctx = mon_ctx.current()
+        now_wall = self._wall()
+        echo = {}
+        # snapshot: the watchdog thread's scan() inserts never-seen
+        # peers into _obs concurrently — iterating the live dict would
+        # RuntimeError and kill the publisher thread (a silent
+        # self-inflicted peer_lost)
+        for r, obs in list(self._obs.items()):
+            if len(obs) >= 6 and obs[0] is not None and obs[4] is not None:
+                echo[str(r)] = [obs[0], obs[4], obs[5]]
         payload = {"seq": self._seq, "rank": self.rank, "pid": os.getpid(),
                    "host": socket.gethostname(),
-                   "pass": ctx.pass_id, "step": ctx.step}
+                   "pass": ctx.pass_id, "step": ctx.step,
+                   "ts": now_wall, "echo": echo}
         self.store.set(self._key(self.rank), json.dumps(payload).encode())
 
     def _publisher(self) -> None:
@@ -234,9 +259,14 @@ class HeartbeatMonitor:
             p = self._read_peer(r)
             obs = self._obs.get(r)
             if obs is None:
-                obs = self._obs[r] = [None, now, None, now]
+                obs = self._obs[r] = [None, now, None, now, None, None]
             if p is not None and p.get("seq") != obs[0]:
                 obs[0], obs[1] = p.get("seq"), now
+                # clock-probe plane: remember WHEN (peer clock + ours)
+                # this fresh payload was read — the echo we publish —
+                # and close the round trip the peer's echo of us opens
+                obs[4], obs[5] = p.get("ts"), self._wall()
+                self._emit_clock_probe(r, p, obs[5])
             prog = None if p is None else (p.get("pass"), p.get("step"))
             if prog != obs[2]:
                 obs[2], obs[3] = prog, now
@@ -260,6 +290,9 @@ class HeartbeatMonitor:
                 if (kind, r) not in self._reported:
                     self._reported.add((kind, r))
                     monitor.counter_add(f"resilience.{kind}")
+                    # pblint: disable=event-registry -- kind iterates
+                    # exactly the registered "peer_lost"/"peer_stalled"
+                    # literals from the loop tuple above
                     monitor.event(kind, rank=int(name(r)),
                                   observer=int(name(self.rank)),
                                   after_s=(self.lost_after_s
@@ -275,6 +308,35 @@ class HeartbeatMonitor:
             if self._failure is None:
                 self._failure = err
             raise err
+
+    def _emit_clock_probe(self, r: int, p: dict, t3: float) -> None:
+        """One NTP-style offset sample from a closed heartbeat round
+        trip: our payload ts came back in the peer's echo (t0, our
+        clock), stamped with the peer's read time (t1) and publish time
+        (t2, peer clock); ``t3`` is our read of the echo. Emitted as a
+        ``trace.clock_probe`` event — at most one per peer per fresh
+        heartbeat, no-op while the hub's event stream is off."""
+        try:
+            mine = (p.get("echo") or {}).get(str(self.rank))
+            t2 = p.get("ts")
+            if not mine or t2 is None:
+                return
+            _seq0, t0, t1 = mine
+            if t0 is None or t1 is None:
+                return
+            from paddlebox_tpu.monitor.trace import ntp_offset
+            offset, rtt = ntp_offset(float(t0), float(t1), float(t2),
+                                     float(t3))
+            name = (lambda x: x) if self._names is None \
+                else (lambda x: self._names[x])
+            monitor.event("trace.clock_probe", peer=int(name(r)),
+                          observer=int(name(self.rank)),
+                          offset_s=round(offset, 6),
+                          rtt_s=round(rtt, 6))
+        except (TypeError, ValueError, IndexError):
+            # a malformed echo (foreign/older payload) is not a probe —
+            # and never a liveness verdict
+            monitor.counter_add("trace.clock_probe_errors")
 
     def check(self) -> None:
         """Raise the latched (or freshly scanned) peer failure, if any.

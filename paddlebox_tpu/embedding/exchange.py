@@ -91,6 +91,18 @@ def push_wire_bytes(cfg: EmbeddingConfig, lanes: int, wire: str) -> int:
     return lanes * (4 + gbytes + side)
 
 
+def flow_fields(cfg: EmbeddingConfig, wire: str, tokens: int) -> dict:
+    """Edge-label fields for a world-trace ``exchange`` flow point
+    (monitor/trace.py): the wire format plus an UPPER BOUND on the bytes
+    this step's all_to_all crosses (lanes <= tokens — the dedup plan can
+    only shrink it; the exact per-pass totals are the ``exchange.*``
+    counter deltas the flight record carries). Host-side arithmetic
+    only — a flow point costs two multiplies, never a device readback."""
+    return {"wire": str(wire), "tokens": int(tokens),
+            "bytes_bound": pull_wire_bytes(cfg, int(tokens))
+            + push_wire_bytes(cfg, int(tokens), wire)}
+
+
 def pull_wire_bytes(cfg: EmbeddingConfig, lanes: int) -> int:
     """A2a bytes for `lanes` pull lanes: the index plane out plus the
     value payload back (quantized tables cross embedx at their storage

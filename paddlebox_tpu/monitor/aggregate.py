@@ -38,7 +38,8 @@ from paddlebox_tpu.monitor import flight
 EVIDENCE_EVENTS = ("peer_lost", "peer_stalled", "nan_guard",
                    "exchange_overflow", "pass_aborted",
                    "serving_publish_failed", "doctor.finding",
-                   "sink_dropped", "sink_rotated", "resume_election")
+                   "sink_dropped", "sink_rotated", "resume_election",
+                   "trace.clock_probe")
 KEEP_PER_NAME = 16
 
 _SEG_RE = re.compile(r"\.(\d{3,})\.jsonl$")
@@ -286,6 +287,18 @@ def _pass_view(pass_id: int, by_rank: "dict[int, dict]",
     if tier:
         view["tiering"] = tier
     return view
+
+
+def merge_world_trace(roots: "list[str]",
+                      rank_names: "list[int] | None" = None) -> dict:
+    """Merge the same per-rank roots into ONE clock-corrected Chrome-
+    trace-event JSON (rank→process, thread→thread, flow arrows for the
+    exchange and publish→swap edges) — the span-level companion of
+    :func:`aggregate`. Thin front for :mod:`paddlebox_tpu.monitor.trace`
+    (which reuses this module's stream discovery + rank naming); lazy
+    import keeps the two modules acyclic."""
+    from paddlebox_tpu.monitor import trace as trace_lib
+    return trace_lib.merge_roots(roots, rank_names=rank_names)
 
 
 def aggregate(roots: "list[str]",

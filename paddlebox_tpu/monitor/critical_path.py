@@ -87,6 +87,51 @@ def _trend(values: "list[float]", rel_threshold: float = 0.1) -> str:
     return "flat"
 
 
+def attribute_flow_edges(edges: "list[dict]",
+                         wall_seconds_mean: "float | None" = None
+                         ) -> dict:
+    """Span-level cross-rank attribution (ISSUE 15): given the merged
+    world trace's flow edges (``trace.summarize()["flow_edges"]`` —
+    {kind, key, src_rank, dst_rank, latency_s}), name the LONGEST edge
+    and the per-kind latency account. ``wall_seconds_mean`` (from
+    :func:`attribute_records`'s passes) turns the longest latency into
+    a share of the pass wall — the doctor's cross-rank-flow rule fires
+    on that share. Negative latencies (a dst point observed before the
+    src after clock correction) are kept and flagged: they measure the
+    residual clock error, which is itself a diagnosis."""
+    if not edges:
+        return {"edges": 0, "longest": None, "by_kind": {}}
+    by_kind: dict[str, dict] = {}
+    for e in edges:
+        k = str(e.get("kind"))
+        b = by_kind.setdefault(k, {"count": 0, "max_latency_s": None,
+                                   "mean_latency_s": 0.0})
+        lat = float(e.get("latency_s") or 0.0)
+        b["count"] += 1
+        b["mean_latency_s"] += lat
+        if b["max_latency_s"] is None or lat > b["max_latency_s"]:
+            b["max_latency_s"] = round(lat, 6)
+    for b in by_kind.values():
+        b["mean_latency_s"] = round(b["mean_latency_s"] / b["count"], 6)
+    longest = max(edges, key=lambda e: float(e.get("latency_s") or 0.0))
+    out = {
+        "edges": len(edges),
+        "longest": {
+            "kind": longest.get("kind"), "key": longest.get("key"),
+            "src_rank": longest.get("src_rank"),
+            "dst_rank": longest.get("dst_rank"),
+            "latency_s": round(float(longest.get("latency_s") or 0.0), 6),
+        },
+        "by_kind": by_kind,
+        "negative_edges": sum(
+            1 for e in edges if float(e.get("latency_s") or 0.0) < 0),
+    }
+    if wall_seconds_mean:
+        out["longest_share_of_wall"] = round(
+            out["longest"]["latency_s"] / wall_seconds_mean, 4)
+    return out
+
+
 def attribute_records(flights: "list[dict]") -> dict:
     """Attribution of a run: one entry per pass plus the cross-pass
     summary the doctor's trend rules read. When several records carry

@@ -568,6 +568,78 @@ class SinkHealthRule(Rule):
             "(full disk / dead path)")
 
 
+class CrossRankFlowRule(Rule):
+    id = "cross-rank-flow"
+    doc = "a cross-rank flow edge (exchange / publish->swap) dominates "\
+          "the pass wall"
+    incident = ("ISSUE 15: stage totals hid WHERE a slow pass crossed "
+                "ranks — the world trace's flow edges (exchange "
+                "all_to_all, end_pass publish -> serving swap) carry "
+                "clock-corrected latencies, and the longest edge is the "
+                "cross-rank statement no per-rank attribution could "
+                "make")
+    SHARE = 0.25       # longest edge vs mean pass wall
+    ABS_S = 5.0        # fallback when no pass walls are in view
+
+    _KIND_FIX = {
+        "exchange": (
+            "the exchange edge is the wall: check the dst rank's shard "
+            "balance (aggregate stage_skew / exchange imbalance), raise "
+            "flags.exchange_capacity_factor if overflow retries ride "
+            "along, and A/B flags.exchange_wire — the edge fields "
+            "carry the wire format and bytes that crossed"),
+        "publish": (
+            "the publish->swap edge is the staleness: check the "
+            "publisher's upload/verify seconds (serving.publish_seconds "
+            "counter), the server's poll cadence (ServingServer "
+            "poll_s), and the donefile root's fs latency"),
+    }
+
+    def evaluate(self, ctx):
+        wt = ctx.detail.get("world_trace")
+        if not isinstance(wt, dict):
+            return "no-data", None
+        edges = wt.get("flow_edges") or []
+        if not edges:
+            return "no-data", None
+        walls = [p["wall_seconds"]
+                 for p in ctx.attribution.get("passes", [])
+                 if p.get("wall_seconds")]
+        wall_mean = (sum(walls) / len(walls)) if walls else None
+        fa = cp_lib.attribute_flow_edges(edges, wall_mean)
+        longest = fa["longest"]
+        share = fa.get("longest_share_of_wall")
+        hot = (share is not None and share >= self.SHARE) or (
+            share is None and longest["latency_s"] >= self.ABS_S)
+        if not hot:
+            return "quiet", None
+        ev = {
+            "longest_edge": longest,
+            "longest_share_of_wall": share,
+            "by_kind": fa["by_kind"],
+            "edges": fa["edges"],
+            "negative_edges": fa["negative_edges"],
+            "clock_offsets_s": wt.get("clock_offsets_s"),
+        }
+        fix = [self._KIND_FIX.get(
+            str(longest["kind"]),
+            "inspect the edge's src/dst rank timelines in the merged "
+            "Perfetto trace (python -m paddlebox_tpu.monitor.trace)")]
+        if fa["negative_edges"]:
+            fix.append(f"{fa['negative_edges']} edge(s) measured "
+                       "negative — residual clock error; check the "
+                       "heartbeat plane's trace.clock_probe coverage "
+                       "before trusting sub-rtt latencies")
+        return "fired", Finding(
+            self.id, "warn",
+            (f"cross-rank flow edge {longest['kind']}:{longest['key']} "
+             f"rank{longest['src_rank']} -> rank{longest['dst_rank']} "
+             f"takes {longest['latency_s']:.3f}s"
+             + (f" ({share:.0%} of the mean pass wall)"
+                if share is not None else "")),
+            ev, "; ".join(fix))
+
+
 ALL_RULES: "tuple[type[Rule], ...]" = (
     BoundaryWallRule,
     ExchangeOverflowRule,
@@ -578,6 +650,7 @@ ALL_RULES: "tuple[type[Rule], ...]" = (
     ServingStalenessRule,
     HeartbeatGapRule,
     SinkHealthRule,
+    CrossRankFlowRule,
 )
 
 _SEV_ORDER = {"critical": 0, "warn": 1, "info": 2}
@@ -739,6 +812,21 @@ def main(argv: "list[str] | None" = None) -> int:
     argv = list(sys.argv[1:] if argv is None else argv)
     as_json = "--json" in argv
     argv = [a for a in argv if a != "--json"]
+    # CI gating (ISSUE 15 satellite): --fail-on SEVERITY exits 1 when
+    # any finding at or above that severity fired — pair with --json so
+    # a pipeline both consumes the findings and gates on them
+    fail_on = None
+    if "--fail-on" in argv:
+        i = argv.index("--fail-on")
+        try:
+            fail_on = argv[i + 1]
+        except IndexError:
+            fail_on = ""
+        if fail_on not in _SEV_ORDER:
+            print(f"--fail-on wants one of {sorted(_SEV_ORDER)}, got "
+                  f"{fail_on!r}", file=sys.stderr)
+            return 2
+        del argv[i:i + 2]
     rank_names = None
     if "--rank-names" in argv:
         i = argv.index("--rank-names")
@@ -752,7 +840,8 @@ def main(argv: "list[str] | None" = None) -> int:
     roots = [a for a in argv if not a.startswith("-")]
     if not roots:
         print("usage: python -m paddlebox_tpu.monitor.doctor "
-              "<telemetry_dir>... [--json] [--rank-names 4,5,7]",
+              "<telemetry_dir>... [--json] [--rank-names 4,5,7] "
+              "[--fail-on critical|warn|info]",
               file=sys.stderr)
         return 2
     from paddlebox_tpu.monitor import aggregate as agg_lib
@@ -765,17 +854,38 @@ def main(argv: "list[str] | None" = None) -> int:
     if not any(r["events"] for r in world["ranks"]):
         print(f"doctor: no events found under {roots}", file=sys.stderr)
         return 2
+    # span-level cross-rank evidence: when the streams carry world-trace
+    # records, the merged flow edges feed the cross-rank-flow rule (a
+    # stream without them is that rule's no-data, never an error)
+    detail = None
+    try:
+        from paddlebox_tpu.monitor import trace as trace_lib
+        summary = trace_lib.summarize(
+            agg_lib.merge_world_trace(roots, rank_names=rank_names))
+        # flight records alone render as pass slices but carry no trace
+        # plane — only real span/flow records mean tracing was on
+        if summary.get("span_records") or summary.get("flow_points"):
+            detail = {"world_trace": summary}
+    except (OSError, ValueError):
+        detail = None
     report = diagnose(flights=world["flight_records"],
                       counters=world["counters"],
                       evidence=world["evidence"],
                       world=world if len(roots) > 1 else None,
+                      detail=detail,
                       inputs=roots)
+    if detail:
+        report["world_trace"] = detail["world_trace"]
     errs = validate_report(report)
     if errs:                      # the contract guards itself
         print(f"doctor: internal schema errors: {errs}", file=sys.stderr)
         return 2
     print(json.dumps(report, default=str) if as_json
           else render_text(report), flush=True)
+    if fail_on is not None and any(
+            _SEV_ORDER.get(f["severity"], 9) <= _SEV_ORDER[fail_on]
+            for f in report["findings"]):
+        return 1
     return 0
 
 

@@ -45,6 +45,29 @@ def validate_event(rec: dict) -> list[str]:
         v = rec.get(k)
         if v is not None and not isinstance(v, numbers.Integral):
             errs.append(f"{k} is neither null nor an integer")
+    # world-trace context (monitor/trace.py): OPTIONAL — records emitted
+    # outside a traced pass carry none of it — but when present the ids
+    # are flat strings (the merger and any downstream OTel bridge key
+    # off them verbatim)
+    for k in ("trace_id", "span_id", "parent_span_id"):
+        v = rec.get(k)
+        if v is not None and not isinstance(v, str):
+            errs.append(f"{k} is neither null nor a string")
+    if rec.get("name") == "trace.flow":
+        f = rec.get("fields") or {}
+        for k in ("kind", "key", "role"):
+            if not isinstance(f.get(k), str):
+                errs.append(f"trace.flow fields[{k!r}] is not a string")
+    if rec.get("name") == "trace.clock_probe":
+        f = rec.get("fields") or {}
+        for k in ("peer", "observer"):
+            if not isinstance(f.get(k), numbers.Integral):
+                errs.append(
+                    f"trace.clock_probe fields[{k!r}] is not an integer")
+        for k in ("offset_s", "rtt_s"):
+            if not isinstance(f.get(k), numbers.Real):
+                errs.append(
+                    f"trace.clock_probe fields[{k!r}] is not a number")
     return errs
 
 

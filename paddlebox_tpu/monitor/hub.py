@@ -38,6 +38,7 @@ from paddlebox_tpu.monitor.registry import STATS
 from paddlebox_tpu.monitor.sinks import Sink  # noqa: F401  (re-export)
 
 _prof = None
+_trace = None
 
 
 def _profiler():
@@ -50,11 +51,27 @@ def _profiler():
     return _prof
 
 
+def _tracer():
+    """Lazy handle on monitor.trace (the world-trace layer): keeps the
+    monitor package import-light AND lets ``python -m
+    paddlebox_tpu.monitor.trace`` run as __main__ without the runpy
+    double-import. Touched only on the hub's enabled paths."""
+    global _trace
+    if _trace is None:
+        from paddlebox_tpu.monitor import trace as t
+        _trace = t
+    return _trace
+
+
 class _Span:
     """Timed scope: chrome-trace span (when the profiler is on) + hub span
-    event (when the hub is on). Disabled cost: two module-global checks."""
+    event (when the hub is on). Disabled cost: two module-global checks
+    (a third — ``trace._ACTIVE`` — only on the already-enabled path).
+    Inside a traced pass the scope additionally pushes a span id onto
+    the trace stack, so the committed record carries its own
+    ``span_id`` + ``parent_span_id`` (the world-trace parent links)."""
 
-    __slots__ = ("_hub", "_name", "_fields", "_t0")
+    __slots__ = ("_hub", "_name", "_fields", "_t0", "_trace")
 
     def __init__(self, hub, name, fields):
         self._hub = hub
@@ -64,8 +81,12 @@ class _Span:
     def __enter__(self):
         if self._hub._enabled or _profiler()._enabled:
             self._t0 = time.perf_counter()
+            tr = _tracer()
+            self._trace = (tr.push_span(self._name)
+                           if tr._ACTIVE else None)
         else:
             self._t0 = None
+            self._trace = None
         return self
 
     def __exit__(self, *exc):
@@ -73,6 +94,8 @@ class _Span:
         if t0 is None:
             return False
         t1 = time.perf_counter()
+        tr = self._trace
+        ids = _tracer().pop_span(tr) if tr is not None else None
         prof = _profiler()
         if prof._enabled:
             prof.record_span(self._name, t0, t1)
@@ -80,6 +103,8 @@ class _Span:
         if h._enabled:
             rec = h._record("span", self._name, self._fields)
             rec["dur_s"] = t1 - t0
+            if ids is not None:
+                rec["span_id"], rec["parent_span_id"] = ids
             h._dispatch(rec)
         return False
 
@@ -193,6 +218,11 @@ class TelemetryHub:
         rec = {"ts": time.time(), "type": type_, "name": name,
                "pass_id": c.pass_id, "step": c.step, "phase": c.phase,
                "thread": threading.current_thread().name}
+        tr = _tracer()
+        if tr._ACTIVE:                # world trace: one check when off
+            tid, enclosing = tr.current_ids()
+            rec["trace_id"] = tid
+            rec["parent_span_id"] = enclosing
         if fields:
             rec["fields"] = fields
         return rec
@@ -242,6 +272,10 @@ class TelemetryHub:
         handle = context.enter_pass(pass_id, phase)
         self._pass = _OpenPass(handle, STATS.snapshot(), owner)
         self._auto_pass_id = max(self._auto_pass_id, int(pass_id))
+        # world trace: sampling decision + pass-root span + (optional)
+        # device-capture window — BEFORE the pass_begin event so it is
+        # the first stamped record of a traced pass
+        _tracer().on_begin_pass(int(pass_id), self._enabled)
         if self._enabled:
             self.event("pass_begin", type="lifecycle", owner=owner)
         _profiler().record_instant("pass_begin", {"pass_id": int(pass_id)})
@@ -322,6 +356,11 @@ class TelemetryHub:
             "metrics": msnap,
             "owner": p.owner,
         })
+        if _tracer()._ACTIVE:
+            # the flight record IS the pass-root span of the world
+            # trace (the merger renders it as the per-rank pass slice)
+            rec["span_id"] = _tracer().pass_root_id()
+            rec["parent_span_id"] = None
         merged = dict(p.extra)
         merged.update(extra)
         # the accumulated boundary account wins over anything a caller
@@ -350,7 +389,8 @@ class TelemetryHub:
         except Exception:
             STATS.add("doctor.errors", 1)
         _profiler().record_instant("pass_end", {"pass_id": c.pass_id})
-        context.exit_pass(p.handle)
+        _tracer().on_end_pass()       # close the trace window + device
+        context.exit_pass(p.handle)   # capture (no-op when untraced)
         return rec
 
     def abort_pass(self, reason: str = "") -> None:
@@ -362,6 +402,7 @@ class TelemetryHub:
         if self._enabled:
             self.event("pass_aborted", type="lifecycle",
                        reason=str(reason)[:200])
+        _tracer().on_end_pass()
         context.exit_pass(p.handle)
 
     def flight_records(self) -> list[dict]:
@@ -383,14 +424,46 @@ class TelemetryHub:
                       "serving.publish_failures")
     ALERT_GAUGES = ("tiering.hot_rows",)
 
+    # sink-health exposition (ISSUE 15 satellite): the derived gauges a
+    # scrape target alarms on — a wedged/detached JsonlSink must read as
+    # exactly that instead of as a mysteriously short event stream.
+    # Always present (zero-filled), like the doctor's alert series.
+    SINK_GAUGES = ("monitor.sinks_attached", "monitor.sinks_unhealthy",
+                   "monitor.sinks_detached_now", "monitor.sinks_closed",
+                   "monitor.sink_dropped_events",
+                   "monitor.sink_latched_errors")
+
+    def _sink_gauges(self) -> dict:
+        health = self.sink_health()
+        by_state: dict[str, int] = {"attached": 0, "detached": 0,
+                                    "closed": 0}
+        for s in health:
+            by_state[s["state"]] = by_state.get(s["state"], 0) + 1
+        return {
+            "monitor.sinks_attached": by_state["attached"],
+            "monitor.sinks_unhealthy": sum(
+                1 for s in health
+                if s.get("dropped") or s.get("error")
+                or s["state"] == "detached"),
+            "monitor.sinks_detached_now": by_state["detached"],
+            "monitor.sinks_closed": by_state["closed"],
+            "monitor.sink_dropped_events": sum(
+                s.get("dropped", 0) for s in health),
+            "monitor.sink_latched_errors": sum(
+                1 for s in health if s.get("error")),
+        }
+
     def prometheus_text(self, prefix: str = "pbtpu") -> str:
         """Prometheus text exposition of the counter/gauge registry (names
         sanitized to the metric charset; gauges are the names set through
         :meth:`gauge_set`, everything else a counter). The doctor's alert
-        series (ALERT_COUNTERS/ALERT_GAUGES) are always present, and the
+        series (ALERT_COUNTERS/ALERT_GAUGES) are always present, the
         derived ``tiering.hot_hit_rate`` gauge — RAM-tier hits over total
         reads — is computed here so the same signal the spill rules
-        diagnose on is directly scrapeable."""
+        diagnose on is directly scrapeable, and the per-session sink
+        health (:meth:`sink_health`) exports as the ``monitor.sinks_*``
+        gauges so a wedged JSONL sink ALARMS instead of silently
+        dropping events."""
         snap = STATS.snapshot()
         gauges = set(self._gauges) | set(self.ALERT_GAUGES)
         for k in self.ALERT_COUNTERS + self.ALERT_GAUGES:
@@ -400,6 +473,9 @@ class TelemetryHub:
         snap["tiering.hot_hit_rate"] = (
             snap.get("spill.cache_hits", 0.0) / seen if seen else 0.0)
         gauges.add("tiering.hot_hit_rate")
+        for k, v in self._sink_gauges().items():
+            snap[k] = float(v)
+            gauges.add(k)
         out: list[str] = []
         for k in sorted(snap):
             n = prefix + "_" + re.sub(r"[^a-zA-Z0-9_:]", "_", k)

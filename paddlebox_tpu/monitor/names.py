@@ -1,0 +1,105 @@
+"""Closed registry of hub event and span names.
+
+Same discipline as the faultpoint registries (``utils/faultpoint.py``)
+and the flag registry (``config.py``): the set of names the telemetry
+plane can emit is CLOSED, machine-checked, and therefore greppable. A
+dashboard, a doctor rule, or the world-trace merger keying off
+``"serving_swap"`` must be able to trust that a renamed or typo'd
+emission site cannot silently fork the namespace — the pblint
+``event-registry`` rule fails the tree when a literal
+``monitor.event("...")`` / ``monitor.span("...")`` site names something
+not listed here.
+
+Adding a name is one line here plus the consumer that reads it (the
+doctor's EVIDENCE_EVENTS, a dashboard panel, a test) — the registry is
+where a reviewer sees the telemetry surface grow.
+"""
+
+from __future__ import annotations
+
+# event names (monitor.event / hub.event emissions across the tree)
+EVENT_NAMES: tuple[str, ...] = (
+    # pass lifecycle (hub / boxps)
+    "pass_begin",
+    "pass_aborted",
+    "flip_phase",
+    "eval_pass",
+    # trainer hot loop + guards
+    "pack_producer_done",
+    "nan_guard",
+    "routed_dropped",
+    "exchange_overflow",
+    "exchange_overflow_retry",
+    "drain_snapshot",
+    "drain_snapshot_skipped",
+    "elastic_min_world_exit",
+    # feed pass (embedding/feed_pass.py)
+    "feed_pass_staged",
+    "feed_pass_flush",
+    # data plane
+    "reader_malformed_line",
+    "reader_close_error",
+    # resilience (distributed/resilience.py)
+    "peer_lost",
+    "peer_stalled",
+    "resume_election",
+    "reform_escalated",
+    "reform_sealed",
+    "world_resize",
+    # serving (publisher + server + boxps degrade arm)
+    "serving_publish",
+    "serving_publish_failed",
+    "serving_compaction_error",
+    "serving_donefile_compacted",
+    "serving_artifact_prune_error",
+    "serving_swap",
+    "serving_version_fallback",
+    # fleet / donefile discipline
+    "donefile_compacted",
+    "donefile_repaired",
+    "donefile_malformed_line",
+    "fleet_base_fetch_fallback",
+    # checkpoints (utils/pass_ckpt.py)
+    "checkpoint_save",
+    "checkpoint_resume",
+    "checkpoint_remote_upload",
+    "checkpoint_remote_download",
+    "checkpoint_remote_fallback",
+    "checkpoint_torn_fallback",
+    "checkpoint_timeline_reset",
+    # fs / faultpoints / dumps
+    "fs_exhausted",
+    "faultpoint_armed",
+    "faultpoint_trip",
+    "dump_fields_written",
+    # doctor live mode
+    "doctor.finding",
+    # sink bookkeeping (JsonlSink meta lines — emitted via the writer
+    # thread's _meta, read back by monitor/aggregate.py)
+    "sink_rotated",
+    "sink_dropped",
+    # world trace (monitor/trace.py)
+    "trace.flow",
+    "trace.clock_probe",
+    "trace.device_capture",
+)
+
+# span names (monitor.span scopes + the StageTimers "stage/<name>"
+# emissions — the trainer's emit_stages set)
+SPAN_NAMES: tuple[str, ...] = (
+    "pack_batch",
+    "train_step",
+    "auc_update",
+    "push_apply",
+    "h2d_stage",
+    "publish",
+    "stage/read",
+    "stage/translate",
+    "stage/drain",
+)
+
+ALL_NAMES: frozenset = frozenset(EVENT_NAMES) | frozenset(SPAN_NAMES)
+
+
+def is_registered(name: str) -> bool:
+    return name in ALL_NAMES

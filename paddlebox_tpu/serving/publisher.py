@@ -41,6 +41,7 @@ from typing import Any
 import numpy as np
 
 from paddlebox_tpu import monitor
+from paddlebox_tpu.monitor import trace as trace_lib
 from paddlebox_tpu.embedding.gating import GateSpec
 from paddlebox_tpu.fleet.fleet_util import FleetUtil
 from paddlebox_tpu.inference import export as export_lib
@@ -128,7 +129,16 @@ class ServingPublisher:
         """Snapshot ``store``'s pull plane + ``dense_params`` into the next
         version (a full base every ``publish_base_every`` publishes, a
         key-delta otherwise), verify it, announce it. Returns the publish
-        info dict ({version, kind, path, seconds, bytes, …})."""
+        info dict ({version, kind, path, seconds, bytes, …}).
+
+        Runs inside a ``publish`` telemetry span; in a traced pass
+        (flags.trace) the span's trace context is stamped into the
+        donefile entry and a ``publish`` flow point anchors the
+        publish → serving-swap edge of the merged world trace."""
+        with monitor.span("publish"):
+            return self._publish_impl(store, dense_params, pass_id)
+
+    def _publish_impl(self, store, dense_params, pass_id: int) -> dict:
         t0 = time.perf_counter()
         # export_serving runs the store's flush hooks first: pending
         # deferred pushes + lazily-retained device rows land before the
@@ -192,8 +202,16 @@ class ServingPublisher:
         faultpoint.hit("serving.publish.pre_donefile")
         entry = {"version": version, "pass": int(pass_id), "kind": kind,
                  "parent": parent, "path": target, "ts": int(time.time())}
+        if trace_lib.active():
+            # cross-process trace propagation: the serving side links
+            # its swap span back to THIS publish span through the
+            # donefile entry (the only channel the two processes share)
+            tid, sid = trace_lib.current_ids()
+            entry["trace"] = {"trace_id": tid, "span_id": sid}
         announced = self._fleet.append_donefile(DONEFILE, entry,
                                                 dedup=("version", "path"))
+        trace_lib.flow("publish", f"v{version}", role="src",
+                       kind_published=kind, pass_id=int(pass_id))
         if announced:
             self._entry_count += 1
         if (is_base and self.compact_after > 0

@@ -45,6 +45,7 @@ import numpy as np
 from paddlebox_tpu import monitor
 from paddlebox_tpu.embedding.gating import GateSpec
 from paddlebox_tpu.monitor import context as mon_ctx
+from paddlebox_tpu.monitor import trace as trace_lib
 from paddlebox_tpu.embedding.replica_cache import ReplicaCache
 from paddlebox_tpu.fleet.fleet_util import FleetUtil
 from paddlebox_tpu.inference import export as export_lib
@@ -144,6 +145,7 @@ class ServingServer:
         version newer than the active one IN ORDER, swap each in. Returns
         the number of versions applied. Never raises on a bad version —
         it diagnoses, skips, and keeps the last good model serving."""
+        trace_lib.ensure_service("serving")   # driver-polled servers too
         entries = self._fleet._entries(DONEFILE)
         if entries:
             self._latest_announced = entries[-1]
@@ -225,6 +227,18 @@ class ServingServer:
             active_v = version
             monitor.counter_add("serving.swaps")
             monitor.gauge_set("serving.active_version", version)
+            # world trace: the swap is the dst of the publish flow edge
+            # — keyed by version (both sides derive it independently),
+            # ACTIVATED by the trace context the donefile entry carries
+            # (cross-process propagation: the producing run traced this
+            # version, so the swap point emits even when this process
+            # has no local trace scope) with the publisher's span ids
+            # as the explicit parent link
+            parent_trace = e.get("trace") if isinstance(
+                e.get("trace"), dict) else None
+            trace_lib.flow_propagated(
+                "publish", f"v{version}", "dst", parent_trace,
+                swap_pause_ms=round(pause_ms, 3))
             monitor.event("serving_swap", type="lifecycle",
                           version=version, kind=kind,
                           pass_id=model.pass_id,
@@ -460,6 +474,10 @@ class ServingServer:
         is to keep serving what it has."""
         if self._thread is not None:
             return self
+        # pass-less process: with flags.trace on, open a standing trace
+        # scope so swap records/flow points are stamped and mergeable
+        # against the training ranks' streams
+        trace_lib.ensure_service("serving")
         self._stop.clear()
 
         def _run():

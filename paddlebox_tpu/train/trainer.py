@@ -43,6 +43,7 @@ from paddlebox_tpu.train import optimizers
 from paddlebox_tpu.parallel import mesh as mesh_lib
 from paddlebox_tpu import monitor
 from paddlebox_tpu.monitor import context as mon_ctx
+from paddlebox_tpu.monitor import trace as mon_trace
 from paddlebox_tpu.monitor.timers import StageTimers
 from paddlebox_tpu.utils import faultpoint
 from paddlebox_tpu.utils.profiler import DumpStream, dump_tree, find_nonfinite
@@ -1427,6 +1428,19 @@ class Trainer:
                 faultpoint.hit("trainer.step.pre")
                 with monitor.span("pack_batch"):
                     idx, mask, dense, labels, *plan = staged
+                if mon_trace._ACTIVE and self.table_layout == "sharded":
+                    # world-trace flow point for this step's all_to_all:
+                    # every rank stamps the SAME deterministic key (all
+                    # ranks run the step in lockstep), so the merger can
+                    # draw the cross-rank exchange edge without a single
+                    # byte of trace context crossing the wire
+                    mon_trace.flow(
+                        "exchange",
+                        f"p{mon_ctx.current().pass_id}"
+                        f".s{self.global_step}",
+                        **exchange.flow_fields(self.store.cfg,
+                                               self.exchange_wire,
+                                               int(idx.size)))
                 with self.timers("train"), monitor.span("train_step"):
                     if stacked:
                         out = self._superstep_fn(table, *dstate, *staged)

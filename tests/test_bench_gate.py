@@ -198,3 +198,7 @@ def test_bench_dryrun_smoke():
     assert out["checks"]["spill_fields"], out.get("spill")
     assert out["spill"]["hot_hit_rate"] > out["spill"]["direct_hot_hit_rate"]
     assert out["spill"]["fetch_keys_per_s"] > 0
+    # the world-trace embed (ISSUE 15): a traced probe pass merged into
+    # a Chrome-trace summary with a publish flow edge, and the span-
+    # level data reached the doctor's cross-rank-flow rule
+    assert out["checks"]["trace_embedded"]

@@ -155,6 +155,26 @@ RULE_FIXTURES: dict = {
         dict(sink_health=[{"type": "JsonlSink", "state": "attached",
                            "strikes": 0, "dropped": 0, "written": 99}]),
     ),
+    "cross-rank-flow": (
+        # longest edge 4s against a 10s mean pass wall = 40% — fired;
+        # quiet: the same edge at 0.1s (1%)
+        dict(flights=[make_flight(1, seconds=10.0)],
+             detail={"world_trace": {
+                 "flow_edges": [
+                     {"kind": "exchange", "key": "p1.s3",
+                      "src_rank": 0, "dst_rank": 1, "latency_s": 4.0,
+                      "fields": {"wire": "bf16"}},
+                     {"kind": "publish", "key": "v7", "src_rank": 0,
+                      "dst_rank": 2, "latency_s": 0.5, "fields": {}}],
+                 "clock_offsets_s": {"0": 0.0, "1": 1.25}}}),
+        dict(flights=[make_flight(1, seconds=10.0)],
+             detail={"world_trace": {
+                 "flow_edges": [
+                     {"kind": "exchange", "key": "p1.s3",
+                      "src_rank": 0, "dst_rank": 1, "latency_s": 0.1,
+                      "fields": {}}],
+                 "clock_offsets_s": {"0": 0.0, "1": 0.0}}}),
+    ),
 }
 
 

@@ -358,6 +358,40 @@ def test_trainer_sharded_engine_end_to_end(mesh2, sharded_flags):
     assert np.isfinite(ev["auc"])
 
 
+def test_trainer_sharded_emits_exchange_flow_points(mesh2, sharded_flags):
+    """World trace (ISSUE 15): a traced pass on the sharded engine
+    stamps one deterministic exchange flow point per step — the
+    cross-rank edge anchor — with the wire identity riding along."""
+    from paddlebox_tpu.config import flags
+    from paddlebox_tpu.monitor import flight
+    ds, schema = _dataset(4 * 32)
+    tr = _trainer(schema, mesh2)
+    h = monitor.hub()
+    h.disable()
+    ms = monitor.MemorySink()
+    prev = flags.trace
+    flags.trace = True
+    h.enable(ms)
+    try:
+        out = tr.train_pass(ds)
+    finally:
+        h.disable()
+        flags.trace = prev
+    flows = [r for r in ms.records if r.get("name") == "trace.flow"]
+    ex = [r for r in flows
+          if (r.get("fields") or {}).get("kind") == "exchange"]
+    assert len(ex) == out["steps"]
+    keys = [(r["fields"]["key"]) for r in ex]
+    assert len(set(keys)) == len(keys)        # one per step, distinct
+    assert all(k.startswith("p") and ".s" in k for k in keys)
+    for r in ex:
+        assert r["fields"]["wire"] == "f32"
+        assert r["fields"]["tokens"] == 32 * 4
+        assert r["fields"]["bytes_bound"] > 0
+        assert r["trace_id"]                  # stamped, mergeable
+        assert flight.validate_event(r) == []
+
+
 def test_trainer_sharded_matches_single_shard_loss(mesh2, sharded_flags):
     """Same data through the 2-shard exchange engine and a single-shard
     trainer: losses agree to float tolerance (dense pmean over 2 devices

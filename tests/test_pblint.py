@@ -420,6 +420,65 @@ def test_silent_except_rule(tmp_path):
 
 
 # ---------------------------------------------------------------------------
+# event-registry
+# ---------------------------------------------------------------------------
+
+EVENT_REGISTRY = '''
+EVENT_NAMES: tuple = (
+    "registered_event",
+)
+
+SPAN_NAMES: tuple = (
+    "registered_span",
+)
+'''
+
+EVENT_SRC = '''
+from paddlebox_tpu import monitor
+from paddlebox_tpu.monitor import event as mon_event
+
+
+def f(hub, name):
+    monitor.event("registered_event", x=1)       # fine
+    mon_event("registered_event")                # aliased import: fine
+    with monitor.span("registered_span"):        # fine
+        pass
+    monitor.event("rogue_event")                 # VIOLATION
+    hub.event("rogue_hub_event")                 # VIOLATION (method call)
+    with monitor.span("rogue_span"):             # VIOLATION
+        pass
+    monitor.event(name)                          # VIOLATION: non-literal
+    # pblint: disable=event-registry -- name iterates registered
+    # literals in the caller
+    monitor.event(name, y=2)
+'''
+
+
+def test_event_registry_rule(tmp_path):
+    proj = make_project(tmp_path, {
+        "paddlebox_tpu/monitor/__init__.py": "",
+        "paddlebox_tpu/monitor/names.py": EVENT_REGISTRY,
+        "paddlebox_tpu/mod.py": EVENT_SRC})
+    res = run_lint(proj)
+    hits = by_rule(res, "event-registry")
+    assert len(hits) == 4
+    msgs = " ".join(f.message for f in hits)
+    for rogue in ("rogue_event", "rogue_hub_event", "rogue_span"):
+        assert rogue in msgs
+    assert sum("not a string literal" in f.message for f in hits) == 1
+    assert "registered_event" not in msgs
+    assert any(f.rule == "event-registry" and "iterates" in r
+               for f, r in res.waived)
+
+
+def test_event_registry_silent_without_registry(tmp_path):
+    # a project without monitor/names.py has no event namespace contract
+    # — the rule must not invent one
+    proj = make_project(tmp_path, {"paddlebox_tpu/mod.py": EVENT_SRC})
+    assert by_rule(run_lint(proj), "event-registry") == []
+
+
+# ---------------------------------------------------------------------------
 # waiver grammar
 # ---------------------------------------------------------------------------
 
