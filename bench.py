@@ -1313,6 +1313,115 @@ def boundary_drill(small: bool, tiny: bool = False) -> dict:
     }
 
 
+def adaptive_wire_drill(small: bool, tiny: bool = False) -> dict:
+    """Drifting-sparsity adaptive-wire drill (ISSUE 16): the REAL
+    trainer on a 2-shard mesh with flags.exchange_adaptive on, fed a
+    key stream whose duplication depth drifts across the wire regimes —
+    duplication-heavy passes (tiny key pool: the merged f32 sum
+    amortizes over many contributions) then unique-heavy passes (wide
+    pool: the wire bytes dominate and the narrow wire wins). The
+    controller must flip the wire within the hysteresis bound, and the
+    pass-summed modeled wire cost of the ADAPTIVE run must be <= every
+    fixed wire's cost on the same stream (``adaptive_best`` — the
+    deterministic gate; real-chip wall-clock wire A/B stays queued for
+    the consolidated chip round). Throughput rides along gate-held like
+    the other sharded points."""
+    import time as _t
+    from paddlebox_tpu import monitor
+    from paddlebox_tpu.config import flags as config_flags
+    from paddlebox_tpu.data import DataFeedSchema, SlotDataset
+    from paddlebox_tpu.embedding import (EmbeddingConfig,
+                                         HostEmbeddingStore, exchange)
+    from paddlebox_tpu.models import DeepFMModel
+    from paddlebox_tpu.parallel import make_mesh
+    from paddlebox_tpu.train import Trainer, TrainerConfig
+
+    bs = 64
+    steps = 2 if tiny else (4 if small else 8)
+    num_slots = 4
+    schema = DataFeedSchema.ctr(num_sparse=num_slots, num_float=1,
+                                batch_size=bs, max_len=1)
+    dense = [s for s in schema.float_slots if s.name != "label"]
+    # The drift: duplication-heavy passes draw from a single hot key per
+    # slot (merge depth ~32, deep in the f32 regime — the per-lane lane
+    # cost amortizes over dozens of duplicates) and carry 6x the
+    # traffic — the busy head of a stream, where the exact wide wire
+    # wins outright; the tail's unique-heavy passes (pool 16x the
+    # stream, depth ~1) are bytes-bound, where the narrow wire wins.
+    # 4 heavy + 5 light passes: the hysteresis window (2 suboptimal
+    # passes after the drift) must cost less than a pinned wire loses
+    # across the other seven.
+    phases = ["dup"] * 4 + ["uni"] * 5
+
+    def pass_dataset(kind, seed):
+        n_ex = bs * steps * (6 if kind == "dup" else 1)
+        space = 1 if kind == "dup" else 16 * n_ex
+        ds = SlotDataset(schema)
+        ds.records = _synth_pass(schema, n_ex, num_slots, dense, space,
+                                 seed=seed)
+        return ds
+
+    def build_trainer():
+        store = HostEmbeddingStore(EmbeddingConfig(
+            dim=8, optimizer="adagrad", learning_rate=0.05))
+        return Trainer(DeepFMModel(num_slots=num_slots, emb_dim=8,
+                                   dense_dim=1, hidden=(16,)),
+                       store, schema, make_mesh(2),
+                       TrainerConfig(global_batch_size=bs)), store
+
+    saved = (config_flags.table_layout, config_flags.exchange_wire,
+             config_flags.exchange_adaptive)
+    try:
+        config_flags.table_layout = "sharded"
+        config_flags.exchange_wire = "f32"
+        config_flags.exchange_adaptive = True
+        tr, store = build_trainer()
+        cfg = store.cfg
+        per_pass = []
+        total = {w: 0.0 for w in exchange.WIRES}
+        adaptive_cost = 0.0
+        examples = 0
+        t0 = _t.perf_counter()
+        for i, kind in enumerate(phases):
+            active = tr.exchange_wire
+            snap0 = monitor.STATS.snapshot()
+            out = tr.train_pass(pass_dataset(kind, seed=100 + i))
+            snap = monitor.STATS.snapshot()
+            toks = int(snap.get("exchange.tokens", 0)
+                       - snap0.get("exchange.tokens", 0))
+            uniq = int(snap.get("exchange.unique_lanes", 0)
+                       - snap0.get("exchange.unique_lanes", 0))
+            examples += out["steps"] * bs
+            adaptive_cost += exchange.wire_cost(cfg, toks, uniq, active)
+            for w in exchange.WIRES:
+                total[w] += exchange.wire_cost(cfg, toks, uniq, w)
+            per_pass.append({"kind": kind, "wire": active,
+                             "tokens": toks, "unique": uniq})
+        seconds = _t.perf_counter() - t0
+        switches = tr._wire_controller.switches
+        hysteresis = tr._wire_controller.hysteresis
+    finally:
+        (config_flags.table_layout, config_flags.exchange_wire,
+         config_flags.exchange_adaptive) = saved
+    wire_path = [p["wire"] for p in per_pass]
+    return {
+        "examples_per_sec_per_chip": round(
+            examples / max(seconds, 1e-9) / 2, 1),
+        "passes": per_pass,
+        "wire_path": wire_path,
+        "switches": int(switches),
+        "hysteresis": int(hysteresis),
+        # the gate: summed modeled cost, adaptive vs each fixed wire
+        "adaptive_cost": round(adaptive_cost, 1),
+        "fixed_costs": {w: round(c, 1) for w, c in total.items()},
+        "adaptive_best": bool(
+            switches >= 1
+            and all(adaptive_cost <= c + 1e-6 for c in total.values())),
+        "table_shards": 2,
+        "simulated": True,
+    }
+
+
 def _run_sharded_probe(small: bool, tiny: bool = False) -> dict:
     """Run the sharded-exchange matrix points in a 2-virtual-device CPU
     subprocess (``--sharded-probe``): a single-device environment cannot
@@ -1353,7 +1462,8 @@ def sharded_probe_main() -> int:
     out: dict = {"simulated": True, "devices": len(jax.devices()),
                  "points": {}}
     for mname, w in (("sharded_wire_f32", "f32"),
-                     ("sharded_wire_bf16", "bf16")):
+                     ("sharded_wire_bf16", "bf16"),
+                     ("sharded_wire_int8", "int8")):
         snap0 = monitor.STATS.snapshot()
         try:
             eps, detail = device_step_bench(
@@ -1376,6 +1486,15 @@ def sharded_probe_main() -> int:
             }
         except Exception as e:
             out["points"][mname] = {"error": repr(e)}
+    # the drifting-sparsity adaptive point: same 2-device mesh, but the
+    # wire is the CONTROLLER's to pick — the point is the proof that
+    # per-pass re-costing beats every pinned wire on a stream whose
+    # dedup depth drifts (the fixed points above are its baselines)
+    try:
+        out["points"]["adaptive_wire"] = adaptive_wire_drill(
+            small, tiny=tiny)
+    except Exception as e:
+        out["points"]["adaptive_wire"] = {"error": repr(e)}
     print(json.dumps(out), flush=True)
     return 0
 
@@ -1523,19 +1642,45 @@ def dryrun_main() -> int:
     sp = probe.get("points") or {}
     f32p = sp.get("sharded_wire_f32") or {}
     bfp = sp.get("sharded_wire_bf16") or {}
+    i8p = sp.get("sharded_wire_int8") or {}
     checks["sharded_fields"] = (
         f32p.get("table_layout") == "sharded"
         and f32p.get("exchange_wire") == "f32"
         and bfp.get("exchange_wire") == "bf16"
+        and i8p.get("exchange_wire") == "int8"
         and f32p.get("push_engine") in _pk_chk.PUSH_ENGINES
         and f32p.get("table_shards") == 2
         and isinstance(f32p.get("examples_per_sec_per_chip"),
                        (int, float))
         and isinstance(bfp.get("examples_per_sec_per_chip"),
                        (int, float))
+        and isinstance(i8p.get("examples_per_sec_per_chip"),
+                       (int, float))
         and (f32p.get("dedup_ratio") or 0) > 0
         and "table_layout" in detail and "exchange_wire" in detail
         and "table_shards" in detail)
+    # the adaptive point's CONTRACT (ISSUE 16): on the drifting-sparsity
+    # stream the controller must actually flip (within its hysteresis
+    # bound of the drift pass) and land a modeled wire cost no worse
+    # than EVERY fixed wire — adaptive that loses to a pinned wire is a
+    # regression, not a feature
+    from paddlebox_tpu.embedding import exchange as _exch_chk
+    adp = sp.get("adaptive_wire") or {}
+    wpath = adp.get("wire_path") or []
+    n_dup = sum(1 for k in (adp.get("passes") or [])
+                if k.get("kind") == "dup")
+    checks["adaptive_wire_fields"] = (
+        adp.get("adaptive_best") is True
+        and adp.get("switches", 0) >= 1
+        and isinstance(adp.get("adaptive_cost"), (int, float))
+        and set(adp.get("fixed_costs") or {}) == set(_exch_chk.WIRES)
+        and len(wpath) == len(adp.get("passes") or ())
+        # the flip lands within hysteresis passes of the dup->uni drift
+        and 0 < n_dup < len(wpath)
+        and wpath[:n_dup] == ["f32"] * n_dup
+        and all(w == wpath[-1] for w in
+                wpath[n_dup + adp.get("hysteresis", 2):])
+        and wpath[-1] != "f32")
     g_lat = apply_regression_gate(
         {"serving.p99_ms": 10.0},
         {"device_kind": None, "metrics": {"serving.p99_ms": 5.0}}, "")
@@ -1976,7 +2121,8 @@ def _enrich(small: bool, detail: dict, ctx: dict,
                 from paddlebox_tpu.config import flags as config_flags
                 try:
                     for mname, w in (("sharded_wire_f32", "f32"),
-                                     ("sharded_wire_bf16", "bf16")):
+                                     ("sharded_wire_bf16", "bf16"),
+                                     ("sharded_wire_int8", "int8")):
                         try:
                             s_eps, s_detail = device_step_bench(
                                 small, n_steps=3 if small else 50,
@@ -2006,6 +2152,15 @@ def _enrich(small: bool, detail: dict, ctx: dict,
                         _startup_flag("table_layout")
                     config_flags.exchange_wire = \
                         _startup_flag("exchange_wire")
+                # drifting-sparsity adaptive point: the controller picks
+                # the wire per pass and must beat every fixed point
+                # above on its modeled cost (the drill saves/restores
+                # its own flags)
+                try:
+                    matrix["adaptive_wire"] = adaptive_wire_drill(small)
+                except Exception as e:
+                    matrix["adaptive_wire"] = {"error": repr(e)}
+                _mark("matrix point adaptive_wire done")
             else:
                 probe = _run_sharded_probe(small)
                 for mname, p in (probe.get("points") or {}).items():

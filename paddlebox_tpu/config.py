@@ -171,6 +171,30 @@ class Flags:
     # adaptively doubled for the next pass, and eval passes re-run
     # in place at the grown factor (exchange.eval.pre_retry).
     exchange_capacity_factor: float = 0.0   # (new)
+    # Per-pass wire adaptation (exchange.WireController): at every owned
+    # pass boundary the controller re-costs the f32/bf16/int8 wires from
+    # the pass's OWN exchange counters (tokens, unique lanes — the dedup
+    # depth that moves the crossover) plus any clock-corrected flow-edge
+    # attribution fed from a world trace, and switches flags.exchange_wire
+    # for the NEXT pass once a challenger wins `hysteresis` consecutive
+    # passes (a switch recompiles the steps, exactly like the adaptive
+    # capacity doubling). Decisions land in the flight-record extras
+    # (exchange_wire / exchange_wire_next) and the exchange_wire_adapted
+    # event. Parity guard holds on every wire: show/clk counters and the
+    # int8 scale always ride the f32 side plane — a wire switch is never
+    # a counter-precision change. Opt-in like spill_cache_autotune.
+    exchange_adaptive: bool = False         # (new)
+    # All_to_all decomposition for the sharded exchange push: "flat" =
+    # one global exchange (the PR-9 shape); "hier" = two-stage — an
+    # intra-host shuffle over the dp axis (f32, in-host bandwidth),
+    # then a host-level merge of the received runs so the inter-host
+    # leg over the node axis carries each host's UNIQUE lanes once,
+    # wire-compressed, instead of per-device duplicates (the two-stage
+    # array-redistribution decomposition). "auto" = hier exactly when
+    # the mesh has a real multi-host (node, dp) shape, flat elsewhere.
+    # Bit-identical to flat under exact arithmetic (f32 wire): the same
+    # per-row contributions sum in the same merged order.
+    exchange_topology: str = "auto"         # (new)
     # --- tiered table: SSD + host-RAM + HBM (embedding/tiering.py) ---
     # Storage tier of the host table (and of every shard of a
     # ShardedEmbeddingStore built through tiering.store_from_flags /

@@ -57,11 +57,15 @@ from paddlebox_tpu.embedding.config import EmbeddingConfig
 from paddlebox_tpu.embedding import sharded
 from paddlebox_tpu.embedding.sharded import (_axis_size, _capacity,
                                              _normalize_plan, _route,
-                                             dedup_tokens)
+                                             _route_owner, dedup_tokens,
+                                             merge_sorted_runs)
 
 # push-payload wire formats (the pull's embedx plane already crosses
 # quantized for quantized tables — sharded.routed_lookup)
 WIRES = ("f32", "bf16", "int8")
+
+# all_to_all decompositions for the push exchange
+TOPOLOGIES = ("flat", "hier")
 
 
 def select_wire(cfg: EmbeddingConfig) -> str:
@@ -79,6 +83,149 @@ def select_wire(cfg: EmbeddingConfig) -> str:
         raise ValueError(
             f"flags.exchange_wire={w!r} (want auto|f32|bf16|int8)")
     return w
+
+
+def select_topology(axis_sizes) -> str:
+    """Resolve flags.exchange_topology against the mesh shape
+    (trace-time static, recorded in the flight-record extras).
+
+    "hier" decomposes the push all_to_all into an intra-host shuffle
+    over the trailing (dp) axis followed by a host-merged inter-host
+    exchange over the leading (node) axis — it needs a real 2-axis
+    mesh. "auto" picks hier exactly when such a (node, dp) shape
+    exists (both axes > 1: a degenerate axis has nothing to merge
+    across or nothing to exchange between), flat elsewhere."""
+    t = config_flags.exchange_topology
+    if t not in ("auto",) + TOPOLOGIES:
+        raise ValueError(
+            f"flags.exchange_topology={t!r} (want auto|flat|hier)")
+    sizes = tuple(int(s) for s in axis_sizes)
+    if t == "hier":
+        if len(sizes) < 2:
+            raise ValueError(
+                "flags.exchange_topology='hier' needs a (node, dp) mesh; "
+                f"got axis sizes {sizes}")
+        return "hier"
+    if t == "auto" and len(sizes) >= 2 and all(s > 1 for s in sizes):
+        return "hier"
+    return "flat"
+
+
+# ---------------------------------------------------------------------------
+# per-pass wire selection (the adaptive controller)
+# ---------------------------------------------------------------------------
+
+# Modeled precision-exposure surcharge per merged token contribution, in
+# byte units per grad column: each duplicate of a row adds one ROUNDED
+# contribution to the cross-device sum (bf16: 8-bit mantissa on each
+# value; int8: 7-bit resolution of the per-lane max, worse when a lane's
+# columns spread in magnitude). f32 is exact — the parity baseline.
+_WIRE_EXPOSURE = {"f32": 0.0, "bf16": 0.25, "int8": 1.0}
+
+
+def wire_cost(cfg: EmbeddingConfig, tokens: int, unique_lanes: int,
+              wire: str) -> float:
+    """Modeled per-pass cost of a push wire, in byte units: the real
+    a2a bytes for the pass's unique lanes plus the precision-exposure
+    surcharge scaled by the token count (the number of rounded
+    contributions that merge). The dedup depth d = tokens/unique is the
+    regime knob: duplication-heavy passes amortize the wide exact wire
+    over many merged contributions (f32 wins past d ≈ 8), unique-heavy
+    passes are bytes-bound (bf16, then int8 once the grad plane dwarfs
+    the fixed index/side/scale columns)."""
+    u = max(1, int(unique_lanes))
+    t = max(int(tokens), u)
+    if wire not in WIRES:
+        raise ValueError(f"wire={wire!r} (want f32|bf16|int8)")
+    base = float(push_wire_bytes(cfg, u, wire))
+    return base + _WIRE_EXPOSURE[wire] * t * cfg.grad_width
+
+
+class WireController:
+    """Per-pass exchange_wire selection from the evidence the exchange
+    already emits (flags.exchange_adaptive, ROADMAP "self-adapting
+    exchange") — the collective-selection loop of the adaptive sparse
+    collectives line (arXiv:2607.04676) run at pass grain, the way
+    spill_cache_autotune adapts the cache budget.
+
+    ``observe`` is called once per owned pass with the pass's OWN
+    counter deltas (exchange.tokens / exchange.unique_lanes /
+    overflow retries) and, when a world trace has been attributed,
+    the clock-corrected flow-edge summary
+    (``critical_path.attribute_flow_edges``). It returns a decision
+    dict; the caller applies ``decision["wire"]`` to the NEXT pass
+    (a switch recompiles the steps — same contract as the adaptive
+    capacity doubling).
+
+    Stability rules (the no-flap guarantee):
+      - a challenger wire must win ``hysteresis`` CONSECUTIVE passes
+        before the switch; a different challenger resets the streak;
+      - overflow retries hold the wire (the capacity histogram is
+        shifting — the evidence is stale);
+      - a flow attribution that shows the exchange edge under
+        ``min_share`` of the wall holds the wire (not the limiter:
+        switching buys nothing and costs a recompile);
+      - cost ties break toward the ACTIVE wire, then the wider one.
+
+    The parity guard is structural, not a controller rule: show/clk
+    counter increments (and the int8 scale) ride the f32 side plane on
+    EVERY wire (``_compress_push``), so no decision can round a counter.
+    """
+
+    def __init__(self, cfg: EmbeddingConfig, wire: str,
+                 hysteresis: int = 2, min_share: float = 0.02):
+        self.cfg = cfg
+        self.wire = wire
+        self.hysteresis = max(1, int(hysteresis))
+        self.min_share = float(min_share)
+        self.switches = 0
+        self._challenger = None
+        self._streak = 0
+
+    def _hold(self, reason: str, costs=None) -> dict:
+        self._challenger, self._streak = None, 0
+        return {"wire": self.wire, "prev_wire": self.wire,
+                "switched": False, "candidate": None, "streak": 0,
+                "costs": costs or {}, "reason": reason}
+
+    def observe(self, tokens: int, unique_lanes: int,
+                overflow_retries: int = 0, flow: dict | None = None,
+                wall_seconds: float | None = None) -> dict:
+        if int(tokens) <= 0:
+            return self._hold("no-traffic")
+        if int(overflow_retries) > 0:
+            return self._hold("overflow-hold")
+        if flow and wall_seconds and flow.get("edges", 0) > 0:
+            ex = (flow.get("by_kind") or {}).get("exchange")
+            share = (float(ex["max_latency_s"]) / float(wall_seconds)
+                     if ex else 0.0)
+            if share < self.min_share:
+                return self._hold("not-limiter")
+        costs = {w: wire_cost(self.cfg, tokens, unique_lanes, w)
+                 for w in WIRES}
+        # tie-break: active wire first, then wider (WIRES is widest-first)
+        best = min(WIRES, key=lambda w: (costs[w], 0 if w == self.wire
+                                         else 1, WIRES.index(w)))
+        if best == self.wire:
+            self._challenger, self._streak = None, 0
+            return {"wire": self.wire, "prev_wire": self.wire,
+                    "switched": False, "candidate": None, "streak": 0,
+                    "costs": costs, "reason": "optimal"}
+        if best == self._challenger:
+            self._streak += 1
+        else:
+            self._challenger, self._streak = best, 1
+        if self._streak >= self.hysteresis:
+            prev, self.wire = self.wire, best
+            self._challenger, self._streak = None, 0
+            self.switches += 1
+            return {"wire": best, "prev_wire": prev, "switched": True,
+                    "candidate": best, "streak": self.hysteresis,
+                    "costs": costs, "reason": "switched"}
+        return {"wire": self.wire, "prev_wire": self.wire,
+                "switched": False, "candidate": best,
+                "streak": self._streak, "costs": costs,
+                "reason": "challenger"}
 
 
 def push_wire_bytes(cfg: EmbeddingConfig, lanes: int, wire: str) -> int:
@@ -251,29 +398,83 @@ def _decompress_push(planes: tuple, wire: str) -> jnp.ndarray:
     return jnp.concatenate([x, side[..., :-1]], axis=-1)
 
 
+def _scatter_engine(table_shard, cfg: EmbeddingConfig, rps: int) -> bool:
+    from paddlebox_tpu.ops import pallas_kernels
+    s_f32 = not quant.is_quant(table_shard)
+    return pallas_kernels.resolve_push_engine(
+        cfg, rps, premerged=True, storage_f32=s_f32,
+        table_width=table_shard.shape[1] if s_f32 else None) \
+        == "scatter_accumulate"
+
+
+def _apply_received(table_shard, local_row, flat_pay, touched,
+                    cfg: EmbeddingConfig, rps: int, runs: int):
+    """The exchange apply tail on the owner shard: `runs` row-wise
+    ascending received runs of local rows (``local_row`` flattened,
+    out-of-range ``rps`` on empty lanes) with (gw+2) payloads and a
+    per-lane real-contribution count `touched`.
+
+    When the fused row-wise engine is selected, the cross-device merge
+    onto one lane per unique row is a D-way MERGE of the received runs
+    (``sharded.merge_sorted_runs``) — each source premerged ascending,
+    the routing argsort is stable, and capacity capping keeps ascending
+    prefixes, so no global sort is needed; the result is bit-identical
+    to the ``dedup_tokens`` argsort it replaces. Empty lanes merge onto
+    the out-of-range rps lane and merge pads carry a zero touch count,
+    so neither ever writes."""
+    gw = cfg.grad_width
+    if _scatter_engine(table_shard, cfg, rps):
+        from paddlebox_tpu.ops import pallas_kernels
+        if runs > 0:
+            uniq, inverse = merge_sorted_runs(
+                local_row.reshape(runs, -1))
+        else:
+            uniq, inverse = dedup_tokens(local_row)
+        payload = jnp.concatenate([flat_pay, touched[:, None]], axis=1)
+        merged = jnp.zeros((local_row.shape[0], gw + 3),
+                           payload.dtype).at[inverse].add(payload)
+        return pallas_kernels.scatter_accumulate(
+            table_shard, uniq, merged[:, :gw], merged[:, gw],
+            merged[:, gw + 1], cfg, touched=merged[:, gw + 2])
+    return sharded.push(table_shard, local_row, flat_pay[:, :gw],
+                        flat_pay[:, gw], flat_pay[:, gw + 1], cfg)
+
+
 def routed_push(table_shard, idx: jnp.ndarray, grads: jnp.ndarray,
                 shows: jnp.ndarray, clks: jnp.ndarray,
                 cfg: EmbeddingConfig, axis_name,
                 capacity_factor: float = 2.0, wire: str = "f32",
-                plan=None, premerged: bool = False):
+                plan=None, premerged: bool = False,
+                topology: str = "flat"):
     """Distributed merge-update with a premerged, wire-compressed
     payload (the exchange's push half; reverse of ``routed_pull``).
 
     When `plan` carries the host dedup bounds (or `premerged` lanes
-    arrive from a deferred apply), per-token payloads merge onto one
-    lane per unique row BEFORE routing — each row crosses the wire once
-    per source device. The grad plane crosses in `wire` format; the
-    owner shard's ``sharded.push`` then merges cross-device lanes and
-    applies the optimizer exactly as the single-shard engine does."""
+    arrive from a deferred apply — the plan's unique order, ascending),
+    per-token payloads merge onto one lane per unique row BEFORE
+    routing — each row crosses the wire once per source device. The
+    grad plane crosses in `wire` format; the owner shard then merges
+    cross-device lanes and applies the optimizer exactly as the
+    single-shard engine does.
+
+    `topology` "flat" is the one-stage global all_to_all; "hier"
+    (``select_topology``) runs the two-stage intra-host/inter-host
+    decomposition — axis_name must then be the (node, dp) axis pair."""
     D = _axis_size(axis_name)
     if D == 1:
         return sharded.push(table_shard, idx, grads, shows, clks, cfg,
                             plan=plan, premerged=premerged)
+    merged_input = premerged
     if not premerged:
         _, dplan = _normalize_plan(plan)
         if dplan is not None:
             idx, grads, shows, clks, _ = sharded.plan_premerge(
                 idx, grads, shows, clks, dplan)
+            merged_input = True
+    if topology == "hier":
+        return _routed_push_hier(table_shard, idx, grads, shows, clks,
+                                 cfg, axis_name, capacity_factor, wire,
+                                 merged=merged_input)
     n = idx.shape[0]
     rps = quant.table_rows(table_shard)
     cap = _capacity(n, D, capacity_factor)
@@ -294,29 +495,114 @@ def routed_push(table_shard, idx: jnp.ndarray, grads: jnp.ndarray,
     # sharded.routed_push on why row 0 would be wrong for adam)
     local_row = jnp.where(empty, rps, flat_idx % rps).astype(jnp.int32)
     flat_pay = jnp.where(empty[:, None], 0.0, flat_pay)
-    from paddlebox_tpu.ops import pallas_kernels
-    s_f32 = not quant.is_quant(table_shard)
-    if pallas_kernels.resolve_push_engine(
-            cfg, rps, premerged=True, storage_f32=s_f32,
-            table_width=table_shard.shape[1] if s_f32 else None) \
-            == "scatter_accumulate":
-        # The received lanes are unique per SOURCE device (each source
-        # premerged before routing), so a row arrives on at most D
-        # lanes. Merge those onto ONE lane per unique row with a
-        # compact lane-grade scatter — the cross-device half of the
-        # premerge, over D*cap lanes, never over the shard table — and
-        # hand the fused row-wise engine unique lanes: each touched row
-        # is gathered, updated in VMEM, and written back exactly once
-        # (the O(shard-table) update pass never runs). Empty lanes
-        # merge onto the out-of-range rps lane and dedup's capacity
-        # pads carry a zero touch count, so neither ever writes.
-        uniq, inverse = dedup_tokens(local_row)
-        real = (~empty).astype(flat_pay.dtype)
-        payload = jnp.concatenate([flat_pay, real[:, None]], axis=1)
-        merged = jnp.zeros((local_row.shape[0], gw + 3),
-                           payload.dtype).at[inverse].add(payload)
-        return pallas_kernels.scatter_accumulate(
-            table_shard, uniq, merged[:, :gw], merged[:, gw],
-            merged[:, gw + 1], cfg, touched=merged[:, gw + 2])
-    return sharded.push(table_shard, local_row, flat_pay[:, :gw],
-                        flat_pay[:, gw], flat_pay[:, gw + 1], cfg)
+    # ascending-runs invariant for the D-way merge: it needs a MERGED
+    # source order (the plan's unique rows ascend; token-order input
+    # does not), so unmerged input keeps the argsort dedup
+    return _apply_received(table_shard, local_row, flat_pay,
+                           (~empty).astype(flat_pay.dtype), cfg, rps,
+                           runs=D if merged_input else 0)
+
+
+def _routed_push_hier(table_shard, idx: jnp.ndarray, grads: jnp.ndarray,
+                      shows: jnp.ndarray, clks: jnp.ndarray,
+                      cfg: EmbeddingConfig, axis_name,
+                      capacity_factor: float, wire: str,
+                      merged: bool):
+    """Two-stage push exchange on a (node, dp) mesh (the array-
+    redistribution decomposition, arXiv:2112.01075, applied to the
+    sparse push):
+
+    1. **intra-host shuffle** over the dp axis, f32 uncompressed (the
+       in-host leg is not the scarce bandwidth): tokens route to the
+       host-local device whose dp slot owns their column of the shard
+       grid, so every lane bound for host h sits on the one local
+       device that will talk to h's matching dp slot.
+    2. **host merge**: the P received runs (ascending — premerged
+       sources through the stable routing argsort) D-way-merge onto
+       one lane per unique global row, summing payloads and real
+       counts. This is the whole point: a row referenced by all P
+       local devices crosses the inter-host wire ONCE.
+    3. **inter-host exchange** over the node axis, wire-compressed
+       (``_compress_push`` — the merged touch counts ride the f32 side
+       plane with show/clk, so counters stay exact on every wire).
+
+    Capacities are sized so hier never drops a batch flat would not:
+    stage 1's per-slot lanes hold H flat-capacity groups; stage 2's
+    per-host lanes hold P. Under exact arithmetic (f32 wire) the final
+    per-row sums are the same contributions in the same merged order as
+    the flat exchange — bit-identical, which the hier-vs-flat parity
+    test pins."""
+    if not isinstance(axis_name, (tuple, list)) or len(axis_name) != 2:
+        raise ValueError(
+            "exchange_topology='hier' needs the (node, dp) axis pair; "
+            f"got axis_name={axis_name!r}")
+    node_ax, dp_ax = axis_name
+    H = lax.axis_size(node_ax)
+    P = lax.axis_size(dp_ax)
+    D = H * P
+    gw = cfg.grad_width
+    rps = quant.table_rows(table_shard)
+    if not merged:
+        # host plan absent (e.g. a planless caller): device-merge first
+        # so the stage-1 runs ascend and each row leaves a device once
+        uniq0, inv0 = dedup_tokens(idx)
+        payload = jnp.concatenate(
+            [grads, shows[:, None], clks[:, None]], axis=1)
+        m0 = jnp.zeros((uniq0.shape[0], gw + 2),
+                       payload.dtype).at[inv0].add(payload)
+        idx, grads, shows, clks = (uniq0, m0[:, :gw], m0[:, gw],
+                                   m0[:, gw + 1])
+    n = idx.shape[0]
+    flat_cap = _capacity(n, D, capacity_factor)
+    # --- stage 1: route by the owner shard's dp slot, intra-host a2a.
+    # NULL tokens and the plan's out-of-range pads (>= the table's
+    # rps*D rows) go to the drop group — the slot modulus would
+    # otherwise wrap pads into real groups and crowd out tokens
+    cap1 = min(n, H * flat_cap)
+    owner1 = jnp.where((idx == sharded.NULL_INDEX) | (idx >= rps * D),
+                       P, (idx // rps) % P)
+    order1, sown1, pos1, valid1, send_idx1 = _route_owner(
+        idx, owner1, P, cap1)
+    payload = jnp.concatenate(
+        [grads, shows[:, None], clks[:, None]], axis=1)[order1]
+    send_pay1 = jnp.zeros((P, cap1, gw + 2), payload.dtype)
+    send_pay1 = send_pay1.at[sown1, pos1].set(payload, mode="drop")
+    recv_idx1 = lax.all_to_all(send_idx1, dp_ax, 0, 0, tiled=True)
+    recv_pay1 = lax.all_to_all(send_pay1, dp_ax, 0, 0, tiled=True)
+    # --- host merge: P ascending runs of global rows → unique lanes
+    flat1 = recv_idx1.reshape(-1)
+    empty1 = flat1 < 0
+    sentinel = rps * D                        # > every valid global row
+    midx = jnp.where(empty1, sentinel, flat1)
+    uniq1, inverse1 = merge_sorted_runs(midx.reshape(P, cap1))
+    real1 = (~empty1).astype(recv_pay1.dtype)
+    pay1 = jnp.where(empty1[:, None], 0.0,
+                     recv_pay1.reshape(-1, gw + 2))
+    merged1 = jnp.zeros((uniq1.shape[0], gw + 3),
+                        pay1.dtype).at[inverse1].add(
+        jnp.concatenate([pay1, real1[:, None]], axis=1))
+    # --- stage 2: route merged uniques by owner host, inter-host a2a.
+    # The sentinel lane and the merge's tail pads carry a zero touch
+    # count — both go to the drop group (a padded row 0 would otherwise
+    # reach shard 0 and let a stateful optimizer decay an untouched row)
+    drop2 = merged1[:, gw + 2] <= 0.0
+    owner2 = jnp.where(drop2, H, uniq1 // (rps * P))
+    cap2 = P * flat_cap
+    order2, sown2, pos2, valid2, send_idx2 = _route_owner(
+        uniq1, owner2, H, cap2)
+    send_pay2 = jnp.zeros((H, cap2, gw + 3), merged1.dtype)
+    send_pay2 = send_pay2.at[sown2, pos2].set(merged1[order2],
+                                              mode="drop")
+    recv_idx2 = lax.all_to_all(send_idx2, node_ax, 0, 0, tiled=True)
+    recv2 = tuple(lax.all_to_all(p, node_ax, 0, 0, tiled=True)
+                  for p in _compress_push(send_pay2, gw, wire))
+    recv_pay2 = _decompress_push(recv2, wire)
+    # --- apply: every arriving row belongs to THIS shard; H ascending
+    # runs of local rows merge through the same D-way-merge tail
+    flat2 = recv_idx2.reshape(-1)
+    empty2 = flat2 < 0
+    local_row = jnp.where(empty2, rps, flat2 % rps).astype(jnp.int32)
+    pay2 = jnp.where(empty2[:, None], 0.0,
+                     recv_pay2.reshape(-1, gw + 3))
+    return _apply_received(table_shard, local_row, pay2[:, :gw + 2],
+                           pay2[:, gw + 2], cfg, rps, runs=H)

@@ -432,17 +432,27 @@ def _route(idx: jnp.ndarray, rows_per_shard: int, n_shards: int, cap: int):
     shard they would otherwise flood the capacity lanes and crowd out
     real tokens (a batch is often 20-40% padding).
     """
-    n = idx.shape[0]
     owner = jnp.where(idx == NULL_INDEX, n_shards, idx // rows_per_shard)
+    return _route_owner(idx, owner, n_shards, cap)
+
+
+def _route_owner(idx: jnp.ndarray, owner: jnp.ndarray, n_groups: int,
+                 cap: int):
+    """The routing-plan core with the destination group precomputed:
+    group `n_groups` is the drop group (never sent). The argsort is
+    STABLE, so within each group tokens keep their input order — an
+    ascending input yields ascending per-destination runs (the invariant
+    the D-way merge of the receive side rests on)."""
+    n = idx.shape[0]
     order = jnp.argsort(owner)
     sidx = idx[order]
     sowner = owner[order]
-    counts = jnp.bincount(owner, length=n_shards + 1)
+    counts = jnp.bincount(owner, length=n_groups + 1)
     starts = jnp.cumsum(counts) - counts
     pos = jnp.arange(n, dtype=jnp.int32) - starts[sowner]
-    valid = (pos < cap) & (sowner < n_shards)
-    send_idx = jnp.full((n_shards, cap), -1, dtype=idx.dtype)
-    # sowner == n_shards (null group) lands out of bounds → dropped
+    valid = (pos < cap) & (sowner < n_groups)
+    send_idx = jnp.full((n_groups, cap), -1, dtype=idx.dtype)
+    # sowner == n_groups (null group) lands out of bounds → dropped
     send_idx = send_idx.at[sowner, pos].set(sidx, mode="drop")
     return order, sowner, pos, valid, send_idx
 
@@ -607,4 +617,47 @@ def dedup_tokens(idx: jnp.ndarray) -> tuple[jnp.ndarray, jnp.ndarray]:
     seg = jnp.cumsum(is_start.astype(jnp.int32)) - 1
     unique_idx = jnp.zeros((n,), idx.dtype).at[seg].max(sidx)
     inverse = jnp.zeros((n,), jnp.int32).at[order].set(seg)
+    return unique_idx, inverse
+
+
+def merge_sorted_runs(runs: jnp.ndarray) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """``dedup_tokens(runs.reshape(-1))`` — bit-identical outputs — for a
+    (D, L) batch of row-wise ASCENDING runs, without the global argsort.
+
+    The exchange receive side is exactly this shape: each source device
+    premerged (ascending unique rows), routed through the stable-argsort
+    plan (order preserved within a destination group), and capacity
+    capping keeps an ascending prefix — so every received run is an
+    ascending valid prefix padded with a constant out-of-range sentinel.
+
+    The D-way merge computes each element's global sorted rank directly:
+    its own within-run position plus, per other run, a searchsorted
+    (side="right" for earlier runs, "left" for later ones — equal values
+    count only from earlier runs). That tie-break IS the stable argsort's
+    run-major-then-position order over the flattened array, so the
+    sorted values, segment ids, unique vector, and inverse all match
+    ``dedup_tokens`` exactly. D² binary searches of length-L runs
+    replace one O(n log n) sort of n = D*L lanes; D is the static axis
+    size, so the Python loop unrolls at trace time.
+    """
+    D, L = runs.shape
+    n = D * L
+    ranks = []
+    for r in range(D):
+        acc = jnp.arange(L, dtype=jnp.int32)
+        for r2 in range(D):
+            if r2 == r:
+                continue
+            side = "right" if r2 < r else "left"
+            acc = acc + jnp.searchsorted(
+                runs[r2], runs[r], side=side).astype(jnp.int32)
+        ranks.append(acc)
+    rank = jnp.stack(ranks).reshape(-1)
+    flat = runs.reshape(-1)
+    sorted_vals = jnp.zeros((n,), runs.dtype).at[rank].set(flat)
+    is_start = jnp.concatenate(
+        [jnp.ones((1,), jnp.bool_), sorted_vals[1:] != sorted_vals[:-1]])
+    seg = jnp.cumsum(is_start.astype(jnp.int32)) - 1
+    unique_idx = jnp.zeros((n,), runs.dtype).at[seg].max(sorted_vals)
+    inverse = seg[rank]
     return unique_idx, inverse

@@ -180,6 +180,14 @@ class BoxPS:
         tier = tiering.end_pass_rebalance(self.store)
         if tier is not None:
             out["tiering"] = tier
+        # pass-boundary exchange-wire adaptation (flags.exchange_adaptive):
+        # fleet-driven scopes adapt here, mirroring the tier re-eval —
+        # BEFORE the flight-record commit so the decision (and any
+        # exchange_wire_adapted event) lands in this pass's record
+        if trainer is not None and hasattr(trainer, "adapt_wire_boundary"):
+            wire_next = trainer.adapt_wire_boundary()
+            if wire_next is not None:
+                out["exchange_wire_next"] = wire_next
         # flight-record commit LAST: checkpoint/delta durations and bytes
         # above land in this pass's stats_delta and event stream
         out["flight_record"] = monitor.hub().end_pass(metrics=self.metrics)

@@ -249,7 +249,11 @@ class ExchangeOverflowRule(Rule):
             "for the observed skew (routed_capacity_preplan covers train "
             "passes; eval retries re-run whole passes), and check the "
             "per-pass dedup ratio — a duplication shift changes the "
-            "per-destination histogram the preplan sized for")
+            "per-destination histogram the preplan sized for; on a "
+            "multi-host (node, dp) mesh set flags.exchange_topology="
+            "'hier' — the host-merged inter-host leg carries each "
+            "host's unique lanes once, shrinking the duplicated "
+            "per-destination histogram the capacity was sized for")
 
 
 class SpillThrashRule(Rule):
@@ -334,7 +338,11 @@ class DedupDriftRule(Rule):
             "the duplication profile the engines were tuned on has "
             "moved: re-check upstream merge (dataset merge_by_ins_id / "
             "feed dedup) and re-A/B flags.push_dedup_premerge and the "
-            "exchange capacity preplan against the new ratio")
+            "exchange capacity preplan against the new ratio — or turn "
+            "on flags.exchange_adaptive, whose per-pass wire controller "
+            "re-costs the exchange wire from exactly this drifting "
+            "tokens/unique ratio instead of pinning one wire to a "
+            "stale profile")
 
 
 class PushFloorRule(Rule):
@@ -586,8 +594,14 @@ class CrossRankFlowRule(Rule):
             "the exchange edge is the wall: check the dst rank's shard "
             "balance (aggregate stage_skew / exchange imbalance), raise "
             "flags.exchange_capacity_factor if overflow retries ride "
-            "along, and A/B flags.exchange_wire — the edge fields "
-            "carry the wire format and bytes that crossed"),
+            "along, and instead of hand-A/Bing a fixed "
+            "flags.exchange_wire turn on flags.exchange_adaptive — the "
+            "per-pass controller selects the wire from these counters "
+            "and THIS flow attribution (feed it via "
+            "Trainer.note_flow_attribution); on a multi-host mesh set "
+            "flags.exchange_topology='hier' so the inter-host leg "
+            "carries each host's merged unique lanes once — the edge "
+            "fields carry the wire format and bytes that crossed"),
         "publish": (
             "the publish->swap edge is the staleness: check the "
             "publisher's upload/verify seconds (serving.publish_seconds "

@@ -108,6 +108,18 @@ def validate_flight_record(rec: dict) -> list[str]:
         tt = extra.get("table_tiering")
         if tt is not None and not isinstance(tt, str):
             errs.append("extra['table_tiering'] is not a string")
+        # sharded-exchange identity (trainer extras): the pass's active
+        # wire/topology and — under flags.exchange_adaptive — the
+        # controller's verdict for the NEXT pass. Flat strings from the
+        # closed vocabularies; dashboards and the doctor's exchange
+        # rules key off them verbatim
+        for k, vocab in (("exchange_wire", ("f32", "bf16", "int8")),
+                         ("exchange_wire_next", ("f32", "bf16", "int8")),
+                         ("exchange_topology", ("flat", "hier"))):
+            v = extra.get(k)
+            if v is not None and (not isinstance(v, str)
+                                  or v not in vocab):
+                errs.append(f"extra[{k!r}] is not one of {vocab}")
         # the pass-boundary account (trainer extra): the wall is a
         # non-negative number and the split is a flat object of
         # non-negative component seconds — the critical-path attributor

@@ -30,6 +30,10 @@ EVENT_NAMES: tuple[str, ...] = (
     "routed_dropped",
     "exchange_overflow",
     "exchange_overflow_retry",
+    # adaptive wire controller (embedding/exchange.WireController via
+    # Trainer._adapt_wire): a per-pass exchange_wire switch, carrying
+    # prev/next wire, the winning streak, and the modeled wire costs
+    "exchange_wire_adapted",
     "drain_snapshot",
     "drain_snapshot_skipped",
     "elastic_min_world_exit",

@@ -246,6 +246,26 @@ class Trainer:
         self.table_layout = self._select_table_layout()
         self.exchange_wire = (exchange.select_wire(self.store.cfg)
                               if self.table_layout == "sharded" else None)
+        # All_to_all decomposition for the push exchange: "hier" = the
+        # two-stage intra-host/inter-host exchange on a (node, dp) mesh
+        # (host-merged unique lanes cross the inter-host leg once),
+        # "flat" = the one-stage global a2a (flags.exchange_topology).
+        self.exchange_topology = (
+            exchange.select_topology(self.mesh.devices.shape)
+            if self.table_layout == "sharded" else None)
+        # Per-pass wire adaptation (flags.exchange_adaptive): the
+        # controller re-costs the wires at every owned pass boundary
+        # from the pass's exchange counter deltas (+ any fed flow-edge
+        # attribution, note_flow_attribution) and switches
+        # self.exchange_wire for the NEXT pass — a switch recompiles
+        # the steps like the adaptive capacity doubling.
+        self._wire_controller = (
+            exchange.WireController(self.store.cfg, self.exchange_wire)
+            if self.table_layout == "sharded"
+            and config_flags.exchange_adaptive else None)
+        self._flow_attribution: tuple | None = None
+        self._last_wire_decision: dict | None = None
+        self._wire_stats0: dict | None = None
         # Storage-tier identity of the host table ("spill" /
         # "sharded+spill" / None for the in-RAM store) — flight-record
         # extra, like table_layout; the tier is a storage choice, never
@@ -411,6 +431,7 @@ class Trainer:
         # with the wire-compressed push payload (embedding/exchange.py)
         sharded_x = self.table_layout == "sharded"
         wire = self.exchange_wire
+        topo = self.exchange_topology or "flat"
 
         def push_tail(tshard, flat_idx, sgrad, mask_l, labels_l, plan):
             """Push stage tail: deferred operands, ablated no-op, or the
@@ -431,7 +452,7 @@ class Trainer:
                 return exchange.routed_push(tshard, flat_idx, sgrad,
                                             show_inc, clk_inc, emb_cfg,
                                             axes, capf, wire=wire,
-                                            plan=plan)
+                                            plan=plan, topology=topo)
             return sharded.routed_push(tshard, flat_idx, sgrad, show_inc,
                                        clk_inc, emb_cfg, axes, capf,
                                        dedup=dedup, plan=plan)
@@ -749,6 +770,7 @@ class Trainer:
         dedup = config_flags.pullpush_dedup_keys and self.n_shards > 1
         sharded_x = self.table_layout == "sharded"
         wire = self.exchange_wire
+        topo = self.exchange_topology or "flat"
         batch_spec = P(axes)
         tbl_sh = mesh_lib.table_sharding(self.mesh)
 
@@ -762,7 +784,8 @@ class Trainer:
                 if sharded_x:
                     return exchange.routed_push(tshard, uniq, g0, g1, g2,
                                                 emb_cfg, axes, capf,
-                                                wire=wire, premerged=True)
+                                                wire=wire, premerged=True,
+                                                topology=topo)
                 kplan = ((None, rstart, endb) if rstart.shape[0]
                          else None)
                 return sharded.push(tshard, uniq, g0, g1, g2, emb_cfg,
@@ -777,7 +800,7 @@ class Trainer:
                 return exchange.routed_push(tshard, flat_idx, g0,
                                             show_inc, clk_inc, emb_cfg,
                                             axes, capf, wire=wire,
-                                            plan=plan)
+                                            plan=plan, topology=topo)
             return sharded.routed_push(tshard, flat_idx, g0, show_inc,
                                        clk_inc, emb_cfg, axes, capf,
                                        dedup=dedup, plan=plan)
@@ -1304,6 +1327,10 @@ class Trainer:
         pass_t0 = time.perf_counter()
         stage0 = self.timers.snapshot()
         applies0 = self.push_applies
+        if self._wire_controller is not None and self._wire_stats0 is None:
+            # counter baseline for this PASS (kept across the phases of
+            # a phased lifecycle — the controller observes whole passes)
+            self._wire_stats0 = monitor.STATS.snapshot()
         try:
             out = self._train_pass_impl(dataset, metrics, preload_keys,
                                         skip_steps=skip_steps)
@@ -1337,15 +1364,72 @@ class Trainer:
             # record's stats_delta as exchange.* counter deltas)
             table_layout=self.table_layout,
             exchange_wire=self.exchange_wire,
+            exchange_topology=self.exchange_topology,
             # storage-tier identity (None filtered out for in-RAM
             # stores); the tiering.* counter deltas ride stats_delta
             table_tiering=self.table_tiering)
         if owned_pass:
             # trainer-owned scope: the BoxPS lifecycle is not driving, so
-            # the pass-boundary tier re-evaluation runs here instead
+            # the pass-boundary tier re-evaluation and the adaptive
+            # exchange-wire re-cost run here instead (BoxPS.end_pass
+            # drives both for fleet-owned scopes)
             tiering.end_pass_rebalance(self.store)
+            self.adapt_wire_boundary()
             hub.end_pass(metrics=metrics)
         return out
+
+    # ------------------------------------------------------------------
+    def note_flow_attribution(self, attribution: dict | None,
+                              wall_seconds: float | None = None) -> None:
+        """Feed the adaptive wire controller a clock-corrected flow-edge
+        attribution (``critical_path.attribute_flow_edges`` over a merged
+        world trace) plus the wall it attributes against. In-process
+        records can't form cross-rank exchange edges, so this evidence
+        arrives from the driver that holds the merged timeline; the
+        controller uses it as a veto — when the exchange edge is not the
+        limiter, the wire holds."""
+        self._flow_attribution = (
+            (attribution, wall_seconds) if attribution else None)
+
+    def adapt_wire_boundary(self):
+        """Pass-boundary wire adaptation (flags.exchange_adaptive): run
+        the controller on this pass's OWN exchange counter deltas; on a
+        switch, rebind self.exchange_wire and recompile the steps (the
+        same contract as the adaptive capacity doubling). Called once
+        per pass — by ``train_pass`` for trainer-owned scopes, by
+        ``BoxPS.end_pass`` for fleet-driven ones (phased lifecycles
+        adapt once per WHOLE pass, never between phases). Safe no-op
+        when the controller is inactive or no pass was observed.
+        Returns the wire the NEXT pass will run with."""
+        ctl = self._wire_controller
+        stats0, self._wire_stats0 = self._wire_stats0, None
+        if ctl is None or stats0 is None:
+            return None
+        now = monitor.STATS.snapshot()
+
+        def delta(name):
+            return int(now.get(name, 0.0) - stats0.get(name, 0.0))
+
+        flow, wall = self._flow_attribution or (None, None)
+        decision = ctl.observe(
+            tokens=delta("exchange.tokens"),
+            unique_lanes=delta("exchange.unique_lanes"),
+            overflow_retries=(delta("exchange.overflow_retries")
+                              + delta("exchange.overflow_dropped")),
+            flow=flow, wall_seconds=wall)
+        self._last_wire_decision = decision
+        if decision["switched"]:
+            monitor.event(
+                "exchange_wire_adapted", type="exchange",
+                prev=decision["prev_wire"], wire=decision["wire"],
+                streak=decision["streak"], reason=decision["reason"],
+                costs={w: round(c, 1)
+                       for w, c in decision["costs"].items()})
+            monitor.counter_add("exchange.wire_switches")
+            self.exchange_wire = decision["wire"]
+            self._rebuild_steps()
+        monitor.hub().record_train(exchange_wire_next=decision["wire"])
+        return decision["wire"]
 
     def _train_pass_impl(self, dataset, metrics: Any = None,
                          preload_keys: np.ndarray | None = None,

@@ -674,3 +674,24 @@ def test_monitor_faultpoints_registered():
     ELASTIC/SERVING/EXCHANGE_POINTS)."""
     assert set(faultpoint.MONITOR_POINTS) <= set(faultpoint.POINTS)
     assert "telemetry.rotate.pre" in faultpoint.MONITOR_POINTS
+
+
+def test_exchange_rules_name_adaptive_exchange_knobs():
+    """ISSUE 16: the exchange rules' suggestions name the CONCRETE
+    adaptive-exchange knobs — overflow points at the hierarchical
+    topology, dedup drift at the per-pass wire controller, and the
+    cross-rank exchange edge at both — never a bare 'tune the wire'."""
+    rep = doctor.diagnose(**RULE_FIXTURES["exchange-overflow"][0])
+    f = next(f for f in rep["findings"] if f["rule"] == "exchange-overflow")
+    assert "flags.exchange_topology='hier'" in f["suggestion"]
+
+    rep = doctor.diagnose(**RULE_FIXTURES["dedup-drift"][0])
+    f = next(f for f in rep["findings"] if f["rule"] == "dedup-drift")
+    assert "flags.exchange_adaptive" in f["suggestion"]
+
+    rep = doctor.diagnose(**RULE_FIXTURES["cross-rank-flow"][0])
+    f = next(f for f in rep["findings"] if f["rule"] == "cross-rank-flow")
+    assert f["evidence"]["longest_edge"]["kind"] == "exchange"
+    assert "flags.exchange_adaptive" in f["suggestion"]
+    assert "flags.exchange_topology='hier'" in f["suggestion"]
+    assert "note_flow_attribution" in f["suggestion"]

@@ -676,3 +676,255 @@ def test_sharded_store_drives_working_set(mesh2):
         ss.get_rows(keys),
         np.asarray(ws.table)[idx.reshape(-1)][:, :c.row_width])
     mgr.close()
+
+
+# ---------------------------------------------------------------------------
+# self-adapting exchange (ISSUE 16): the D-way merge of the routed tail,
+# the hierarchical topology, and the per-pass wire controller
+# ---------------------------------------------------------------------------
+
+@pytest.fixture(scope="module")
+def mesh4h():
+    """2 hosts x 2 devices — the (node, dp) mesh the hier topology keys
+    off (conftest forces 8 virtual CPU devices, so 4 are available)."""
+    return make_mesh(4, num_nodes=2)
+
+
+def test_merge_sorted_runs_matches_argsort_dedup():
+    """The D-way merge is bit-equivalent to the stable-argsort dedup on
+    row-wise ascending runs — including overflow-capped runs (ascending
+    valid prefix + out-of-range pad tail, exactly what a capacity-capped
+    receive buffer holds)."""
+    rng = np.random.default_rng(17)
+    for trial in range(10):
+        D = int(rng.integers(2, 6))
+        L = int(rng.integers(3, 40))
+        runs = np.sort(rng.integers(0, 50, size=(D, L)), axis=1)
+        if trial % 2:
+            for r in range(D):          # capped run: pad tail stays sorted
+                k = int(rng.integers(0, L + 1))
+                runs[r, k:] = 64        # out-of-range, >= any valid row
+        runs = jnp.asarray(np.ascontiguousarray(runs).astype(np.int32))
+        u_m, inv_m = sharded.merge_sorted_runs(runs)
+        u_a, inv_a = sharded.dedup_tokens(runs.reshape(-1))
+        np.testing.assert_array_equal(np.asarray(u_m), np.asarray(u_a))
+        np.testing.assert_array_equal(np.asarray(inv_m), np.asarray(inv_a))
+
+
+def test_select_topology_resolution_and_errors():
+    old = flags.exchange_topology
+    try:
+        flags.exchange_topology = "auto"
+        assert exchange.select_topology((2,)) == "flat"
+        assert exchange.select_topology((2, 2)) == "hier"
+        assert exchange.select_topology((1, 4)) == "flat"   # degenerate axis
+        flags.exchange_topology = "flat"
+        assert exchange.select_topology((2, 2)) == "flat"
+        flags.exchange_topology = "hier"
+        assert exchange.select_topology((2, 2)) == "hier"
+        with pytest.raises(ValueError, match="hier"):
+            exchange.select_topology((4,))
+        flags.exchange_topology = "ring"
+        with pytest.raises(ValueError, match="exchange_topology"):
+            exchange.select_topology((2, 2))
+    finally:
+        flags.exchange_topology = old
+
+
+def test_hier_push_bit_identical_to_flat_and_single_shard(mesh4h):
+    """The two-stage (intra-host shuffle, host-merge, inter-host) push
+    over the f32 wire lands the exact bits of both the flat 4-way a2a
+    and the single-shard scatter path — for the plan-keyed AND the
+    planless (token-order) input."""
+    c = _cfg()
+    store, ws = _ws(c, 120, mesh4h)
+    idx, grads, shows, clks = _push_operands(c, ws, n_tok=128, seed=21)
+    plan = _device_plans(idx, ws.padded_rows, 4)
+    args = tuple(map(jnp.asarray, (idx, grads, shows, clks)))
+    axes = tuple(mesh4h.axis_names)
+
+    def run(topology, use_plan):
+        def body(tshard, i, g, sh, ck, *p):
+            return exchange.routed_push(
+                tshard, i, g, sh, ck, c, axes, 2.0, wire="f32",
+                plan=p if use_plan else None, topology=topology)
+        return np.asarray(jax.jit(jax.shard_map(
+            body, mesh=mesh4h, in_specs=(P(axes),) * 10,
+            out_specs=P(axes)))(ws.table, *args, *plan))
+
+    want = np.asarray(sharded.push(ws.table, *args, c))
+    for use_plan in (True, False):
+        np.testing.assert_array_equal(run("flat", use_plan), want)
+        np.testing.assert_array_equal(run("hier", use_plan), want)
+
+
+@pytest.mark.parametrize("wire,rtol", [("bf16", 2e-2), ("int8", 2e-2)])
+def test_hier_push_wire_compression_bounded(mesh4h, wire, rtol):
+    """Compressed wires through the hier topology: grads round within
+    the wire's tolerance, but the parity guard holds — show/clk counter
+    columns cross the f32 side plane on BOTH legs and stay bit-exact."""
+    c = _cfg()
+    store, ws = _ws(c, 120, mesh4h)
+    idx, grads, shows, clks = _push_operands(c, ws, n_tok=128, seed=23)
+    plan = _device_plans(idx, ws.padded_rows, 4)
+    args = tuple(map(jnp.asarray, (idx, grads, shows, clks)))
+    axes = tuple(mesh4h.axis_names)
+    out = np.asarray(jax.jit(jax.shard_map(
+        lambda t, i, g, sh, ck, *p: exchange.routed_push(
+            t, i, g, sh, ck, c, axes, 2.0, wire=wire, plan=p,
+            topology="hier"),
+        mesh=mesh4h, in_specs=(P(axes),) * 10,
+        out_specs=P(axes)))(ws.table, *args, *plan))
+    want = np.asarray(sharded.push(ws.table, *args, c))
+    np.testing.assert_allclose(out, want, rtol=rtol, atol=rtol)
+    np.testing.assert_array_equal(out[:, :2], want[:, :2])
+
+
+def test_compress_push_side_plane_exact_on_every_wire():
+    """The structural parity guard: whatever the wire does to the grad
+    plane, the show/clk side plane survives compress->decompress
+    bit-for-bit (int8 additionally rides its scale column there)."""
+    rng = np.random.default_rng(29)
+    gw = 5
+    pay = jnp.asarray(rng.normal(size=(2, 16, gw + 2)).astype(np.float32))
+    for wire in exchange.WIRES:
+        planes = exchange._compress_push(pay, gw, wire)
+        back = exchange._decompress_push(planes, wire)
+        np.testing.assert_array_equal(np.asarray(back[..., gw:gw + 2]),
+                                      np.asarray(pay[..., gw:gw + 2]))
+        if wire != "f32":               # the grad plane really compressed
+            assert planes[0].dtype != jnp.float32
+
+
+def test_wire_cost_regimes_and_errors():
+    c = _cfg()                          # grad_width 5
+    # unique-heavy (depth ~1): bytes-bound, the narrow wire wins
+    assert (exchange.wire_cost(c, 100, 100, "bf16")
+            < exchange.wire_cost(c, 100, 100, "f32"))
+    # duplication-heavy (depth 32): exposure-bound, the exact wire wins
+    assert (exchange.wire_cost(c, 3200, 100, "f32")
+            < exchange.wire_cost(c, 3200, 100, "bf16"))
+    with pytest.raises(ValueError, match="wire"):
+        exchange.wire_cost(c, 1, 1, "fp8")
+
+
+def test_wire_controller_flips_within_hysteresis_no_flap():
+    c = _cfg()
+    ctl = exchange.WireController(c, "f32", hysteresis=2)
+    for _ in range(3):                  # deep-dup regime: f32 optimal
+        d = ctl.observe(3200, 100)
+        assert d["wire"] == "f32" and d["reason"] == "optimal"
+    # a single unique-heavy spike: challenger appears, hysteresis holds
+    d = ctl.observe(100, 100)
+    assert (d["candidate"] == "bf16" and not d["switched"]
+            and d["wire"] == "f32" and d["streak"] == 1)
+    # regime snaps back: the streak resets — no flap
+    d = ctl.observe(3200, 100)
+    assert d["reason"] == "optimal" and ctl.switches == 0
+    # sustained drift: the flip lands on EXACTLY the hysteresis'th
+    # consecutive challenger win, not earlier
+    assert not ctl.observe(100, 100)["switched"]
+    d = ctl.observe(100, 100)
+    assert d["switched"] and d["wire"] == "bf16" and d["prev_wire"] == "f32"
+    assert ctl.switches == 1 and ctl.wire == "bf16"
+
+
+def test_wire_controller_holds_on_overflow_flow_and_silence():
+    c = _cfg()
+    ctl = exchange.WireController(c, "f32", hysteresis=1)
+    assert ctl.observe(0, 0)["reason"] == "no-traffic"
+    d = ctl.observe(100, 100, overflow_retries=1)
+    assert not d["switched"] and d["reason"] == "overflow-hold"
+    # flow attribution says the exchange edge is not the limiter: hold
+    quiet = {"edges": 4, "by_kind": {"exchange": {"max_latency_s": 0.01}}}
+    d = ctl.observe(100, 100, flow=quiet, wall_seconds=10.0)
+    assert not d["switched"] and d["reason"] == "not-limiter"
+    # no exchange edge at all in the attribution: same hold
+    d = ctl.observe(100, 100, flow={"edges": 4, "by_kind": {}},
+                    wall_seconds=10.0)
+    assert d["reason"] == "not-limiter"
+    # the limiter signal present: the switch proceeds (hysteresis=1)
+    hot = {"edges": 4, "by_kind": {"exchange": {"max_latency_s": 5.0}}}
+    d = ctl.observe(100, 100, flow=hot, wall_seconds=10.0)
+    assert d["switched"] and d["wire"] == "bf16"
+
+
+def test_trainer_adaptive_wire_end_to_end(mesh2):
+    """flags.exchange_adaptive on a drifting stream: duplication-heavy
+    passes hold f32, then unique-heavy passes flip the wire to bf16 on
+    exactly the hysteresis'th pass after the drift; the switch emits the
+    registered exchange_wire_adapted event, bumps the switch counter,
+    and every pass's flight record carries the exchange_wire /
+    exchange_topology / exchange_wire_next extras through the schema."""
+    from paddlebox_tpu.monitor import flight
+    set_flags(table_layout="sharded", exchange_wire="f32",
+              exchange_adaptive=True)
+    try:
+        dup, schema = _dataset(4 * 32, key_space=1, seed=3)
+        uni, _ = _dataset(4 * 32, key_space=1 << 30, seed=4)
+        tr = _trainer(schema, mesh2)
+        assert tr._wire_controller is not None
+        assert tr.exchange_topology == "flat"    # 1-axis mesh
+        h = monitor.hub()
+        h.disable()
+        ms = monitor.MemorySink()
+        h.enable(ms)
+        try:
+            sw0 = monitor.STATS.snapshot().get("exchange.wire_switches", 0)
+            for _ in range(2):                   # dup regime: f32 holds
+                tr.train_pass(dup)
+                assert tr.exchange_wire == "f32"
+            wires = []
+            for _ in range(3):                   # the drift
+                tr.train_pass(uni)
+                wires.append(tr.exchange_wire)
+        finally:
+            h.disable()
+        # hysteresis=2: pass 1 after the drift challenges, pass 2 flips
+        assert wires == ["f32", "bf16", "bf16"]
+        ev = [r for r in ms.records
+              if r.get("name") == "exchange_wire_adapted"]
+        assert len(ev) == 1
+        f = ev[0]["fields"]
+        assert f["prev"] == "f32" and f["wire"] == "bf16"
+        assert f["streak"] == 2 and set(f["costs"]) == set(exchange.WIRES)
+        assert flight.validate_event(ev[0]) == []
+        assert (monitor.STATS.snapshot()["exchange.wire_switches"]
+                - sw0) == 1
+        flights = [r for r in ms.records
+                   if r.get("type") == "flight_record"]
+        assert len(flights) == 5
+        for r in flights:
+            assert flight.validate_flight_record(r) == []
+            assert r["extra"]["exchange_topology"] == "flat"
+        # the record carries the pass's ACTIVE wire and the controller's
+        # verdict for the next one — the flip pass shows the handover
+        assert [r["extra"]["exchange_wire"] for r in flights] \
+            == ["f32"] * 4 + ["bf16"]
+        assert flights[3]["extra"]["exchange_wire_next"] == "bf16"
+        assert flights[-1]["extra"]["exchange_wire_next"] == "bf16"
+    finally:
+        set_flags(table_layout="auto", exchange_wire="auto",
+                  exchange_adaptive=False)
+
+
+def test_adaptive_wire_via_boxps_end_pass(mesh2):
+    """Fleet-driven scopes adapt at BoxPS.end_pass(trainer=...) — the
+    boundary mirror of the tier re-evaluation — and surface the next
+    wire in the end_pass dict."""
+    from paddlebox_tpu.fleet.boxps import BoxPS
+    set_flags(table_layout="sharded", exchange_wire="f32",
+              exchange_adaptive=True)
+    try:
+        uni, schema = _dataset(2 * 32, key_space=1 << 30, seed=6)
+        tr = _trainer(schema, mesh2)
+        tr._wire_controller.hysteresis = 1       # flip on first evidence
+        box = BoxPS(tr.store)
+        box.begin_pass()
+        tr.train_pass(uni, metrics=box.metrics)
+        out = box.end_pass(trainer=tr)
+        assert out["exchange_wire_next"] == "bf16"
+        assert tr.exchange_wire == "bf16"
+    finally:
+        set_flags(table_layout="auto", exchange_wire="auto",
+                  exchange_adaptive=False)

@@ -495,3 +495,23 @@ def test_two_pass_train_flight_records_and_schema(tmp_path):
     # background threads contributed tagged events (the pack producer at
     # minimum — prefetch is on by default)
     assert any(t != "MainThread" for t in res["threads"]), res["threads"]
+
+
+def test_flight_validator_rejects_bad_exchange_extras():
+    """ISSUE 16: the adaptive-exchange identity extras are closed
+    vocabularies — an off-vocabulary wire or topology is a schema error,
+    not a silent dashboard mystery."""
+    base = {"ts": 1.0, "type": "flight_record", "name": "pass",
+            "pass_id": 1, "step": None, "phase": None, "thread": "t",
+            "seconds": 1.0, "steps": 1, "examples": 1,
+            "examples_per_sec": 1.0, "stage_seconds": {},
+            "stats_delta": {}, "metrics": {}}
+    for k, bad in (("exchange_wire", "fp64"),
+                   ("exchange_wire_next", 8),
+                   ("exchange_topology", "ring")):
+        errs = flight.validate_flight_record(dict(base, extra={k: bad}))
+        assert any(k in e for e in errs), (k, errs)
+    ok = dict(base, extra={"exchange_wire": "f32",
+                           "exchange_wire_next": "bf16",
+                           "exchange_topology": "hier"})
+    assert flight.validate_flight_record(ok) == []
