@@ -138,6 +138,14 @@ def collect_gate_metrics(eps_chip: float, detail: dict) -> dict:
         for k in ("fetch_keys_per_s", "hot_hit_rate"):
             if isinstance(sp.get(k), (int, float)):
                 m[f"spill_10x.{k}"] = sp[k]
+    sa = (detail.get("matrix") or {}).get("spill_assoc")
+    if isinstance(sa, dict):
+        # set-associative geometry point: the N-way hot hit rate on the
+        # adversarial colliding stream (the number direct-mapped caps)
+        # plus the fetch throughput — both higher-is-better, gate-held
+        for k in ("assoc_hit_rate", "fetch_keys_per_s"):
+            if isinstance(sa.get(k), (int, float)):
+                m[f"spill_assoc.{k}"] = sa[k]
     bd = (detail.get("matrix") or {}).get("boundary_incremental")
     if isinstance(bd, dict):
         # pass-boundary point: the incremental+overlapped boundary wall
@@ -1202,6 +1210,91 @@ def spill_drill(small: bool, tiny: bool = False) -> dict:
     }
 
 
+def spill_assoc_drill(small: bool, tiny: bool = False) -> dict:
+    """spill_assoc point: set-associative RAM-cache geometry
+    (``flags.spill_cache_assoc``) vs the direct-mapped baseline on an
+    ADVERSARIAL colliding stream — a hot set built so ``assoc`` rows
+    land on every set index. Direct-mapped, those rows evict each other
+    on every pass (conflict misses — the whole set is one slot); N-way,
+    they coexist and the hot re-read holds. Both variants replay the
+    IDENTICAL key/write sequence and the drill byte-compares the row
+    files at the end: geometry is placement only, never a math change
+    (the ``parity`` field the dryrun gate asserts)."""
+    import tempfile as _tf
+    import time as _t
+    from paddlebox_tpu.embedding import EmbeddingConfig
+    from paddlebox_tpu.embedding.spill_store import SpillEmbeddingStore
+
+    cache_rows = 128 if tiny else (1 << 11 if small else 1 << 14)
+    assoc = 4
+    n_keys = cache_rows * 8
+    passes = 3
+    cfg = EmbeddingConfig(dim=8, optimizer="adagrad", learning_rate=0.05)
+
+    def key_window(lo, hi):
+        return (np.arange(lo, hi, dtype=np.uint64)
+                * np.uint64(2654435761) + np.uint64(1))
+
+    # row ids are assigned in first-lookup order, so building the whole
+    # space with key_window(0, n_keys) pins id i to key i — the hot set
+    # below then holds `assoc` ids per direct-mapped slot j (ids
+    # j, j+C, j+2C, j+3C all map to slot j mod C) and exactly fills the
+    # N-way set j under the set-major geometry
+    hot_ids = np.concatenate(
+        [np.arange(cache_rows // assoc) + i * cache_rows
+         for i in range(assoc)])
+    results: dict = {}
+    with _tf.TemporaryDirectory(prefix="pbtpu_assoc_drill_") as td:
+        for name, policy, ways in (("assoc", "freq", assoc),
+                                   ("direct", "direct", 1)):
+            st = SpillEmbeddingStore(
+                cfg, spill_dir=os.path.join(td, name),
+                cache_rows=cache_rows, initial_capacity=n_keys + 16,
+                tier_policy=policy, cache_assoc=ways)
+            chunk = 1 << 18
+            for lo in range(0, n_keys, chunk):
+                st.lookup_or_init(key_window(lo, min(n_keys, lo + chunk)))
+            hot = key_window(0, n_keys)[hot_ids]
+            hot_hits_last = 0
+            fetch_s = 1e-9
+            for p in range(passes):
+                cold_lo = 4 * cache_rows + (p * cache_rows) % (
+                    3 * cache_rows)
+                cold = key_window(cold_lo, cold_lo + cache_rows)
+                h0 = st.cache_hits
+                t0 = _t.perf_counter()
+                rows = st.lookup_or_init(hot)
+                hot_hits_last = st.cache_hits - h0
+                cr = st.lookup_or_init(cold)
+                fetch_s = _t.perf_counter() - t0
+                rows[:, 0] += 4.0
+                st.write_back(hot, rows)
+                cr[:, 0] += 1.0
+                st.write_back(cold, cr)
+                st.tier_end_pass()
+            st._rows.flush()
+            results[name] = {
+                "hit_rate": round(hot_hits_last / len(hot_ids), 4),
+                "conflicts": int(st.conflict_misses),
+                "fetch_keys_per_s": round(
+                    (len(hot_ids) + len(cold)) / fetch_s),
+                "rows": np.array(st._rows[:st._n], np.float32),
+            }
+    a, d = results["assoc"], results["direct"]
+    return {
+        "cache_rows": int(cache_rows), "assoc": int(assoc),
+        "working_set_keys": int(n_keys),
+        "hot_set_rows": int(len(hot_ids)),
+        "passes": passes,
+        "assoc_hit_rate": a["hit_rate"],
+        "direct_hit_rate": d["hit_rate"],
+        "conflict_misses_assoc": a["conflicts"],
+        "conflict_misses_direct": d["conflicts"],
+        "parity": bool(np.array_equal(a.pop("rows"), d.pop("rows"))),
+        "fetch_keys_per_s": a["fetch_keys_per_s"],
+    }
+
+
 def boundary_drill(small: bool, tiny: bool = False) -> dict:
     """boundary_incremental point (ISSUE 14): the same key stream through
     (a) the incremental + overlapped feed — resident reuse, background
@@ -1599,6 +1692,27 @@ def dryrun_main() -> int:
         and spd.get("hot_hit_rate", 0.0)
         > spd.get("direct_hot_hit_rate", 1.0)
         and spd.get("evicted", 1 << 30) < spd.get("direct_evicted", 0))
+    # set-associative geometry drill rides the dryrun too: on the
+    # adversarial colliding stream the N-way cache must hold a hot hit
+    # rate STRICTLY above direct-mapped at the same row budget, the
+    # baseline must show the conflict misses that explain it, and the
+    # two variants' row files must be byte-identical (geometry is
+    # placement only) — before a chip round ever records the point
+    try:
+        sad = spill_assoc_drill(True, tiny=True)
+    except Exception as e:
+        sad = {"error": repr(e)}
+    detail.setdefault("matrix", {})["spill_assoc"] = sad
+    checks["assoc_fields"] = (
+        sad.get("assoc") == 4
+        and isinstance(sad.get("cache_rows"), int)
+        and sad.get("parity") is True
+        and sad.get("conflict_misses_direct", 0) > 0
+        and isinstance(sad.get("assoc_hit_rate"), float)
+        and isinstance(sad.get("direct_hit_rate"), float)
+        and sad.get("assoc_hit_rate", 0.0)
+        > sad.get("direct_hit_rate", 1.0)
+        and isinstance(sad.get("fetch_keys_per_s"), int))
     # pass-boundary drill rides the dryrun too (ISSUE 14): the
     # incremental + overlapped feed must land bit-identical store bytes
     # AND a boundary wall strictly below the full-rebuild baseline on
@@ -1793,6 +1907,11 @@ def dryrun_main() -> int:
         "spill": {k: spd.get(k) for k in
                   ("hot_hit_rate", "direct_hot_hit_rate",
                    "fetch_keys_per_s", "error") if k in spd},
+        "spill_assoc": {k: sad.get(k) for k in
+                        ("assoc", "assoc_hit_rate", "direct_hit_rate",
+                         "conflict_misses_assoc",
+                         "conflict_misses_direct", "parity", "error")
+                        if k in sad},
         "boundary": {k: bdrill.get(k) for k in
                      ("boundary_seconds", "full_rebuild_seconds",
                       "speedup", "parity", "error") if k in bdrill},
@@ -2177,6 +2296,14 @@ def _enrich(small: bool, detail: dict, ctx: dict,
             except Exception as e:
                 matrix["spill_10x"] = {"error": repr(e)}
             _mark("matrix point spill_10x done")
+            # set-associative geometry drill: N-way vs direct-mapped on
+            # the adversarial colliding stream, bit-parity held — the
+            # assoc_hit_rate/fetch points are gate-held like the rest
+            try:
+                matrix["spill_assoc"] = spill_assoc_drill(small)
+            except Exception as e:
+                matrix["spill_assoc"] = {"error": repr(e)}
+            _mark("matrix point spill_assoc done")
             # pass-boundary drill: incremental + overlapped feeds vs the
             # full-rebuild baseline on one key stream — gate-held
             # (boundary_seconds is lower-is-better off the suffix)

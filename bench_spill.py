@@ -18,11 +18,16 @@ Host-only (tunnel-immune). Writes ONE JSON line (and SPILL_r05.json when
 show-count-weighted tier manager, embedding/tiering.py — the default)
 or ``direct`` (the legacy direct-mapped last-wins install, kept as the
 measured baseline the gate-held ``spill_10x`` bench point compares
-against). Per-pass hit rates and the admission/eviction counters are
-recorded either way.
+against). ``--assoc N`` sets the cache's set associativity (default:
+``flags.spill_cache_assoc``; ``direct`` forces 1-way — it IS the
+direct-mapped geometry). Per-pass hit rates, the admission/eviction
+counters, and the per-policy conflict-miss counts are recorded either
+way; a final section refreshes a host-planed TrainerReplicaCache off
+the tier ranking and replays the last pass's keys against it, so one
+run carries the replica-hit numbers next to the RAM-tier ones.
 
 Usage: python bench_spill.py [--keys 50000000] [--policy freq|direct]
-                             [--out SPILL_r05.json]
+                             [--assoc 4] [--out SPILL_r05.json]
 """
 
 from __future__ import annotations
@@ -91,19 +96,24 @@ def main() -> None:
     ap.add_argument("--pass-keys", type=int, default=4_000_000)
     ap.add_argument("--cache-rows", type=int, default=1 << 21)  # ~109MB
     ap.add_argument("--policy", choices=("freq", "direct"), default="freq")
+    ap.add_argument("--assoc", type=int, default=None,
+                    help="cache set associativity (default: "
+                         "flags.spill_cache_assoc; direct forces 1)")
     ap.add_argument("--out", default=None)
     args = ap.parse_args()
 
     cfg = EmbeddingConfig(dim=8, optimizer="adagrad", learning_rate=0.05)
     store = SpillEmbeddingStore(cfg, cache_rows=args.cache_rows,
                                 initial_capacity=args.keys + 1024,
-                                tier_policy=args.policy)
+                                tier_policy=args.policy,
+                                cache_assoc=args.assoc)
     rng = np.random.default_rng(0)
     out = {
         "metric": "spill_store_50m_key_scale",
         "total_keys": args.keys,
         "row_width": cfg.row_width,
         "tier_policy": args.policy,
+        "spill_cache_assoc": int(store._assoc),
         "ram_cache_rows": args.cache_rows,
         "ram_cache_mb": round(args.cache_rows * cfg.row_width * 4 / 1e6,
                               1),
@@ -165,12 +175,32 @@ def main() -> None:
             "cache_hits": hits,
             "cache_misses": misses,
             "hit_rate": round(hits / max(1, hits + misses), 4),
+            "conflict_misses": int(tier_stats["pass_conflicts"]),
             "tier_admitted": int(tier_stats["admitted"]),
             "tier_evicted": int(tier_stats["evicted"]),
             "tier_hot_rows": int(tier_stats["hot_rows"]),
             "pre_pass_cache_drop_ok": bool(drop_ok),
         })
+        last_keys = keys
     out["passes"] = passes
+    out["conflict_misses_total"] = int(store.conflict_misses)
+
+    # --- HBM replica tier replay (flags.use_replica_cache path) -------
+    # refresh harvests the tier ranking the two passes just built, then
+    # the last pass's keys replay against the replica — the fraction the
+    # staging would have short-circuited past RAM/SSD entirely
+    from paddlebox_tpu.embedding.replica_cache import TrainerReplicaCache
+    replica = TrainerReplicaCache(store, mesh=None)
+    t0 = time.perf_counter()
+    replica_rows = replica.refresh()
+    served = replica.serve(np.sort(last_keys))
+    out["replica"] = {
+        "rows": int(replica_rows),
+        "capacity_rows": int(replica.capacity_rows),
+        "replica_hits": int(served.n if served is not None else 0),
+        "replay_keys": int(len(last_keys)),
+        "refresh_and_replay_seconds": round(time.perf_counter() - t0, 3),
+    }
     out["rss_after_passes_mb"] = round(rss_mb(), 1)
     out["final_cache_drop_ok"] = bool(drop_file_cache(store))
     out["rss_after_cache_drop_mb"] = round(rss_mb(), 1)

@@ -39,13 +39,18 @@ class Flags:
 
     # --- embedding engine (role of libbox_ps; flags.cc:603,607) ---
     pullpush_dedup_keys: bool = True        # FLAGS_enable_pullpush_dedup_keys
-    # FLAGS_use_gpu_replica_cache (flags.cc:486): the trainer-side hot-row
-    # replica tier. ReplicaCache itself ships (embedding/replica_cache.py,
-    # serving hot rows ride it since PR 7); this knob gates the TRAINER
-    # pull path once the multi-replica serving arc (ROADMAP "serving
-    # follow-ups": N servers sharing one staging cache) lands it.
-    # pblint: disable=flag-audit -- reserved for the ROADMAP multi-replica
-    # serving arc: gates the trainer-side ReplicaCache hot tier
+    # FLAGS_use_gpu_replica_cache (flags.cc:486): the trainer-side HBM
+    # replica hot tier (embedding/replica_cache.TrainerReplicaCache) ABOVE
+    # the spill store's RAM row cache — the top of the SSD→RAM→HBM
+    # hierarchy. At every pass boundary the trainer rebuilds the replica
+    # from the rows the TierManager already ranks hottest (show-count-
+    # weighted freq EMA); the feed-pass stager then serves a fresh key's
+    # row straight from the replica's device-resident plane instead of
+    # faulting it through the RAM/SSD path. Placement only, never a math
+    # change: pushes fold back through the store's stale-key log plus
+    # explicit write-back invalidation, so training is bit-identical with
+    # the tier on or off (tested). Telemetry: tiering.replica_hits
+    # counter + tiering.replica_rows gauge in the flight record.
     use_replica_cache: bool = False         # FLAGS_use_gpu_replica_cache (flags.cc:486)
     # Pass-boundary transfer compression: embedx crosses host<->device as
     # bf16 (counters/opt state stay f32). TPU-native analogue of the
@@ -208,6 +213,16 @@ class Flags:
     # tier's budget. Rule of thumb: size it to the per-pass working set's
     # hot fraction (row bytes = cache_rows * row_width * 4 per shard).
     spill_cache_rows: int = 1 << 16         # (new)
+    # RAM row-cache associativity: the slot plane is n_sets sets of
+    # `assoc` ways (set = row_id % n_sets), so up to `assoc` rows that
+    # collide on a set index coexist instead of evicting each other —
+    # conflict misses (tiering.conflict_misses: a miss whose whole set
+    # is live) stop capping the hit rate below the budget on adversarial
+    # slot collisions. The victim within a set is the coldest way by the
+    # TierManager score. 1 = the legacy direct-mapped geometry (also
+    # what tier_policy="direct" measures as the baseline); geometry is
+    # placement only, never a math change.
+    spill_cache_assoc: int = 4              # (new)
     # Root directory for spill row files ("" = a fresh temp dir per
     # store); sharded stores put shard s under <spill_dir>/shard-SS.
     spill_dir: str = ""                     # (new)

@@ -110,6 +110,25 @@ def _combine_jit(out_sharding, donate: bool):
 
 
 @functools.lru_cache(maxsize=8)
+def _replica_fill_jit(out_sharding):
+    """staged.at[dst] <- plane[src]: replica-served rows scatter into the
+    fresh staging plane ON DEVICE — a hit row never transits host→device
+    again, it moves HBM→HBM from the replica's resident plane (the
+    short-circuit flags.use_replica_cache buys). Plain-f32 transfer only:
+    compressed/quantized paths fill host-side BEFORE conversion so the
+    staged bytes reproduce the conversion rounding bit-for-bit. Pads
+    repeat the last (dst, src) pair, so duplicate writes are benign
+    (same idiom as _patch_jit)."""
+    def fill(staged, plane, dst, src):
+        return staged.at[dst].set(plane[src])
+
+    kw: dict = {"donate_argnums": (0,)}
+    if out_sharding is not None:
+        kw["out_shardings"] = out_sharding
+    return jax.jit(fill, **kw)
+
+
+@functools.lru_cache(maxsize=8)
 def _patch_jit(out_sharding):
     """table.at[idx] <- rows: the compact post-staging delta plane (rows
     the store mutated AFTER a background staging fetched them). Rows
@@ -169,6 +188,10 @@ class FeedPassManager:
         # set while a training pass has the table donated step to step; a
         # flush then would gather from a dead buffer, so it must refuse
         self._in_pass = False
+        # HBM replica hot tier (replica_cache.TrainerReplicaCache, set by
+        # the trainer under flags.use_replica_cache): staging serves a
+        # fresh key's row from here instead of faulting the RAM/SSD path
+        self._replica = None
         # the store flushes us before any operation that reads row values
         # (save_base/save_delta/export_serving/shrink). WeakMethod: a
         # garbage-collected manager must not pin its device table via the
@@ -342,18 +365,39 @@ class FeedPassManager:
         # covered — a kill here must resume to the full-rebuild state)
         faultpoint.hit("feed_pass.delta_stage.pre")
         fresh_keys = keys[pos < 0]
+        # HBM replica short-circuit: fresh keys the replica tier holds
+        # (still bit-current per the stale-key log + write-back
+        # invalidation) skip the RAM/SSD fault path entirely. Replica
+        # keys always already exist in the store, so skipping
+        # lookup_or_init for them never skips an insert.
+        served = None
+        if self._replica is not None and len(fresh_keys):
+            served = self._replica.serve(fresh_keys)
+        miss_keys = fresh_keys if served is None else fresh_keys[~served.hit]
         if flags.spill_prefetch:
             # async disk-tier readahead BEFORE the fetch: the kernel
             # pages the spill rows in while the fetch assembles rows
             prefetch = getattr(self.store, "prefetch_rows", None)
             if prefetch is not None:
-                prefetch(fresh_keys)
-        fresh_rows = (self.store.peek_rows(fresh_keys) if test_mode
-                      else self.store.lookup_or_init(fresh_keys))
+                prefetch(miss_keys)
+        miss_rows = (self.store.peek_rows(miss_keys) if test_mode
+                     else self.store.lookup_or_init(miss_keys))
         n_fresh = len(fresh_keys)
         n_fresh_pad = bucket_size(max(1, n_fresh))
         staged = np.zeros((n_fresh_pad, cfg.row_width), np.float32)
-        staged[:n_fresh] = fresh_rows
+        # parity: compressed/quantized transfers must convert the served
+        # rows through the same rounding as store-fetched ones, so those
+        # paths fill the hit rows HOST-side before conversion; plain-f32
+        # fills them device-side from the replica plane below
+        host_fill = bool(cfg.storage != "f32"
+                         or (flags.transfer_compress_embedx
+                             and cfg.total_dim))
+        if served is None:
+            staged[:n_fresh] = miss_rows
+        else:
+            staged[np.flatnonzero(~served.hit)] = miss_rows
+            if host_fill:
+                staged[np.flatnonzero(served.hit)] = served.rows
         t1 = time.perf_counter()
         repl = self._repl_sharding()
         if cfg.storage != "f32":
@@ -364,6 +408,19 @@ class FeedPassManager:
             fresh_dev = jax.device_put(staged, repl)
         else:
             fresh_dev = jnp.asarray(staged)
+        if served is not None and not host_fill:
+            # device-side scatter of the replica plane's hit rows into
+            # the staged plane (HBM→HBM; pads repeat the last pair)
+            dst = np.flatnonzero(served.hit).astype(np.int32)
+            k = len(dst)
+            k_pad = bucket_size(k)
+            dst_p = np.full(k_pad, dst[k - 1], np.int32)
+            dst_p[:k] = dst
+            src_p = np.full(k_pad, served.src[k - 1], np.int32)
+            src_p[:k] = served.src
+            fresh_dev = _replica_fill_jit(repl)(fresh_dev, served.plane,
+                                                jnp.asarray(dst_p),
+                                                jnp.asarray(src_p))
         # barrier before the clock stops: device_put is async and the
         # h2d component must carry the transfer, not the dispatch (this
         # runs on the feed thread under begin_feed_pass, so blocking
@@ -377,6 +434,7 @@ class FeedPassManager:
         # begin_feed_pass (background-thread events carry the pass tag)
         mon_event("feed_pass_staged", n_fresh=int(n_fresh),
                   n_keys=int(len(keys)),
+                  replica_hits=int(served.n if served is not None else 0),
                   h2d_bytes=int(transfer_bytes(cfg, n_fresh_pad)))
         return _Staging(keys=keys, pos_prev=pos, fresh_dev=fresh_dev,
                         n_fresh=n_fresh, n_stale=n_stale,
@@ -529,7 +587,12 @@ class FeedPassManager:
         if len(retiring) == 0:
             return 0
         rows, nbytes = fetch_rows(prev.table, retiring, self.store.cfg)
-        self.store.write_back(prev.sorted_keys[retiring - 1], rows)
+        rkeys = prev.sorted_keys[retiring - 1]
+        self.store.write_back(rkeys, rows)
+        if self._replica is not None:
+            # write_back does not enter the store's stale-key log — the
+            # replica tier must be told its copies of these keys are old
+            self._replica.note_written(rkeys)
         self._unsynced[retiring] = False
         stat_add("feed_pass.retired_rows", len(retiring))
         return nbytes
@@ -578,7 +641,10 @@ class FeedPassManager:
         k = ws.num_keys
         row_ids = np.flatnonzero(self._unsynced[1:1 + k]) + 1
         rows, nbytes = fetch_rows(ws.table, row_ids, self.store.cfg)
-        self.store.write_back(ws.sorted_keys[row_ids - 1], rows)
+        fkeys = ws.sorted_keys[row_ids - 1]
+        self.store.write_back(fkeys, rows)
+        if self._replica is not None:
+            self._replica.note_written(fkeys)
         self._unsynced[:] = False
         self.last_d2h_bytes += nbytes
         stat_add("feed_pass.d2h_bytes", nbytes)
@@ -658,6 +724,11 @@ class FeedPassManager:
             ws.table = table
         if self._eager:
             nbytes = ws.end_pass(self.store, ws.table)
+            if self._replica is not None:
+                # eager write-back pushed the pass's touched rows; the
+                # replica cannot tell which, so the whole key set is
+                # conservatively invalidated
+                self._replica.note_written(ws.sorted_keys)
             self.last_d2h_bytes = nbytes
             self.last_end_seconds = time.perf_counter() - t0
             stat_add("feed_pass.d2h_bytes", nbytes)
@@ -673,6 +744,15 @@ class FeedPassManager:
         self.last_end_seconds = time.perf_counter() - t0
         stat_set("feed_pass.last_dirty_rows", int(ws.touched.sum()))
         return 0
+
+    def set_replica(self, replica) -> None:
+        """Attach the trainer's HBM replica hot tier
+        (replica_cache.TrainerReplicaCache, flags.use_replica_cache).
+        From then on staging serves fresh keys from the replica when it
+        can prove them current, and every write-back site invalidates
+        the pushed keys there (store.write_back bypasses the stale-key
+        log by design). None detaches."""
+        self._replica = replica
 
     def register_pre_flush(self, method) -> None:
         """Register a bound method to run at the START of flush(), before

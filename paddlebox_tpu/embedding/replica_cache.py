@@ -14,6 +14,13 @@ TPU design: the cache is a plain (N, D) jnp array placed with a replicated
 sharding — every chip holds the full copy, lookups are local gathers (no
 collectives); the host-side dict does key→row translation at batch-translate
 time, same place the pass working set translates uint64 signs to int32.
+
+Two consumers of the idea live here: :class:`ReplicaCache` (the serving
+hot-key path since PR 7) and :class:`TrainerReplicaCache` (the TRAINING
+pull path under ``flags.use_replica_cache`` — the HBM tier above the
+spill store's RAM cache, rebuilt each pass boundary from the TierManager
+ranking and kept bit-consistent through the stale-key log plus explicit
+write-back invalidation).
 """
 
 from __future__ import annotations
@@ -114,6 +121,208 @@ class ReplicaCache:
                 host, mesh_lib.replicated_sharding(mesh))
             self._device_mesh = mesh
         return self._device_table
+
+
+class _ReplicaServe:
+    """One serve()'s consistent snapshot: the hit mask over the asked
+    keys plus the matching rows three ways — host bytes (``rows``, the
+    bit-parity fill for compressed/quantized transfer paths) and the
+    device plane + per-hit plane indices (``plane``/``src``, the
+    device-side fill for the plain-f32 path). Captured under the replica
+    lock so a concurrent boundary refresh can never mix generations."""
+
+    __slots__ = ("hit", "rows", "plane", "src", "n")
+
+
+class TrainerReplicaCache:
+    """Trainer-side HBM replica hot tier (flags.use_replica_cache) — the
+    top of the SSD→RAM→HBM hierarchy (GpuReplicaCache,
+    box_wrapper.h:140-248, on the TRAINING pull path).
+
+    At every pass boundary ``refresh()`` harvests the rows the spill
+    stores' :class:`~paddlebox_tpu.embedding.tiering.TierManager` ranks
+    hottest (show-count-weighted freq EMA — the same skew argument as
+    Parallax's sparsity-aware placement), keeps the top
+    ``capacity_rows`` by score, and mirrors them to every device as a
+    replicated plane. The feed-pass stager then asks ``serve()`` for a
+    pass's FRESH keys: hits short-circuit the RAM/SSD fault path
+    entirely and fill the staged plane from the replica instead.
+
+    Bit-consistency (the PR-14 mutation-marker discipline):
+
+    - rows are harvested straight from the spill memmap (the
+      authoritative tier) under the store lock — replica bytes ARE store
+      bytes at refresh time;
+    - out-of-cycle mutations (shrink / delta replay / restore) enter the
+      store's stale-key log; ``serve()`` folds ``stale_keys_since`` in
+      before answering and a full log overflow (None) drops the whole
+      replica — exactly how the incremental feed patches a staging;
+    - ``store.write_back`` deliberately does NOT enter that log (it is
+      the steady-state training push), so the feed manager calls
+      ``note_written`` at every write-back site (retirement, flush,
+      eager end-pass) to invalidate the pushed keys here. Within one
+      boundary the two traffic classes cannot collide: write-backs
+      target keys resident in the PREVIOUS pass, serves target keys
+      fresh to the NEXT one.
+
+    Telemetry: ``tiering.replica_hits`` counter (batched per pass,
+    flushed at refresh so the delta lands in the pass's flight record)
+    + ``tiering.replica_rows`` gauge + the ``replica_refresh`` event.
+    """
+
+    def __init__(self, store, mesh: jax.sharding.Mesh | None = None,
+                 capacity_rows: int = 1 << 14):
+        self.store = store
+        self.mesh = mesh
+        self.capacity_rows = max(1, int(capacity_rows))
+        self._row_width = int(store.cfg.row_width)
+        self._lock = threading.Lock()
+        self._keys = np.zeros(0, np.uint64)          # sorted
+        self._rows = np.zeros((0, self._row_width), np.float32)
+        self._valid = np.zeros(0, bool)
+        self._marker = None          # store mutation marker at refresh
+        self._plane = None           # device-resident replicated mirror
+        self.replica_hits = 0        # cumulative, tests/observability
+        self._stat_hits = 0          # batched → tiering.replica_hits
+        self.refreshes = 0
+
+    def __len__(self) -> int:
+        return int(self._valid.sum())
+
+    # ---- pass boundary (main thread) ----------------------------------
+
+    def refresh(self) -> int:
+        """Rebuild the replica from the tier's current ranking; returns
+        the replica row count. Flushes the batched hit counter FIRST so
+        the hits a pass's staging recorded land in that pass's flight
+        record (refresh runs before the hub's end-of-pass commit). No-op
+        (empty replica) for untiered stores — there is no tier ranking
+        to harvest."""
+        from paddlebox_tpu.embedding import tiering as tiering_lib
+        from paddlebox_tpu.monitor import counter_add, event, gauge_set
+        marker_fn = getattr(self.store, "mutation_marker", None)
+        # the marker is captured BEFORE the harvest: a mutation landing
+        # mid-harvest is then re-checked by the next serve()'s
+        # stale_keys_since(marker) — conservative, never stale
+        marker = marker_fn() if marker_fn is not None else None
+        ks: list[np.ndarray] = []
+        rs: list[np.ndarray] = []
+        sc: list[np.ndarray] = []
+        for sub in tiering_lib._spill_subs(self.store):
+            with sub._lock:
+                live = sub._ctags[sub._ctags >= 0]
+                if not live.size:
+                    continue
+                rid = np.unique(live)
+                ks.append(sub._keys[rid])
+                # straight from the memmap (the authoritative tier), NOT
+                # the RAM cache plane: replica bytes == store bytes by
+                # construction, and the read perturbs no tier signal
+                rs.append(np.array(sub._rows[rid], dtype=np.float32))
+                sc.append(np.asarray(sub.tier.score(rid), np.float64))
+        if ks:
+            keys = np.concatenate(ks)
+            rows = np.concatenate(rs)
+            scores = np.concatenate(sc)
+            if len(keys) > self.capacity_rows:
+                top = np.argpartition(
+                    -scores, self.capacity_rows - 1)[:self.capacity_rows]
+                keys, rows = keys[top], rows[top]
+            order = np.argsort(keys)
+            keys = keys[order]
+            rows = np.ascontiguousarray(rows[order])
+            # plane built BEFORE taking the replica lock: device_put can
+            # block, and serve() runs on the feed thread
+            plane = (jax.device_put(rows,
+                                    mesh_lib.replicated_sharding(self.mesh))
+                     if self.mesh is not None else jnp.asarray(rows))
+        else:
+            keys = np.zeros(0, np.uint64)
+            rows = np.zeros((0, self._row_width), np.float32)
+            plane = None
+        with self._lock:
+            flush, self._stat_hits = self._stat_hits, 0
+            self._keys, self._rows = keys, rows
+            self._valid = np.ones(len(keys), bool)
+            self._marker = marker if len(keys) else None
+            self._plane = plane
+        if flush:
+            counter_add("tiering.replica_hits", flush)
+        self.refreshes += 1
+        n = len(keys)
+        gauge_set("tiering.replica_rows", n)
+        event("replica_refresh", rows=int(n), hits_flushed=int(flush))
+        return n
+
+    # ---- staging path (feed thread) -----------------------------------
+
+    def serve(self, keys: np.ndarray) -> _ReplicaServe | None:
+        """Answer a staging's fresh-key pull from the replica: the hit
+        mask plus the hit rows (host bytes and device-plane indices).
+        None = nothing to serve (empty/dropped replica, no hits, or the
+        store's stale-key log overflowed since the refresh — the
+        unprovable case drops everything, like the incremental feed)."""
+        keys = np.asarray(keys).astype(np.uint64)
+        marker = self._marker
+        if len(keys) == 0 or marker is None:
+            return None
+        marker_fn = getattr(self.store, "mutation_marker", None)
+        stale_fn = getattr(self.store, "stale_keys_since", None)
+        if marker_fn is None or stale_fn is None:
+            return None
+        # capture the NEW marker before asking for staleness since the
+        # OLD one: a mutation racing between the two calls is both
+        # invalidated now and re-checked next serve. The store calls run
+        # OUTSIDE the replica lock (they take the store's own).
+        new_marker = marker_fn()
+        stale = stale_fn(marker)
+        with self._lock:
+            if self._marker != marker or not len(self._keys):
+                return None          # a refresh swapped state mid-serve
+            if stale is None:
+                # log overflow — staleness unprovable, drop the replica
+                self._valid[:] = False
+                self._marker = None
+                return None
+            if len(stale):
+                pos = np.searchsorted(self._keys,
+                                      np.asarray(stale, np.uint64))
+                pos = np.minimum(pos, len(self._keys) - 1)
+                m = self._keys[pos] == stale
+                if m.any():
+                    self._valid[pos[m]] = False
+            self._marker = new_marker
+            pos = np.searchsorted(self._keys, keys)
+            pos = np.minimum(pos, len(self._keys) - 1)
+            hit = (self._keys[pos] == keys) & self._valid[pos]
+            n = int(hit.sum())
+            if not n:
+                return None
+            self.replica_hits += n
+            self._stat_hits += n
+            out = _ReplicaServe()
+            out.hit = hit
+            out.n = n
+            out.src = pos[hit].astype(np.int32)
+            out.rows = self._rows[out.src]           # fancy-index copy
+            out.plane = self._plane
+            return out
+
+    def note_written(self, keys: np.ndarray) -> None:
+        """Invalidate keys the feed manager just pushed through
+        ``store.write_back`` — the one mutation class the store's
+        stale-key log deliberately does not record."""
+        keys = np.asarray(keys).astype(np.uint64)
+        if len(keys) == 0 or not len(self._keys):
+            return
+        with self._lock:
+            if not len(self._keys):
+                return
+            pos = np.searchsorted(self._keys, keys)
+            pos = np.minimum(pos, len(self._keys) - 1)
+            m = self._keys[pos] == keys
+            if m.any():
+                self._valid[pos[m]] = False
 
 
 def pull_cache_value(cache_table: jnp.ndarray, idx: jnp.ndarray

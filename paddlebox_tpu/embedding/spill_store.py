@@ -8,18 +8,26 @@ store was a pure in-RAM numpy arena, bounding table capacity by host DRAM.
 
 :class:`SpillEmbeddingStore` replaces the arena with a **memory-mapped row
 file** (the SSD tier — capacity bounded by disk) plus a fixed-size
-**direct-mapped RAM row cache** (the host-DRAM hot tier). Reads come from
-the cache when warm and fault in from the file otherwise; writes go
+**set-associative RAM row cache** (the host-DRAM hot tier). Reads come
+from the cache when warm and fault in from the file otherwise; writes go
 through to the file (the authoritative tier) and install into the cache.
-Cache placement is driven by the tier manager
-(:class:`~paddlebox_tpu.embedding.tiering.TierManager`): admission and
-victim selection are show-count-weighted off the observed per-row
-traffic, re-scored at every pass boundary (``tier_end_pass``), so a cold
-scan can never thrash the hot rows out of RAM — the direct-mapped "last
-wins" install survives only as the measured ``tier_policy="direct"``
-baseline. The pass-granular access pattern does the LoadSSD2Mem job
-implicitly: a working-set build (`lookup_or_init` over the pass's keys)
-pulls exactly the pass's rows through the cache.
+Geometry (``flags.spill_cache_assoc``, default 4-way): the slot plane is
+split into ``n_sets = cache_rows // assoc`` sets of ``assoc`` ways each,
+``set = row_id % n_sets``, so up to ``assoc`` rows that collide on the
+same set index coexist instead of evicting each other — the conflict
+misses that capped a direct-mapped cache's hit rate below its budget on
+adversarial slot collisions (counted: ``tiering.conflict_misses`` = a
+miss whose whole set is live). Cache placement WITHIN a set is driven by
+the tier manager (:class:`~paddlebox_tpu.embedding.tiering.TierManager`):
+the victim is the set's coldest way by the show-count-weighted score
+(empty ways first) and admission contests that victim, re-scored at
+every pass boundary (``tier_end_pass``), so a cold scan can never thrash
+the hot rows out of RAM. ``tier_policy="direct"`` keeps the legacy
+1-way always-install geometry as the measured baseline; ``assoc=1``
+under ``freq`` reproduces the old direct-mapped placement exactly
+(``slot = row_id % cache_rows``). The pass-granular access pattern does
+the LoadSSD2Mem job implicitly: a working-set build (`lookup_or_init`
+over the pass's keys) pulls exactly the pass's rows through the cache.
 
 Checkpointing: base/delta payloads **stream from the memmap in bounded
 chunks** (``_save_base_payload``/``_save_delta_payload`` — the full row
@@ -47,6 +55,7 @@ import zipfile
 import numpy as np
 from numpy.lib import format as npy_format
 
+from paddlebox_tpu.config import flags as config_flags
 from paddlebox_tpu.embedding.config import EmbeddingConfig
 from paddlebox_tpu.embedding.store import HostEmbeddingStore
 from paddlebox_tpu.embedding.tiering import TierManager
@@ -95,18 +104,25 @@ class SpillEmbeddingStore(HostEmbeddingStore):
 
     def __init__(self, cfg: EmbeddingConfig, spill_dir: str | None = None,
                  cache_rows: int = 1 << 16, initial_capacity: int = 1024,
-                 tier_policy: str = "freq"):
+                 tier_policy: str = "freq", cache_assoc: int | None = None):
         self._spill_dir = spill_dir or tempfile.mkdtemp(prefix="pbtpu_spill_")
         os.makedirs(self._spill_dir, exist_ok=True)
         self._rows_path = os.path.join(self._spill_dir, "rows.dat")
-        self._cache_slots = max(1, int(cache_rows))
-        # direct-mapped cache: slot = row_id % cache_slots; WHAT occupies
-        # a slot is the tier manager's call (frequency-aware admission)
-        self._ctags = np.full(self._cache_slots, -1, dtype=np.int64)
-        self._cdata = np.zeros((self._cache_slots, cfg.row_width),
-                               dtype=np.float32)
+        # set-associative geometry: row_id % n_sets picks the SET, the
+        # tier manager picks the way within it. cache_assoc=None resolves
+        # to flags.spill_cache_assoc for the freq policy and to 1 for
+        # "direct" (the measured direct-mapped baseline keeps its legacy
+        # geometry at the same total budget).
+        if cache_assoc is None:
+            cache_assoc = (1 if tier_policy == "direct"
+                           else max(1, int(config_flags.spill_cache_assoc)))
+        self._init_geometry(cache_rows, cache_assoc, cfg.row_width)
         self.cache_hits = 0
         self.cache_misses = 0
+        # misses whose whole set was live — the geometry's share of the
+        # miss rate (a bigger budget would NOT have helped; more ways
+        # would). Flushed per pass as tiering.conflict_misses.
+        self.conflict_misses = 0
         # cumulative wall seconds spent faulting rows in from the disk
         # tier (the memmap read below) — the feed-pass stager reads the
         # delta per boundary for the flight record's boundary_seconds
@@ -123,9 +139,42 @@ class SpillEmbeddingStore(HostEmbeddingStore):
         self._stat_hits = 0
         self._stat_misses = 0
         self._stat_prefetched = 0
+        self._stat_conflicts = 0
         self.tier = TierManager(max(initial_capacity, 1),
                                 policy=tier_policy)
         super().__init__(cfg, initial_capacity)
+
+    def _init_geometry(self, cache_rows: int, assoc: int,
+                       row_width: int) -> None:
+        """(Re)shape the cache plane: ``n_sets`` sets of ``assoc`` ways,
+        set-major layout (``slot = set * assoc + way``). The total slot
+        count rounds DOWN to a whole number of sets so every set has
+        exactly ``assoc`` ways; ``assoc=1`` degenerates to the legacy
+        direct-mapped ``slot = row_id % cache_rows``."""
+        budget = max(1, int(cache_rows))
+        self._assoc = max(1, min(int(assoc), budget))
+        self._n_sets = max(1, budget // self._assoc)
+        self._cache_slots = self._n_sets * self._assoc
+        self._ctags = np.full(self._cache_slots, -1, dtype=np.int64)
+        self._cdata = np.zeros((self._cache_slots, row_width),
+                               dtype=np.float32)
+
+    def _probe(self, idx: np.ndarray):
+        """(hit, slot, set_full): multi-way tag probe. ``slot`` holds the
+        matching way's slot at hit positions (undefined at misses);
+        ``set_full`` marks rows whose whole set is live — a miss there is
+        a conflict miss."""
+        base = (idx % self._n_sets) * self._assoc
+        if self._assoc == 1:
+            tags = self._ctags[base]
+            hit = tags == idx
+            return hit, base, tags >= 0
+        cand = base[:, None] + np.arange(self._assoc, dtype=np.int64)
+        tags = self._ctags[cand]
+        match = tags == idx[:, None]
+        hit = match.any(axis=1)
+        slot = base + match.argmax(axis=1)
+        return hit, slot, (tags >= 0).all(axis=1)
 
     # ---- storage hooks -------------------------------------------------
 
@@ -144,11 +193,41 @@ class SpillEmbeddingStore(HostEmbeddingStore):
         return np.memmap(self._rows_path, dtype=np.float32, mode="r+",
                          shape=(capacity, w))
 
-    def _install(self, idx: np.ndarray, slot: np.ndarray,
-                 rows: np.ndarray) -> None:
+    def _victim_slots(self, idx: np.ndarray) -> np.ndarray:
+        """Per candidate, the slot it would install into: its set's ways
+        in victim-priority order — empty ways first, then occupants
+        coldest-first by tier score — with batch-internal candidates of
+        the same set spread across successive priority ranks, so one
+        batch can fill a whole set instead of contending for its first
+        empty way (``assoc=1``: the single candidate slot, i.e. the
+        legacy direct-mapped victim)."""
+        base = (idx % self._n_sets) * self._assoc
+        if self._assoc == 1:
+            return base
+        cand = base[:, None] + np.arange(self._assoc, dtype=np.int64)
+        tags = self._ctags[cand]
+        occ = tags >= 0
+        scores = np.where(occ, self.tier.score(np.where(occ, tags, 0)),
+                          -np.inf)
+        # per-set victim priority: ways sorted empty-first then coldest
+        # (stable, so ties keep way order)
+        order = np.argsort(scores, axis=1, kind="stable")
+        # occurrence rank of each candidate within its set in THIS batch
+        set_id = base // self._assoc
+        sort = np.argsort(set_id, kind="stable")
+        ss = set_id[sort]
+        starts = np.flatnonzero(np.r_[True, ss[1:] != ss[:-1]])
+        runs = np.diff(np.r_[starts, len(ss)])
+        rank = np.empty(len(idx), np.int64)
+        rank[sort] = np.arange(len(ss)) - np.repeat(starts, runs)
+        way = order[np.arange(len(idx)), rank % self._assoc]
+        return base + way
+
+    def _install(self, idx: np.ndarray, rows: np.ndarray) -> None:
         """Frequency-aware cache install: each candidate contests its
-        direct-mapped slot's occupant through the tier manager (ties →
+        set's victim way's occupant through the tier manager (ties →
         the newcomer, a strictly hotter resident stays)."""
+        slot = self._victim_slots(idx)
         adm = self.tier.admit(idx, self._ctags[slot])
         if not adm.any():
             return
@@ -168,8 +247,7 @@ class SpillEmbeddingStore(HostEmbeddingStore):
     def _read_rows(self, idx: np.ndarray) -> np.ndarray:
         idx = np.asarray(idx, dtype=np.int64)
         out = np.empty((len(idx), self.cfg.row_width), dtype=np.float32)
-        slot = idx % self._cache_slots
-        hit = self._ctags[slot] == idx
+        hit, slot, set_full = self._probe(idx)
         out[hit] = self._cdata[slot[hit]]
         miss = ~hit
         self.tier.note_access(idx)
@@ -179,12 +257,15 @@ class SpillEmbeddingStore(HostEmbeddingStore):
             rows = np.asarray(self._rows[mi])       # disk-tier read
             self.fault_in_seconds += time.perf_counter() - t0
             out[miss] = rows
-            self._install(mi, slot[miss], rows)
+            self._install(mi, rows)
         nh, nm = int(hit.sum()), int(miss.sum())
+        nc = int((miss & set_full).sum())            # full-set misses
         self.cache_hits += nh
         self.cache_misses += nm
+        self.conflict_misses += nc
         self._stat_hits += nh
         self._stat_misses += nm
+        self._stat_conflicts += nc
         return out
 
     def prefetch_rows(self, keys: np.ndarray) -> int:
@@ -207,8 +288,8 @@ class SpillEmbeddingStore(HostEmbeddingStore):
             idx = idx[idx >= 0].astype(np.int64)
             if len(idx) == 0:
                 return 0
-            slot = idx % self._cache_slots
-            idx = np.unique(idx[self._ctags[slot] != idx])  # misses only
+            hit, _, _ = self._probe(idx)
+            idx = np.unique(idx[~hit])                      # misses only
         if len(idx) == 0:
             return 0
         mm = getattr(self._rows, "_mmap", None)
@@ -234,22 +315,22 @@ class SpillEmbeddingStore(HostEmbeddingStore):
         self._stat_prefetched += n
         return n
 
-    def resize_cache(self, cache_rows: int) -> None:
-        """Re-budget the RAM hot tier (the spill_cache_rows autotune).
+    def resize_cache(self, cache_rows: int,
+                     assoc: int | None = None) -> None:
+        """Re-budget the RAM hot tier (the spill_cache_rows autotune),
+        keeping the current associativity unless ``assoc`` re-shapes it.
         Contents drop — the spill file is authoritative, rows re-fault
         and re-contest admission off their persisted tier signals — so
         a resize is a budget change, never a math change."""
         n = max(1, int(cache_rows))
-        if n == self._cache_slots:
+        a = self._assoc if assoc is None else max(1, int(assoc))
+        if n == self._cache_slots and a == self._assoc:
             return
         # under the store lock: a background feed staging may be inside
-        # lookup_or_init/_read_rows (which hold it) — the slot count and
+        # lookup_or_init/_read_rows (which hold it) — the geometry and
         # the tag/data arrays must swap atomically against those reads
         with self._lock:
-            self._cache_slots = n
-            self._ctags = np.full(n, -1, dtype=np.int64)
-            self._cdata = np.zeros((n, self.cfg.row_width),
-                                   dtype=np.float32)
+            self._init_geometry(n, a, self.cfg.row_width)
 
     def _write_rows(self, idx: np.ndarray, rows: np.ndarray) -> None:
         idx = np.asarray(idx, dtype=np.int64)
@@ -259,18 +340,16 @@ class SpillEmbeddingStore(HostEmbeddingStore):
         # (columns 0/1) for free — the show-count weight of the
         # admission score, clicks counting on top of impressions
         self.tier.note_written(idx, rows[:, 0] + rows[:, 1])
-        slot = idx % self._cache_slots
-        occ = self._ctags[slot]
-        hit = occ == idx
+        hit, slot, _ = self._probe(idx)
         if hit.any():
             self._cdata[slot[hit]] = rows[hit]
         miss = ~hit
         if miss.any():
-            # a just-written row installs into its slot (it used to only
+            # a just-written row installs into its set (it used to only
             # refresh HITS, so a just-trained hot row faulted back in
             # from disk on its next read); admission is still
             # score-contested so cold write-backs cannot thrash the tier
-            self._install(idx[miss], slot[miss], rows[miss])
+            self._install(idx[miss], rows[miss])
 
     def _rows_compacted(self) -> None:
         # shrink/remove reassigned row ids; cached tags and per-row tier
@@ -314,6 +393,10 @@ class SpillEmbeddingStore(HostEmbeddingStore):
         # caller need not re-diff the registry)
         stats["pass_hits"] = int(self._stat_hits)
         stats["pass_misses"] = int(self._stat_misses)
+        stats["pass_conflicts"] = int(self._stat_conflicts)
+        if self._stat_conflicts:
+            counter_add("tiering.conflict_misses", self._stat_conflicts)
+            self._stat_conflicts = 0
         if self._stat_hits:
             counter_add("spill.cache_hits", self._stat_hits)
             self._stat_hits = 0

@@ -20,8 +20,16 @@ pass, and GPU HBM only ever sees the pass working set
   direct-mapped "last wins" install with frequency-aware victim
   selection, re-scored at every pass boundary off the pass's observed
   per-row traffic (the flight-record delta window).
-- **HBM**  — unchanged: the per-pass working set
-  (embedding/working_set.py) + FeedPassManager's resident reuse.
+  Geometry is set-associative (``flags.spill_cache_assoc`` ways per
+  set) so adversarial slot collisions stop capping the hit rate below
+  the budget — conflict misses are counted (tiering.conflict_misses).
+- **HBM**  — the per-pass working set (embedding/working_set.py) +
+  FeedPassManager's resident reuse, plus — under
+  ``flags.use_replica_cache`` — the trainer-side replica hot tier
+  (:class:`~paddlebox_tpu.embedding.replica_cache.TrainerReplicaCache`):
+  a device-resident plane of the rows the TierManager ranks hottest,
+  rebuilt at every pass boundary, serving fresh-key pulls without
+  touching the RAM/SSD path (tiering.replica_hits).
 
 Checkpointing rides the existing chains unchanged in FORMAT: spill
 stores stream their base/delta payloads straight from the memmap
@@ -30,10 +38,10 @@ stores keep per-shard chain dirs, and PassCheckpointer records/verifies
 the shard-prefixed chain members. Crash windows are the closed-registry
 faultpoints ``tiering.save.pre_flush`` / ``tiering.evict.pre``.
 
-Telemetry: ``tiering.{admitted,evicted}`` counters and
-``tiering.{hot_rows,spill_bytes}`` gauges land in the per-pass flight
-record (validated in monitor/flight.py), plus the ``table_tiering``
-identity in the flight-record extras.
+Telemetry: ``tiering.{admitted,evicted,conflict_misses,replica_hits}``
+counters and ``tiering.{hot_rows,spill_bytes,replica_rows}`` gauges land
+in the per-pass flight record (validated in monitor/flight.py), plus the
+``table_tiering`` identity in the flight-record extras.
 """
 
 from __future__ import annotations
@@ -211,7 +219,8 @@ class TierManager:
 def shard_store_factory(tiering: str | None = None,
                         cache_rows: int | None = None,
                         spill_dir: str | None = None,
-                        policy: str = "freq"):
+                        policy: str = "freq",
+                        assoc: int | None = None):
     """A ``store_factory`` for :class:`ShardedEmbeddingStore` (signature
     ``(cfg, initial_capacity, shard) -> store``) selecting the storage
     tier per ``flags.table_tiering`` / ``flags.spill_cache_rows`` /
@@ -235,7 +244,7 @@ def shard_store_factory(tiering: str | None = None,
                    if root else None)
         return SpillEmbeddingStore(cfg, spill_dir=sub_dir, cache_rows=rows,
                                    initial_capacity=initial_capacity,
-                                   tier_policy=policy)
+                                   tier_policy=policy, cache_assoc=assoc)
 
     return factory
 
@@ -283,6 +292,10 @@ def autotune_cache_rows(sub, stats: dict) -> int | None:
         target = max(slots // 2, CACHE_MIN_ROWS)
     else:
         return None
+    # keep the budget a whole number of sets: the store rounds a ragged
+    # budget down, which would make the next decision's `slots` drift
+    assoc = int(getattr(sub, "_assoc", 1))
+    target = max(assoc, (target // assoc) * assoc)
     if target == slots:
         return None
     sub.resize_cache(target)
@@ -350,11 +363,14 @@ def spill_stats(store) -> dict | None:
     if not subs:
         return None
     out = {"cache_rows": 0, "cache_hits": 0, "cache_misses": 0,
-           "hot_rows": 0, "spill_bytes": 0, "admitted": 0, "evicted": 0}
+           "conflict_misses": 0, "hot_rows": 0, "spill_bytes": 0,
+           "admitted": 0, "evicted": 0,
+           "assoc": int(getattr(subs[0], "_assoc", 1))}
     for s in subs:
         out["cache_rows"] += int(s._cache_slots)
         out["cache_hits"] += int(s.cache_hits)
         out["cache_misses"] += int(s.cache_misses)
+        out["conflict_misses"] += int(getattr(s, "conflict_misses", 0))
         out["hot_rows"] += int((s._ctags >= 0).sum())
         out["spill_bytes"] += int(s.spill_file_bytes)
         out["admitted"] += int(s.tier.total_admitted)

@@ -180,6 +180,13 @@ class BoxPS:
         tier = tiering.end_pass_rebalance(self.store)
         if tier is not None:
             out["tiering"] = tier
+        # HBM replica-tier refresh (flags.use_replica_cache): rebuilt off
+        # the ranking the rebalance above just re-scored, and BEFORE the
+        # flight-record commit so the pass's replica-hit delta lands in
+        # this pass's stats_delta
+        if trainer is not None and hasattr(trainer,
+                                           "refresh_replica_boundary"):
+            trainer.refresh_replica_boundary()
         # pass-boundary exchange-wire adaptation (flags.exchange_adaptive):
         # fleet-driven scopes adapt here, mirroring the tier re-eval —
         # BEFORE the flight-record commit so the decision (and any

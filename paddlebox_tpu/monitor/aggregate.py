@@ -99,10 +99,17 @@ def _iter_lines(root: str, path: str):
             yield from f
 
 
-def read_stream(root: str) -> dict:
+def read_stream(root: str, trace_out: "dict | None" = None) -> dict:
     """Parse one rank's stream (all segments, in order) into the compact
     per-rank account: schema-validated flight records, counts + retained
-    samples of the evidence events, and every schema error found."""
+    samples of the evidence events, and every schema error found.
+
+    With ``trace_out`` (an empty dict), the SAME pass over the lines
+    also collects the world-trace plane — ``trace_out`` is filled to the
+    ``trace.read_trace_records`` shape (kept span/flow/lifecycle/flight
+    records, clock probes, bounded by the trace module's per-rank cap)
+    so a consumer needing both views parses every rotated segment
+    once, not twice."""
     files = discover_stream_files(root)
     flights: list[dict] = []
     errors: list[str] = []
@@ -110,6 +117,16 @@ def read_stream(root: str) -> dict:
     evidence: dict[str, list[dict]] = {}
     threads: set[str] = set()
     n = 0
+    keep_types: tuple = ()
+    max_trace = 0
+    if trace_out is not None:
+        # lazy: trace imports this module at top level — the cycle is
+        # broken by deferring this side (same as merge_world_trace)
+        from paddlebox_tpu.monitor import trace as trace_lib
+        keep_types = trace_lib.KEEP_TYPES
+        max_trace = trace_lib.MAX_RECORDS_PER_RANK
+        trace_out.update(root=root, events=0, records=[],
+                         clock_probes=[], dropped=0)
     for path in files:
         seg = posixpath.basename(path)
         for lineno, line in enumerate(_iter_lines(root, path), 1):
@@ -124,6 +141,16 @@ def read_stream(root: str) -> dict:
             n += 1
             name = rec.get("name")
             typ = rec.get("type")
+            if trace_out is not None:
+                trace_out["events"] += 1
+                if name == "trace.clock_probe":
+                    trace_out["clock_probes"].append(
+                        rec.get("fields") or {})
+                elif typ in keep_types:
+                    if len(trace_out["records"]) >= max_trace:
+                        trace_out["dropped"] += 1
+                    else:
+                        trace_out["records"].append(rec)
             if typ != "meta":
                 for e in (flight.validate_flight_record(rec)
                           if typ == "flight_record"
@@ -306,6 +333,28 @@ def aggregate(roots: "list[str]",
     """Merge per-rank telemetry roots into the per-pass world view."""
     streams = [read_stream(r) for r in roots]
     labels = [rank_label(r, i, rank_names) for i, r in enumerate(roots)]
+    return _world_view(streams, labels, roots)
+
+
+def aggregate_with_trace(roots: "list[str]",
+                         rank_names: "list[int] | None" = None
+                         ) -> tuple[dict, dict]:
+    """Both read-side views from ONE pass over the streams: the per-pass
+    world view (:func:`aggregate`) AND the clock-corrected merged world
+    trace (:func:`merge_world_trace`), as ``(world, trace)``. The doctor
+    CLI needs both; calling the two entry points separately parses every
+    rotated segment twice — here each line is read and decoded once."""
+    trace_streams: list[dict] = [{} for _ in roots]
+    streams = [read_stream(r, trace_out=t)
+               for r, t in zip(roots, trace_streams)]
+    labels = [rank_label(r, i, rank_names) for i, r in enumerate(roots)]
+    from paddlebox_tpu.monitor import trace as trace_lib
+    return (_world_view(streams, labels, roots),
+            trace_lib.merge_streams(trace_streams, labels))
+
+
+def _world_view(streams: "list[dict]", labels: "list[int]",
+                roots: "list[str]") -> dict:
     per_pass: dict[int, dict[int, dict]] = {}
     for label, st in zip(labels, streams):
         for fr in st["flight_records"]:

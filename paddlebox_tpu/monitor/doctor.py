@@ -278,6 +278,8 @@ class SpillThrashRule(Rule):
             return "no-data", None
         adm = dict(ctx.pass_deltas("tiering.admitted"))
         evc = dict(ctx.pass_deltas("tiering.evicted"))
+        cnf = dict(ctx.pass_deltas("tiering.conflict_misses"))
+        rep = dict(ctx.pass_deltas("tiering.replica_hits"))
         last_p, last_rate = rates[-1]
         best = max(r for _, r in rates)
         churn = (adm.get(last_p, 0.0) > 0
@@ -286,6 +288,29 @@ class SpillThrashRule(Rule):
         thrash = last_rate < self.ABS_LOW and churn
         if not collapsed and not thrash:
             return "quiet", None
+        # which knob: a miss stream dominated by conflict misses is a
+        # GEOMETRY problem (the whole set was live — more rows won't
+        # help, more ways will); a hot stream with no replica traffic is
+        # leaving the HBM tier on the table
+        last_miss = misses.get(last_p, 0.0)
+        conflict_bound = (last_miss > 0
+                          and cnf.get(last_p, 0.0) >= 0.5 * last_miss)
+        replica_idle = (hits.get(last_p, 0.0) > last_miss
+                        and rep.get(last_p, 0.0) <= 0)
+        suggest = ("raise flags.spill_cache_rows toward the pass working "
+                   "set's hot fraction (rows x row_width x 4B per shard "
+                   "is the RAM bill)")
+        if conflict_bound:
+            suggest = ("conflict misses dominate the miss stream — the "
+                       "geometry, not the budget, is capping the hit "
+                       "rate: raise flags.spill_cache_assoc (more ways "
+                       "per set) before spending RAM on "
+                       "flags.spill_cache_rows")
+        if replica_idle:
+            suggest += ("; hit traffic dominates with zero replica hits "
+                        "— flags.use_replica_cache would serve the "
+                        "hottest rows from the HBM replica tier and "
+                        "skip the RAM probe entirely")
         return "fired", Finding(
             self.id, "warn",
             (f"pass {last_p}: spill hot-tier hit rate "
@@ -294,13 +319,10 @@ class SpillThrashRule(Rule):
              (" with admission/eviction churn" if churn else "")),
             {"hit_rate_per_pass": [(p, round(r, 4)) for p, r in rates],
              "admitted_last_pass": adm.get(last_p),
-             "evicted_last_pass": evc.get(last_p)},
-            "raise flags.spill_cache_rows toward the pass working set's "
-            "hot fraction (rows x row_width x 4B per shard is the RAM "
-            "bill); if the budget is right, the geometry is the suspect "
-            "— direct-mapped conflict misses cap the hit rate on "
-            "adversarial slot collisions (ROADMAP tiered-table "
-            "follow-ups)")
+             "evicted_last_pass": evc.get(last_p),
+             "conflict_misses_last_pass": cnf.get(last_p),
+             "replica_hits_last_pass": rep.get(last_p)},
+            suggest)
 
 
 class DedupDriftRule(Rule):
@@ -860,7 +882,11 @@ def main(argv: "list[str] | None" = None) -> int:
         return 2
     from paddlebox_tpu.monitor import aggregate as agg_lib
     try:
-        world = agg_lib.aggregate(roots, rank_names=rank_names)
+        # one shared pass over every rotated segment feeds BOTH the
+        # per-pass world view and the merged world trace — the doctor
+        # used to parse the whole stream set twice
+        world, merged = agg_lib.aggregate_with_trace(
+            roots, rank_names=rank_names)
     except (OSError, ValueError) as e:
         print(f"doctor: cannot read telemetry roots: {e}",
               file=sys.stderr)
@@ -872,16 +898,12 @@ def main(argv: "list[str] | None" = None) -> int:
     # records, the merged flow edges feed the cross-rank-flow rule (a
     # stream without them is that rule's no-data, never an error)
     detail = None
-    try:
-        from paddlebox_tpu.monitor import trace as trace_lib
-        summary = trace_lib.summarize(
-            agg_lib.merge_world_trace(roots, rank_names=rank_names))
-        # flight records alone render as pass slices but carry no trace
-        # plane — only real span/flow records mean tracing was on
-        if summary.get("span_records") or summary.get("flow_points"):
-            detail = {"world_trace": summary}
-    except (OSError, ValueError):
-        detail = None
+    from paddlebox_tpu.monitor import trace as trace_lib
+    summary = trace_lib.summarize(merged)
+    # flight records alone render as pass slices but carry no trace
+    # plane — only real span/flow records mean tracing was on
+    if summary.get("span_records") or summary.get("flow_points"):
+        detail = {"world_trace": summary}
     report = diagnose(flights=world["flight_records"],
                       counters=world["counters"],
                       evidence=world["evidence"],

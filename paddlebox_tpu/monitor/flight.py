@@ -99,7 +99,8 @@ def validate_flight_record(rec: dict) -> list[str]:
     # be negative (a negative delta means a consumer double-counted or
     # the counter was rebuilt mid-pass), and the tier identity is a flat
     # string like the other engine-identity fields
-    for k in ("tiering.admitted", "tiering.evicted"):
+    for k in ("tiering.admitted", "tiering.evicted",
+              "tiering.conflict_misses", "tiering.replica_hits"):
         v = (rec.get("stats_delta") or {}).get(k)
         if isinstance(v, numbers.Real) and v < 0:
             errs.append(f"stats_delta[{k!r}] is negative — tiering "

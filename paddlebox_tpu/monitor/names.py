@@ -40,6 +40,10 @@ EVENT_NAMES: tuple[str, ...] = (
     # feed pass (embedding/feed_pass.py)
     "feed_pass_staged",
     "feed_pass_flush",
+    # HBM replica hot tier (embedding/replica_cache.TrainerReplicaCache,
+    # flags.use_replica_cache): per-boundary rebuild, carrying the
+    # replica row count + the pass's flushed hit delta
+    "replica_refresh",
     # data plane
     "reader_malformed_line",
     "reader_close_error",

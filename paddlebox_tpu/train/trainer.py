@@ -271,6 +271,18 @@ class Trainer:
         # extra, like table_layout; the tier is a storage choice, never
         # a math change (embedding/tiering.py)
         self.table_tiering = tiering.describe(store)
+        # HBM replica hot tier (flags.use_replica_cache): the top of the
+        # SSD→RAM→HBM hierarchy — a device-resident plane of the rows
+        # the TierManager ranks hottest, rebuilt at every owned pass
+        # boundary (refresh_replica_boundary), serving the stager's
+        # fresh-key pulls without touching the RAM/SSD path. Placement
+        # only: bit-identical on or off.
+        self.replica_cache = None
+        if config_flags.use_replica_cache:
+            from paddlebox_tpu.embedding.replica_cache import \
+                TrainerReplicaCache
+            self.replica_cache = TrainerReplicaCache(store, mesh=mesh)
+            self.feed_mgr.set_replica(self.replica_cache)
         if (self.table_layout == "sharded"
                 and config_flags.exchange_capacity_factor > 0):
             # operator-set starting capacity for the exchange lanes (the
@@ -1370,10 +1382,12 @@ class Trainer:
             table_tiering=self.table_tiering)
         if owned_pass:
             # trainer-owned scope: the BoxPS lifecycle is not driving, so
-            # the pass-boundary tier re-evaluation and the adaptive
-            # exchange-wire re-cost run here instead (BoxPS.end_pass
-            # drives both for fleet-owned scopes)
+            # the pass-boundary tier re-evaluation, the replica-tier
+            # refresh, and the adaptive exchange-wire re-cost run here
+            # instead (BoxPS.end_pass drives all three for fleet-owned
+            # scopes)
             tiering.end_pass_rebalance(self.store)
+            self.refresh_replica_boundary()
             self.adapt_wire_boundary()
             hub.end_pass(metrics=metrics)
         return out
@@ -1390,6 +1404,21 @@ class Trainer:
         limiter, the wire holds."""
         self._flow_attribution = (
             (attribution, wall_seconds) if attribution else None)
+
+    def refresh_replica_boundary(self) -> int | None:
+        """Pass-boundary rebuild of the HBM replica hot tier
+        (flags.use_replica_cache): harvest the tier manager's current
+        hottest rows into the device-resident plane the NEXT pass's
+        staging serves from, and flush the ending pass's batched
+        replica-hit delta so it lands in that pass's flight record.
+        Called once per pass AFTER ``tiering.end_pass_rebalance`` (the
+        refresh reads the re-scored ranking) and BEFORE the hub's
+        end-of-pass commit — by ``train_pass`` for trainer-owned scopes,
+        by ``BoxPS.end_pass`` for fleet-driven ones. Safe no-op (None)
+        when the tier is off."""
+        if self.replica_cache is None:
+            return None
+        return self.replica_cache.refresh()
 
     def adapt_wire_boundary(self):
         """Pass-boundary wire adaptation (flags.exchange_adaptive): run

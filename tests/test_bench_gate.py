@@ -198,6 +198,17 @@ def test_bench_dryrun_smoke():
     assert out["checks"]["spill_fields"], out.get("spill")
     assert out["spill"]["hot_hit_rate"] > out["spill"]["direct_hot_hit_rate"]
     assert out["spill"]["fetch_keys_per_s"] > 0
+    # the set-associative geometry point (PR 17): on the adversarial
+    # colliding stream the N-way cache must beat direct-mapped at the
+    # SAME row budget with byte-identical row files, and the baseline
+    # must show the conflict misses that explain the gap — so the
+    # spill_assoc point enters the BENCH_BEST gate from day one
+    assert out["checks"]["assoc_fields"], out.get("spill_assoc")
+    sa = out["spill_assoc"]
+    assert sa["assoc"] == 4
+    assert sa["assoc_hit_rate"] > sa["direct_hit_rate"]
+    assert sa["conflict_misses_direct"] > 0
+    assert sa["parity"] is True
     # the world-trace embed (ISSUE 15): a traced probe pass merged into
     # a Chrome-trace summary with a publish flow edge, and the span-
     # level data reached the doctor's cross-rank-flow rule

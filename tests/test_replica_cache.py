@@ -1,0 +1,194 @@
+"""TrainerReplicaCache: the HBM replica hot tier on the TRAINING pull
+path (flags.use_replica_cache).
+
+Reference role: GpuReplicaCache (box_wrapper.h:140-248) above the
+SSD+RAM hierarchy — the hottest rows mirrored to every device, the
+staging short-circuiting the RAM/SSD fault path for them. The contract
+under test is bit-consistency: a replica-served run must be
+byte-identical to the no-replica baseline through a mutation-heavy
+stream (write-backs, shrinks), on the single-store and sharded+spill
+paths alike.
+"""
+
+import numpy as np
+
+import jax.numpy as jnp
+
+from paddlebox_tpu import monitor
+from paddlebox_tpu.embedding import (EmbeddingConfig,
+                                     ShardedEmbeddingStore,
+                                     SpillEmbeddingStore, tiering)
+from paddlebox_tpu.embedding.feed_pass import FeedPassManager
+from paddlebox_tpu.embedding.replica_cache import TrainerReplicaCache
+from paddlebox_tpu.monitor.flight import validate_flight_record
+from paddlebox_tpu.parallel import make_mesh
+
+
+def cfg_small(**kw):
+    kw.setdefault("dim", 4)
+    kw.setdefault("optimizer", "adagrad")
+    kw.setdefault("learning_rate", 0.1)
+    return EmbeddingConfig(**kw)
+
+
+def _keys(lo, hi):
+    return np.arange(lo, hi, dtype=np.uint64) * np.uint64(2654435761) + 1
+
+
+# ---------------------------------------------------------------------------
+# unit surface: refresh / serve / invalidation
+# ---------------------------------------------------------------------------
+
+def test_refresh_serves_tier_ranked_rows_bit_exact(tmp_path):
+    st = SpillEmbeddingStore(cfg_small(), spill_dir=str(tmp_path / "s"),
+                             cache_rows=256)
+    keys = _keys(0, 128)
+    rows = st.lookup_or_init(keys)
+    rows[:, 0] = 5.0
+    st.write_back(keys, rows)
+    tiering.end_pass_rebalance(st)
+    rc = TrainerReplicaCache(st, mesh=None, capacity_rows=1 << 10)
+    assert rc.refresh() == 128
+    out = rc.serve(keys)
+    assert out is not None and out.n == 128 and out.hit.all()
+    # replica bytes ARE store bytes (harvested from the memmap)
+    np.testing.assert_array_equal(out.rows, st.get_rows(keys))
+    np.testing.assert_array_equal(np.asarray(out.plane)[out.src],
+                                  out.rows)
+
+
+def test_note_written_and_stale_log_invalidate_served_keys(tmp_path):
+    st = SpillEmbeddingStore(cfg_small(), spill_dir=str(tmp_path / "s"),
+                             cache_rows=256)
+    keys = _keys(0, 64)
+    rows = st.lookup_or_init(keys)
+    rows[:, 0] = 5.0
+    st.write_back(keys, rows)
+    tiering.end_pass_rebalance(st)
+    rc = TrainerReplicaCache(st, mesh=None)
+    rc.refresh()
+    # write-back invalidation: the one mutation class outside the log
+    rc.note_written(keys[:16])
+    out = rc.serve(keys)
+    assert out is not None and out.n == 48
+    assert not out.hit[:16].any() and out.hit[16:].all()
+    # a shrink that evicts rows enters the stale-key log — the next
+    # serve folds it in before answering
+    rows2 = st.get_rows(keys)
+    rows2[16:32, 0] = 0.0
+    st.write_back(keys, rows2)          # doomed rows lose their shows
+    rc.refresh()                        # clean replica of current bytes
+    assert st.shrink(min_show=1.0) == 16
+    out2 = rc.serve(keys)
+    assert out2 is not None
+    assert not out2.hit[16:32].any()    # evicted keys never served
+    assert out2.hit[32:].all()
+
+
+def test_stale_log_overflow_drops_whole_replica(tmp_path):
+    st = SpillEmbeddingStore(cfg_small(), spill_dir=str(tmp_path / "s"),
+                             cache_rows=64)
+    keys = _keys(0, 32)
+    st.write_back(keys, st.lookup_or_init(keys))
+    tiering.end_pass_rebalance(st)
+    rc = TrainerReplicaCache(st, mesh=None)
+    rc.refresh()
+    assert len(rc) == 32
+    # unprovable staleness (log overflow → None): everything drops
+    st.stale_keys_since = lambda marker: None
+    assert rc.serve(keys) is None
+    assert len(rc) == 0
+    assert rc.serve(keys) is None       # stays dropped until a refresh
+
+
+# ---------------------------------------------------------------------------
+# the acceptance bar: replica run bit-identical to the no-replica
+# baseline through a mutation-heavy feed stream, hits on the record
+# ---------------------------------------------------------------------------
+
+A = _keys(0, 256)
+B = _keys(1000, 1256)
+C = _keys(2000, 2064)      # doomed: zero shows, evicted mid-stream
+
+
+def _run_stream(store, mesh=None, use_replica=False):
+    """Three passes (A∪B∪C → A → A∪B) through the incremental feed with
+    write-backs every pass and a REAL eviction between the pass-2
+    replica refresh and pass 3 — so pass 3's serve must fold the
+    stale-key log (C gone, row ids compacted) out of the replica while
+    still hitting every fresh B key. Returns (final store bytes,
+    replica, flight records)."""
+    mgr = FeedPassManager(store, mesh) if mesh is not None \
+        else FeedPassManager(store)
+    rc = None
+    if use_replica:
+        rc = TrainerReplicaCache(store, mesh=mesh, capacity_rows=1 << 10)
+        mgr.set_replica(rc)
+    h = monitor.hub()
+    h.disable()
+    ms = monitor.MemorySink()
+    h.enable(ms)
+    recs = []
+    try:
+        for p, ks in enumerate((np.concatenate([A, B, C]), A,
+                                np.concatenate([A, B]))):
+            h.begin_pass(p + 1)
+            ws = mgr.begin_pass(ks)
+            idx = ws.translate(ks)
+            t = np.array(ws.table)
+            t[idx, 2] += float(p + 1)
+            t[idx, 0] += 4.0            # shows — the tier ranking signal
+            if p == 0:
+                t[ws.translate(C), 0] = 0.0   # C never earns its slot
+            mgr.end_pass(ws, jnp.asarray(t))
+            # the trainer's boundary order: rebalance → replica refresh
+            # → flight-record commit (the hit delta lands in THIS pass)
+            tiering.end_pass_rebalance(store)
+            if rc is not None:
+                rc.refresh()
+            recs.append(h.end_pass())
+            # out-of-cycle mutation AFTER the pass-2 refresh captured
+            # its marker: evicting C enters the stale-key log and
+            # compacts row ids under the replica — pass 3's serve must
+            # prove B's bytes are still current before answering
+            if p == 1:
+                assert store.shrink(min_show=0.5) == len(C)
+    finally:
+        h.disable()
+    mgr.flush()
+    return store.get_rows(np.concatenate([A, B])), rc, recs
+
+
+def test_replica_run_bit_identical_with_hits_in_flight_record(tmp_path):
+    rows = {}
+    for name, use in (("base", False), ("repl", True)):
+        st = SpillEmbeddingStore(cfg_small(),
+                                 spill_dir=str(tmp_path / name),
+                                 cache_rows=1024)
+        rows[name], rc, recs = _run_stream(st, use_replica=use)
+    # pass 3's fresh keys (B re-entering) were served from the replica…
+    assert rc.replica_hits == len(B)
+    d3 = recs[2]["stats_delta"]
+    assert d3.get("tiering.replica_hits") == len(B)
+    # the replica_rows gauge moved inside pass 3 (C's eviction shrank
+    # the harvest), so its delta is on the record; the post-stream
+    # flush's note_written then rightly empties the replica
+    assert d3.get("tiering.replica_rows", 0.0) != 0.0
+    assert rc.refreshes == 3 and len(rc) == 0
+    assert all(validate_flight_record(r) == [] for r in recs)
+    # …and the training stream is bit-identical to the baseline's
+    np.testing.assert_array_equal(rows["repl"], rows["base"])
+
+
+def test_replica_parity_on_sharded_spill_mesh(tmp_path):
+    mesh = make_mesh(8)
+    rows = {}
+    for name, use in (("base", False), ("repl", True)):
+        ss = ShardedEmbeddingStore(
+            cfg_small(), 2, store_factory=tiering.shard_store_factory(
+                tiering="spill", cache_rows=1024,
+                spill_dir=str(tmp_path / name)))
+        rows[name], rc, recs = _run_stream(ss, mesh=mesh, use_replica=use)
+    assert rc.replica_hits == len(B)
+    assert recs[2]["stats_delta"].get("tiering.replica_hits") == len(B)
+    np.testing.assert_array_equal(rows["repl"], rows["base"])

@@ -229,6 +229,137 @@ def test_cache_stat_counters_batch_to_pass_boundary(tmp_path):
 
 
 # ---------------------------------------------------------------------------
+# set-associative geometry (flags.spill_cache_assoc): adversarial slot
+# collisions stop capping the hit rate, and the geometry is placement
+# only — never a math change
+# ---------------------------------------------------------------------------
+
+def test_set_assoc_holds_colliding_hot_set_where_direct_thrashes(tmp_path):
+    """The adversarial stream the assoc geometry exists for: `assoc` hot
+    rows per direct-mapped slot. 1-way, they evict EACH OTHER every pass
+    (conflict misses — no budget increase fixes that); 4-way, the whole
+    colliding set coexists and the hot re-read holds at 100%. Identical
+    op sequences must leave byte-identical row files either way."""
+    C, assoc = 64, 4
+    # build the whole space first so row id i is pinned to key i — the
+    # hot ids j, j+C, j+2C, j+3C then land 4-deep on direct slot j and
+    # exactly fill the 4-way set j
+    hot_ids = np.concatenate(
+        [np.arange(C // assoc) + i * C for i in range(assoc)])
+    results = {}
+    for name, pol, ways in (("assoc", "freq", assoc),
+                            ("direct", "direct", 1)):
+        st = SpillEmbeddingStore(_cfg(), spill_dir=str(tmp_path / name),
+                                 cache_rows=C, tier_policy=pol,
+                                 cache_assoc=ways)
+        space = _keys(0, 8 * C)
+        st.lookup_or_init(space)
+        hot = space[hot_ids]
+        rows = st.lookup_or_init(hot)
+        rows[:, 0] = 8.0                     # hot rows carry real shows
+        st.write_back(hot, rows)
+        st.tier_end_pass()
+        last_hits = 0
+        for p in range(2):
+            h0 = st.cache_hits
+            r = st.lookup_or_init(hot)
+            last_hits = st.cache_hits - h0
+            r[:, 0] += 1.0
+            st.write_back(hot, r)
+            cold = _keys(4 * C + p * C, 4 * C + (p + 1) * C)
+            st.write_back(cold, st.lookup_or_init(cold))
+            st.tier_end_pass()
+        results[name] = (st, last_hits)
+    sa, hits_a = results["assoc"]
+    sd, hits_d = results["direct"]
+    assert sa._n_sets * sa._assoc == C and sa._assoc == assoc
+    assert hits_a == len(hot_ids)            # whole colliding set resident
+    assert hits_d < hits_a                   # direct-mapped thrashed it
+    assert sd.conflict_misses > 0            # ...and says why
+    np.testing.assert_array_equal(np.array(sa._rows[:sa._n]),
+                                  np.array(sd._rows[:sd._n]))
+
+
+def test_cache_assoc_flag_default_and_direct_forces_one(tmp_path):
+    st = SpillEmbeddingStore(_cfg(), spill_dir=str(tmp_path / "a"),
+                             cache_rows=16)
+    assert st._assoc == flags.spill_cache_assoc == 4
+    sd = SpillEmbeddingStore(_cfg(), spill_dir=str(tmp_path / "d"),
+                             cache_rows=16, tier_policy="direct")
+    assert sd._assoc == 1            # direct IS the 1-way geometry
+    set_flags(spill_cache_assoc=2)
+    try:
+        st2 = SpillEmbeddingStore(_cfg(), spill_dir=str(tmp_path / "b"),
+                                  cache_rows=16)
+        assert st2._assoc == 2
+    finally:
+        set_flags(spill_cache_assoc=4)
+
+
+def test_resize_cache_assoc_roundtrip(tmp_path):
+    """The autotune's resize keeps the current associativity (and the
+    budget a whole number of sets); an explicit ``assoc`` re-shapes the
+    geometry. Either way contents re-fault from the authoritative spill
+    file — every row still reads back exactly."""
+    st = SpillEmbeddingStore(_cfg(), spill_dir=str(tmp_path / "s"),
+                             cache_rows=64, cache_assoc=4)
+    keys = _keys(0, 200)
+    rows = st.lookup_or_init(keys).copy()
+    assert (st._n_sets, st._assoc, st._cache_slots) == (16, 4, 64)
+    st.resize_cache(32)                          # assoc sticks
+    assert (st._n_sets, st._assoc, st._cache_slots) == (8, 4, 32)
+    np.testing.assert_array_equal(st.get_rows(keys), rows)
+    st.resize_cache(48, assoc=3)                 # reshape
+    assert (st._n_sets, st._assoc, st._cache_slots) == (16, 3, 48)
+    np.testing.assert_array_equal(st.get_rows(keys), rows)
+    st.resize_cache(40, assoc=1)                 # legacy direct-mapped
+    assert (st._n_sets, st._assoc, st._cache_slots) == (40, 1, 40)
+    np.testing.assert_array_equal(st.get_rows(keys), rows)
+    # a ragged budget rounds down to whole sets, never below one set
+    st.resize_cache(13, assoc=4)
+    assert (st._n_sets, st._assoc, st._cache_slots) == (3, 4, 12)
+    np.testing.assert_array_equal(st.get_rows(keys), rows)
+
+
+def test_autotune_keeps_budget_set_aligned(tmp_path):
+    """The grow/shrink targets align to the current associativity so the
+    recorded slot count never drifts from the decision's arithmetic."""
+    st = SpillEmbeddingStore(_cfg(), spill_dir=str(tmp_path / "s"),
+                             cache_rows=tiering.CACHE_MIN_ROWS,
+                             cache_assoc=4)
+    st.lookup_or_init(_keys(0, 8 * tiering.CACHE_MIN_ROWS))
+    stats = st.tier_end_pass()
+    target = tiering.autotune_cache_rows(st, stats)
+    if target is not None:                       # thrash path fired
+        assert target % st._assoc == 0
+        assert st._cache_slots == target
+
+
+def test_conflict_counters_batch_to_pass_boundary(tmp_path):
+    """tiering.conflict_misses rides the same batch-to-boundary
+    discipline as the hit/miss counters: accumulated in-store, flushed
+    once per tier_end_pass (with the per-pass window in the returned
+    stats), so the delta lands in the pass's flight record."""
+    st = SpillEmbeddingStore(_cfg(), spill_dir=str(tmp_path / "s"),
+                             cache_rows=8, cache_assoc=2)
+    snap0 = monitor.STATS.snapshot()
+    st.lookup_or_init(_keys(0, 64))        # cold fill: sets still empty
+    assert st.conflict_misses == 0         # compulsory, not conflict
+    st.get_rows(_keys(0, 32))              # sets live now → conflicts
+    assert st.conflict_misses > 0
+    snap1 = monitor.STATS.snapshot()
+    assert snap1.get("tiering.conflict_misses", 0.0) == \
+        snap0.get("tiering.conflict_misses", 0.0)   # batched, not live
+    stats = st.tier_end_pass()
+    assert stats["pass_conflicts"] == st.conflict_misses
+    snap2 = monitor.STATS.snapshot()
+    assert (snap2.get("tiering.conflict_misses", 0.0)
+            - snap0.get("tiering.conflict_misses", 0.0)) \
+        == st.conflict_misses
+    assert st._stat_conflicts == 0
+
+
+# ---------------------------------------------------------------------------
 # flag-driven construction
 # ---------------------------------------------------------------------------
 
@@ -300,11 +431,23 @@ def test_flight_validator_rejects_bad_tiering_fields():
     bad_counter = dict(base, stats_delta={"tiering.admitted": -3})
     assert any("monotone" in e for e in
                validate_flight_record(bad_counter))
+    # the set-assoc / replica counters are monotone too — a negative
+    # per-pass delta means a consumer double-counted the flush
+    bad_conflicts = dict(base,
+                         stats_delta={"tiering.conflict_misses": -1})
+    assert any("monotone" in e for e in
+               validate_flight_record(bad_conflicts))
+    bad_replica = dict(base, stats_delta={"tiering.replica_hits": -2})
+    assert any("monotone" in e for e in
+               validate_flight_record(bad_replica))
     bad_extra = dict(base, extra={"table_tiering": 7})
     assert any("table_tiering" in e for e in
                validate_flight_record(bad_extra))
     ok = dict(base, stats_delta={"tiering.admitted": 3,
-                                 "tiering.evicted": 0},
+                                 "tiering.evicted": 0,
+                                 "tiering.conflict_misses": 5,
+                                 "tiering.replica_hits": 12,
+                                 "tiering.replica_rows": -64},
               extra={"table_tiering": "sharded+spill"})
     assert validate_flight_record(ok) == []
 
