@@ -332,6 +332,18 @@ class Flags:
     elastic_max_reforms: int = 4            # (new)
     elastic_reform_backoff_s: float = 0.5   # (new) doubles per attempt
 
+    # --- self-healing runtime (new — runtime/remediation.py) ---
+    # Doctor-driven remediation loop: at each pass boundary the
+    # RemediationController consumes the live doctor findings, applies at
+    # most ONE machine-applicable action per pass (flag flip + recompile,
+    # cache resize, world grow) under the parity guard, and records the
+    # before/after counter deltas in the flight record. Off = today's
+    # operator-reads-the-suggestion behavior.
+    self_healing: bool = False              # (new)
+    # How many CONSECUTIVE pass boundaries a rule must fire before its
+    # action is applied — one noisy pass never reconfigures the run.
+    self_healing_sustain: int = 2           # (new)
+
     # --- telemetry (new — monitor/ TelemetryHub + utils/profiler) ---
     # RecordEvent span ring capacity: the profiler keeps at most this many
     # spans, dropping oldest-first (profiler.dropped_spans counts); 0 =

@@ -99,6 +99,22 @@ class ShardOwnership:
                 "stores have no partition to split")
         return keys[self.owns(shard_of(keys))]
 
+    def diff(self, other: "ShardOwnership | None") -> dict:
+        """The rebind delta from ``other`` (the PREVIOUS partition) to
+        this one: ``{"gained": [...], "lost": [...], "kept": [...]}`` of
+        shard ids. This is what an elastic resize costs THIS host —
+        ``gained`` shards' working sets are rebuilt on the next
+        ``begin_pass``, ``lost`` shards' resident rows drop — and what
+        the grow tests assert: a newcomer's ``gained`` must equal its
+        ``owned`` exactly (it rebuilds its shards' boundary set and
+        nothing else). ``other=None`` means no prior partition (all
+        owned shards are gained)."""
+        mine = set(self.owned.tolist())
+        prev = set() if other is None else set(other.owned.tolist())
+        return {"gained": sorted(mine - prev),
+                "lost": sorted(prev - mine),
+                "kept": sorted(mine & prev)}
+
     def __eq__(self, other) -> bool:
         """Partition equality — an elastic re-formation that resolves to
         the same (shards, world, rank) must be a no-op rebind, not a

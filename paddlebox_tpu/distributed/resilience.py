@@ -455,6 +455,27 @@ def _reform_key(gen: int, kind: str, rank: int) -> str:
     return f"elastic.reform.g{gen}.{kind}.{rank}"
 
 
+def _admit_key(gen: int, rank: int) -> str:
+    # a joiner's registration against the generation it observed sealed.
+    # The final dot component IS the joiner's rank id on purpose:
+    # sweep_stale(rank=…) then reclaims a dead joiner's request the same
+    # way it reclaims its heartbeat. A LIVE joiner re-asserts this key
+    # every admit poll, because a replacement reusing a departed rank's
+    # id races that very sweep (admit's wait loop).
+    return f"elastic.admit.g{gen}.{rank}"
+
+
+def _latest_sealed_gen(store: FileStore) -> int:
+    """Highest sealed generation number (0 = only the launch generation
+    exists). Sealed generations are contiguous from 1 — every re-formation
+    attempt seals its generation before escalating past it — so probing
+    upward from 1 terminates at the live world's generation."""
+    g = 0
+    while store.get(_world_key(g + 1)) is not None:
+        g += 1
+    return g
+
+
 class ElasticWorld:
     """One generation of the elastic world: membership, the
     generation-scoped collectives + heartbeat watchdog, and the
@@ -524,9 +545,28 @@ class ElasticWorld:
 
     # -- re-formation -----------------------------------------------------
 
-    def reform(self, dead_orig_ranks: list[int]) -> "ElasticWorld":
-        """Form the next generation without ``dead_orig_ranks``; returns
-        the new :class:`ElasticWorld` (this one's watchdog is closed).
+    def pending_admissions(self) -> list[int]:
+        """Original ranks with a live admit registration against THIS
+        generation (written by a joiner's :meth:`admit`). A local store
+        scan only — two incumbents may observe different sets at the same
+        instant (a registration landing between their reads), so a grow
+        decision must be made over the UNION of every member's scan (the
+        RemediationController all-gathers these before calling
+        :meth:`reform` with ``admit_orig_ranks``)."""
+        prefix = f"elastic.admit.g{self.gen}."
+        out = set()
+        for key in self.store.keys(prefix):
+            tail = key[len(prefix):]
+            if tail.isdigit() and int(tail) not in self.members:
+                out.add(int(tail))
+        return sorted(out)
+
+    def reform(self, dead_orig_ranks: list[int],
+               admit_orig_ranks: list[int] = ()) -> "ElasticWorld":
+        """Form the next generation without ``dead_orig_ranks`` and —
+        elastic GROW — with ``admit_orig_ranks`` (new ranks whose
+        :meth:`admit` protocol is waiting to join); returns the new
+        :class:`ElasticWorld` (this one's watchdog is closed).
 
         Raises :class:`WorldFencedError` when a sealed membership excludes
         this rank, and :class:`WorldTooSmallError` when survivors fall
@@ -535,15 +575,20 @@ class ElasticWorld:
         acks) escalates to the next generation number without it — each
         generation seals at most once, so every rank that forms lands on
         the same (gen, members) and a straggler can only be fenced, never
-        split off into a second world."""
+        split off into a second world. A joiner that dies mid-admit is
+        escalated past exactly like a dead survivor — the grown world
+        simply forms without it."""
         self.close()
         dead = set(int(r) for r in dead_orig_ranks)
+        admits = sorted(set(int(r) for r in admit_orig_ranks))
         gen = self.gen
         members = self.members
         floor = max(1, int(config_flags.elastic_min_world))
         while True:
             gen += 1
-            survivors = [r for r in members if r not in dead]
+            survivors = sorted(
+                [r for r in members if r not in dead]
+                + [a for a in admits if a not in dead and a not in members])
             if self.orig_rank not in survivors:
                 raise WorldFencedError(gen, survivors, self.orig_rank)
             if len(survivors) < floor:
@@ -559,18 +604,35 @@ class ElasticWorld:
                 dead |= set(missing)
                 continue
             seconds = time.monotonic() - t0
+            joined = sorted(set(formed) - set(members))
+            departed = sorted(set(members) - set(formed))
             monitor.counter_add("resilience.world_reforms")
             monitor.event("world_resize", type="lifecycle",
                           from_world=len(members), to_world=len(formed),
                           gen=gen, members=list(formed),
-                          departed=sorted(set(members) - set(formed)),
+                          departed=departed,
                           rank=self.orig_rank, seconds=seconds)
+            if joined:
+                monitor.counter_add("resilience.world_grows")
+                monitor.event("world_grow", type="lifecycle",
+                              gen=gen, joined=joined,
+                              members=list(formed),
+                              from_world=len(members),
+                              to_world=len(formed),
+                              rank=self.orig_rank, seconds=seconds)
+                # consume the joiners' admit registrations (every member
+                # deletes; unlink races are benign) — a satisfied request
+                # must never re-trigger a grow against a later generation
+                for key in self.store.keys("elastic.admit."):
+                    tail = key.rsplit(".", 1)[-1]
+                    if tail.isdigit() and int(tail) in set(joined):
+                        self.store.delete(key)
             # ghost hygiene: the departed ranks' heartbeat keys, barrier
             # arrivals and collective contributions must never satisfy a
             # later wait_count (every survivor sweeps; unlink races are
             # benign)
             if self.store.namespace:
-                for r in sorted(set(members) - set(formed)):
+                for r in departed:
                     self.store.sweep_stale(rank=r)
             return ElasticWorld(
                 self.store, self.orig_rank, formed, gen=gen,
@@ -580,6 +642,132 @@ class ElasticWorld:
                 reform_timeout_s=self.reform_timeout_s,
                 collectives_timeout_s=self._col_timeout,
                 initial_world=self.initial_world)
+
+    @classmethod
+    def admit(cls, store: FileStore, orig_rank: int,
+              timeout_s: float = 60.0,
+              heartbeat_interval_s: float | None = None,
+              lost_after_s: float | None = None,
+              stall_after_s: float | None = None,
+              reform_timeout_s: float | None = None,
+              collectives_timeout_s: float | None = None,
+              initial_world: int | None = None) -> "ElasticWorld":
+        """Join a live (typically degraded) world as a NEW rank — the
+        elastic GROW entry point, run by the replacement process.
+
+        The joiner never seals a generation (only incumbents do — a
+        joiner can therefore never fence the live world). It:
+
+        1. CAS-registers an *admit request* against the latest sealed
+           generation (:func:`_admit_key`) — the incumbents'
+           RemediationController discovers it via
+           :meth:`pending_admissions` and triggers
+           ``reform(admit_orig_ranks=[rank])`` at the next pass boundary;
+        2. proactively publishes its *arrival* under each successive
+           candidate generation, so the incumbents' grow attempt can seal
+           a membership that includes it;
+        3. when a generation seals WITH it, acks and waits for every
+           member's ack exactly like :meth:`_attempt` — an ack timeout
+           (an incumbent died inside the grow window) rolls forward to
+           the next generation, where the escalating incumbents still
+           carry this rank;
+        4. when a generation seals WITHOUT it (a shrink raced the admit,
+           or no incumbent had scanned yet), it re-registers against the
+           newly sealed generation and keeps waiting.
+
+        Returns the joined :class:`ElasticWorld`; raises TimeoutError
+        when no generation admits this rank within ``timeout_s``."""
+        me = int(orig_rank)
+        reform_timeout = (config_flags.elastic_reform_timeout_s
+                          if reform_timeout_s is None
+                          else float(reform_timeout_s))
+        faultpoint.hit("elastic.admit.pre_register")
+
+        def register(g: int) -> None:
+            store.set(_admit_key(g, me), json.dumps(
+                {"rank": me, "host": socket.gethostname(),
+                 "pid": os.getpid(), "gen": g,
+                 "ts": int(time.time())}).encode())
+
+        cur = _latest_sealed_gen(store)
+        register(cur)
+        monitor.counter_add("resilience.admit_requests")
+        poll = store.poll_s
+        deadline = time.monotonic() + float(timeout_s)
+        gen = cur + 1
+        t0 = time.monotonic()
+        while True:
+            arrive = json.dumps({"rank": me,
+                                 "host": socket.gethostname(),
+                                 "pid": os.getpid(),
+                                 "expect": []}).encode()
+            store.set(_reform_key(gen, "arrive", me), arrive)
+            members = None
+            while members is None:
+                raw = store.get(_world_key(gen))
+                if raw is not None:
+                    members = [int(r) for r in json.loads(raw)["members"]]
+                    break
+                if time.monotonic() > deadline:
+                    raise TimeoutError(
+                        f"admit of rank {me} timed out after {timeout_s}s "
+                        f"waiting for generation {gen} to seal (no grow "
+                        "attempt admitted it)")
+                time.sleep(poll)
+                # re-assert the registration and arrival every poll: a
+                # joiner replacing a DEAD rank carries that rank's id, so
+                # the shrink's ghost hygiene (reform's rank-sweep of the
+                # departed id) deletes this joiner's keys whenever they
+                # land before the last survivor's sweep runs — rewriting
+                # keeps the request alive through the race, and a joiner
+                # that actually dies stops rewriting, so the sweep still
+                # reclaims it
+                register(gen - 1)
+                store.set(_reform_key(gen, "arrive", me), arrive)
+            if me not in members:
+                # sealed without us — shrink raced the admit, or the
+                # incumbents had not scanned yet: re-register against the
+                # generation that just sealed and wait for the next
+                register(gen)
+                gen += 1
+                continue
+            store.set(_reform_key(gen, "ack", me), b"1")
+            faultpoint.hit("elastic.admit.post_ack")
+            ack_deadline = time.monotonic() + reform_timeout
+            acked = False
+            while True:
+                missing = [r for r in members
+                           if store.get(_reform_key(gen, "ack", r))
+                           is None]
+                if not missing:
+                    acked = True
+                    break
+                if time.monotonic() > ack_deadline:
+                    # an incumbent died inside the grow window: the
+                    # survivors escalate to gen+1 still carrying this
+                    # rank — follow them
+                    break
+                time.sleep(poll)
+            if not acked:
+                register(gen)
+                gen += 1
+                continue
+            world = cls(store, me, members, gen=gen,
+                        heartbeat_interval_s=heartbeat_interval_s,
+                        lost_after_s=lost_after_s,
+                        stall_after_s=stall_after_s,
+                        reform_timeout_s=reform_timeout_s,
+                        collectives_timeout_s=collectives_timeout_s,
+                        initial_world=initial_world)
+            prev = store.get(_world_key(gen - 1))
+            from_world = (len(json.loads(prev)["members"])
+                          if prev is not None else None)
+            monitor.counter_add("resilience.world_admits")
+            monitor.event("world_grow", type="lifecycle",
+                          gen=gen, joined=[me], members=list(members),
+                          from_world=from_world, to_world=len(members),
+                          rank=me, seconds=time.monotonic() - t0)
+            return world
 
     def _attempt(self, gen: int, expected: list[int]
                  ) -> tuple[list[int] | None, list[int]]:

@@ -149,6 +149,23 @@ class FileStore:
                     f"ranks arrived; missing ranks {missing}")
             time.sleep(self.poll_s)
 
+    def keys(self, prefix: str = "") -> list[str]:
+        """Published keys in THIS namespace starting with ``prefix``,
+        sorted. In-flight ``.tmp.`` files are skipped (they are not yet
+        published), and other namespaces' keys are invisible — same
+        isolation as every read path. Keys are returned in their stored
+        (sanitized) form: ``/`` became ``_`` at publish time. The elastic
+        grow protocol discovers pending admit registrations this way."""
+        own = f"{self.namespace}." if self.namespace else ""
+        want = own + prefix.replace("/", "_")
+        out = []
+        for name in os.listdir(self.root):
+            if ".tmp." in name:
+                continue
+            if name.startswith(want):
+                out.append(name[len(own):])
+        return sorted(out)
+
     def sweep_stale(self, max_age_s: float | None = None,
                     rank: int | None = None) -> int:
         """Store hygiene; returns the count of files removed. Two modes,

@@ -195,6 +195,14 @@ class BoxPS:
             wire_next = trainer.adapt_wire_boundary()
             if wire_next is not None:
                 out["exchange_wire_next"] = wire_next
+        # self-healing boundary (flags.self_healing): the remediation
+        # loop consumes the live doctor findings and applies at most one
+        # guarded action — BEFORE the flight-record commit so the
+        # remediation record + before-deltas land in this pass's record
+        if trainer is not None and hasattr(trainer, "remediation_boundary"):
+            healed = trainer.remediation_boundary()
+            if healed is not None:
+                out["remediation"] = healed
         # flight-record commit LAST: checkpoint/delta durations and bytes
         # above land in this pass's stats_delta and event stream
         out["flight_record"] = monitor.hub().end_pass(metrics=self.metrics)

@@ -39,7 +39,11 @@ EVIDENCE_EVENTS = ("peer_lost", "peer_stalled", "nan_guard",
                    "exchange_overflow", "pass_aborted",
                    "serving_publish_failed", "doctor.finding",
                    "sink_dropped", "sink_rotated", "resume_election",
-                   "trace.clock_probe")
+                   "trace.clock_probe",
+                   # self-healing runtime (ISSUE 18): what the controller
+                   # did to the run, and the elastic grow it triggered
+                   "remediation_applied", "remediation_reverted",
+                   "world_grow")
 KEEP_PER_NAME = 16
 
 _SEG_RE = re.compile(r"\.(\d{3,})\.jsonl$")

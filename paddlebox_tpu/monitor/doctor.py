@@ -543,12 +543,25 @@ class HeartbeatGapRule(Rule):
                         for e in events
                         if (e.get("fields") or {}).get("rank")
                         is not None})
+        # grow-side evidence (ISSUE 18): the RemediationController keys
+        # its grow trigger off these — world_size/degraded are gauges set
+        # identically on every surviving rank at world formation, so a
+        # controller gating on them decides rank-consistently, and
+        # world_grows/admit_requests show whether healing already ran
+        world_size = int(ctx.counter("resilience.world_size"))
+        degraded = bool(ctx.counter("resilience.degraded"))
         return "fired", Finding(
             self.id, "critical",
             (f"heartbeat gaps: {lost} lost, {stalled} stalled"
              + (f" (ranks {ranks})" if ranks else "")),
             {"peer_lost": lost, "peer_stalled": stalled,
              "ranks": ranks,
+             "world_size": world_size,
+             "degraded": degraded,
+             "world_reforms": int(ctx.counter("resilience.world_reforms")),
+             "world_grows": int(ctx.counter("resilience.world_grows")),
+             "admit_requests": int(
+                 ctx.counter("resilience.admit_requests")),
              "events": [{"name": e.get("name"),
                          "rank": (e.get("fields") or {}).get("rank"),
                          "after_s": (e.get("fields") or {}).get("after_s")}
@@ -556,7 +569,11 @@ class HeartbeatGapRule(Rule):
             "inspect the named rank's host (OOM/preemption for lost, "
             "hung collective or dead remote FS for stalled); "
             "flags.elastic_min_world governs whether the world shrinks "
-            "past it or checkpoints and exits")
+            "past it or checkpoints and exits, and a degraded world "
+            "GROWS back: launch a replacement via ElasticWorld.admit() "
+            "— with flags.self_healing the RemediationController admits "
+            "it at the next pass boundary (world_grow event) and the "
+            "newcomer rebuilds exactly its owned shards")
 
 
 class SinkHealthRule(Rule):

@@ -138,6 +138,38 @@ def validate_flight_record(rec: dict) -> list[str]:
                     if not isinstance(v, numbers.Real) or v < 0:
                         errs.append(f"boundary_split[{name!r}] is not a "
                                     "non-negative number")
+        # the self-healing runtime's remediation record (ISSUE 18,
+        # runtime/remediation.py): what the controller did to the run
+        # this pass. rule/action name the doctor rule and its mapped
+        # Action; status is the closed applied/reverted vocabulary the
+        # --fail-on CI gate keys off; before/after are the watched
+        # counters' per-pass deltas (flat numeric objects) bracketing
+        # the apply — the honesty record
+        rem = extra.get("remediation")
+        if rem is not None:
+            if not isinstance(rem, dict):
+                errs.append("extra['remediation'] is not an object")
+            else:
+                for k in ("rule", "action"):
+                    if not isinstance(rem.get(k), str):
+                        errs.append(f"remediation[{k!r}] is not a string")
+                if rem.get("status") not in ("applied", "reverted"):
+                    errs.append("remediation['status'] is not one of "
+                                "('applied', 'reverted')")
+                if (rem.get("reason") is not None
+                        and not isinstance(rem["reason"], str)):
+                    errs.append("remediation['reason'] is not a string")
+                for k in ("before", "after"):
+                    win = rem.get(k)
+                    if win is None:
+                        continue
+                    if not isinstance(win, dict):
+                        errs.append(f"remediation[{k!r}] is not an object")
+                        continue
+                    for name, v in win.items():
+                        if not isinstance(v, numbers.Real):
+                            errs.append(f"remediation {k}[{name!r}] is "
+                                        "not a number")
     return errs
 
 

@@ -54,6 +54,10 @@ EVENT_NAMES: tuple[str, ...] = (
     "reform_escalated",
     "reform_sealed",
     "world_resize",
+    "world_grow",
+    # self-healing runtime (runtime/remediation.py)
+    "remediation_applied",
+    "remediation_reverted",
     # serving (publisher + server + boxps degrade arm)
     "serving_publish",
     "serving_publish_failed",

@@ -1,0 +1,1 @@
+"""Runtime self-management: the doctor-driven remediation loop."""

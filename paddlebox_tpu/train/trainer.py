@@ -266,6 +266,10 @@ class Trainer:
         self._flow_attribution: tuple | None = None
         self._last_wire_decision: dict | None = None
         self._wire_stats0: dict | None = None
+        # Self-healing runtime (flags.self_healing, runtime/remediation):
+        # bound by enable_self_healing(); remediation_boundary() runs it
+        # at every pass boundary before the flight-record commit.
+        self._remediation = None
         # Storage-tier identity of the host table ("spill" /
         # "sharded+spill" / None for the in-RAM store) — flight-record
         # extra, like table_layout; the tier is a storage choice, never
@@ -1389,10 +1393,44 @@ class Trainer:
             tiering.end_pass_rebalance(self.store)
             self.refresh_replica_boundary()
             self.adapt_wire_boundary()
+            self.remediation_boundary()
             hub.end_pass(metrics=metrics)
         return out
 
     # ------------------------------------------------------------------
+    def enable_self_healing(self, controller=None):
+        """Bind the doctor-driven remediation loop (ISSUE 18): with
+        ``flags.self_healing`` on, every pass boundary consumes the live
+        doctor findings and applies at most one action under the parity
+        guard (runtime/remediation.py). Pass ``controller`` to inject a
+        pre-built/customized one; returns the bound controller."""
+        if controller is None:
+            from paddlebox_tpu.runtime.remediation import \
+                RemediationController
+            controller = RemediationController(self)
+        self._remediation = controller
+        return controller
+
+    def remediation_boundary(self, findings=None):
+        """Run the bound RemediationController's pass-boundary step —
+        called once per pass BEFORE the flight-record commit (by
+        ``train_pass`` for trainer-owned scopes, by ``BoxPS.end_pass``
+        for fleet-driven ones), so the remediation record lands in the
+        ending pass's flight record. Safe no-op (None) when no
+        controller is bound or ``flags.self_healing`` is off; the loop
+        must never take down the training it heals."""
+        ctl = self._remediation
+        if ctl is None or not config_flags.self_healing:
+            return None
+        try:
+            return ctl.boundary(findings=findings)
+        # pblint: disable=silent-except -- the healing loop is an
+        # observer with side effects: a broken controller is counted
+        # (remediation.errors) but must never abort the pass boundary
+        except Exception:
+            monitor.counter_add("remediation.errors")
+            return None
+
     def note_flow_attribution(self, attribution: dict | None,
                               wall_seconds: float | None = None) -> None:
         """Feed the adaptive wire controller a clock-corrected flow-edge
@@ -1990,7 +2028,11 @@ class Trainer:
         ShardOwnership): every feed builds only the keys hash-
         partitioned onto THIS host's shards of the sharded store, so
         working-set build cost divides by world size. Re-bound
-        automatically on elastic re-formation (``recover_world``)."""
+        automatically on elastic re-formation (``recover_world``) and on
+        elastic grow (``RemediationController.poll_grow``); the
+        faultpoint is the grow kill matrix's mid-rebind window."""
+        from paddlebox_tpu.utils import faultpoint
+        faultpoint.hit("elastic.ownership.rebind.pre")
         self.feed_mgr.set_ownership(ownership)
 
     def _dispatch_pending_apply(self, table):

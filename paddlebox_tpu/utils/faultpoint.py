@@ -12,9 +12,14 @@ Every interesting crash window in the save/flush/apply paths calls
 global ``is None`` check — nothing to measure. Armed — via :func:`arm` in
 process, or the environment for subprocess tests::
 
-    PBTPU_FAULTPOINT=store.save_delta.pre_manifest   # point name
+    PBTPU_FAULTPOINT=store.save_delta.pre_manifest   # point name(s), comma-ok
     PBTPU_FAULTPOINT_ACTION=kill                     # kill | ioerror
     PBTPU_FAULTPOINT_AFTER=2                         # fire on the 3rd hit
+
+Several points may be armed at once (comma-separated names in the
+environment, or a list to :func:`arm`): compound-failure kill matrices —
+a joiner dying while an incumbent's spill write-back faults — arm each
+leg independently and every armed point keeps its own hit counter.
 
 — the named point either hard-kills the process (``os._exit(137)``, the
 closest in-process stand-in for SIGKILL/preemption: no atexit handlers, no
@@ -141,6 +146,22 @@ POINTS: tuple[str, ...] = (
     # in-process by tests/test_doctor.py, not by the kill matrices
     # (rotation never fires in the crash workers' small streams).
     "telemetry.rotate.pre",
+    # distributed/resilience ElasticWorld.admit (ISSUE 18): the elastic
+    # GROW windows. pre_register = the joiner is about to CAS-register
+    # its admit request against the sealed generation; post_ack = the
+    # joiner acked a generation that includes it, incumbents may or may
+    # not have completed — a kill at either must leave the incumbents
+    # converging on one generation (with or without the joiner, never a
+    # mixed world) and the next admit attempt able to join cleanly.
+    "elastic.admit.pre_register",
+    "elastic.admit.post_ack",
+    # train/trainer.set_shard_ownership: the per-host build partition is
+    # about to rebind after an elastic resize — the newcomer (or a
+    # shrunk survivor) is about to start rebuilding exactly its shards'
+    # working set. A kill here (joiner mid-shard-rebuild, or an
+    # incumbent mid-rebind) must leave the surviving generation
+    # trainable and bit-consistent.
+    "elastic.ownership.rebind.pre",
 )
 
 # Points that fire only inside the elastic re-formation window: the
@@ -151,6 +172,16 @@ ELASTIC_POINTS: tuple[str, ...] = (
     "elastic.reform.pre_arrive",
     "elastic.reform.post_seal",
     "elastic.reform.post_ack",
+)
+
+# Points that fire only inside the elastic ADMIT (world-grow) window:
+# nothing in the shrink-only matrices ever calls ElasticWorld.admit or
+# rebinds ownership onto a grown world — they are covered by the grow
+# kill matrix (tests/test_elastic.py + tests/grow_worker.py) instead.
+ADMIT_POINTS: tuple[str, ...] = (
+    "elastic.admit.pre_register",
+    "elastic.admit.post_ack",
+    "elastic.ownership.rebind.pre",
 )
 
 # Points that fire only inside the serving publish path: the training
@@ -197,35 +228,57 @@ class _Armed:
         self.hits = 0
 
 
-_armed: _Armed | None = None
+# armed points by name: multiple points may be live at once, so compound
+# failures (a joiner dying while an incumbent's spill write-back faults)
+# are expressible in one kill matrix entry
+_armed: dict[str, _Armed] = {}
 # per-point hit counters, kept even when disarmed is re-armed (observability
 # for tests asserting a point is actually on the executed path)
 _counts: dict[str, int] = {}
 
 
-def arm(name: str, action: str = "kill", after: int = 0) -> None:
-    """Arm one fault point. ``action``: ``kill`` (os._exit(137)) or
-    ``ioerror`` (raise FaultInjected). ``after``: fire on hit #after+1."""
-    global _armed
-    if name not in POINTS:
-        raise KeyError(f"unknown fault point {name!r}; registered: {POINTS}")
+def arm(name, action: str = "kill", after: int = 0) -> None:
+    """Arm one or more fault points concurrently. ``name`` is a point
+    name, a comma-separated list of names, or a list/tuple of names — all
+    armed with the same ``action``/``after`` (arm() again for per-point
+    settings; a re-arm of a live name resets its hit count). ``action``:
+    ``kill`` (os._exit(137)) or ``ioerror`` (raise FaultInjected).
+    ``after``: fire on hit #after+1."""
+    names = ([n.strip() for n in name.split(",") if n.strip()]
+             if isinstance(name, str) else [str(n) for n in name])
+    if not names:
+        raise ValueError("arm() needs at least one fault point name")
+    for n in names:
+        if n not in POINTS:
+            raise KeyError(
+                f"unknown fault point {n!r}; registered: {POINTS}")
     if action not in ("kill", "ioerror"):
         raise ValueError(f"fault action {action!r} (want kill|ioerror)")
-    _armed = _Armed(name, action, int(after))
+    for n in names:
+        _armed[n] = _Armed(n, action, int(after))
     try:
         from paddlebox_tpu.monitor.hub import _HUB
-        _HUB.counter_add("faultpoint.armed")
-        _HUB.event("faultpoint_armed", point=name, action=action,
-                   after=int(after))
+        for n in names:
+            _HUB.counter_add("faultpoint.armed")
+            _HUB.event("faultpoint_armed", point=n, action=action,
+                       after=int(after))
     # pblint: disable=silent-except -- observability must not mask the
     # harness: a broken hub cannot be allowed to fail arm() itself
     except Exception:
         pass
 
 
-def disarm() -> None:
-    global _armed
-    _armed = None
+def disarm(name: str | None = None) -> None:
+    """Disarm one point (by name) or, with no argument, all of them."""
+    if name is None:
+        _armed.clear()
+    else:
+        _armed.pop(name, None)
+
+
+def armed_points() -> tuple[str, ...]:
+    """Names currently armed (observability for harness assertions)."""
+    return tuple(sorted(_armed))
 
 
 def hit_count(name: str) -> int:
@@ -234,13 +287,13 @@ def hit_count(name: str) -> int:
 
 def hit(name: str) -> None:
     """Mark a registered crash window. No-op unless armed on this name."""
-    a = _armed
-    if a is None:
+    if not _armed:
         return
     if name not in POINTS:
         raise KeyError(f"unregistered fault point {name!r}")
     _counts[name] = _counts.get(name, 0) + 1
-    if name != a.name:
+    a = _armed.get(name)
+    if a is None:
         return
     a.hits += 1
     if a.hits <= a.after:
@@ -266,11 +319,20 @@ def hit(name: str) -> None:
 
 
 def _arm_from_env() -> None:
-    name = os.environ.get("PBTPU_FAULTPOINT", "")
-    if not name:
+    spec = os.environ.get("PBTPU_FAULTPOINT", "")
+    if not spec:
         return
-    arm(name, os.environ.get("PBTPU_FAULTPOINT_ACTION", "kill"),
-        int(os.environ.get("PBTPU_FAULTPOINT_AFTER", "0")))
+    names = [n.strip() for n in spec.split(",") if n.strip()]
+    actions = [a.strip() for a in
+               os.environ.get("PBTPU_FAULTPOINT_ACTION", "kill").split(",")]
+    afters = [a.strip() for a in
+              os.environ.get("PBTPU_FAULTPOINT_AFTER", "0").split(",")]
+    # a single action/after applies to every name; otherwise the lists
+    # align positionally with the comma-separated point names
+    for i, n in enumerate(names):
+        action = actions[i] if len(actions) > 1 else actions[0]
+        after = afters[i] if len(afters) > 1 else afters[0]
+        arm(n, action, int(after))
 
 
 _arm_from_env()

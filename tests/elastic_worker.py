@@ -40,6 +40,27 @@ Env knobs (see tests/test_elastic.py):
                             from the start. Final planes must be
                             bit-identical to the survivors of the real
                             killed run.
+
+Elastic GROW mode (ISSUE 18) — the self-healing e2e:
+  PBTPU_ELASTIC_GROW=1      launcher spawns train_world+1 processes; the
+                            extra one is a REPLACEMENT that joins via
+                            ElasticWorld.admit while the incumbents train
+  PBTPU_ELASTIC_TRAIN_WORLD the training world size (launcher ranks
+                            below it are incumbents; at/above are
+                            joiners)
+  PBTPU_ELASTIC_JOINER_AS   the ORIGINAL rank identity the joiner
+                            assumes (the dead rank's: its checkpoint
+                            root, its seed, its shard of every pass)
+
+The grow flow: the victim dies mid-pass → the survivors shrink (gen 1,
+degraded) via recover_world → at the next pass boundary their
+RemediationController's poll_grow — gated on the REAL doctor
+heartbeat-gap finding over the hub — all-gathers the joiner's admit
+registration, re-forms WITH it (gen 2, full world), and the coordinated
+resume election rolls every rank (newcomer included, restoring from the
+dead rank's snapshots) back to the last common pass boundary. Training
+then continues at full world: the final planes must be BIT-IDENTICAL to
+a never-failed run of the same world size.
 """
 
 import json
@@ -62,11 +83,14 @@ import numpy as np  # noqa: E402
 
 from crash_worker import synth  # noqa: E402
 from paddlebox_tpu import monitor  # noqa: E402
+from paddlebox_tpu.config import set_flags  # noqa: E402
 from paddlebox_tpu.data import SlotDataset  # noqa: E402
 from paddlebox_tpu.data.slot_record import SlotRecordBatch  # noqa: E402
 from paddlebox_tpu.distributed import RoleMaker  # noqa: E402
-from paddlebox_tpu.distributed.resilience import (PeerFailureError,  # noqa: E402
-                                                  WorldFencedError)
+from paddlebox_tpu.distributed.resilience import (ElasticWorld,  # noqa: E402
+                                                  PeerFailureError,
+                                                  WorldFencedError,
+                                                  coordinated_resume)
 from paddlebox_tpu.embedding import (EmbeddingConfig,  # noqa: E402
                                      HostEmbeddingStore)
 from paddlebox_tpu.fleet import BoxPS  # noqa: E402
@@ -169,30 +193,47 @@ def run(log) -> None:
     lost_s = float(os.environ.get("PBTPU_ELASTIC_LOST_S", "2.0"))
     sim = os.environ.get("PBTPU_ELASTIC_SIM", "")
     sim = json.loads(sim) if sim else None
+    grow = os.environ.get("PBTPU_ELASTIC_GROW", "") == "1"
+    train_world = int(os.environ.get("PBTPU_ELASTIC_TRAIN_WORLD", "0") or 0)
 
     # ---- identity: launcher rank vs ORIGINAL rank -------------------------
+    joiner = False
     if sim is not None:
         orig_members = sorted(sim["orig_members"])
         survivors = [r for r in orig_members if r not in set(sim["dead"])]
         me = survivors[rm.rank]           # sim rank i IS survivor i
         members = list(survivors)
+    elif grow:
+        orig_members = list(range(train_world))
+        members = list(orig_members)
+        joiner = rm.rank >= train_world
+        # the replacement assumes the DEAD rank's original identity: its
+        # checkpoint root, its trainer seed, its shard of every pass
+        me = (int(os.environ["PBTPU_ELASTIC_JOINER_AS"]) if joiner
+              else rm.rank)
     else:
         me = rm.rank
         orig_members = list(range(rm.world_size))
         members = list(orig_members)
 
-    # victim arming: each process keeps only ITS designated fault point
+    # victim arming: each process keeps only ITS designated fault point.
+    # The joiner shares the victim's original-rank identity, so it must
+    # never inherit the victim's kill.
     only = os.environ.get("PBTPU_FAULTPOINT_ONLY_RANK", "")
-    if only and only != str(me):
+    if joiner or (only and only != str(me)):
         faultpoint.disarm()
     fp2, fp2_rank = (os.environ.get("PBTPU_FAULTPOINT2", ""),
                      os.environ.get("PBTPU_FAULTPOINT2_RANK", ""))
-    if fp2 and fp2_rank == str(me):
+    if fp2 and (fp2_rank == "joiner" if joiner else fp2_rank == str(me)):
         faultpoint.arm(fp2, "kill",
                        int(os.environ.get("PBTPU_FAULTPOINT2_AFTER", "0")))
 
-    monitor.hub().enable(monitor.JsonlSink(
-        os.path.join(work, f"events_{me}.jsonl")))
+    # the joiner's event stream must not interleave with the dead
+    # original rank's (same assumed identity, different process)
+    monitor.hub().enable(monitor.JsonlSink(os.path.join(
+        work, f"events_{me}{'_joiner' if joiner else ''}.jsonl")))
+    if grow:
+        set_flags(self_healing=True, self_healing_sustain=1)
 
     # ---- deterministic shared dataset: ins_id = 1..n ----------------------
     ds, schema = synth(n=n_ex, seed=11)
@@ -215,10 +256,33 @@ def run(log) -> None:
     if midpass > 0:
         tr.enable_midpass_snapshots(ckpt, midpass, box, metrics=box.metrics)
 
-    if sim is None:
-        world = rm.elastic_world(
-            timeout_s=60, heartbeat_interval_s=0.15, lost_after_s=lost_s,
-            stall_after_s=90.0, reform_timeout_s=8.0)
+    if joiner:
+        # the replacement process: join the (by now degraded) live world
+        # as a NEW rank — blocks until the incumbents' poll_grow admits
+        # it at a pass boundary
+        world = ElasticWorld.admit(
+            rm.base_store(150.0), me, timeout_s=150.0,
+            heartbeat_interval_s=0.15, lost_after_s=lost_s,
+            stall_after_s=90.0, reform_timeout_s=8.0,
+            collectives_timeout_s=60.0, initial_world=train_world)
+        log(f"admitted at gen {world.gen} members {world.members}")
+        box.attach_collectives(world.collectives,
+                               heartbeat=world.heartbeat)
+        tr.peer_check = world.check
+    elif sim is None:
+        if grow:
+            # incumbents of a launcher that spawned train_world+joiners:
+            # generation 0 spans only the TRAINING members
+            world = ElasticWorld(
+                rm.base_store(60.0), me, orig_members,
+                heartbeat_interval_s=0.15, lost_after_s=lost_s,
+                stall_after_s=90.0, reform_timeout_s=8.0,
+                collectives_timeout_s=60.0)
+        else:
+            world = rm.elastic_world(
+                timeout_s=60, heartbeat_interval_s=0.15,
+                lost_after_s=lost_s, stall_after_s=90.0,
+                reform_timeout_s=8.0)
         # warmup grace: pass 1 compiles the step programs, and N jax
         # processes compiling on few cores can starve a publisher thread
         # past a tight lost_after — a mutual false-positive would fence
@@ -249,14 +313,88 @@ def run(log) -> None:
     old_members: list[int] | None = None
     sim_q, sim_m = ((int(sim["elected"][0]), int(sim["elected"][1]))
                     if sim is not None else (None, None))
+    # the production binding: BoxPS.end_pass runs this controller's
+    # boundary step each pass; the incumbents additionally drive its
+    # grow poll between passes
+    ctl = None
+    if grow:
+        bound = tr.enable_self_healing()
+        if not joiner:
+            ctl = bound
+    hb_grace = world is not None      # generous lost_after until cleared
+
+    if joiner:
+        # compile grace: the newcomer compiles its step programs during
+        # its first trained pass
+        world.heartbeat.lost_after_s = max(lost_s, 10.0)
+        # the same election the incumbents run inside poll_grow: the
+        # grown world stands on one snapshot — the newcomer restores the
+        # DEAD rank's newest snapshot that is intact everywhere
+        cursor = coordinated_resume(ckpt, tr, world.collectives,
+                                    box=box, metrics=box.metrics)
+        members = list(world.members)
+        info.update(gen=world.gen, members=members, admitted=True)
+        if cursor is not None:
+            info["elected"] = cursor.get("elected")
+            if cursor.get("shuffle_state"):
+                ds.set_shuffle_state(cursor["shuffle_state"])
+            p = int(cursor["pass_id"]) + 1
+            skip = int(cursor.get("mid_steps") or 0)
 
     def train_one(recs, skip_steps=0):
         dsp = _ds_for(schema, recs)
         return tr.train_pass(dsp, metrics=box.metrics,
                              skip_steps=skip_steps)
 
+    grow_polls = int(os.environ.get("PBTPU_ELASTIC_GROW_POLLS", "600"))
     while p <= passes:
         try:
+            if (ctl is not None and world is not None
+                    and world.world < world.initial_world):
+                # a degraded pass boundary: the remediation controller
+                # polls for a replacement under the REAL doctor
+                # heartbeat-gap finding. The poll COUNT (not wall time)
+                # bounds the wait so every member abandons it on the
+                # same all-gather round; on timeout training continues
+                # degraded. Inside the try: a joiner dying mid-admit
+                # surfaces as PeerFailureError and takes the normal
+                # recovery path.
+                new_world = world
+                for _ in range(grow_polls):
+                    new_world, cursor = ctl.poll_grow(
+                        world, box=box, checkpointer=ckpt,
+                        metrics=box.metrics)
+                    if new_world is not world:
+                        break
+                    time.sleep(0.1)
+                if new_world is not world:
+                    world = new_world
+                    members = list(world.members)
+                    tr.peer_check = world.check
+                    world.heartbeat.lost_after_s = max(lost_s, 10.0)
+                    hb_grace = True       # the newcomer compiles now
+                    info.update(gen=world.gen, members=members,
+                                grew=True)
+                    log(f"grew to gen {world.gen} members {members}")
+                    if cursor is None:
+                        # no common snapshot: whole-world fresh start
+                        consumed.clear()
+                        ds.set_shuffle_state(init_shuffle_state)
+                        p, skip, old_members = 1, 0, None
+                    else:
+                        # the grown world stands on the newest snapshot
+                        # intact on EVERY rank (the newcomer's is the
+                        # dead rank's last boundary) — roll back to it
+                        # and retrain at full world
+                        info["elected"] = cursor.get("elected")
+                        q = int(cursor["pass_id"])
+                        if cursor.get("shuffle_state"):
+                            ds.set_shuffle_state(cursor["shuffle_state"])
+                        consumed = {pp: v for pp, v in consumed.items()
+                                    if pp <= q}
+                        p, skip, old_members = q + 1, 0, None
+                else:
+                    log("no replacement appeared; continuing degraded")
             pre_state = ds.shuffle_state()
             tr.midpass_cursor_extra = {"shuffle_state": pre_state}
             if sim is not None:
@@ -325,8 +463,9 @@ def run(log) -> None:
             box.end_pass(checkpointer=ckpt, trainer=tr, dataset=ds)
             skip = 0
             old_members = None
-            if p == 1 and world is not None:
+            if hb_grace and world is not None:
                 world.heartbeat.lost_after_s = lost_s   # grace over
+                hb_grace = False
             p += 1
         except PeerFailureError as e:
             log(f"peer failure in pass {p}: {e}")

@@ -1,0 +1,172 @@
+"""Worker process for the elastic GROW kill matrix (ISSUE 18), protocol
+level: ownership rebind under fire.
+
+Launched (3 processes, ``fail_stop=False``) by tests/test_elastic.py.
+Launcher ranks 0/1 are INCUMBENTS of a 2-member world that believes it
+was launched at 3 (degraded); launcher rank 2 is a JOINER running
+``ElasticWorld.admit``. The incumbents' RemediationController polls grow
+under (synthetic, rank-consistent) heartbeat-gap evidence; the union
+all-gather admits the joiner; ownership — a real 8-shard
+:class:`ShardOwnership`, rebound through the REAL
+``Trainer.set_shard_ownership`` (the ``elastic.ownership.rebind.pre``
+crash window) — re-deals across the grown world.
+
+``PBTPU_GROW_MODE`` selects the leg:
+
+  clean                  no kill: all three converge on gen 1 [0, 1, 2];
+                         the newcomer's ownership diff ``gained`` equals
+                         its ``owned`` exactly (it rebuilds its shards'
+                         boundary set and nothing else)
+  kill_joiner_rebind     the NEWCOMER dies mid-shard-rebuild bind: the
+                         incumbents detect it at the post-grow barrier
+                         and shrink back to gen 2 [0, 1]
+  kill_incumbent_rebind  incumbent 1 dies INSIDE poll_grow's ownership
+                         rebind: the surviving incumbent + the newcomer
+                         re-form gen 2 [0, 2]
+
+Every leg ends with a live all_reduce on the surviving generation — the
+"still trainable" witness — and writes info_{rank}.json.
+"""
+
+import json
+import os
+import sys
+import time
+import traceback
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np  # noqa: E402
+
+from paddlebox_tpu import monitor  # noqa: E402
+from paddlebox_tpu.config import set_flags  # noqa: E402
+from paddlebox_tpu.distributed import RoleMaker  # noqa: E402
+from paddlebox_tpu.distributed.ownership import ShardOwnership  # noqa: E402
+from paddlebox_tpu.distributed.resilience import (ElasticWorld,  # noqa: E402
+                                                  PeerFailureError)
+from paddlebox_tpu.runtime.remediation import (  # noqa: E402
+    RemediationController)
+from paddlebox_tpu.train.trainer import Trainer  # noqa: E402
+from paddlebox_tpu.utils import faultpoint  # noqa: E402
+
+N_SHARDS = 8
+INCUMBENTS = 2
+
+HBGAP = {"rule": "heartbeat-gap", "severity": "critical",
+         "summary": "synthetic grow evidence",
+         "evidence": {"degraded": True, "world_size": INCUMBENTS},
+         "suggestion": ""}
+
+
+class _FeedMgr:
+    def __init__(self, ownership):
+        self.ownership = ownership
+
+    def set_ownership(self, ownership):
+        self.ownership = ownership
+
+
+class _StubTrainer:
+    """Just enough trainer for the rebind path — the ownership bind goes
+    through the REAL Trainer.set_shard_ownership so the registered crash
+    window is on the executed path."""
+
+    set_shard_ownership = Trainer.set_shard_ownership
+
+    def __init__(self, ownership):
+        self.feed_mgr = _FeedMgr(ownership)
+        self.peer_check = None
+
+
+def run(log) -> None:
+    rm = RoleMaker.from_env()
+    mode = os.environ.get("PBTPU_GROW_MODE", "clean")
+    work = os.environ["PBTPU_TEST_WORKDIR"]
+    me = rm.rank
+    monitor.hub().enable(monitor.JsonlSink(
+        os.path.join(work, f"events_{me}.jsonl")))
+    set_flags(self_healing=True, self_healing_sustain=1)
+    store = rm.base_store(60.0)
+    kw = dict(heartbeat_interval_s=0.1, lost_after_s=1.5,
+              stall_after_s=60.0, reform_timeout_s=3.0,
+              initial_world=INCUMBENTS + 1)
+    info = {"rank": me, "mode": mode, "rebind": None, "owned": None}
+
+    if me < INCUMBENTS:
+        if mode == "kill_incumbent_rebind" and me == 1:
+            faultpoint.arm("elastic.ownership.rebind.pre", "kill")
+        own0 = ShardOwnership(N_SHARDS, INCUMBENTS, me)
+        tr = _StubTrainer(own0)
+        world = ElasticWorld(store, me, list(range(INCUMBENTS)), **kw)
+        ctl = RemediationController(trainer=tr)
+        deadline = time.monotonic() + 60.0
+        while True:
+            nw, _ = ctl.poll_grow(world, findings=[HBGAP])
+            if nw is not world:
+                world = nw
+                break
+            if time.monotonic() > deadline:
+                raise TimeoutError("incumbent never grew the world")
+            time.sleep(0.05)
+        log(f"grew to gen {world.gen} members {world.members}")
+        info["rebind"] = tr.feed_mgr.ownership.diff(own0)
+        info["owned"] = tr.feed_mgr.ownership.owned.tolist()
+    else:
+        if mode == "kill_joiner_rebind":
+            faultpoint.arm("elastic.ownership.rebind.pre", "kill")
+        world = ElasticWorld.admit(store, me, timeout_s=60.0, **kw)
+        log(f"admitted at gen {world.gen} members {world.members}")
+        tr = _StubTrainer(None)
+        own_new = ShardOwnership(N_SHARDS, world.world, world.rank)
+        # the newcomer's shard-rebuild bind — the mid-rebuild crash window
+        tr.set_shard_ownership(own_new)
+        info["rebind"] = own_new.diff(None)
+        info["owned"] = own_new.owned.tolist()
+
+    # post-grow convergence: a rank dead mid-rebind must shrink back out
+    try:
+        world.collectives.barrier("post_grow")
+    except PeerFailureError as e:
+        log(f"peer died mid-grow: {e}")
+        world = world.reform(sorted(e.ranks))
+        world.collectives.barrier("post_reform")
+
+    # the surviving generation is operational: a live collective completes
+    total = world.collectives.all_reduce(
+        np.asarray([world.rank + 1.0], dtype=np.float64))
+    info.update(gen=world.gen, members=world.members,
+                allreduce=float(np.asarray(total)[0]))
+    with open(os.path.join(work, f"info_{me}.json"), "w") as f:
+        json.dump(info, f)
+    world.close()
+    monitor.hub().disable()
+    log("done")
+
+
+def main() -> None:
+    work = os.environ["PBTPU_TEST_WORKDIR"]
+    os.makedirs(work, exist_ok=True)
+    rank = os.environ.get("PBTPU_TRAINER_ID", "?")
+
+    def log(msg):
+        print(f"grow rank {rank}: {msg}", flush=True)
+
+    try:
+        run(log)
+    except BaseException as e:
+        with open(os.path.join(work, f"err_{rank}.txt"), "w") as f:
+            f.write(f"{type(e).__name__}: {e}\n")
+            f.write(traceback.format_exc())
+        monitor.hub().disable()
+        raise
+
+
+if __name__ == "__main__":
+    main()

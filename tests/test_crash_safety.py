@@ -169,6 +169,7 @@ def test_kill_resume_smoke(tmp_path, golden):
                           if p not in ("store.save_delta.pre_manifest",
                                        "remote_ckpt.download.pre")
                           and p not in faultpoint.ELASTIC_POINTS
+                          and p not in faultpoint.ADMIT_POINTS
                           and p not in faultpoint.SERVING_POINTS
                           and p not in faultpoint.EXCHANGE_POINTS
                           and p not in faultpoint.MONITOR_POINTS])
@@ -282,13 +283,18 @@ def test_every_point_has_a_matrix_entry():
     the ShardedEmbeddingStore save / eval-overflow-retry paths and are
     covered by tests/test_exchange.py; the telemetry-plane points fire
     only on the JSONL writer thread — telemetry must never perturb
-    training state — and are covered by tests/test_doctor.py. All carry
-    the same closed-registry guard."""
+    training state — and are covered by tests/test_doctor.py; the elastic
+    ADMIT (world-grow) points fire only in ElasticWorld.admit / the
+    post-grow ownership rebind and are covered by the grow kill matrix
+    (tests/test_elastic.py + tests/grow_worker.py). All carry the same
+    closed-registry guard."""
     assert (set(POINT_AFTER) | set(faultpoint.ELASTIC_POINTS)
+            | set(faultpoint.ADMIT_POINTS)
             | set(faultpoint.SERVING_POINTS)
             | set(faultpoint.EXCHANGE_POINTS)
             | set(faultpoint.MONITOR_POINTS) == set(faultpoint.POINTS))
     assert not set(POINT_AFTER) & (set(faultpoint.ELASTIC_POINTS)
+                                   | set(faultpoint.ADMIT_POINTS)
                                    | set(faultpoint.SERVING_POINTS)
                                    | set(faultpoint.EXCHANGE_POINTS)
                                    | set(faultpoint.MONITOR_POINTS))

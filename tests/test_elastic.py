@@ -107,10 +107,10 @@ def _events(tmp_path, rank):
     return [json.loads(ln) for ln in p.read_text().splitlines() if ln]
 
 
-def _worker_errors(tmp_path):
+def _worker_errors(tmp_path, n=WORLD):
     return "; ".join(
         (tmp_path / "work" / f"err_{r}.txt").read_text()[:500]
-        for r in range(WORLD)
+        for r in range(n)
         if (tmp_path / "work" / f"err_{r}.txt").exists())
 
 
@@ -390,6 +390,344 @@ def test_gen_collectives_isolated_from_old_generation(tmp_path):
     assert g1.get("barrier.1.0") is None
     g1.set("x", b"1")
     assert base.get("x") is None
+
+
+def test_admit_joins_next_generation(tmp_path):
+    """Elastic GROW, protocol level: a world shrunk to one survivor
+    admits a NEW rank — the joiner registers via ElasticWorld.admit, the
+    incumbent sees it in pending_admissions and re-forms WITH it, both
+    converge on the same grown generation, and the consumed admit
+    registration is deleted (it can never re-trigger)."""
+    results, errs = {}, []
+
+    def incumbent():
+        try:
+            w0 = _world(tmp_path, 0, [0, 1], reform_timeout_s=0.5,
+                        initial_world=2)
+            g1 = w0.reform([1])                    # degraded: [0]
+            deadline = time.monotonic() + 20.0
+            while not g1.pending_admissions():
+                assert time.monotonic() < deadline, "joiner never registered"
+                time.sleep(0.02)
+            assert g1.pending_admissions() == [1]
+            g2 = g1.reform([], admit_orig_ranks=g1.pending_admissions())
+            results["inc"] = (g2.gen, g2.members, g2.rank)
+            g2.collectives.barrier("post_grow")
+            g2.close()
+        except BaseException as e:    # pragma: no cover
+            errs.append(("inc", e))
+
+    def joiner():
+        try:
+            store = FileStore(str(tmp_path), namespace="r", poll_s=0.01)
+            w = ElasticWorld.admit(store, 1, timeout_s=20.0,
+                                   heartbeat_interval_s=0.05,
+                                   lost_after_s=30.0, stall_after_s=60.0,
+                                   reform_timeout_s=2.0, initial_world=2)
+            results["join"] = (w.gen, w.members, w.rank)
+            w.collectives.barrier("post_grow")
+            w.close()
+        except BaseException as e:    # pragma: no cover
+            errs.append(("join", e))
+
+    ts = [threading.Thread(target=incumbent), threading.Thread(target=joiner)]
+    [t.start() for t in ts]
+    [t.join(timeout=60) for t in ts]
+    assert not errs, errs
+    assert results["inc"] == (2, [0, 1], 0)
+    assert results["join"] == (2, [0, 1], 1)
+    store = FileStore(str(tmp_path), namespace="r", poll_s=0.01)
+    assert store.keys("elastic.admit.") == []
+
+
+def test_pending_admissions_scoped_to_generation_and_members(tmp_path):
+    """A member rank's registration is invisible (it already belongs), a
+    stale registration against an OLD generation is invisible, and only
+    a live non-member registration against THIS generation shows."""
+    store = FileStore(str(tmp_path), namespace="r", poll_s=0.01)
+    w0 = _world(tmp_path, 0, [0, 1])
+    store.set("elastic.admit.g0.1", b"{}")       # member: already in
+    store.set("elastic.admit.g7.5", b"{}")       # wrong generation
+    store.set("elastic.admit.g0.5", b"{}")       # live non-member
+    assert w0.pending_admissions() == [5]
+    w0.close()
+
+
+def test_admit_reregisters_when_sealed_without_it(tmp_path):
+    """The shrink-races-admit window: a generation seals WITHOUT the
+    joiner (the incumbent never scanned). The joiner must re-register
+    against the newly sealed generation and join the NEXT one — never
+    block forever on a train that left the station."""
+    results, errs = {}, []
+
+    def incumbent():
+        try:
+            w0 = _world(tmp_path, 0, [0, 1, 2], reform_timeout_s=0.5,
+                        initial_world=3)
+            g1 = w0.reform([1])                  # seals g1 = [0, 2]...
+            deadline = time.monotonic() + 20.0
+            while not g1.pending_admissions():
+                assert time.monotonic() < deadline
+                time.sleep(0.02)
+            g2 = g1.reform([], admit_orig_ranks=g1.pending_admissions())
+            results["inc0"] = (g2.gen, sorted(g2.members))
+            g2.collectives.barrier("post_grow")
+            g2.close()
+        except BaseException as e:    # pragma: no cover
+            errs.append(("inc0", e))
+
+    def survivor2():
+        try:
+            w = _world(tmp_path, 2, [0, 1, 2], reform_timeout_s=0.5,
+                       initial_world=3)
+            g1 = w.reform([1])
+            deadline = time.monotonic() + 20.0
+            while not g1.pending_admissions():
+                assert time.monotonic() < deadline
+                time.sleep(0.02)
+            g2 = g1.reform([], admit_orig_ranks=g1.pending_admissions())
+            results["inc2"] = (g2.gen, sorted(g2.members))
+            g2.collectives.barrier("post_grow")
+            g2.close()
+        except BaseException as e:    # pragma: no cover
+            errs.append(("inc2", e))
+
+    def joiner():
+        try:
+            # registers against gen 0 BEFORE the shrink; g1 then seals
+            # without it (the ...raced window) and the re-registration
+            # against g1 is what the incumbents' scans pick up
+            store = FileStore(str(tmp_path), namespace="r", poll_s=0.01)
+            w = ElasticWorld.admit(store, 3, timeout_s=30.0,
+                                   heartbeat_interval_s=0.05,
+                                   lost_after_s=30.0, stall_after_s=60.0,
+                                   reform_timeout_s=2.0, initial_world=3)
+            results["join"] = (w.gen, sorted(w.members))
+            w.collectives.barrier("post_grow")
+            w.close()
+        except BaseException as e:    # pragma: no cover
+            errs.append(("join", e))
+
+    tj = threading.Thread(target=joiner)
+    tj.start()
+    time.sleep(0.3)                  # let the g0 registration land first
+    ts = [threading.Thread(target=incumbent),
+          threading.Thread(target=survivor2)]
+    [t.start() for t in ts]
+    [t.join(timeout=60) for t in ts]
+    tj.join(timeout=60)
+    assert not errs, errs
+    assert results["inc0"] == (2, [0, 2, 3])
+    assert results["inc2"] == (2, [0, 2, 3])
+    assert results["join"] == (2, [0, 2, 3])
+
+
+def test_admit_times_out_when_nobody_grows(tmp_path):
+    """No incumbent ever polls: the admit deadline raises instead of
+    hanging the replacement process forever."""
+    store = FileStore(str(tmp_path), namespace="r", poll_s=0.01)
+    with pytest.raises(TimeoutError, match="admit of rank 7"):
+        ElasticWorld.admit(store, 7, timeout_s=0.4, reform_timeout_s=0.2,
+                           heartbeat_interval_s=0.05)
+
+
+def test_admit_points_are_registered_and_scoped():
+    """Closed-registry guard for the grow kill matrix: the admit-window
+    points — 'elastic.admit.pre_register', 'elastic.admit.post_ack',
+    'elastic.ownership.rebind.pre' — are registered, elastic-scoped, and
+    parametrized over by the grow kill matrix below, so a new grow crash
+    window cannot ship without a matrix entry."""
+    assert set(faultpoint.ADMIT_POINTS) <= set(faultpoint.POINTS)
+    assert all(p.startswith("elastic.") for p in faultpoint.ADMIT_POINTS)
+    assert set(faultpoint.ADMIT_POINTS) == {
+        "elastic.admit.pre_register", "elastic.admit.post_ack",
+        "elastic.ownership.rebind.pre"}
+    assert not set(faultpoint.ADMIT_POINTS) & set(faultpoint.ELASTIC_POINTS)
+
+
+# -- grow kill matrix (tests/grow_worker.py) ------------------------------
+#
+# Protocol-level harness: 2 incumbents + 1 joiner across real processes,
+# real ShardOwnership rebound through the REAL Trainer.set_shard_ownership
+# (so the 'elastic.ownership.rebind.pre' crash window is on the executed
+# path), RemediationController.poll_grow driving the admission.
+
+GROW_WORKER = os.path.join(TESTS_DIR, "grow_worker.py")
+
+
+def _run_grow(tmp_path, mode):
+    env = {"PBTPU_TEST_WORKDIR": str(tmp_path / "work"),
+           "PBTPU_GROW_MODE": mode}
+    os.makedirs(env["PBTPU_TEST_WORKDIR"], exist_ok=True)
+    return launch(3, [sys.executable, GROW_WORKER],
+                  store_dir=str(tmp_path / "store"),
+                  base_env=env, fail_stop=False, timeout_s=240)
+
+
+def _grow_errors(tmp_path):
+    return "; ".join(
+        (tmp_path / "work" / f"err_{r}.txt").read_text()[:500]
+        for r in range(3)
+        if (tmp_path / "work" / f"err_{r}.txt").exists())
+
+
+def test_grow_rebinds_ownership_to_exactly_the_owned_shards(tmp_path):
+    """Clean grow: poll_grow admits the joiner into gen 1 [0, 1, 2]; the
+    newcomer's ownership diff ``gained`` equals its ``owned`` EXACTLY (it
+    rebuilds its shards' boundary set and nothing else); incumbents shed
+    precisely the shards the 3-way re-deal takes from them; the grown
+    generation completes a live all_reduce."""
+    codes = _run_grow(tmp_path, "clean")
+    assert codes == [0, 0, 0], (codes, _grow_errors(tmp_path))
+    infos = [_info(tmp_path, r) for r in range(3)]
+    assert all(i["gen"] == 1 and i["members"] == [0, 1, 2] for i in infos)
+    # sum over gen-local ranks of (rank + 1) on the 3-member world
+    assert all(i["allreduce"] == 6.0 for i in infos)
+    joiner = infos[2]
+    assert joiner["owned"] == sorted(range(2, 8, 3))
+    assert joiner["rebind"] == {"gained": joiner["owned"],
+                                "lost": [], "kept": []}
+    for i in infos[:2]:
+        r = i["rank"]
+        before, after = set(range(r, 8, 2)), set(range(r, 8, 3))
+        assert i["owned"] == sorted(after)
+        assert i["rebind"] == {"gained": sorted(after - before),
+                               "lost": sorted(before - after),
+                               "kept": sorted(before & after)}
+    # the grow is visible in every incumbent's event stream
+    for r in range(2):
+        grows = [e for e in _events(tmp_path, r) if e["name"] == "world_grow"]
+        assert grows and grows[0]["fields"]["joined"] == [2], grows
+
+
+@pytest.mark.slow
+def test_grow_kill_joiner_mid_shard_rebuild(tmp_path):
+    """The NEWCOMER dies inside its shard-rebuild bind (after acking the
+    grown generation): the incumbents detect the silence at the post-grow
+    barrier and shrink back to a trainable gen 2 [0, 1]."""
+    codes = _run_grow(tmp_path, "kill_joiner_rebind")
+    assert codes[2] == 137, (codes, _grow_errors(tmp_path))
+    assert codes[0] == 0 and codes[1] == 0, (codes, _grow_errors(tmp_path))
+    infos = [_info(tmp_path, r) for r in range(2)]
+    assert all(i["gen"] == 2 and i["members"] == [0, 1] for i in infos)
+    assert all(i["allreduce"] == 3.0 for i in infos)
+
+
+@pytest.mark.slow
+def test_grow_kill_incumbent_mid_ownership_rebind(tmp_path):
+    """An INCUMBENT dies inside poll_grow's ownership rebind, after the
+    grown generation sealed: the surviving incumbent and the newcomer
+    both detect it and re-form a trainable gen 2 [0, 2] — the joiner's
+    admission survives the very failure mode it was healing."""
+    codes = _run_grow(tmp_path, "kill_incumbent_rebind")
+    assert codes[1] == 137, (codes, _grow_errors(tmp_path))
+    assert codes[0] == 0 and codes[2] == 0, (codes, _grow_errors(tmp_path))
+    infos = [_info(tmp_path, r) for r in (0, 2)]
+    assert all(i["gen"] == 2 and i["members"] == [0, 2] for i in infos)
+    assert all(i["allreduce"] == 3.0 for i in infos)
+
+
+# -- self-healing grow e2e (elastic_worker.py grow mode) ------------------
+
+def _grow_env(tmp_path, extra=None):
+    e = {"PBTPU_ELASTIC_GROW": "1",
+         "PBTPU_ELASTIC_TRAIN_WORLD": str(WORLD),
+         "PBTPU_ELASTIC_JOINER_AS": "1",
+         "PBTPU_ELASTIC_MIDPASS": "0",
+         "PBTPU_FAULTPOINT": "trainer.step.pre",
+         "PBTPU_FAULTPOINT_AFTER": "9",
+         "PBTPU_FAULTPOINT_ONLY_RANK": "1"}
+    e.update(extra or {})
+    return _env(tmp_path, extra=e)
+
+
+@pytest.mark.slow
+def test_self_healing_grow_is_bit_identical_to_never_failed(tmp_path):
+    """The acceptance drill: a 3-rank run loses rank 1 mid-pass, shrinks,
+    and the controller admits a replacement (assuming the dead rank's
+    identity) at the next pass boundary. The grown world elects the last
+    snapshot intact EVERYWHERE — the dead rank's last completed pass —
+    rolls back, and retrains at full world. Every final plane on every
+    rank must be bit-identical to a 3-rank run that never failed."""
+    live = tmp_path / "live"
+    env = _grow_env(live)
+    codes = _launch(live, env, nprocs=WORLD + 1)
+    assert codes[1] == 137, (codes, _worker_errors(live, WORLD + 1))
+    assert [codes[0], codes[2], codes[3]] == [0, 0, 0], (
+        codes, _worker_errors(live, WORLD + 1))
+    infos = {r: _info(live, r) for r in range(WORLD)}
+    # one grown generation, full membership, one elected cursor
+    assert all(i["members"] == [0, 1, 2] for i in infos.values()), infos
+    assert len({i["gen"] for i in infos.values()}) == 1, infos
+    assert infos[0]["gen"] >= 2, infos
+    assert infos[0].get("grew") and infos[2].get("grew"), infos
+    assert infos[1].get("admitted"), infos
+    # the election landed on the victim's last completed pass boundary
+    assert all(i["elected"] == [1, 0] for i in infos.values()), infos
+    # the grow is visible in the survivors' event streams
+    for r in (0, 2):
+        grows = [e for e in _events(live, r)
+                 if e.get("name") == "world_grow"]
+        assert grows and grows[-1]["fields"]["joined"] == [1], grows
+    # golden: the same world size, never failed
+    gold = tmp_path / "gold"
+    genv = _env(gold, extra={"PBTPU_ELASTIC_MIDPASS": "0"})
+    gcodes = _launch(gold, genv)
+    assert gcodes == [0] * WORLD, (gcodes, _worker_errors(gold))
+    _assert_parity(live, gold, [0, 1, 2])
+    # the surviving timeline consumed exactly the golden schedule: full
+    # history on the incumbents; from the rejoin pass on the newcomer
+    for r in (0, 2):
+        assert _consumed(live, r) == _consumed(gold, r)
+    jc, gc = _consumed(live, 1), _consumed(gold, 1)
+    assert set(jc) == {pp for pp in gc if pp >= 2}, sorted(jc)
+    for pp in jc:
+        assert jc[pp] == gc[pp], f"pass {pp} schedule diverged"
+
+
+@pytest.mark.slow
+def test_grow_joiner_killed_before_registering(tmp_path):
+    """The replacement dies before its admit registration ever lands:
+    the survivors' grow polls drain on the same all-gather round, and
+    the degraded world simply finishes training — bit-consistent between
+    the survivors."""
+    env = _grow_env(tmp_path, extra={
+        "PBTPU_FAULTPOINT2": "elastic.admit.pre_register",
+        "PBTPU_FAULTPOINT2_RANK": "joiner",
+        "PBTPU_ELASTIC_GROW_POLLS": "30"})
+    codes = _launch(tmp_path, env, nprocs=WORLD + 1)
+    assert codes[1] == 137 and codes[3] == 137, (
+        codes, _worker_errors(tmp_path, WORLD + 1))
+    assert codes[0] == 0 and codes[2] == 0, (
+        codes, _worker_errors(tmp_path, WORLD + 1))
+    infos = [_info(tmp_path, r) for r in (0, 2)]
+    assert all(i["gen"] == 1 and i["members"] == [0, 2] for i in infos)
+    assert all(not i.get("grew") for i in infos), infos
+    aucs = [i["global_auc"] for i in infos]
+    assert aucs[0] == aucs[1] and not np.isnan(aucs[0]), aucs
+
+
+@pytest.mark.slow
+def test_grow_joiner_killed_after_ack_escalates_cleanly(tmp_path):
+    """The replacement acks the grown generation then dies: the
+    incumbents' resume election hits the silence, recovery re-forms past
+    the sealed-with-it generation, and the survivors escalate to a
+    trainable two-rank world — the grow path's own failure mode heals
+    through the same machinery it extends."""
+    env = _grow_env(tmp_path, extra={
+        "PBTPU_FAULTPOINT2": "elastic.admit.post_ack",
+        "PBTPU_FAULTPOINT2_RANK": "joiner",
+        "PBTPU_ELASTIC_GROW_POLLS": "30"})
+    codes = _launch(tmp_path, env, nprocs=WORLD + 1)
+    assert codes[1] == 137 and codes[3] == 137, (
+        codes, _worker_errors(tmp_path, WORLD + 1))
+    assert codes[0] == 0 and codes[2] == 0, (
+        codes, _worker_errors(tmp_path, WORLD + 1))
+    infos = [_info(tmp_path, r) for r in (0, 2)]
+    assert all(i["members"] == [0, 2] for i in infos), infos
+    assert infos[0]["gen"] == infos[1]["gen"] >= 2, infos
+    aucs = [i["global_auc"] for i in infos]
+    assert aucs[0] == aucs[1] and not np.isnan(aucs[0]), aucs
 
 
 def test_heartbeat_names_original_ranks(tmp_path):

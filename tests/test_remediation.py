@@ -1,0 +1,525 @@
+"""Self-healing runtime (ISSUE 18): the RemediationController loop.
+
+Covers the tentpole contract end to end, in process:
+
+- gating: ``flags.self_healing`` off is a hard no-op; a rule must fire
+  ``flags.self_healing_sustain`` consecutive boundaries before its
+  action applies; at most ONE action per pass (a settling action blocks
+  new applies);
+- the parity guard: an action whose rule promises bit-identity but whose
+  apply changes the dense params is REVERTED and its rule quarantined
+  for the rest of the run — and a bit-identity action with no
+  fingerprintable params is skipped, never trusted;
+- the honesty record: apply/revert land in the committed flight record
+  (``extra["remediation"]``, schema-validated here with negatives) with
+  before/after counter deltas bracketing the apply, plus registered
+  ``remediation_applied``/``remediation_reverted`` events;
+- the flow feed (ROADMAP exchange follow-up 3): the cross-rank-flow
+  finding feeds ``Trainer.note_flow_attribution`` at every boundary and
+  a quiet boundary CLEARS the veto;
+- elastic grow: ``grow_evidence`` gates on the heartbeat-gap finding's
+  ``degraded`` field, and ``poll_grow`` over real threaded ElasticWorlds
+  admits a joiner registered via ``ElasticWorld.admit`` (the union
+  all-gather) and queues the world-grow record for the next boundary;
+- faultpoint multi-arm (the compound-failure harness the grow kill
+  matrix runs on): comma/list arming, per-point counters and AFTER
+  thresholds, selective disarm, env parsing.
+"""
+
+import threading
+
+import numpy as np
+import pytest
+
+from paddlebox_tpu import monitor
+from paddlebox_tpu.config import flags, set_flags
+from paddlebox_tpu.distributed.resilience import ElasticWorld
+from paddlebox_tpu.distributed.store import FileStore
+from paddlebox_tpu.monitor import flight
+from paddlebox_tpu.monitor.names import EVENT_NAMES
+from paddlebox_tpu.monitor.registry import STATS
+from paddlebox_tpu.runtime.remediation import (Action,
+                                               RemediationController)
+from paddlebox_tpu.utils import faultpoint
+
+
+@pytest.fixture(autouse=True)
+def _clean_hub():
+    h = monitor.hub()
+    h.disable()
+    h.abort_pass(reason="test setup")
+    faultpoint.disarm()
+    yield
+    h.abort_pass(reason="test teardown")
+    h.disable()
+    faultpoint.disarm()
+
+
+@pytest.fixture
+def healing():
+    set_flags(self_healing=True, self_healing_sustain=1)
+    yield
+    set_flags(self_healing=False, self_healing_sustain=2)
+
+
+def _finding(rule, severity="warn", evidence=None):
+    return {"rule": rule, "severity": severity, "summary": rule,
+            "evidence": dict(evidence or {}), "suggestion": "fix it"}
+
+
+class _StubTrainer:
+    """The minimum surface the controller touches: fingerprintable dense
+    params (the parity witness) and the flow-attribution note."""
+
+    def __init__(self):
+        self.params = np.arange(8, dtype=np.float32)
+        self.flow_notes = []
+
+    def eval_params(self):
+        return {"w": self.params}
+
+    def note_flow_attribution(self, fa, wall=None):
+        self.flow_notes.append((fa, wall))
+
+
+def _noop_action(rule="test-rule", bit_identity=True, watch=(),
+                 mutate=None, fail=False, log=None):
+    log = log if log is not None else []
+
+    def _apply():
+        log.append("apply")
+        if fail:
+            raise RuntimeError("boom")
+        if mutate is not None:
+            mutate()
+
+    def _revert():
+        log.append("revert")
+
+    return Action(rule, "test-action", bit_identity=bit_identity,
+                  apply=_apply, revert=_revert, watch=watch,
+                  detail={"flag": "none"}), log
+
+
+# ---------------------------------------------------------------------------
+# gating
+# ---------------------------------------------------------------------------
+
+
+def test_self_healing_off_is_a_noop():
+    tr = _StubTrainer()
+    act, log = _noop_action()
+    ctl = RemediationController(trainer=tr,
+                                actions={"test-rule": lambda t, f: act})
+    assert ctl.boundary([_finding("test-rule")]) is None
+    assert log == []
+
+
+def test_sustain_threshold_blocks_the_first_firing(healing):
+    set_flags(self_healing_sustain=2)
+    tr = _StubTrainer()
+    act, log = _noop_action()
+    ctl = RemediationController(trainer=tr,
+                                actions={"test-rule": lambda t, f: act})
+    h = monitor.hub()
+    h.begin_pass(1)
+    try:
+        assert ctl.boundary([_finding("test-rule")]) is None   # streak 1
+        rec = ctl.boundary([_finding("test-rule")])            # streak 2
+        assert rec is not None and rec["status"] == "applied"
+        assert log == ["apply"]
+    finally:
+        h.abort_pass()
+
+
+def test_streak_resets_on_a_quiet_boundary(healing):
+    set_flags(self_healing_sustain=2)
+    tr = _StubTrainer()
+    act, log = _noop_action()
+    ctl = RemediationController(trainer=tr,
+                                actions={"test-rule": lambda t, f: act})
+    h = monitor.hub()
+    h.begin_pass(1)
+    try:
+        assert ctl.boundary([_finding("test-rule")]) is None
+        assert ctl.boundary([]) is None                        # quiet: reset
+        assert ctl.boundary([_finding("test-rule")]) is None   # streak 1 again
+        assert log == []
+    finally:
+        h.abort_pass()
+
+
+# ---------------------------------------------------------------------------
+# parity guard
+# ---------------------------------------------------------------------------
+
+
+def test_parity_guard_reverts_and_quarantines(healing):
+    tr = _StubTrainer()
+    act, log = _noop_action(mutate=lambda: tr.params.__setitem__(0, 99.0))
+    ctl = RemediationController(trainer=tr,
+                                actions={"test-rule": lambda t, f: act})
+    h = monitor.hub()
+    ms = monitor.MemorySink()
+    h.enable(ms)
+    before = STATS.get("remediation.reverted")
+    h.begin_pass(1)
+    try:
+        rec = ctl.boundary([_finding("test-rule")])
+        assert rec["status"] == "reverted"
+        assert rec["reason"] == "parity-guard"
+        assert log == ["apply", "revert"]
+        assert "test-rule" in ctl.quarantined
+        assert STATS.get("remediation.reverted") == before + 1
+        # quarantined for the rest of the run: the rule can never apply
+        assert ctl.boundary([_finding("test-rule")]) is None
+    finally:
+        h.abort_pass()
+        h.disable()
+    ev = ms.find("remediation_reverted")
+    assert ev and ev[0]["fields"]["reason"] == "parity-guard"
+
+
+def test_apply_error_reverts_and_quarantines(healing):
+    tr = _StubTrainer()
+    act, log = _noop_action(fail=True)
+    ctl = RemediationController(trainer=tr,
+                                actions={"test-rule": lambda t, f: act})
+    h = monitor.hub()
+    h.begin_pass(1)
+    try:
+        rec = ctl.boundary([_finding("test-rule")])
+        assert rec["status"] == "reverted"
+        assert rec["reason"] == "apply-error"
+        assert log == ["apply", "revert"]
+        assert "test-rule" in ctl.quarantined
+    finally:
+        h.abort_pass()
+
+
+def test_bit_identity_without_params_is_skipped_not_trusted(healing):
+    act, log = _noop_action(bit_identity=True)
+    ctl = RemediationController(trainer=None,
+                                actions={"test-rule": lambda t, f: act})
+    h = monitor.hub()
+    h.begin_pass(1)
+    try:
+        assert ctl.boundary([_finding("test-rule")]) is None
+        assert log == []                      # never applied blind
+    finally:
+        h.abort_pass()
+
+
+# ---------------------------------------------------------------------------
+# the honesty record: flight-record schema + before/after windows
+# ---------------------------------------------------------------------------
+
+
+def test_applied_record_rides_the_flight_record_with_after(healing):
+    tr = _StubTrainer()
+    act, log = _noop_action(watch=("healing.test_counter",))
+    ctl = RemediationController(trainer=tr,
+                                actions={"test-rule": lambda t, f: act})
+    h = monitor.hub()
+    ms = monitor.MemorySink()
+    h.enable(ms)
+    try:
+        h.begin_pass(1)
+        monitor.counter_add("healing.test_counter", 3)
+        rec = ctl.boundary([_finding("test-rule")])
+        assert rec["status"] == "applied" and "after" not in rec
+        flight_1 = h.end_pass()
+        assert flight.validate_flight_record(flight_1) == []
+        assert flight_1["extra"]["remediation"]["status"] == "applied"
+
+        h.begin_pass(2)
+        monitor.counter_add("healing.test_counter", 5)
+        # the settling boundary: the SAME action's after-window commits,
+        # and a concurrently fired rule must NOT apply (one per pass)
+        act2, log2 = _noop_action(rule="other-rule")
+        ctl.actions["other-rule"] = lambda t, f: act2
+        rec2 = ctl.boundary([_finding("other-rule")])
+        assert rec2["status"] == "applied"
+        assert rec2["after"] == {"healing.test_counter": 5.0}
+        assert log2 == []                     # settling blocked it
+        flight_2 = h.end_pass()
+        assert flight.validate_flight_record(flight_2) == []
+        assert flight_2["extra"]["remediation"]["after"] == \
+            {"healing.test_counter": 5.0}
+    finally:
+        h.disable()
+    ev = ms.find("remediation_applied")
+    assert ev and ev[0]["fields"]["rule"] == "test-rule"
+
+
+def test_remediation_schema_negatives():
+    base = {"ts": 0.0, "type": "flight_record", "name": "pass",
+            "pass_id": 1, "step": None, "phase": 1, "thread": "Main",
+            "seconds": 1.0, "steps": 1, "examples": 8,
+            "examples_per_sec": 8.0, "stage_seconds": {},
+            "stats_delta": {}, "metrics": {}}
+
+    def with_rem(rem):
+        return dict(base, extra={"remediation": rem})
+
+    good = {"rule": "boundary-wall", "action": "enable-incremental-feed",
+            "status": "applied", "before": {"feed_pass.fresh_rows": 10.0},
+            "after": {"feed_pass.fresh_rows": 0.0}}
+    assert flight.validate_flight_record(with_rem(good)) == []
+    reverted = dict(good, status="reverted", reason="parity-guard")
+    assert flight.validate_flight_record(with_rem(reverted)) == []
+    # negatives: the CI gate must reject a forged/torn record
+    assert flight.validate_flight_record(
+        with_rem(dict(good, status="maybe")))
+    assert flight.validate_flight_record(
+        with_rem(dict(good, before={"x": "NaN-ish"})))
+    assert flight.validate_flight_record(
+        with_rem(dict(good, rule=7)))
+    assert flight.validate_flight_record(
+        with_rem(dict(good, reason=1.5)))
+    assert flight.validate_flight_record(with_rem("applied"))
+
+
+def test_self_healing_events_are_registered():
+    assert {"remediation_applied", "remediation_reverted",
+            "world_grow"} <= set(EVENT_NAMES)
+
+
+# ---------------------------------------------------------------------------
+# the flow feed (ROADMAP exchange follow-up 3)
+# ---------------------------------------------------------------------------
+
+
+def test_cross_rank_flow_finding_feeds_the_wire_veto(healing):
+    tr = _StubTrainer()
+    ctl = RemediationController(trainer=tr, actions={})
+    h = monitor.hub()
+    h.begin_pass(1)
+    try:
+        ev = {"longest_edge": {"kind": "exchange", "latency_s": 0.4},
+              "longest_share_of_wall": 0.5,
+              "by_kind": {"exchange": 0.4}, "edges": 3,
+              "negative_edges": 0}
+        ctl.boundary([_finding("cross-rank-flow", evidence=ev)])
+        fa, wall = tr.flow_notes[-1]
+        assert fa["longest"]["kind"] == "exchange"
+        assert wall == pytest.approx(0.8)     # latency_s / share
+        # a quiet boundary clears the veto — stale flow evidence must
+        # not pin a wire forever
+        ctl.boundary([])
+        assert tr.flow_notes[-1] == (None, None) or \
+            tr.flow_notes[-1][0] is None
+    finally:
+        h.abort_pass()
+
+
+def test_feed_report_findings_consumed_at_next_boundary(healing):
+    tr = _StubTrainer()
+    act, log = _noop_action()
+    ctl = RemediationController(trainer=tr,
+                                actions={"test-rule": lambda t, f: act})
+    ctl.feed_report({"findings": [_finding("test-rule")]})
+    h = monitor.hub()
+    h.begin_pass(1)
+    try:
+        rec = ctl.boundary()                  # consumes the fed findings
+        assert rec is not None and rec["status"] == "applied"
+        assert log == ["apply"]
+    finally:
+        h.abort_pass()
+
+
+def test_boundary_wall_builder_flips_incremental_feed(healing):
+    """The flagship catalog entry: the boundary-wall finding's reuse_off
+    arm flips flags.incremental_feed under the parity guard (the flag
+    defaults on; the run being healed turned it off)."""
+    set_flags(incremental_feed=False)
+    tr = _StubTrainer()
+    ctl = RemediationController(trainer=tr)
+    h = monitor.hub()
+    h.begin_pass(1)
+    try:
+        rec = ctl.boundary(
+            [_finding("boundary-wall",
+                      evidence={"share": 0.8, "reused_rows": 0})])
+        assert rec["status"] == "applied"
+        assert rec["action"] == "enable-incremental-feed"
+        assert flags.incremental_feed
+        # already on: the builder declines (no second apply ever)
+        ctl2 = RemediationController(trainer=tr)
+        assert ctl2.boundary(
+            [_finding("boundary-wall", evidence={"share": 0.8})]) is None
+    finally:
+        set_flags(incremental_feed=True)
+        h.abort_pass()
+
+
+# ---------------------------------------------------------------------------
+# elastic grow
+# ---------------------------------------------------------------------------
+
+
+def test_grow_evidence_gates_on_degraded():
+    ctl = RemediationController()
+    assert ctl.grow_evidence(
+        [_finding("heartbeat-gap", evidence={"degraded": False})]) is None
+    ev = ctl.grow_evidence(
+        [_finding("heartbeat-gap",
+                  evidence={"degraded": True, "world_size": 2})])
+    assert ev and ev["world_size"] == 2
+    assert ctl.grow_evidence([]) is None
+
+
+def test_poll_grow_requires_evidence_and_flag(healing):
+    ctl = RemediationController()
+    assert ctl.poll_grow(None) == (None, None)
+
+    class _W:
+        gen = 0
+        members = [0]
+
+    w = _W()
+    set_flags(self_healing=False)
+    assert ctl.poll_grow(
+        w, findings=[_finding("heartbeat-gap",
+                              evidence={"degraded": True})]) == (w, None)
+    set_flags(self_healing=True)
+    # healthy world: no heartbeat-gap evidence -> unchanged, no gather
+    assert ctl.poll_grow(w, findings=[]) == (w, None)
+    ctl.quarantined.add("world-grow")
+    assert ctl.poll_grow(
+        w, findings=[_finding("heartbeat-gap",
+                              evidence={"degraded": True})]) == (w, None)
+
+
+def test_poll_grow_admits_joiner_over_threaded_world(tmp_path, healing):
+    """The grow protocol end to end in threads: a degraded 2-member world
+    (launched at 3) polls grow under heartbeat-gap evidence while a
+    joiner thread runs ElasticWorld.admit — the union all-gather sees the
+    registration, reform admits it, and the world-grow record is queued
+    for the next boundary."""
+    hbgap = _finding("heartbeat-gap",
+                     evidence={"degraded": True, "world_size": 2})
+    results, errs = {}, []
+    h = monitor.hub()
+    ms = monitor.MemorySink()
+    h.enable(ms)
+
+    def incumbent(r):
+        try:
+            w = ElasticWorld(FileStore(str(tmp_path), namespace="r",
+                                       poll_s=0.01),
+                             r, [0, 1], heartbeat_interval_s=0.05,
+                             lost_after_s=30.0, stall_after_s=60.0,
+                             reform_timeout_s=2.0, initial_world=3)
+            ctl = RemediationController()
+            deadline = 60
+            nw, cursor = w, None
+            for _ in range(deadline):
+                nw, cursor = ctl.poll_grow(w, findings=[hbgap])
+                if nw is not w:
+                    break
+            results[r] = (nw.gen, nw.members, ctl._notes)
+            nw.collectives.barrier("post_grow")
+            nw.close()
+        except BaseException as e:   # pragma: no cover
+            errs.append((r, e))
+
+    def joiner():
+        try:
+            w = ElasticWorld.admit(
+                FileStore(str(tmp_path), namespace="r", poll_s=0.01),
+                2, timeout_s=30.0, heartbeat_interval_s=0.05,
+                lost_after_s=30.0, stall_after_s=60.0,
+                reform_timeout_s=2.0, initial_world=3)
+            results["j"] = (w.gen, w.members)
+            w.collectives.barrier("post_grow")
+            w.close()
+        except BaseException as e:   # pragma: no cover
+            errs.append(("j", e))
+
+    ts = ([threading.Thread(target=incumbent, args=(r,)) for r in (0, 1)]
+          + [threading.Thread(target=joiner)])
+    [t.start() for t in ts]
+    [t.join(timeout=90) for t in ts]
+    h.disable()
+    assert not errs, errs
+    assert results[0][:2] == (1, [0, 1, 2])
+    assert results[1][:2] == (1, [0, 1, 2])
+    assert results["j"] == (1, [0, 1, 2])
+    # the queued world-grow record drains at the next boundary
+    notes = results[0][2]
+    assert notes and notes[0]["action"] == "world-grow"
+    assert notes[0]["detail"]["joined"] == "2"
+    assert notes[0]["detail"]["to_world"] == 3
+    grow_events = ms.find("world_grow")
+    assert grow_events and any(e["fields"]["joined"] == [2]
+                               for e in grow_events)
+    # consumed registration never re-triggers a grow
+    store = FileStore(str(tmp_path), namespace="r", poll_s=0.01)
+    assert store.keys("elastic.admit.") == []
+
+
+# ---------------------------------------------------------------------------
+# faultpoint multi-arm (the compound-failure harness)
+# ---------------------------------------------------------------------------
+
+
+def test_faultpoint_multi_arm_comma_and_list():
+    faultpoint.arm("elastic.admit.pre_register,elastic.admit.post_ack",
+                   action="ioerror")
+    assert faultpoint.armed_points() == ("elastic.admit.post_ack",
+                                         "elastic.admit.pre_register")
+    with pytest.raises(faultpoint.FaultInjected):
+        faultpoint.hit("elastic.admit.pre_register")
+    with pytest.raises(faultpoint.FaultInjected):
+        faultpoint.hit("elastic.admit.post_ack")
+    # selective disarm leaves the other leg armed
+    faultpoint.disarm("elastic.admit.pre_register")
+    faultpoint.hit("elastic.admit.pre_register")      # now a no-op
+    with pytest.raises(faultpoint.FaultInjected):
+        faultpoint.hit("elastic.admit.post_ack")
+    faultpoint.disarm()
+    faultpoint.arm(["elastic.ownership.rebind.pre"], action="ioerror")
+    with pytest.raises(faultpoint.FaultInjected):
+        faultpoint.hit("elastic.ownership.rebind.pre")
+    faultpoint.disarm()
+
+
+def test_faultpoint_multi_arm_keeps_per_point_counters():
+    faultpoint.arm(["elastic.admit.pre_register",
+                    "elastic.ownership.rebind.pre"],
+                   action="ioerror", after=1)
+    faultpoint.hit("elastic.admit.pre_register")       # hit 1: below after
+    with pytest.raises(faultpoint.FaultInjected):
+        faultpoint.hit("elastic.admit.pre_register")   # hit 2: fires
+    # the OTHER point's counter is untouched by the first point's hits
+    faultpoint.hit("elastic.ownership.rebind.pre")
+    with pytest.raises(faultpoint.FaultInjected):
+        faultpoint.hit("elastic.ownership.rebind.pre")
+    faultpoint.disarm()
+
+
+def test_faultpoint_env_comma_parsing(monkeypatch):
+    monkeypatch.setenv("PBTPU_FAULTPOINT",
+                       "elastic.admit.pre_register,elastic.admit.post_ack")
+    monkeypatch.setenv("PBTPU_FAULTPOINT_ACTION", "ioerror")
+    monkeypatch.setenv("PBTPU_FAULTPOINT_AFTER", "0,2")
+    faultpoint._arm_from_env()
+    try:
+        assert faultpoint.armed_points() == ("elastic.admit.post_ack",
+                                             "elastic.admit.pre_register")
+        with pytest.raises(faultpoint.FaultInjected):
+            faultpoint.hit("elastic.admit.pre_register")   # after=0
+        faultpoint.hit("elastic.admit.post_ack")           # after=2: 1st
+        faultpoint.hit("elastic.admit.post_ack")           # 2nd
+        with pytest.raises(faultpoint.FaultInjected):
+            faultpoint.hit("elastic.admit.post_ack")       # 3rd fires
+    finally:
+        faultpoint.disarm()
+
+
+def test_faultpoint_unknown_name_rejected_in_multi_arm():
+    with pytest.raises(KeyError):
+        faultpoint.arm("elastic.admit.pre_register,nope.not.registered")
+    assert faultpoint.armed_points() == ()
