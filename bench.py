@@ -130,6 +130,13 @@ def collect_gate_metrics(eps_chip: float, detail: dict) -> dict:
         for k in ("publish_seconds", "swap_pause_ms", "p99_ms"):
             if isinstance(srv.get(k), (int, float)):
                 m[f"serving.{k}"] = srv[k]
+    ss = (detail.get("matrix") or {}).get("serving_split")
+    if isinstance(ss, dict):
+        # version-split point (ISSUE 19): the served tail latency while
+        # shadow scoring doubles the predictor work per request —
+        # lower-is-better off the _ms suffix like the serving points
+        if isinstance(ss.get("shadow_p99_ms"), (int, float)):
+            m["serving_split.shadow_p99_ms"] = ss["shadow_p99_ms"]
     sp = (detail.get("matrix") or {}).get("spill_10x")
     if isinstance(sp, dict):
         # tiered-table point: cold-tier fetch throughput + the hot-tier
@@ -1110,6 +1117,111 @@ def serving_drill(small: bool, tiny: bool = False) -> dict:
             "swapped_to_version": srv.active.version}
 
 
+def serving_split_drill(small: bool, tiny: bool = False) -> dict:
+    """Version-split serving drill (ISSUE 19): shadow-mode scoring on the
+    REAL two-version path. Pass 1 publishes the stable version, pass 2's
+    publish is HELD as the candidate (``flags.serving_shadow``) while
+    every request scores on both — the drill records the served tail
+    latency under the doubled predictor work (``shadow_p99_ms``,
+    gate-held lower-is-better), joins the pass's labels back to both
+    versions' scores for the per-version AUC + candidate-vs-stable
+    score-KL, commits a serving window record, schema-checks it, and
+    runs the doctor's three serving rules over it — the whole
+    capture→record→diagnose loop the chip run will lean on."""
+    import tempfile as _tempfile
+    import time as _t
+    from paddlebox_tpu.config import flags as _flags
+    from paddlebox_tpu.data import DataFeedSchema, SlotDataset
+    from paddlebox_tpu.embedding import EmbeddingConfig, HostEmbeddingStore
+    from paddlebox_tpu.fleet import BoxPS
+    from paddlebox_tpu.models import DeepFMModel
+    from paddlebox_tpu.monitor import doctor as doctor_lib
+    from paddlebox_tpu.monitor import flight as flight_lib
+    from paddlebox_tpu.parallel import make_mesh
+    from paddlebox_tpu.serving import ServingPublisher, ServingServer
+    from paddlebox_tpu.train import Trainer, TrainerConfig
+
+    bs = 64
+    n_ex = bs * (2 if tiny else (8 if small else 32))
+    schema = DataFeedSchema.ctr(num_sparse=4, num_float=1, batch_size=bs,
+                                max_len=1)
+    rec = _synth_pass(schema, n_ex, 4,
+                      [s for s in schema.float_slots if s.name != "label"],
+                      2000, seed=13)
+    store = HostEmbeddingStore(EmbeddingConfig(dim=8, optimizer="adagrad",
+                                               learning_rate=0.05))
+    model = DeepFMModel(num_slots=4, emb_dim=8, dense_dim=1, hidden=(16,))
+    tr = Trainer(model, store, schema, make_mesh(1),
+                 TrainerConfig(global_batch_size=bs))
+    box = BoxPS(store)
+    ds = SlotDataset(schema)
+    ds.records = rec
+    prev_shadow = _flags.serving_shadow
+    try:
+        _flags.serving_shadow = True
+        with _tempfile.TemporaryDirectory() as td:
+            root = os.path.join(td, "serve")
+            pub = ServingPublisher(root, model, schema,
+                                   publish_base_every=8, quant="f32",
+                                   hot_top_k=64)
+            box.begin_pass()
+            tr.train_pass(ds)
+            box.end_pass(trainer=tr, publisher=pub)
+            srv = ServingServer(root, poll_s=0.01)
+            if srv.poll_once() != 1:
+                raise RuntimeError(
+                    "server failed to load the published base")
+            # pass 2's publish lands as the HELD candidate
+            box.begin_pass()
+            tr.train_pass(ds)
+            box.end_pass(trainer=tr, publisher=pub)
+            if srv.poll_once() != 1 or srv.candidate is None:
+                raise RuntimeError("candidate did not load under shadow")
+            pb = next(iter(ds.batches(batch_size=bs)))
+            lc, lw, _ = schema.float_split_cols("label")
+            floats = np.concatenate(
+                [pb.floats[:, :lc], pb.floats[:, lc + lw:]], axis=1)
+            ids64 = pb.ids.astype(np.uint64)
+            labels = pb.floats[:, lc:lc + lw].reshape(-1)
+            # warmup OUTSIDE the measured window: first batch compiles
+            srv.predict(ids64, pb.mask, floats)
+            srv.observe_labels(labels)
+            srv.commit_window(force=True)
+            n_batches = 2 if tiny else (8 if small else 32)
+            t0 = _t.perf_counter()
+            for _ in range(n_batches):
+                srv.predict(ids64, pb.mask, floats)
+                srv.observe_labels(labels)
+            serve_s = _t.perf_counter() - t0
+            fields = srv.commit_window(force=True)
+            srv.stop()
+    finally:
+        _flags.serving_shadow = prev_shadow
+    full_rec = {"ts": _t.time(), "type": "serving_record",
+                "name": "serving_window", "pass_id": None, "step": None,
+                "phase": -1, "thread": "bench", "fields": fields}
+    schema_errors = flight_lib.validate_serving_record(full_rec)
+    rep = doctor_lib.diagnose(servings=[full_rec])
+    rules = {r["rule"]: r["status"] for r in rep["rules"]
+             if r["rule"] in ("version-regression", "p99-burn",
+                              "swap-regression")}
+    by_role = {e.get("role"): (vid, e)
+               for vid, e in (fields.get("versions") or {}).items()}
+    stable = by_role.get("stable", (None, {}))
+    cand = by_role.get("candidate", (None, {}))
+    return {"shadow": True,
+            "stable_version": stable[0], "candidate_version": cand[0],
+            "requests": int(fields["requests"]),
+            "shadow_p50_ms": float(fields["p50_ms"]),
+            "shadow_p99_ms": float(fields["p99_ms"]),
+            "serve_eps": round(n_batches * bs / max(serve_s, 1e-9), 1),
+            "stable_auc": stable[1].get("auc"),
+            "candidate_auc": cand[1].get("auc"),
+            "score_kl": cand[1].get("score_kl"),
+            "record_schema_errors": schema_errors,
+            "doctor_rules": rules}
+
+
 def spill_drill(small: bool, tiny: bool = False) -> dict:
     """Tiered-table drill (ISSUE 11): a working set >= 10x the RAM
     row-cache budget through the sharded+spill path — 2 hash-partitioned
@@ -1843,6 +1955,29 @@ def dryrun_main() -> int:
         and sdrill.get("p99_ms", 0) > 0
         and sdrill.get("failures") == 0
         and sdrill.get("swapped_to_version") == 2)
+    # version-split drill rides the dryrun too (ISSUE 19): the shadow
+    # two-version loop must produce a schema-valid serving window record
+    # with per-version AUC + score-KL attribution, and the doctor's
+    # three serving rules must have evaluated it (version-regression off
+    # real signal, not no-data) — before a chip round records the point
+    try:
+        ssd = serving_split_drill(True, tiny=True)
+    except Exception as e:
+        ssd = {"error": repr(e)}
+    detail.setdefault("matrix", {})["serving_split"] = ssd
+    _ssr = ssd.get("doctor_rules") or {}
+    checks["serving_obs_fields"] = (
+        ssd.get("record_schema_errors") == []
+        and ssd.get("requests", 0) > 0
+        and isinstance(ssd.get("shadow_p99_ms"), float)
+        and ssd.get("shadow_p99_ms", 0) > 0
+        and isinstance(ssd.get("stable_auc"), float)
+        and isinstance(ssd.get("candidate_auc"), float)
+        and isinstance(ssd.get("score_kl"), float)
+        and ssd.get("score_kl", -1) >= 0
+        and set(_ssr) == {"version-regression", "p99-burn",
+                          "swap-regression"}
+        and _ssr.get("version-regression") in ("quiet", "fired"))
     # tiered-table drill rides the dryrun too (ISSUE 11): the spill_10x
     # point must carry a working set >= 10x the RAM cache budget through
     # the sharded+spill path, with the tier identity + cache budget +
@@ -2112,6 +2247,10 @@ def dryrun_main() -> int:
         "serving": {k: sdrill.get(k) for k in
                     ("publish_seconds", "swap_pause_ms", "p99_ms",
                      "error") if k in sdrill},
+        "serving_split": {k: ssd.get(k) for k in
+                          ("shadow_p99_ms", "stable_auc",
+                           "candidate_auc", "score_kl", "requests",
+                           "doctor_rules", "error") if k in ssd},
         "spill": {k: spd.get(k) for k in
                   ("hot_hit_rate", "direct_hot_hit_rate",
                    "fetch_keys_per_s", "error") if k in spd},
@@ -2543,6 +2682,14 @@ def _enrich(small: bool, detail: dict, ctx: dict,
             except Exception as e:
                 matrix["serving"] = {"error": repr(e)}
             _mark("matrix point serving done")
+            # version-split drill: shadow-mode two-version scoring —
+            # shadow_p99_ms is gate-held (lower-is-better), the AUC /
+            # score-KL attribution and doctor verdicts ride the artifact
+            try:
+                matrix["serving_split"] = serving_split_drill(small)
+            except Exception as e:
+                matrix["serving_split"] = {"error": repr(e)}
+            _mark("matrix point serving_split done")
         detail["matrix"] = matrix
     if os.environ.get("PBTPU_BENCH_HOST", "1") != "0":
         # tunnel-immune host section, in a CPU subprocess: the parent

@@ -391,6 +391,28 @@ class Flags:
     # training).
     trace_device: bool = False              # (new)
     trace_device_dir: str = ""              # (new) "" = <tmp>/pbtpu_device_trace
+    # --- serving observability (new — serving/obs.py, ISSUE 19) ---
+    # Version-split traffic: fraction of live requests the server routes
+    # to the CANDIDATE version (the newest published model held next to
+    # the stable one). 0.0 = no split: every new version hot-swaps to
+    # active immediately, exactly the pre-split behavior.
+    serving_split_fraction: float = 0.0     # (new)
+    # Shadow mode: score every request on BOTH versions but always serve
+    # the stable answer — per-version latency/score/AUC attribution with
+    # zero user-facing risk (the paper's AUC-runner A/B, serving half).
+    serving_shadow: bool = False            # (new)
+    # Serving flight-record cadence: commit one schema-validated
+    # `serving_window` record to the hub every this-many seconds of
+    # request traffic. 0 disables windowed records.
+    serving_window_s: float = 30.0          # (new)
+    # Request tracing: every Nth dispatched batch opens serve/wait +
+    # serve/score spans under the standing `ensure_service` scope,
+    # parent-linked to the served version's publish span via the
+    # donefile-carried ids. 0 = no request spans (one flag check).
+    serving_trace_sample: int = 0           # (new)
+    # Serving latency SLO (ms) the doctor's p99-burn rule burns against;
+    # stamped into every serving window record.
+    serving_slo_ms: float = 50.0            # (new)
 
     def set(self, name: str, value: Any) -> None:
         if not hasattr(self, name):

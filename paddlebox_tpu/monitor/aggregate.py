@@ -43,8 +43,15 @@ EVIDENCE_EVENTS = ("peer_lost", "peer_stalled", "nan_guard",
                    # self-healing runtime (ISSUE 18): what the controller
                    # did to the run, and the elastic grow it triggered
                    "remediation_applied", "remediation_reverted",
-                   "world_grow")
+                   "world_grow",
+                   # serving plane (ISSUE 19): the per-window serving
+                   # flight record the doctor's serving rules read
+                   "serving_window")
 KEEP_PER_NAME = 16
+# serving window records retained per rank (one per window cadence — a
+# day at 30s windows is ~3k records; cap keeps pathological streams
+# bounded while holding far more history than the doctor's rules read)
+MAX_SERVING_RECORDS = 512
 
 _SEG_RE = re.compile(r"\.(\d{3,})\.jsonl$")
 _RANK_RE = re.compile(r"rank[_-]?(\d+)", re.IGNORECASE)
@@ -116,6 +123,7 @@ def read_stream(root: str, trace_out: "dict | None" = None) -> dict:
     once, not twice."""
     files = discover_stream_files(root)
     flights: list[dict] = []
+    servings: list[dict] = []
     errors: list[str] = []
     event_counts: dict[str, int] = {}
     evidence: dict[str, list[dict]] = {}
@@ -156,12 +164,19 @@ def read_stream(root: str, trace_out: "dict | None" = None) -> dict:
                     else:
                         trace_out["records"].append(rec)
             if typ != "meta":
-                for e in (flight.validate_flight_record(rec)
-                          if typ == "flight_record"
-                          else flight.validate_event(rec)):
+                if typ == "flight_record":
+                    errs = flight.validate_flight_record(rec)
+                elif typ == "serving_record":
+                    errs = flight.validate_serving_record(rec)
+                else:
+                    errs = flight.validate_event(rec)
+                for e in errs:
                     errors.append(f"{seg}:{lineno} ({name}): {e}")
             if typ == "flight_record":
                 flights.append(rec)
+            elif typ == "serving_record" \
+                    and len(servings) < MAX_SERVING_RECORDS:
+                servings.append(rec)
             if rec.get("thread"):
                 threads.add(rec["thread"])
             if isinstance(name, str):
@@ -171,8 +186,10 @@ def read_stream(root: str, trace_out: "dict | None" = None) -> dict:
                     if len(kept) < KEEP_PER_NAME:
                         kept.append(rec)
     flights.sort(key=lambda r: (r.get("pass_id") or 0, r.get("ts") or 0))
+    servings.sort(key=lambda r: r.get("ts") or 0)
     return {"root": root, "files": files, "events": n,
-            "flight_records": flights, "errors": errors,
+            "flight_records": flights, "serving_records": servings,
+            "errors": errors,
             "event_counts": event_counts, "evidence": evidence,
             "threads": sorted(threads)}
 
@@ -403,4 +420,10 @@ def _world_view(streams: "list[dict]", labels: "list[int]",
         "evidence": evidence,
         "flight_records": [fr for st in streams
                            for fr in st["flight_records"]],
+        # serving plane (ISSUE 19): every rank's window records, merged
+        # in time order — what the doctor's serving rules read
+        "serving_records": sorted(
+            (sr for st in streams
+             for sr in st.get("serving_records", ())),
+            key=lambda r: r.get("ts") or 0),
     }

@@ -63,7 +63,8 @@ class DoctorContext:
     ``sink_health`` the hub's per-sink account."""
 
     def __init__(self, flights=None, counters=None, evidence=None,
-                 world=None, detail=None, sink_health=None):
+                 world=None, detail=None, sink_health=None,
+                 servings=None):
         self.flights = sorted(flights or [],
                               key=lambda fr: (fr.get("pass_id") or 0))
         self.counters = dict(counters or {})
@@ -71,6 +72,23 @@ class DoctorContext:
         self.world = world
         self.detail = dict(detail or {})
         self.sink_health = list(sink_health or [])
+        # serving plane (ISSUE 19): per-window serving records, oldest
+        # first, flattened to their field payloads. Explicit ``servings``
+        # (the aggregate's serving_records) wins; the retained
+        # serving_window evidence is the fallback so the CLI's
+        # single-rank path still feeds the serving rules
+        raw = servings if servings is not None \
+            else (self.evidence.get("serving_window") or [])
+        self.servings = []
+        for r in raw:
+            if not isinstance(r, dict):
+                continue
+            f = r.get("fields") if isinstance(r.get("fields"), dict) \
+                else r
+            w = dict(f)
+            w["ts"] = r.get("ts") or f.get("ts") or 0
+            self.servings.append(w)
+        self.servings.sort(key=lambda w: w["ts"])
         self.attribution = cp_lib.attribute_records(self.flights)
 
     def pass_deltas(self, key: str) -> "list[tuple[int, float]]":
@@ -693,6 +711,168 @@ class CrossRankFlowRule(Rule):
             ev, "; ".join(fix))
 
 
+def _roles(window: dict) -> "dict[str, tuple[str, dict]]":
+    """{role: (version_id, entry)} off one serving window's ``versions``
+    object — last entry per role wins (there is at most one stable and
+    one candidate per window by construction)."""
+    out: dict[str, tuple[str, dict]] = {}
+    for vid, v in (window.get("versions") or {}).items():
+        if isinstance(v, dict) and v.get("role") in ("stable",
+                                                     "candidate"):
+            out[v["role"]] = (str(vid), v)
+    return out
+
+
+class VersionRegressionRule(Rule):
+    id = "version-regression"
+    doc = "candidate version scores below stable (AUC gap / score-KL "\
+          "drift)"
+    incident = ("ISSUE 19: the paper's AUC-runner A/B, serving half — a "
+                "candidate version served blind (no per-version "
+                "attribution) regressed CTR for a full window before "
+                "the offline AUC caught it; the serving window record "
+                "carries per-version AUC and candidate-vs-stable "
+                "score-KL exactly so this fires DURING the split")
+    AUC_MARGIN = 0.005
+    KL_MAX = 0.5
+
+    def evaluate(self, ctx):
+        target = None
+        for w in reversed(ctx.servings):
+            if {"stable", "candidate"} <= set(_roles(w)):
+                target = w
+                break
+        if target is None:
+            return "no-data", None
+        roles = _roles(target)
+        vid_s, stable = roles["stable"]
+        vid_c, cand = roles["candidate"]
+        auc_s, auc_c = stable.get("auc"), cand.get("auc")
+        kl = cand.get("score_kl")
+        auc_gap = (float(auc_s) - float(auc_c)
+                   if auc_s is not None and auc_c is not None else None)
+        fired_auc = auc_gap is not None and auc_gap > self.AUC_MARGIN
+        fired_kl = isinstance(kl, (int, float)) and kl > self.KL_MAX
+        if not fired_auc and not fired_kl:
+            if auc_gap is None and kl is None:
+                return "no-data", None      # both versions, no signal yet
+            return "quiet", None
+        sev = "critical" if fired_auc else "warn"
+        return "fired", Finding(
+            self.id, sev,
+            (f"candidate v{vid_c} regresses vs stable v{vid_s}: "
+             + (f"AUC {auc_c:.4f} vs {auc_s:.4f}"
+                if fired_auc else f"score-KL {kl:.3f}")),
+            {"stable_version": vid_s, "candidate_version": vid_c,
+             "stable_auc": auc_s, "candidate_auc": auc_c,
+             "auc_gap": auc_gap, "score_kl": kl,
+             "stable_score_mean": stable.get("score_mean"),
+             "candidate_score_mean": cand.get("score_mean"),
+             "candidate_requests": cand.get("requests")},
+            "do not promote: keep flags.serving_shadow on (or "
+            "flags.serving_split_fraction small) and hold stable; check "
+            "the candidate's training pass for the regression source "
+            "(nan-guard, dedup-drift, a bad dataset day) — the publish "
+            "flow edge in the merged trace names the producing pass")
+
+
+class P99BurnRule(Rule):
+    id = "p99-burn"
+    doc = "serving p99 is burning through its latency SLO across "\
+          "windows"
+    incident = ("ISSUE 19: the frontend's since-start latency reservoir "
+                "hid a post-swap p99 step inside a lifetime blend — the "
+                "windowed records exist so sustained SLO burn is "
+                "visible window by window, not after the day's average "
+                "moves")
+    RECENT = 6          # windows considered
+    BURN = 0.5          # fraction of recent windows breaching
+
+    def evaluate(self, ctx):
+        wins = [w for w in ctx.servings if w.get("requests")]
+        if not wins:
+            return "no-data", None
+        recent = wins[-self.RECENT:]
+        latest = recent[-1]
+        slo = latest.get("slo_ms")
+        if not isinstance(slo, (int, float)) or slo <= 0:
+            return "no-data", None
+        breaches = [w for w in recent
+                    if isinstance(w.get("p99_ms"), (int, float))
+                    and float(w["p99_ms"]) > float(slo)]
+        rate = len(breaches) / len(recent)
+        if latest not in breaches or rate < self.BURN:
+            return "quiet", None
+        return "fired", Finding(
+            self.id, "warn",
+            (f"serving p99 {latest.get('p99_ms'):.1f}ms over the "
+             f"{slo:g}ms SLO in {len(breaches)}/{len(recent)} recent "
+             f"window(s)"),
+            {"slo_ms": slo, "burn_rate": round(rate, 3),
+             "p99_per_window": [(round(w['ts'], 1), w.get("p99_ms"))
+                                for w in recent],
+             "latest_requests": latest.get("requests"),
+             "latest_p50_ms": latest.get("p50_ms")},
+            "check what changed at the first breaching window: a swap "
+            "(swap-regression names the step), shadow scoring overhead "
+            "(flags.serving_shadow doubles predictor work per request), "
+            "or batch-coalesce pressure (frontend max_wait_s / "
+            "max_batch); raise flags.serving_slo_ms only if the SLO "
+            "itself was wrong")
+
+
+class SwapRegressionRule(Rule):
+    id = "swap-regression"
+    doc = "post-swap serving p99 stepped up vs the pre-swap window"
+    incident = ("ISSUE 19 (and PR 7's swap discipline): the swap is one "
+                "atomic rebind, but the VERSION behind it can be slow — "
+                "a bigger table, a cold predictor cache, a dense config "
+                "that recompiles; comparing the swap window's p99 "
+                "against the window before it is the regression "
+                "statement the cumulative reservoir could never make")
+    STEP = 1.5          # post/pre p99 ratio
+    FLOOR_MS = 1.0      # absolute step floor (timer noise guard)
+
+    def evaluate(self, ctx):
+        wins = ctx.servings
+        if not wins:
+            return "no-data", None
+        for i in range(len(wins) - 1, 0, -1):
+            w = wins[i]
+            if not w.get("swaps"):
+                continue
+            pre = wins[i - 1]
+            post_p99, pre_p99 = w.get("p99_ms"), pre.get("p99_ms")
+            if not (isinstance(post_p99, (int, float))
+                    and isinstance(pre_p99, (int, float))
+                    and w.get("requests") and pre.get("requests")):
+                continue            # no traffic on one side: no verdict
+            if post_p99 > self.STEP * pre_p99 \
+                    and post_p99 > pre_p99 + self.FLOOR_MS:
+                return "fired", Finding(
+                    self.id, "warn",
+                    (f"p99 stepped {pre_p99:.1f}ms -> {post_p99:.1f}ms "
+                     f"across the swap to "
+                     f"v{w.get('active_version')}"),
+                    {"pre_p99_ms": pre_p99, "post_p99_ms": post_p99,
+                     "step_ratio": round(post_p99 / max(pre_p99, 1e-9),
+                                         2),
+                     "swap_window_ts": w.get("ts"),
+                     "active_version": w.get("active_version"),
+                     "swaps_in_window": w.get("swaps"),
+                     "version_lag": w.get("version_lag")},
+                    "compare the swapped version against its parent: "
+                    "table_keys (a grown table lengthens the probe), "
+                    "model config (a changed architecture recompiles "
+                    "the forward on first request — with_model reuse "
+                    "only holds same-config swaps), replica hot-tier "
+                    "coverage (replica_hot_keys in the window record); "
+                    "roll back by republishing the parent if the step "
+                    "holds")
+            return "quiet", None    # latest assessable swap looks clean
+        return "quiet", None        # windows exist, no assessable swap
+
+
 ALL_RULES: "tuple[type[Rule], ...]" = (
     BoundaryWallRule,
     ExchangeOverflowRule,
@@ -704,6 +884,9 @@ ALL_RULES: "tuple[type[Rule], ...]" = (
     HeartbeatGapRule,
     SinkHealthRule,
     CrossRankFlowRule,
+    VersionRegressionRule,
+    P99BurnRule,
+    SwapRegressionRule,
 )
 
 _SEV_ORDER = {"critical": 0, "warn": 1, "info": 2}
@@ -714,12 +897,13 @@ _SEV_ORDER = {"critical": 0, "warn": 1, "info": 2}
 # ---------------------------------------------------------------------------
 
 def diagnose(flights=None, counters=None, evidence=None, world=None,
-             detail=None, sink_health=None, inputs=None) -> dict:
+             detail=None, sink_health=None, servings=None,
+             inputs=None) -> dict:
     """Evaluate every rule over the given telemetry; returns the report
     (validate with :func:`validate_report`)."""
     ctx = DoctorContext(flights=flights, counters=counters,
                         evidence=evidence, world=world, detail=detail,
-                        sink_health=sink_health)
+                        sink_health=sink_health, servings=servings)
     rules = []
     findings = []
     for rule_cls in ALL_RULES:
@@ -926,6 +1110,7 @@ def main(argv: "list[str] | None" = None) -> int:
                       evidence=world["evidence"],
                       world=world if len(roots) > 1 else None,
                       detail=detail,
+                      servings=world.get("serving_records"),
                       inputs=roots)
     if detail:
         report["world_trace"] = detail["world_trace"]

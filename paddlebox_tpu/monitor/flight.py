@@ -173,6 +173,68 @@ def validate_flight_record(rec: dict) -> list[str]:
     return errs
 
 
+# serving-window record fields (serving/obs.py, under rec["fields"]
+# because the record rides the generic hub.event envelope), with
+# required types — the serving plane's flight record (ISSUE 19)
+SERVING_REQUIRED_FIELDS = {
+    "window_s": numbers.Real,
+    "requests": numbers.Integral,
+    "failures": numbers.Integral,
+    "swaps": numbers.Integral,
+    "version_lag": numbers.Integral,
+    "slo_ms": numbers.Real,
+    "p50_ms": numbers.Real,
+    "p99_ms": numbers.Real,
+}
+
+# per-version attribution fields inside fields["versions"][vid]: role is
+# the closed stable/candidate vocabulary; the rest are numbers when
+# present (auc is absent until delayed labels arrive)
+_SERVING_VERSION_NUMERIC = ("p50_ms", "p99_ms", "requests", "score_mean",
+                            "auc", "score_kl")
+
+
+def validate_serving_record(rec: dict) -> list[str]:
+    """Schema errors for a serving window record (ISSUE 19).
+
+    The record is a hub event (``type="serving_record"``, name
+    ``serving_window``) whose payload lives under ``fields`` — the
+    serving plane's per-window flight record: request/failure counts,
+    windowed p50/p99, version lag, swap count, and a ``versions`` object
+    with per-version latency/score/AUC attribution."""
+    errs = validate_event(rec)
+    if rec.get("type") != "serving_record":
+        errs.append(f"type is {rec.get('type')!r}, not 'serving_record'")
+    f = rec.get("fields")
+    if not isinstance(f, dict):
+        return errs + [f"fields is {type(f).__name__}, not an object"]
+    for k, want in SERVING_REQUIRED_FIELDS.items():
+        if k not in f:
+            errs.append(f"missing field {k!r}")
+        elif not isinstance(f[k], want) or isinstance(f[k], bool):
+            errs.append(f"fields[{k!r}] is {type(f[k]).__name__}, want "
+                        f"{want.__name__}")
+    versions = f.get("versions")
+    if versions is None:
+        return errs
+    if not isinstance(versions, dict):
+        return errs + ["fields['versions'] is not an object"]
+    for vid, v in versions.items():
+        if not isinstance(v, dict):
+            errs.append(f"versions[{vid!r}] is not an object")
+            continue
+        if v.get("role") not in ("stable", "candidate"):
+            errs.append(f"versions[{vid!r}]['role'] is not one of "
+                        "('stable', 'candidate')")
+        for k in _SERVING_VERSION_NUMERIC:
+            val = v.get(k)
+            if val is not None and (not isinstance(val, numbers.Real)
+                                    or isinstance(val, bool)):
+                errs.append(f"versions[{vid!r}][{k!r}] is neither null "
+                            "nor a number")
+    return errs
+
+
 def validate_events_file(path: str) -> dict:
     """Validate a JSONL event stream end to end.
 
@@ -196,9 +258,12 @@ def validate_events_file(path: str) -> dict:
             n += 1
             if rec.get("type") == "meta":
                 continue              # sink bookkeeping, not telemetry
-            errs = (validate_flight_record(rec)
-                    if rec.get("type") == "flight_record"
-                    else validate_event(rec))
+            if rec.get("type") == "flight_record":
+                errs = validate_flight_record(rec)
+            elif rec.get("type") == "serving_record":
+                errs = validate_serving_record(rec)
+            else:
+                errs = validate_event(rec)
             for e in errs:
                 errors.append(f"line {lineno} ({rec.get('name')}): {e}")
             if rec.get("type") == "flight_record":

@@ -66,6 +66,10 @@ EVENT_NAMES: tuple[str, ...] = (
     "serving_artifact_prune_error",
     "serving_swap",
     "serving_version_fallback",
+    # serving observability (serving/obs.py via server.commit_window):
+    # the per-window serving flight record — requests, per-version
+    # p50/p99 + score stats, version lag, swap count, replica-cache hits
+    "serving_window",
     # fleet / donefile discipline
     "donefile_compacted",
     "donefile_repaired",
@@ -108,6 +112,10 @@ SPAN_NAMES: tuple[str, ...] = (
     "stage/read",
     "stage/translate",
     "stage/drain",
+    # serving request spans (serving/frontend.py + server.py, sampled by
+    # flags.serving_trace_sample): batch-coalesce wait vs. score time
+    "serve/wait",
+    "serve/score",
 )
 
 ALL_NAMES: frozenset = frozenset(EVENT_NAMES) | frozenset(SPAN_NAMES)

@@ -399,6 +399,13 @@ def merge_streams(streams: "list[dict]", labels: "list[int]") -> dict:
     spans = 0
     span_records = 0          # type=="span" only — "is there a trace
     t_min = None              # plane here at all" (flights always exist)
+    # cross-process parent links (ISSUE 19): a serving request span
+    # carries the producing run's ids in its FIELDS (propagated through
+    # the donefile entry) — pair them with the parent span's merged
+    # location to draw publish -> request arrows across process
+    # boundaries
+    span_locs: dict[str, dict] = {}
+    linked: list[dict] = []
 
     def corrected(rank: int, ts: float) -> float:
         return float(ts) - offsets.get(rank, 0.0)
@@ -447,12 +454,24 @@ def merge_streams(streams: "list[dict]", labels: "list[int]") -> dict:
             elif typ == "span":
                 dur = float(rec.get("dur_s") or 0.0)
                 tid = _tid_for(rec.get("thread") or "main", tids)
+                start_us = us(label, ts, dur)
                 events.append({
                     "name": name, "ph": "X", "pid": label, "tid": tid,
-                    "ts": us(label, ts, dur), "dur": round(dur * 1e6, 3),
+                    "ts": start_us, "dur": round(dur * 1e6, 3),
                     "args": args})
                 spans += 1
                 span_records += 1
+                sid = rec.get("span_id")
+                if isinstance(sid, str):
+                    span_locs.setdefault(sid, {"rank": label, "tid": tid,
+                                               "ts_us": start_us})
+                f = rec.get("fields") or {}
+                if isinstance(f.get("parent_span_id"), str):
+                    linked.append({"name": name, "rank": label,
+                                   "tid": tid, "ts_us": start_us,
+                                   "parent_span_id": f["parent_span_id"],
+                                   "parent_trace_id":
+                                       f.get("parent_trace_id")})
             elif typ == "flow" and name == "trace.flow":
                 f = rec.get("fields") or {}
                 pt = {"rank": label,
@@ -509,12 +528,35 @@ def merge_streams(streams: "list[dict]", labels: "list[int]") -> dict:
                                    - src["corrected_s"], 6),
                 "fields": {k: v for k, v in p["fields"].items()
                            if k not in ("kind", "key", "role")}})
+    # parent-link arrows (ISSUE 19): one s/f pair from the parent span
+    # (the producing pass's publish) to each propagated-linked child
+    # span (a serving request) — NOT a flow edge (the cross-rank-flow
+    # doctor rule keys off flow() points only), so it gets its own
+    # counter. Parents outside the merged roots still count as linked:
+    # the ids are stamped either way.
+    linked_edges = 0
+    for n, lk in enumerate(sorted(linked, key=lambda p: p["ts_us"]), 1):
+        src = span_locs.get(lk["parent_span_id"])
+        if src is None:
+            continue
+        linked_edges += 1
+        fid = _flow_id("parent", lk["parent_span_id"], n)
+        events.append({"name": f"parent:{lk['name']}", "ph": "s",
+                       "id": fid, "cat": "flow.parent",
+                       "pid": src["rank"], "tid": src["tid"],
+                       "ts": src["ts_us"]})
+        events.append({"name": f"parent:{lk['name']}", "ph": "f",
+                       "bp": "e", "id": fid, "cat": "flow.parent",
+                       "pid": lk["rank"], "tid": lk["tid"],
+                       "ts": lk["ts_us"]})
     events.sort(key=lambda e: (e.get("ts", -1), e.get("pid", 0)))
     summary = {
         "ranks": list(labels),
         "events": len(events),
         "spans": spans,
         "span_records": span_records,
+        "linked_spans": len(linked),
+        "linked_edges": linked_edges,
         "flow_points": sum(len(v) for v in flow_points.values()),
         "flow_edges": edges,
         "clock_offsets_s": {str(r): v

@@ -5,18 +5,21 @@ versioned base/delta artifact from every pass (announced by donefile only
 after a verified upload — a torn publish can never serve),
 ``ServingServer`` tails the donefile, CRC-verifies, and hot-swaps the new
 version under load without dropping a request, ``BatchingFrontend``
-batches a request stream into the predictor at concurrency. See
+batches a request stream into the predictor at concurrency, and
+``ServingObs`` (ISSUE 19) attributes latency/AUC/score-KL per version
+for the window-cadence serving flight records the doctor reads. See
 docs/PARITY.md "Online model publish + hot-swap serving" and the README
-serving runbook.
+serving + version-split runbooks.
 """
 
 from paddlebox_tpu.serving.artifact import (read_artifact, version_name,
                                             write_artifact)
 from paddlebox_tpu.serving.frontend import BatchingFrontend
+from paddlebox_tpu.serving.obs import ServingObs
 from paddlebox_tpu.serving.publisher import DONEFILE, ServingPublisher
 from paddlebox_tpu.serving.server import (ServingModel, ServingServer,
                                           ServingUnavailableError)
 
 __all__ = ["ServingPublisher", "ServingServer", "ServingModel",
-           "ServingUnavailableError", "BatchingFrontend", "DONEFILE",
-           "read_artifact", "write_artifact", "version_name"]
+           "ServingUnavailableError", "BatchingFrontend", "ServingObs",
+           "DONEFILE", "read_artifact", "write_artifact", "version_name"]

@@ -10,13 +10,19 @@ compiled batch shape — a varying batch size would recompile the jitted
 forward mid-traffic — scores once, and scatters results.
 
 Latency accounting is the product: per-request wall time (submit →
-result) lands in a bounded reservoir; :meth:`stats` reports p50/p99/max,
-batch-size distribution, and failures — the numbers bench.py's
-``serving_drill`` records and the BENCH_BEST gate holds.
+result) lands in a TIME-WINDOWED reservoir (``serving/obs.py`` —
+ISSUE 19: a since-start blend hides a swap-induced p99 step behind
+hours of pre-swap samples); :meth:`stats` reports recent-traffic
+p50/p99/max, batch-size distribution, and failures — the numbers
+bench.py's ``serving_drill`` records and the BENCH_BEST gate holds.
+``flags.serving_trace_sample`` opens a ``serve/wait`` span around every
+Nth batch's coalesce window, splitting queue wait from score time in
+the merged world trace.
 """
 
 from __future__ import annotations
 
+import contextlib
 import queue
 import threading
 import time
@@ -25,7 +31,9 @@ from concurrent.futures import Future
 import numpy as np
 
 from paddlebox_tpu import monitor
+from paddlebox_tpu.config import flags
 from paddlebox_tpu.monitor import context as mon_ctx
+from paddlebox_tpu.serving.obs import LatencyWindow
 
 
 class _Request:
@@ -41,14 +49,21 @@ class _Request:
 
 class BatchingFrontend:
     def __init__(self, server, *, max_batch: int = 256,
-                 max_wait_s: float = 0.002, max_latencies: int = 100_000):
+                 max_wait_s: float = 0.002, max_latencies: int = 100_000,
+                 window_s: float | None = None):
         self.server = server
         self.max_batch = int(max_batch)
         self.max_wait_s = float(max_wait_s)
         self._q: queue.Queue[_Request | None] = queue.Queue()
-        self._lat: list[float] = []
-        self._lat_cap = int(max_latencies)
+        # windowed, not since-start: stats()/flight records must report
+        # RECENT traffic (flags.serving_window_s; a 0 record cadence
+        # still wants a sane stats window)
+        self._lat = LatencyWindow(
+            float(flags.serving_window_s or 30.0)
+            if window_s is None else float(window_s),
+            cap=int(max_latencies))
         self._lat_lock = threading.Lock()
+        self._gathers = 0
         self._batches = 0
         self._batched_reqs = 0
         self._failures = 0
@@ -126,19 +141,28 @@ class BatchingFrontend:
         first = self._q.get()
         if first is None:
             return []
-        batch = [first]
-        deadline = time.perf_counter() + self.max_wait_s
-        while len(batch) < self.max_batch:
-            remaining = deadline - time.perf_counter()
-            if remaining <= 0:
-                break
-            try:
-                r = self._q.get(timeout=remaining)
-            except queue.Empty:
-                break
-            if r is None:
-                break
-            batch.append(r)
+        # sampled request tracing: every Nth batch's coalesce window is
+        # a serve/wait span — the queue-wait half of request latency
+        # (serve/score is the server's half). 0 = one flag check.
+        self._gathers += 1
+        n = int(flags.serving_trace_sample)
+        ctx = (monitor.span("serve/wait", max_batch=self.max_batch)
+               if n > 0 and self._gathers % n == 0
+               else contextlib.nullcontext())
+        with ctx:
+            batch = [first]
+            deadline = time.perf_counter() + self.max_wait_s
+            while len(batch) < self.max_batch:
+                remaining = deadline - time.perf_counter()
+                if remaining <= 0:
+                    break
+                try:
+                    r = self._q.get(timeout=remaining)
+                except queue.Empty:
+                    break
+                if r is None:
+                    break
+                batch.append(r)
         return batch
 
     def _run(self) -> None:
@@ -183,11 +207,11 @@ class BatchingFrontend:
                     r.future.set_exception(e)
             return
         now = time.perf_counter()
+        wall = time.time()
         lats = [(now - r.t0) * 1e3 for r in batch]
         with self._lat_lock:
-            self._lat.extend(lats)
-            if len(self._lat) > self._lat_cap:
-                del self._lat[:len(self._lat) - self._lat_cap]
+            for ms in lats:
+                self._lat.add(ms, now=wall)
         self._batches += 1
         self._batched_reqs += n
         monitor.counter_add("serving.frontend_requests", n)
@@ -197,9 +221,12 @@ class BatchingFrontend:
     # ---- accounting ------------------------------------------------------
 
     def stats(self) -> dict:
+        """count/failures are cumulative; the percentiles are over the
+        latency WINDOW (recent traffic only — an empty window after an
+        idle spell reports count with no percentiles)."""
         with self._lat_lock:
-            lat = np.asarray(self._lat, np.float64)
-        if not len(lat):
+            snap = self._lat.snapshot()
+        if not snap["count"]:
             return {"count": 0, "failures": self._failures}
         return {
             "count": int(self._batched_reqs),
@@ -207,7 +234,8 @@ class BatchingFrontend:
             "batches": int(self._batches),
             "mean_batch": round(self._batched_reqs
                                 / max(self._batches, 1), 2),
-            "p50_ms": round(float(np.percentile(lat, 50)), 3),
-            "p99_ms": round(float(np.percentile(lat, 99)), 3),
-            "max_ms": round(float(lat.max()), 3),
+            "window_count": int(snap["count"]),
+            "p50_ms": round(snap["p50_ms"], 3),
+            "p99_ms": round(snap["p99_ms"], 3),
+            "max_ms": round(snap["max_ms"], 3),
         }

@@ -31,6 +31,7 @@ mid-update.
 
 from __future__ import annotations
 
+import contextlib
 import http.server
 import json
 import os
@@ -43,6 +44,7 @@ from typing import Any
 import numpy as np
 
 from paddlebox_tpu import monitor
+from paddlebox_tpu.config import flags
 from paddlebox_tpu.embedding.gating import GateSpec
 from paddlebox_tpu.monitor import context as mon_ctx
 from paddlebox_tpu.monitor import trace as trace_lib
@@ -52,6 +54,7 @@ from paddlebox_tpu.inference import export as export_lib
 from paddlebox_tpu.inference.predictor import Predictor
 from paddlebox_tpu.inference.serving_table import ServingTable
 from paddlebox_tpu.serving import artifact as art
+from paddlebox_tpu.serving.obs import ServingObs
 from paddlebox_tpu.serving.publisher import DONEFILE
 from paddlebox_tpu.utils import checkpoint as ckpt_lib
 from paddlebox_tpu.utils import fs as fs_lib
@@ -79,12 +82,14 @@ class ServingModel:
     rebind of ``server._active`` IS the swap."""
 
     __slots__ = ("version", "pass_id", "kind", "predictor", "table",
-                 "replica_cache", "hot_keys", "published_ts", "loaded_ts")
+                 "replica_cache", "hot_keys", "published_ts", "loaded_ts",
+                 "trace")
 
     def __init__(self, version: int, pass_id: int, kind: str,
                  predictor: Predictor, table: ServingTable,
                  replica_cache: ReplicaCache | None,
-                 hot_keys: np.ndarray, published_ts: int):
+                 hot_keys: np.ndarray, published_ts: int,
+                 trace: dict | None = None):
         self.version = version
         self.pass_id = pass_id
         self.kind = kind
@@ -94,6 +99,10 @@ class ServingModel:
         self.hot_keys = hot_keys
         self.published_ts = published_ts
         self.loaded_ts = time.time()
+        # the producing run's {"trace_id", "span_id"} off the donefile
+        # entry — request spans scored on this version parent-link to
+        # its publish span through these (ISSUE 19)
+        self.trace = trace
 
 
 class ServingServer:
@@ -119,6 +128,10 @@ class ServingServer:
         self.stale_pass_lag = int(stale_pass_lag)
         self.stale_after_s = float(stale_after_s)
         self._active: ServingModel | None = None
+        # version-split / shadow (ISSUE 19): with the split flags on, a
+        # newly built version lands HERE while _active keeps serving —
+        # stable + candidate score side by side until promotion
+        self._candidate: ServingModel | None = None
         self._latest_announced: dict | None = None
         self._skipped: dict[int, str] = {}     # version → diagnosis
         self._unusable: set[str] = set()       # entries diagnosed once
@@ -127,6 +140,14 @@ class ServingServer:
         self._request_failures = 0
         self._last_error: str | None = None
         self._last_swap_pause_ms = 0.0
+        # serving observability: per-window/per-version bookkeeping,
+        # built on first use so flag flips after construction stick
+        self._obs: ServingObs | None = None
+        self._obs_lock = threading.Lock()
+        self._split_acc = 0.0                  # deterministic router
+        self._score_n = 0                      # serve/score sampling
+        self._win_failures0 = 0                # counters at last commit
+        self._win_swaps0 = 0
         self._stop = threading.Event()
         self._thread: threading.Thread | None = None
         self._http: Any = None
@@ -139,6 +160,21 @@ class ServingServer:
     @property
     def active(self) -> ServingModel | None:
         return self._active
+
+    @property
+    def candidate(self) -> ServingModel | None:
+        return self._candidate
+
+    def _newest(self) -> ServingModel | None:
+        """The newest loaded model — the candidate when one is held,
+        else the active. Donefile progress and version lag are measured
+        against it."""
+        return self._candidate or self._active
+
+    @staticmethod
+    def _split_on() -> bool:
+        return (float(flags.serving_split_fraction) > 0.0
+                or bool(flags.serving_shadow))
 
     def poll_once(self) -> int:
         """One tail step: read the donefile, fetch/verify/build every
@@ -167,11 +203,17 @@ class ServingServer:
                 applied = self._apply_entries(entries)
         else:
             applied = self._apply_entries(entries)
+        # split flags turned off while a candidate is held: promote it —
+        # the newest version must not strand behind a dead A/B
+        if self._candidate is not None and not self._split_on():
+            self.promote_candidate()
         self._update_staleness_gauges()
+        self.commit_window()                  # due-gated; no-op early
         return applied
 
     def _apply_entries(self, entries: list[dict]) -> int:
-        active_v = self._active.version if self._active else 0
+        newest = self._newest()
+        active_v = newest.version if newest else 0
         applied = 0
         for e in entries:
             try:
@@ -193,14 +235,15 @@ class ServingServer:
                 continue
             if kind == "delta":
                 parent = e.get("parent")
-                if self._active is None or parent is None \
-                        or int(parent) != self._active.version:
+                base = self._newest()      # deltas chain off the newest
+                if base is None or parent is None \
+                        or int(parent) != base.version:
                     # parent skipped/never loaded: this delta can never
                     # apply — wait for the next base to resync
                     self._diag(version,
                                f"delta v{version} parents "
                                f"v{parent}, active is "
-                               f"v{self._active.version if self._active else None}"
+                               f"v{base.version if base else None}"
                                f" — waiting for the next base")
                     continue
             staged = None
@@ -218,15 +261,35 @@ class ServingServer:
                 # until the staging disk fills
                 if staged is not None:
                     shutil.rmtree(staged, ignore_errors=True)
+            # the producing run's trace context off the donefile entry:
+            # the swap flow point AND every request span scored on this
+            # version parent-link through it (cross-process propagation)
+            parent_trace = e.get("trace") if isinstance(
+                e.get("trace"), dict) else None
+            model.trace = parent_trace
             t_swap = time.perf_counter()
-            self._active = model           # THE swap: one atomic rebind
-            pause_ms = (time.perf_counter() - t_swap) * 1e3
-            self._last_swap_pause_ms = pause_ms
-            self._swaps += 1
+            if self._split_on() and self._active is not None:
+                # version-split/shadow: the new version lands as the
+                # CANDIDATE; stable keeps serving until promotion
+                prev, self._candidate = self._candidate, model
+                role = "candidate"
+                pause_ms = (time.perf_counter() - t_swap) * 1e3
+                monitor.counter_add("serving.candidate_loads")
+                monitor.gauge_set("serving.candidate_version", version)
+                with self._obs_lock:
+                    if prev is not None:
+                        self._obs_get().drop_version(prev.version)
+                    self._obs_get().ensure_version(version, "candidate")
+            else:
+                self._active = model       # THE swap: one atomic rebind
+                role = "stable"
+                pause_ms = (time.perf_counter() - t_swap) * 1e3
+                self._last_swap_pause_ms = pause_ms
+                self._swaps += 1
+                monitor.counter_add("serving.swaps")
+                monitor.gauge_set("serving.active_version", version)
             applied += 1
             active_v = version
-            monitor.counter_add("serving.swaps")
-            monitor.gauge_set("serving.active_version", version)
             # world trace: the swap is the dst of the publish flow edge
             # — keyed by version (both sides derive it independently),
             # ACTIVATED by the trace context the donefile entry carries
@@ -234,13 +297,11 @@ class ServingServer:
             # version, so the swap point emits even when this process
             # has no local trace scope) with the publisher's span ids
             # as the explicit parent link
-            parent_trace = e.get("trace") if isinstance(
-                e.get("trace"), dict) else None
             trace_lib.flow_propagated(
                 "publish", f"v{version}", "dst", parent_trace,
                 swap_pause_ms=round(pause_ms, 3))
             monitor.event("serving_swap", type="lifecycle",
-                          version=version, kind=kind,
+                          version=version, kind=kind, role=role,
                           pass_id=model.pass_id,
                           swap_pause_ms=round(pause_ms, 3),
                           keys=len(model.table))
@@ -319,7 +380,7 @@ class ServingServer:
             hot_keys = np.asarray(loaded["keys"])[
                 np.asarray(loaded["hot"], bool)].astype(np.uint64)
         else:
-            active = self._active
+            active = self._newest()        # deltas chain off the newest
             table = active.table.copy()
             table._merge(loaded["keys"], loaded["rows"])
             if len(loaded["removed"]):
@@ -338,7 +399,7 @@ class ServingServer:
         import jax
         from paddlebox_tpu.models import MODEL_REGISTRY
         from paddlebox_tpu.utils import checkpoint as _ckpt
-        active = self._active
+        active = self._newest()
         if active is not None and \
                 active.predictor.model.name == model_meta["model"] and \
                 _normalize_cfg(export_lib.model_config(
@@ -374,6 +435,35 @@ class ServingServer:
             return None
         return ReplicaCache.from_keys_rows(live, table.vals[pos[hit]])
 
+    def promote_candidate(self) -> bool:
+        """Promote the held candidate to stable (the A/B verdict came
+        in, or the split flags went off). Returns whether a promotion
+        happened."""
+        cand = self._candidate
+        if cand is None:
+            return False
+        old = self._active
+        t_swap = time.perf_counter()
+        self._active = cand                # THE swap: one atomic rebind
+        self._candidate = None
+        pause_ms = (time.perf_counter() - t_swap) * 1e3
+        self._last_swap_pause_ms = pause_ms
+        self._swaps += 1
+        monitor.counter_add("serving.swaps")
+        monitor.gauge_set("serving.active_version", cand.version)
+        with self._obs_lock:
+            obs = self._obs_get()
+            obs.ensure_version(cand.version, "stable")
+            if old is not None:
+                obs.drop_version(old.version)
+        monitor.event("serving_swap", type="lifecycle",
+                      version=cand.version, kind=cand.kind,
+                      role="stable", promoted=True,
+                      pass_id=cand.pass_id,
+                      swap_pause_ms=round(pause_ms, 3),
+                      keys=len(cand.table))
+        return True
+
     # ---- request path ----------------------------------------------------
 
     def _handle(self) -> ServingModel:
@@ -384,15 +474,72 @@ class ServingServer:
                 f"(last error: {self._last_error})")
         return m
 
+    def _obs_get(self) -> ServingObs:
+        if self._obs is None:
+            self._obs = ServingObs()
+        return self._obs
+
+    def _score(self, model: ServingModel, ids, mask, dense,
+               served: bool) -> np.ndarray:
+        """Score one batch on ``model``, with per-version latency/score
+        attribution and (every ``flags.serving_trace_sample``-th served
+        batch) a ``serve/score`` span parent-linked to the version's
+        publish span via the donefile-carried ids."""
+        role = "candidate" if model is self._candidate else "stable"
+        n = int(flags.serving_trace_sample)
+        ctx: Any = contextlib.nullcontext()
+        if n > 0 and served:
+            self._score_n += 1
+            if self._score_n % n == 0:
+                span_fields = {"version": model.version, "role": role}
+                if isinstance(model.trace, dict):
+                    # parent link as FIELDS: the envelope's trace keys
+                    # belong to THIS process's scope; the propagated
+                    # producer ids ride the payload (the merger draws
+                    # the cross-process arrow off them)
+                    span_fields["parent_trace_id"] = \
+                        model.trace.get("trace_id")
+                    span_fields["parent_span_id"] = \
+                        model.trace.get("span_id")
+                ctx = monitor.span("serve/score", **span_fields)
+        t0 = time.perf_counter()
+        with ctx:
+            out = model.predictor.predict(ids, mask, dense)
+        if flags.serving_window_s > 0 or self._split_on():
+            with self._obs_lock:
+                self._obs_get().record(
+                    model.version, role, out,
+                    (time.perf_counter() - t0) * 1e3, served)
+        return out
+
     def predict(self, ids: np.ndarray, mask: np.ndarray,
                 dense: np.ndarray | None = None) -> np.ndarray:
         m = self._handle()
+        cand = self._candidate
+        serve_model = m
+        if cand is not None and not flags.serving_shadow:
+            # deterministic live split: route every 1/fraction-th batch
+            # to the candidate (accumulator, not RNG — reproducible)
+            frac = float(flags.serving_split_fraction)
+            if frac > 0.0:
+                with self._obs_lock:
+                    self._split_acc += frac
+                    if self._split_acc >= 1.0:
+                        self._split_acc -= 1.0
+                        serve_model = cand
         try:
-            out = m.predictor.predict(ids, mask, dense)
+            out = self._score(serve_model, ids, mask, dense, served=True)
         except Exception:
             self._request_failures += 1
             monitor.counter_add("serving.request_failures")
             raise
+        if cand is not None and flags.serving_shadow:
+            # shadow: score the candidate too, serve the stable answer;
+            # a shadow failure is counted, never surfaced to the caller
+            try:
+                self._score(cand, ids, mask, dense, served=False)
+            except Exception:   # noqa: BLE001 — shadow must not break serving
+                monitor.counter_add("serving.shadow_failures")
         self._served += len(np.asarray(ids))
         return out
 
@@ -406,6 +553,52 @@ class ServingServer:
             raise
         self._served += int(pb.num)
         return out
+
+    # ---- delayed labels / window records (ISSUE 19) ----------------------
+
+    def observe_labels(self, labels, *, preds=None,
+                       version: int | None = None) -> dict:
+        """Delayed labels arrived: join them to the scores the loaded
+        versions produced and feed the per-version AUC (the serving half
+        of the paper's AUC-runner A/B). See ServingObs.observe_labels.
+        Returns {version: joined_count}."""
+        with self._obs_lock:
+            return self._obs_get().observe_labels(labels,
+                                                  version=version,
+                                                  preds=preds)
+
+    def commit_window(self, force: bool = False,
+                      now: float | None = None) -> dict | None:
+        """Commit one serving flight record when the window cadence is
+        due (``force`` for test/bench-driven stepping): the fields go
+        out as a ``serving_window`` event (``type="serving_record"``,
+        schema-checked by monitor/flight.py) and come back to the
+        caller. None when not due."""
+        obs = self._obs_get()
+        if not (force or obs.due(now)):
+            return None
+        newest = self._newest()
+        ann_pass = _entry_int(self._latest_announced, "pass")
+        lag = (max(0, ann_pass - newest.pass_id)
+               if newest is not None and ann_pass is not None else 0)
+        with self._obs_lock:
+            fields = obs.commit(
+                now,
+                failures=int(self._request_failures
+                             - self._win_failures0),
+                swaps=int(self._swaps - self._win_swaps0),
+                version_lag=int(lag),
+                active_version=(self._active.version
+                                if self._active else None),
+                candidate_version=(self._candidate.version
+                                   if self._candidate else None),
+                replica_hot_keys=(len(newest.replica_cache) - 1
+                                  if newest and newest.replica_cache
+                                  else 0))
+        self._win_failures0 = self._request_failures
+        self._win_swaps0 = self._swaps
+        monitor.event("serving_window", type="serving_record", **fields)
+        return fields
 
     # ---- staleness / health ----------------------------------------------
 
@@ -422,6 +615,8 @@ class ServingServer:
         is, and whether the tail is degraded (newer versions announced
         but unloadable). ``status``: ok | stale | degraded | empty."""
         m = self._active
+        cand = self._candidate
+        newest = cand or m
         ann = self._latest_announced
         # snapshot: the tailer thread inserts concurrently, and iterating
         # the live dict from the HTTP thread can raise "changed size
@@ -431,22 +626,37 @@ class ServingServer:
         # or hand-written last line must degrade the report, not 500 it
         ann_v = _entry_int(ann, "version")
         ann_pass = _entry_int(ann, "pass")
+        now = time.time()
         if m is None:
             status = "empty"
             pass_lag = ann_pass if ann_pass is not None else None
             age = None
         else:
-            pass_lag = (max(0, ann_pass - m.pass_id)
+            # staleness is measured against the NEWEST loaded model: a
+            # fresh candidate means the tail is keeping up even while
+            # stable intentionally lags behind the split
+            pass_lag = (max(0, ann_pass - newest.pass_id)
                         if ann_pass is not None else 0)
-            age = time.time() - (m.published_ts or m.loaded_ts)
-            if ann_v is not None and ann_v > m.version \
-                    and any(v > m.version for v in skipped):
+            age = now - (newest.published_ts or newest.loaded_ts)
+            if ann_v is not None and ann_v > newest.version \
+                    and any(v > newest.version for v in skipped):
                 status = "degraded"
             elif pass_lag > self.stale_pass_lag \
                     or age > self.stale_after_s:
                 status = "stale"
             else:
                 status = "ok"
+        # per-version staleness for a fleet health-checker: a
+        # half-swapped replica is visible as stable/candidate ids plus
+        # each version's own age (ISSUE 19)
+        versions = {}
+        for vm, role in ((m, "stable"), (cand, "candidate")):
+            if vm is None:
+                continue
+            versions[str(vm.version)] = {
+                "role": role, "pass_id": vm.pass_id, "kind": vm.kind,
+                "age_seconds": round(
+                    now - (vm.published_ts or vm.loaded_ts), 1)}
         return {"status": status,
                 "active_version": m.version if m else None,
                 "active_pass": m.pass_id if m else None,
@@ -454,6 +664,11 @@ class ServingServer:
                 "table_keys": len(m.table) if m else 0,
                 "hot_cached_keys": (len(m.replica_cache) - 1
                                     if m and m.replica_cache else 0),
+                "candidate_version": cand.version if cand else None,
+                "candidate_pass": cand.pass_id if cand else None,
+                "split_fraction": float(flags.serving_split_fraction),
+                "shadow": bool(flags.serving_shadow),
+                "versions": versions,
                 "announced_version": ann_v,
                 "announced_pass": ann_pass,
                 "pass_lag": pass_lag,

@@ -1,0 +1,97 @@
+"""Subprocess driver for the serving-side observability loop (ISSUE 19).
+
+A REAL serving process: its own hub + JsonlSink telemetry stream, a
+standing ``serving`` trace scope (no pass lifecycle ever runs here),
+a ServingServer tailing a donefile some TRAINING process published, and
+a BatchingFrontend driving sampled request traffic through it. With
+``PBTPU_TRACE=1`` and ``PBTPU_SERVING_TRACE_SAMPLE=1`` every batch opens
+``serve/wait`` + ``serve/score`` spans, the score spans carrying the
+donefile-propagated publish trace ids — the parent test merges this
+stream with the trainer's and asserts the request spans parent-link to
+the publish span ACROSS the process boundary. Before exiting, delayed
+labels join the pending scores and one serving window record commits.
+
+Usage: python tests/serving_obs_worker.py SERVE_ROOT TELEMETRY_DIR
+       [--requests N]
+"""
+
+import argparse
+import json
+import os
+import sys
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO not in sys.path:
+    sys.path.insert(0, REPO)
+TESTS = os.path.join(REPO, "tests")
+if TESTS not in sys.path:
+    sys.path.insert(0, TESTS)
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np  # noqa: E402
+
+import mockfs  # noqa: E402
+from paddlebox_tpu import monitor  # noqa: E402
+from paddlebox_tpu.monitor import trace as trace_lib  # noqa: E402
+from paddlebox_tpu.serving import (BatchingFrontend,  # noqa: E402
+                                   ServingServer)
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("serve_root")
+    ap.add_argument("telemetry_dir")
+    ap.add_argument("--requests", type=int, default=32)
+    args = ap.parse_args()
+
+    mockfs.register_from_env()
+    h = monitor.hub()
+    h.enable(monitor.JsonlSink(
+        os.path.join(args.telemetry_dir, "events.jsonl")))
+    # the pass-less process opens the standing serving scope (the same
+    # call ServingServer.start() makes) — this worker drives poll_once
+    # synchronously so the request count below is deterministic
+    trace_lib.ensure_service("serving")
+
+    from test_train_e2e import synth_dataset
+    ds, _schema = synth_dataset(128)
+    pb = next(iter(ds.batches(batch_size=64)))
+    lc, lw, _ = pb.schema.float_split_cols("label")
+    floats = np.concatenate([pb.floats[:, :lc], pb.floats[:, lc + lw:]],
+                            axis=1)
+    labels = pb.floats[:, lc:lc + lw].reshape(-1)
+
+    srv = ServingServer(args.serve_root, poll_s=0.05)
+    applied = srv.poll_once()
+    assert srv.active is not None, "no version loadable from the root"
+
+    n = int(args.requests)
+    fe = BatchingFrontend(srv, max_batch=n, max_wait_s=0.02).start()
+    try:
+        futs = [fe.submit(pb.ids[i].astype(np.uint64), pb.mask[i],
+                          floats[i]) for i in range(n)]
+        probs = np.asarray([f.result(timeout=60) for f in futs])
+    finally:
+        fe.stop()
+    joined = srv.observe_labels(labels[:n])
+    rec = srv.commit_window(force=True)
+    h.disable()
+
+    print(json.dumps({
+        "applied": applied, "version": srv.active.version,
+        "served": int(srv._served), "scored": int(probs.size),
+        "joined": {str(k): v for k, v in joined.items()},
+        "window": {"requests": rec["requests"],
+                   "p99_ms": rec["p99_ms"],
+                   "versions": sorted(rec["versions"])},
+        "frontend": fe.stats(),
+    }), flush=True)
+
+
+if __name__ == "__main__":
+    main()

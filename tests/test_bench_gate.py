@@ -100,6 +100,22 @@ def test_collect_gate_metrics_serving_points(bench):
     assert not any(k.startswith("serving.") for k in m2)
 
 
+def test_collect_gate_metrics_serving_split_point(bench):
+    """The version-split drill gates exactly shadow_p99_ms (ISSUE 19) —
+    the AUC/KL attribution rides the artifact, not the gate; a failed
+    drill contributes nothing."""
+    detail = {"matrix": {"serving_split": {
+        "shadow_p99_ms": 9.5, "shadow_p50_ms": 3.0, "stable_auc": 0.8,
+        "candidate_auc": 0.79, "score_kl": 0.01, "requests": 256}}}
+    m = bench.collect_gate_metrics(1.0, detail)
+    assert m["serving_split.shadow_p99_ms"] == 9.5
+    assert not any(k.startswith("serving_split.")
+                   for k in m if k != "serving_split.shadow_p99_ms")
+    m2 = bench.collect_gate_metrics(
+        1.0, {"matrix": {"serving_split": {"error": "boom"}}})
+    assert not any(k.startswith("serving_split.") for k in m2)
+
+
 def test_gate_latency_metrics_are_lower_is_better(bench):
     """Metrics named *_ms / *_seconds gate in the latency direction: a
     HIGHER current value regresses, a lower one is an improvement —
@@ -181,6 +197,15 @@ def test_bench_dryrun_smoke():
     assert out["serving"]["publish_seconds"] > 0
     assert out["serving"]["swap_pause_ms"] > 0
     assert out["serving"]["p99_ms"] > 0
+    # the version-split point must exist with per-version attribution
+    # (ISSUE 19): shadow tail latency gate-held, AUC/score-KL recorded,
+    # schema-valid serving record, the three serving rules evaluated
+    assert out["checks"]["serving_obs_fields"], out.get("serving_split")
+    assert out["serving_split"]["shadow_p99_ms"] > 0
+    assert 0 <= out["serving_split"]["stable_auc"] <= 1
+    assert out["serving_split"]["score_kl"] >= 0
+    assert set(out["serving_split"]["doctor_rules"]) == {
+        "version-regression", "p99-burn", "swap-regression"}
     # the sharded-exchange matrix points must exist with their identity
     # fields (ISSUE 10): table_layout/exchange_wire/shard count recorded,
     # dedup ratio measured — so sharded points enter the BENCH_BEST gate

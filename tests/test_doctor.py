@@ -54,6 +54,26 @@ def make_flight(pass_id, seconds=10.0, train=6.0, read=0.5, auc=0.2,
     return rec
 
 
+def make_serving_window(ts, requests=100, failures=0, swaps=0,
+                        version_lag=0, slo_ms=50.0, p50_ms=3.0,
+                        p99_ms=8.0, versions=None, **extra):
+    """One schema-valid serving window record (ISSUE 19) — the serving
+    plane's make_flight. The doctor flattens ``fields``; fixtures pass
+    full records so every synthetic window also exercises the schema."""
+    rec = {
+        "ts": float(ts), "type": "serving_record",
+        "name": "serving_window", "pass_id": None, "step": None,
+        "phase": -1, "thread": "serving",
+        "fields": dict({"window_s": 30.0, "requests": requests,
+                        "failures": failures, "swaps": swaps,
+                        "version_lag": version_lag, "slo_ms": slo_ms,
+                        "p50_ms": p50_ms, "p99_ms": p99_ms,
+                        "versions": versions or {}}, **extra),
+    }
+    assert flight.validate_serving_record(rec) == []
+    return rec
+
+
 # Per-rule (fire_kwargs, quiet_kwargs) for doctor.diagnose — the
 # closed-registry discipline: a new rule cannot ship without BOTH a
 # firing and a quiet synthetic fixture registered here (the coverage
@@ -174,6 +194,50 @@ RULE_FIXTURES: dict = {
                       "src_rank": 0, "dst_rank": 1, "latency_s": 0.1,
                       "fields": {}}],
                  "clock_offsets_s": {"0": 0.0, "1": 0.0}}}),
+    ),
+    "version-regression": (
+        # candidate AUC 0.58 against stable 0.74 — far past the 0.005
+        # margin; quiet: identical versions score identically
+        dict(servings=[make_serving_window(
+            100.0,
+            versions={"1": {"role": "stable", "requests": 80,
+                            "auc": 0.74, "score_mean": 0.21},
+                      "2": {"role": "candidate", "requests": 80,
+                            "auc": 0.58, "score_mean": 0.34,
+                            "score_kl": 0.8}})]),
+        dict(servings=[make_serving_window(
+            100.0,
+            versions={"1": {"role": "stable", "requests": 80,
+                            "auc": 0.74, "score_mean": 0.21},
+                      "2": {"role": "candidate", "requests": 80,
+                            "auc": 0.74, "score_mean": 0.21,
+                            "score_kl": 0.01}})]),
+    ),
+    "p99-burn": (
+        # 3 of 4 recent windows (incl. the latest) breach the 50ms SLO;
+        # quiet: same traffic, p99 comfortably under
+        dict(servings=[
+            make_serving_window(100.0, p99_ms=12.0),
+            make_serving_window(130.0, p99_ms=72.0),
+            make_serving_window(160.0, p99_ms=65.0),
+            make_serving_window(190.0, p99_ms=80.0)]),
+        dict(servings=[
+            make_serving_window(100.0, p99_ms=12.0),
+            make_serving_window(130.0, p99_ms=72.0),
+            make_serving_window(160.0, p99_ms=11.0),
+            make_serving_window(190.0, p99_ms=13.0)]),
+    ),
+    "swap-regression": (
+        # the swap window's p99 steps 6ms -> 40ms (> 1.5x and > +1ms);
+        # quiet: a swap whose window holds the pre-swap latency
+        dict(servings=[
+            make_serving_window(100.0, p99_ms=6.0),
+            make_serving_window(130.0, p99_ms=40.0, swaps=1,
+                                active_version=7)]),
+        dict(servings=[
+            make_serving_window(100.0, p99_ms=6.0),
+            make_serving_window(130.0, p99_ms=6.5, swaps=1,
+                                active_version=7)]),
     ),
 }
 
@@ -654,6 +718,36 @@ def test_cli_two_rank_world(tmp_path, capsys):
     rep = json.loads(out)
     assert rep["world"]["ranks"] == [4, 7]
     assert rep["world"]["passes"][0]["straggler"] == 7
+
+
+def test_cli_fail_on_gates_serving_rules_from_stream(tmp_path, capsys):
+    """ISSUE 19 CI gate: serving window records in a telemetry stream
+    reach the serving rules through the CLI — --fail-on warn exits 1 on
+    a version regression read off disk, 0 when the split looks clean."""
+    bad = [make_flight(1),
+           make_serving_window(
+               100.0,
+               versions={"1": {"role": "stable", "auc": 0.74},
+                         "2": {"role": "candidate", "auc": 0.58}})]
+    _write_stream(str(tmp_path / "bad"), bad)
+    rc = doctor.main([str(tmp_path / "bad"), "--json",
+                      "--fail-on", "warn"])
+    out = capsys.readouterr().out
+    assert rc == 1
+    rep = json.loads(out)
+    status = {r["rule"]: r["status"] for r in rep["rules"]}
+    assert status["version-regression"] == "fired"
+
+    good = [make_flight(1),
+            make_serving_window(
+                100.0,
+                versions={"1": {"role": "stable", "auc": 0.74},
+                          "2": {"role": "candidate", "auc": 0.74,
+                                "score_kl": 0.02}})]
+    _write_stream(str(tmp_path / "good"), good)
+    assert doctor.main([str(tmp_path / "good"), "--json",
+                        "--fail-on", "warn"]) == 0
+    capsys.readouterr()
 
 
 def test_cli_refuses_empty_inputs(tmp_path, capsys):

@@ -220,6 +220,10 @@ def test_health_endpoint_http(job):
         with urllib.request.urlopen(url, timeout=10) as r:
             h = json.loads(r.read())
         assert h["status"] == "ok" and h["active_version"] == 1
+        # per-version fields ride the same payload (ISSUE 19)
+        assert h["candidate_version"] is None
+        assert h["split_fraction"] == 0.0 and h["shadow"] is False
+        assert h["versions"]["1"]["role"] == "stable"
         metrics_url = f"http://127.0.0.1:{srv.health_port}/metrics"
         with urllib.request.urlopen(metrics_url, timeout=10) as r:
             assert b"pbtpu" in r.read()
@@ -346,6 +350,164 @@ def test_frontend_splits_mixed_dense_batch(job):
     want = srv.predict(pb.ids[1:5].astype(np.uint64), pb.mask[1:5],
                        floats[1:5])
     np.testing.assert_allclose(want, got, rtol=1e-6, atol=1e-7)
+
+
+# ------------------------------------- version split / shadow (ISSUE 19)
+
+
+@pytest.fixture()
+def _split_flags():
+    from paddlebox_tpu.config import flags, set_flags
+    keys = ("serving_split_fraction", "serving_shadow",
+            "serving_window_s", "serving_trace_sample")
+    saved = {k: flags.get(k) for k in keys}
+    yield set_flags
+    for k, v in saved.items():
+        flags.set(k, v)
+
+
+def _req_batch(ds):
+    pb = next(iter(ds.batches(batch_size=64)))
+    lc, lw, _ = pb.schema.float_split_cols("label")
+    floats = np.concatenate([pb.floats[:, :lc], pb.floats[:, lc + lw:]],
+                            axis=1)
+    return pb.ids.astype(np.uint64), pb.mask, floats
+
+
+class _WorsePredictor:
+    """The injected-worse candidate: anti-correlated scores."""
+
+    def __init__(self, inner):
+        self._inner = inner
+
+    def predict(self, ids, mask, dense=None):
+        return 1.0 - self._inner.predict(ids, mask, dense)
+
+
+def test_shadow_two_versions_records_and_doctor_verdicts(job, _split_flags):
+    """ISSUE 19 acceptance: a two-version shadow run produces
+    schema-valid serving window records the doctor reads end to end —
+    version-regression FIRES on an injected-worse candidate and stays
+    quiet when the versions score identically."""
+    from paddlebox_tpu import monitor
+    from paddlebox_tpu.monitor import doctor, flight
+    ds, schema, store, model, tr, box, pub, root = job
+    box.end_pass(trainer=tr, publisher=pub)            # v1 (stable)
+    _split_flags(serving_shadow=True)
+    ms = monitor.MemorySink()
+    monitor.hub().enable(ms)
+    try:
+        srv = ServingServer(root)
+        srv.poll_once()
+        # v2 publishes the SAME params (no training in between): a
+        # byte-identical candidate — the deterministic quiet case
+        pub.publish(store, tr.eval_params(), pass_id=1)
+        assert srv.poll_once() == 1
+        assert srv.active.version == 1 and srv.candidate.version == 2
+        ids, mask, floats = _req_batch(ds)
+        served = srv.predict(ids, mask, floats)
+        # delayed labels arrive, perfectly separating the stable scores:
+        # both versions scored the batch, both join, identical AUC
+        labels = (np.asarray(served) >
+                  np.median(served)).astype(np.float64).reshape(-1)
+        joined = srv.observe_labels(labels)
+        assert set(joined) == {1, 2}
+        assert srv.commit_window(force=True) is not None
+        rec = ms.find("serving_window")[-1]
+        assert flight.validate_serving_record(rec) == []
+        v = rec["fields"]["versions"]
+        assert v["1"]["role"] == "stable"
+        assert v["2"]["role"] == "candidate"
+        assert v["2"]["auc"] == pytest.approx(v["1"]["auc"])
+        assert v["2"]["score_kl"] == pytest.approx(0.0, abs=1e-9)
+        assert rec["fields"]["requests"] == 64      # shadow not counted
+        rep = doctor.diagnose(servings=[rec])
+        status = {r["rule"]: r["status"] for r in rep["rules"]}
+        assert status["version-regression"] == "quiet"
+
+        # inject the worse candidate: the next window's record must fire
+        srv._candidate.predictor = _WorsePredictor(
+            srv._candidate.predictor)
+        served = srv.predict(ids, mask, floats)
+        labels = (np.asarray(served) >
+                  np.median(served)).astype(np.float64).reshape(-1)
+        srv.observe_labels(labels)
+        srv.commit_window(force=True)
+        rec2 = ms.find("serving_window")[-1]
+        assert flight.validate_serving_record(rec2) == []
+        v2 = rec2["fields"]["versions"]
+        assert v2["1"]["auc"] - v2["2"]["auc"] > 0.2
+        rep2 = doctor.diagnose(servings=[rec2])
+        status2 = {r["rule"]: r["status"] for r in rep2["rules"]}
+        assert status2["version-regression"] == "fired"
+        f = next(f for f in rep2["findings"]
+                 if f["rule"] == "version-regression")
+        assert f["severity"] == "critical"
+        assert f["evidence"]["candidate_version"] == "2"
+        assert "do not promote" in f["suggestion"]
+    finally:
+        monitor.hub().disable()
+
+
+def test_live_split_routes_and_health_reports_versions(job, _split_flags):
+    """flags.serving_split_fraction live-splits request batches between
+    stable and candidate (deterministic accumulator), /healthz reports
+    the per-version fields, and dropping the split promotes."""
+    ds, schema, store, model, tr, box, pub, root = job
+    box.end_pass(trainer=tr, publisher=pub)
+    _split_flags(serving_split_fraction=0.5)
+    srv = ServingServer(root)
+    srv.poll_once()
+    pub.publish(store, tr.eval_params(), pass_id=1)       # identical v2
+    assert srv.poll_once() == 1
+    assert srv.active.version == 1 and srv.candidate.version == 2
+    ids, mask, floats = _req_batch(ds)
+    for _ in range(4):
+        srv.predict(ids, mask, floats)
+    h = srv.health()
+    assert h["status"] == "ok"
+    assert h["active_version"] == 1 and h["candidate_version"] == 2
+    assert h["split_fraction"] == 0.5 and h["shadow"] is False
+    assert h["versions"]["1"]["role"] == "stable"
+    assert h["versions"]["2"]["role"] == "candidate"
+    assert h["versions"]["2"]["age_seconds"] >= 0
+    # 4 batches at fraction 0.5: exactly 2 routed to each version
+    fields = srv.commit_window(force=True)
+    assert fields["versions"]["1"]["requests"] == 128
+    assert fields["versions"]["2"]["requests"] == 128
+    assert fields["requests"] == 256                 # all batches served
+    assert fields["active_version"] == 1
+    assert fields["candidate_version"] == 2
+    # split off -> the next poll promotes the held candidate
+    _split_flags(serving_split_fraction=0.0)
+    assert srv.poll_once() == 0
+    assert srv.active.version == 2 and srv.candidate is None
+    hh = srv.health()
+    assert hh["candidate_version"] is None
+    assert hh["versions"]["2"]["role"] == "stable"
+
+
+def test_frontend_latency_window_ages_out(job, _split_flags):
+    """The satellite fix: the frontend's reservoir is time-windowed —
+    after an idle spell the percentiles report NO stale traffic instead
+    of blending hours of history (count stays cumulative)."""
+    ds, schema, store, model, tr, box, pub, root = job
+    box.end_pass(trainer=tr, publisher=pub)
+    srv = ServingServer(root)
+    srv.poll_once()
+    ids, mask, floats = _req_batch(ds)
+    fe = BatchingFrontend(srv, max_batch=8, max_wait_s=0.01,
+                          window_s=0.4).start()
+    try:
+        futs = [fe.submit(ids[i], mask[i], floats[i]) for i in range(8)]
+        [f.result(timeout=60) for f in futs]
+        st = fe.stats()
+        assert st["count"] == 8 and st["window_count"] == 8
+        assert st["p99_ms"] >= st["p50_ms"] > 0
+        time.sleep(0.6)                   # the window empties
+        assert fe.stats() == {"count": 0, "failures": 0}
+    finally:
+        fe.stop()
 
 
 # -------------------------------------------------- donefile satellites

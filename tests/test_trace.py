@@ -323,6 +323,91 @@ def test_publish_to_swap_flow_edge(tmp_path):
         assert out["errors"] == []
 
 
+def test_request_spans_parent_linked_across_processes(tmp_path):
+    """ISSUE 19 acceptance: a REAL serving process (subprocess, own hub
+    + JsonlSink + standing serving scope) serves a version this process
+    published under a traced pass; the merged world trace contains
+    request-level ``serve/score`` spans parent-linked — through the
+    donefile-carried publish ids — to the publish span, across the
+    process boundary. One timeline: train pass -> publish -> swap ->
+    requests."""
+    import subprocess
+    import sys
+
+    from test_train_e2e import synth_dataset, NUM_SLOTS
+    from paddlebox_tpu.embedding import EmbeddingConfig, HostEmbeddingStore
+    from paddlebox_tpu.fleet import BoxPS, FleetUtil
+    from paddlebox_tpu.models import DeepFMModel
+    from paddlebox_tpu.parallel import make_mesh
+    from paddlebox_tpu.serving import DONEFILE, ServingPublisher
+    from paddlebox_tpu.train import Trainer, TrainerConfig
+
+    flags.set("trace", True)
+    ds, schema = synth_dataset(128)
+    store = HostEmbeddingStore(EmbeddingConfig(dim=8, learning_rate=0.15))
+    model = DeepFMModel(num_slots=NUM_SLOTS, emb_dim=8, dense_dim=1,
+                        hidden=(16,))
+    tr = Trainer(model, store, schema, make_mesh(1),
+                 TrainerConfig(global_batch_size=64, dense_lr=3e-3))
+    box = BoxPS(store)
+    root = str(tmp_path / "serve")
+    pub = ServingPublisher(root, model, schema, quant="f32", hot_top_k=8)
+
+    d_train = str(tmp_path / "rank0")
+    h = monitor.hub()
+    h.enable(monitor.JsonlSink(os.path.join(d_train, "events.jsonl")))
+    box.begin_pass()
+    tr.train_pass(ds)
+    assert box.end_pass(trainer=tr, publisher=pub)["publish"]["announced"]
+    h.disable()
+    entry = FleetUtil(root).latest(DONEFILE)
+    assert isinstance(entry.get("trace"), dict)
+
+    # the serving process: fresh interpreter, request tracing sampled at
+    # every batch, serving telemetry to its own "rank" directory
+    d_serve = str(tmp_path / "rank1")
+    os.makedirs(d_serve)
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = dict(os.environ, JAX_PLATFORMS="cpu", PBTPU_TRACE="1",
+               PBTPU_SERVING_TRACE_SAMPLE="1")
+    env.pop("PBTPU_FAULTPOINT", None)
+    proc = subprocess.run(
+        [sys.executable,
+         os.path.join(repo, "tests", "serving_obs_worker.py"),
+         root, d_serve, "--requests", "16"],
+        cwd=repo, env=env, capture_output=True, text=True, timeout=600)
+    assert proc.returncode == 0, (proc.stdout, proc.stderr)
+    out = json.loads(proc.stdout.splitlines()[-1])
+    assert out["version"] == 1 and out["served"] >= 16
+
+    # the serving stream carries sampled request spans whose payload
+    # parent ids are EXACTLY the donefile-carried publish ids
+    records = [json.loads(ln) for ln in
+               open(os.path.join(d_serve, "events.jsonl"))]
+    score_spans = [r for r in records if r.get("name") == "serve/score"]
+    assert score_spans, "no sampled serve/score span in the stream"
+    for r in score_spans:
+        assert r["fields"]["parent_span_id"] == entry["trace"]["span_id"]
+        assert r["fields"]["parent_trace_id"] == entry["trace"]["trace_id"]
+    assert any(r.get("name") == "serve/wait" for r in records)
+    assert any(r.get("type") == "serving_record" for r in records)
+
+    # the merged world trace draws the cross-process parent link: the
+    # publish span lives in the TRAINER's stream, the request spans in
+    # the serving process's — linked via the propagated ids
+    merged = trace_lib.merge_roots([d_train, d_serve])
+    summary = trace_lib.summarize(merged)
+    assert summary["linked_spans"] >= 1
+    assert summary["linked_edges"] >= 1
+    pub_edges = [e for e in summary["flow_edges"]
+                 if e["kind"] == "publish"]
+    assert pub_edges and pub_edges[0]["dst_rank"] == 1
+    # both streams schema-clean end to end (the serving record included)
+    for d in (d_train, d_serve):
+        res = flight.validate_events_file(os.path.join(d, "events.jsonl"))
+        assert res["errors"] == []
+
+
 # ---------------------------------------------------------------------------
 # CLI + doctor integration
 # ---------------------------------------------------------------------------
