@@ -114,7 +114,8 @@ def load_bench_best() -> dict | None:
 def collect_gate_metrics(eps_chip: float, detail: dict) -> dict:
     """Flatten this run's recorded numbers into the gate's metric
     namespace. Throughput metrics are higher-is-better; names ending in
-    ``_ms``/``_seconds`` (the serving drill's latency points) are
+    ``_ms``/``_seconds``/bare ``_s`` (the serving drills' latency and
+    convergence points — ``_per_s`` stays throughput) are
     lower-is-better — apply_regression_gate keys the direction off the
     suffix."""
     m = {"headline_eps": eps_chip}
@@ -137,6 +138,15 @@ def collect_gate_metrics(eps_chip: float, detail: dict) -> dict:
         # lower-is-better off the _ms suffix like the serving points
         if isinstance(ss.get("shadow_p99_ms"), (int, float)):
             m["serving_split.shadow_p99_ms"] = ss["shadow_p99_ms"]
+    sf = (detail.get("matrix") or {}).get("serving_fleet")
+    if isinstance(sf, dict):
+        # fleet point (ISSUE 20): the routed tail latency while one
+        # replica is injected slow — hedging must hold this gate — and
+        # the wall from a version publish to EVERY replica serving it
+        # (``_s`` suffix without ``_per_s`` is lower-is-better)
+        for k in ("p99_ms", "swap_convergence_s"):
+            if isinstance(sf.get(k), (int, float)):
+                m[f"serving_fleet.{k}"] = sf[k]
     sp = (detail.get("matrix") or {}).get("spill_10x")
     if isinstance(sp, dict):
         # tiered-table point: cold-tier fetch throughput + the hot-tier
@@ -207,7 +217,8 @@ def apply_regression_gate(current: dict, best: dict | None,
         # attribute rebind, sub-µs, where scheduler jitter alone is a
         # multi-x relative swing — so both sides clamp to the floor:
         # noise never trips the gate, real-scale regressions still do
-        if name.endswith(("_ms", "_seconds")):
+        if name.endswith(("_ms", "_seconds")) or \
+                (name.endswith("_s") and not name.endswith("_per_s")):
             floor = 1.0 if name.endswith("_ms") else 0.05
             rel = max(best_v, floor) / max(cur, floor) - 1.0
         else:
@@ -1222,6 +1233,224 @@ def serving_split_drill(small: bool, tiny: bool = False) -> dict:
             "doctor_rules": rules}
 
 
+def serving_fleet_drill(small: bool, tiny: bool = False) -> dict:
+    """Fleet resilience drill (ISSUE 20): two in-process replicas behind
+    the health-aware router with ONE injected slow — the routed tail
+    under hedging is the gate (``p99_ms``: the hedge must cut the slow
+    replica's latency out of the fleet tail), a version publish is timed
+    to EVERY replica serving it (``swap_convergence_s``,
+    lower-is-better), the promotion governor is fed a regressing
+    candidate window and must HOLD, and the composed fleet window record
+    is schema-checked and run through the doctor's fleet-degraded rule
+    (which must fire on the recorded hold)."""
+    import random as _random
+    import tempfile as _tempfile
+    import threading as _threading
+    import time as _t
+    from concurrent.futures import Future as _Future
+    from paddlebox_tpu.config import flags as _flags
+    from paddlebox_tpu.data import DataFeedSchema, SlotDataset
+    from paddlebox_tpu.embedding import EmbeddingConfig, HostEmbeddingStore
+    from paddlebox_tpu.fleet import BoxPS
+    from paddlebox_tpu.models import DeepFMModel
+    from paddlebox_tpu.monitor import doctor as doctor_lib
+    from paddlebox_tpu.monitor import flight as flight_lib
+    from paddlebox_tpu.parallel import make_mesh
+    from paddlebox_tpu.serving import ServingPublisher
+    from paddlebox_tpu.serving.fleet import (FleetReplicaServer,
+                                             LocalReplica,
+                                             PromotionGovernor)
+    from paddlebox_tpu.serving.frontend import BatchingFrontend
+    from paddlebox_tpu.serving.router import Router
+    from paddlebox_tpu.train import Trainer, TrainerConfig
+
+    class _SlowReplica:
+        """LocalReplica wrapper with a mutable injected service delay —
+        the drill's 'one replica went slow' fault. The delayed future is
+        marked running so a hedge-loser cancel fails and the router's
+        discard accounting is the path exercised."""
+
+        def __init__(self, inner):
+            self._inner = inner
+            self.name = inner.name
+            self.delay_s = 0.0
+
+        @property
+        def quarantined(self):
+            return self._inner.quarantined
+
+        @property
+        def inflight(self):
+            return self._inner.inflight
+
+        def health(self):
+            return self._inner.health()
+
+        def promote(self):
+            return self._inner.promote()
+
+        def submit(self, ids, mask, dense=None):
+            inner_fut = self._inner.submit(ids, mask, dense)
+            delay = float(self.delay_s)
+            if delay <= 0:
+                return inner_fut
+            out = _Future()
+            out.set_running_or_notify_cancel()
+
+            def _later(f):
+                def _fire():
+                    try:
+                        out.set_result(f.result())
+                    except Exception as e:  # noqa: BLE001 — relay, not
+                        # swallow: the inner failure must surface on the
+                        # delayed future exactly as it would undelayed
+                        out.set_exception(e)
+                _threading.Timer(delay, _fire).start()
+            inner_fut.add_done_callback(_later)
+            return out
+
+    bs = 64
+    n_ex = bs * (2 if tiny else (8 if small else 32))
+    schema = DataFeedSchema.ctr(num_sparse=4, num_float=1, batch_size=bs,
+                                max_len=1)
+    rec = _synth_pass(schema, n_ex, 4,
+                      [s for s in schema.float_slots if s.name != "label"],
+                      2000, seed=17)
+    store = HostEmbeddingStore(EmbeddingConfig(dim=8, optimizer="adagrad",
+                                               learning_rate=0.05))
+    model = DeepFMModel(num_slots=4, emb_dim=8, dense_dim=1, hidden=(16,))
+    tr = Trainer(model, store, schema, make_mesh(1),
+                 TrainerConfig(global_batch_size=bs))
+    box = BoxPS(store)
+    ds = SlotDataset(schema)
+    ds.records = rec
+    prev_promote = _flags.serving_auto_promote
+    slow_ms = 150.0
+    try:
+        _flags.serving_auto_promote = True
+        with _tempfile.TemporaryDirectory() as td:
+            root = os.path.join(td, "serve")
+            pub = ServingPublisher(root, model, schema,
+                                   publish_base_every=8, quant="f32",
+                                   hot_top_k=64)
+            box.begin_pass()
+            tr.train_pass(ds)
+            box.end_pass(trainer=tr, publisher=pub)
+            servers = [FleetReplicaServer(root, poll_s=0.01)
+                       for _ in range(2)]
+            for s in servers:
+                if s.poll_once() != 1:
+                    raise RuntimeError(
+                        "replica failed to load the published base")
+            fes = [BatchingFrontend(s, max_batch=32,
+                                    max_wait_s=0.002).start()
+                   for s in servers]
+            fast = LocalReplica("replica-0", servers[0], fes[0])
+            slow = _SlowReplica(
+                LocalReplica("replica-1", servers[1], fes[1]))
+            router = Router([fast, slow], timeout_s=10.0,
+                            health_ttl_s=0.2, hedge_factor=1.5,
+                            hedge_min_count=8, window_s=60.0,
+                            rng=_random.Random(7))
+            pb = next(iter(ds.batches(batch_size=bs)))
+            lc, lw, _ = schema.float_split_cols("label")
+            floats = np.concatenate(
+                [pb.floats[:, :lc], pb.floats[:, lc + lw:]], axis=1)
+            ids64 = pb.ids.astype(np.uint64)
+            # compile OUTSIDE the router: the first request per replica
+            # pays the predict compile (seconds) — routed through, it
+            # would land in the hedge-threshold window and a threshold
+            # derived off a compile-scale p99 never hedges anything
+            for fe in fes:
+                fe.submit(ids64[0], pb.mask[0], floats[0]).result(
+                    timeout=300)
+            # warmup through the router: fill its latency window so the
+            # hedge threshold derives from the healthy-fleet p99
+            n_warm = 12 if tiny else (16 if small else 32)
+            for i in range(n_warm):
+                router.score(ids64[i % bs], pb.mask[i % bs],
+                             floats[i % bs])
+            # inject the slow replica, then the measured phase: hedging
+            # must keep the routed tail well under the injected delay
+            slow.delay_s = slow_ms / 1e3
+            n_req = 16 if tiny else (32 if small else 96)
+            t0 = _t.perf_counter()
+            for i in range(n_req):
+                router.score(ids64[i % bs], pb.mask[i % bs],
+                             floats[i % bs])
+            serve_s = _t.perf_counter() - t0
+            slow.delay_s = 0.0
+            # publish the next version and time fleet-wide convergence:
+            # the wall from donefile append to BOTH replicas serving it
+            box.begin_pass()
+            tr.train_pass(ds)
+            box.end_pass(trainer=tr, publisher=pub)
+            t0 = _t.perf_counter()
+            deadline = t0 + 60.0
+            while _t.perf_counter() < deadline:
+                for s in servers:
+                    if s.active is None or s.active.version != 2:
+                        s.poll_once()
+                if all(s.active is not None and s.active.version == 2
+                       for s in servers):
+                    break
+            swap_convergence_s = _t.perf_counter() - t0
+            if any(s.active is None or s.active.version != 2
+                   for s in servers):
+                raise RuntimeError("fleet never converged on version 2")
+            # the governor leg: a window where the candidate regresses
+            # hard on AUC must HOLD promotion fleet-wide
+            gov = PromotionGovernor([fast, slow], windows=2)
+            decision = gov.observe({
+                "ts": _t.time(), "requests": 2 * bs,
+                "candidate_version": 3,
+                "versions": {
+                    "2": {"role": "stable", "auc": 0.74, "requests": bs},
+                    "3": {"role": "candidate", "auc": 0.52,
+                          "requests": bs, "score_kl": 0.7}}})
+            rs = router.stats()
+            healthy = sum(
+                1 for s in servers
+                if str(s.health().get("status", "")).startswith("ok"))
+            for fe in fes:
+                fe.stop()
+            for s in servers:
+                s.stop()
+    finally:
+        _flags.serving_auto_promote = prev_promote
+    fields = {"window_s": round(serve_s, 3), "replicas": 2,
+              "healthy": healthy, "quarantined": 0,
+              "requests": int(rs["requests"]), "sheds": int(rs["sheds"]),
+              "retries": int(rs["retries"]), "hedges": int(rs["hedges"]),
+              "hedges_won": int(rs["hedges_won"]), "restarts": 0,
+              "promote_holds": int(gov.promote_holds),
+              "p50_ms": float(rs.get("p50_ms", 0.0)),
+              "p99_ms": float(rs.get("p99_ms", 0.0))}
+    full_rec = {"ts": _t.time(), "type": "fleet_record",
+                "name": "fleet_window", "pass_id": None, "step": None,
+                "phase": -1, "thread": "bench", "fields": fields}
+    schema_errors = flight_lib.validate_fleet_record(full_rec)
+    rep = doctor_lib.diagnose(fleets=[full_rec])
+    rules = {r["rule"]: r["status"] for r in rep["rules"]
+             if r["rule"] == "fleet-degraded"}
+    return {"replicas": 2, "healthy": healthy,
+            "requests": int(rs["requests"]),
+            "p50_ms": float(rs.get("p50_ms", 0.0)),
+            "p99_ms": float(rs.get("p99_ms", 0.0)),
+            "slow_replica_ms": slow_ms,
+            "hedges": int(rs["hedges"]),
+            "hedges_won": int(rs["hedges_won"]),
+            "retries": int(rs["retries"]), "sheds": int(rs["sheds"]),
+            "failures": int(rs["failures"]),
+            "serve_eps": round(n_req / max(serve_s, 1e-9), 1),
+            "swap_convergence_s": round(swap_convergence_s, 4),
+            "swapped_to_version": 2,
+            "promote_decision": decision,
+            "promote_holds": int(gov.promote_holds),
+            "record_schema_errors": schema_errors,
+            "doctor_rules": rules}
+
+
 def spill_drill(small: bool, tiny: bool = False) -> dict:
     """Tiered-table drill (ISSUE 11): a working set >= 10x the RAM
     row-cache budget through the sharded+spill path — 2 hash-partitioned
@@ -1978,6 +2207,34 @@ def dryrun_main() -> int:
         and set(_ssr) == {"version-regression", "p99-burn",
                           "swap-regression"}
         and _ssr.get("version-regression") in ("quiet", "fired"))
+    # fleet drill rides the dryrun too (ISSUE 20): two replicas behind
+    # the router with one injected slow — hedging must keep the routed
+    # tail under the injected delay with zero failed/shed requests, the
+    # publish must converge fleet-wide, the governor must HOLD the
+    # regressing candidate, and the composed fleet window record must be
+    # schema-valid and fire the doctor's fleet-degraded rule (off the
+    # recorded hold) — before a chip round records the point
+    try:
+        fsd = serving_fleet_drill(True, tiny=True)
+    except Exception as e:
+        fsd = {"error": repr(e)}
+    detail.setdefault("matrix", {})["serving_fleet"] = fsd
+    checks["fleet_fields"] = (
+        fsd.get("record_schema_errors") == []
+        and fsd.get("requests", 0) > 0
+        and fsd.get("failures", -1) == 0
+        and fsd.get("sheds", -1) == 0
+        and isinstance(fsd.get("p99_ms"), float)
+        and 0 < fsd.get("p99_ms", 0) < fsd.get("slow_replica_ms", 0)
+        and fsd.get("hedges", 0) >= 1
+        and fsd.get("hedges_won", 0) >= 1
+        and isinstance(fsd.get("swap_convergence_s"), float)
+        and fsd.get("swap_convergence_s", 0) > 0
+        and fsd.get("swapped_to_version") == 2
+        and fsd.get("promote_decision") == "hold"
+        and fsd.get("promote_holds") == 1
+        and (fsd.get("doctor_rules") or {}).get("fleet-degraded")
+        == "fired")
     # tiered-table drill rides the dryrun too (ISSUE 11): the spill_10x
     # point must carry a working set >= 10x the RAM cache budget through
     # the sharded+spill path, with the tier identity + cache budget +
@@ -2147,6 +2404,20 @@ def dryrun_main() -> int:
             {"serving.p99_ms": 4.0},
             {"device_kind": None,
              "metrics": {"serving.p99_ms": 5.0}}, "")["ok"])
+    # bare _s is lower-is-better (the fleet's swap convergence) while
+    # _per_s stays throughput — a slower convergence must trip, a faster
+    # fetch rate must NOT read as a regression
+    checks["convergence_gate_trips_lower_is_better"] = (
+        not apply_regression_gate(
+            {"serving_fleet.swap_convergence_s": 8.0},
+            {"device_kind": None,
+             "metrics": {"serving_fleet.swap_convergence_s": 2.0}},
+            "")["ok"]
+        and apply_regression_gate(
+            {"spill_10x.fetch_keys_per_s": 9000.0},
+            {"device_kind": None,
+             "metrics": {"spill_10x.fetch_keys_per_s": 5000.0}},
+            "")["ok"])
     # the world trace rides the dryrun too (ISSUE 15): a traced probe
     # pass whose publish flow pair must merge into a Chrome-trace summary
     # embedded in the artifact — asserted like doctor_embedded. The probe
@@ -2251,6 +2522,10 @@ def dryrun_main() -> int:
                           ("shadow_p99_ms", "stable_auc",
                            "candidate_auc", "score_kl", "requests",
                            "doctor_rules", "error") if k in ssd},
+        "serving_fleet": {k: fsd.get(k) for k in
+                          ("p99_ms", "swap_convergence_s", "hedges",
+                           "hedges_won", "promote_decision",
+                           "doctor_rules", "error") if k in fsd},
         "spill": {k: spd.get(k) for k in
                   ("hot_hit_rate", "direct_hot_hit_rate",
                    "fetch_keys_per_s", "error") if k in spd},
@@ -2690,6 +2965,11 @@ def _enrich(small: bool, detail: dict, ctx: dict,
             except Exception as e:
                 matrix["serving_split"] = {"error": repr(e)}
             _mark("matrix point serving_split done")
+            try:
+                matrix["serving_fleet"] = serving_fleet_drill(small)
+            except Exception as e:
+                matrix["serving_fleet"] = {"error": repr(e)}
+            _mark("matrix point serving_fleet done")
         detail["matrix"] = matrix
     if os.environ.get("PBTPU_BENCH_HOST", "1") != "0":
         # tunnel-immune host section, in a CPU subprocess: the parent

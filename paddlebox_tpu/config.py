@@ -413,6 +413,23 @@ class Flags:
     # Serving latency SLO (ms) the doctor's p99-burn rule burns against;
     # stamped into every serving window record.
     serving_slo_ms: float = 50.0            # (new)
+    # --- serving fleet (new — serving/fleet.py + router.py, ISSUE 20) ---
+    # Replicas per host the fleet CLI supervises off ONE donefile (each
+    # builds from the shared verified staging copy).
+    serving_fleet_replicas: int = 2         # (new)
+    # Verdict-guarded auto-promotion: the version-regression rule's
+    # verdict drives promote_candidate() fleet-wide — a critical
+    # do-not-promote verdict HOLDS the candidate and quarantines that
+    # version; promotion fires only after serving_promote_windows clean
+    # windows. Off = promotion stays a manual operator call.
+    serving_auto_promote: bool = False      # (new)
+    # Consecutive clean (version-regression quiet) serving windows
+    # required before auto-promotion fires.
+    serving_promote_windows: int = 2        # (new)
+    # Router hedging: once a request has waited hedge_factor * observed
+    # p99, launch a second request on a different replica (first answer
+    # wins, the loser is cancelled and counted). 0.0 = hedging off.
+    serving_hedge_factor: float = 0.0       # (new)
 
     def set(self, name: str, value: Any) -> None:
         if not hasattr(self, name):

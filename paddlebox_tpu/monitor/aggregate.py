@@ -46,7 +46,12 @@ EVIDENCE_EVENTS = ("peer_lost", "peer_stalled", "nan_guard",
                    "world_grow",
                    # serving plane (ISSUE 19): the per-window serving
                    # flight record the doctor's serving rules read
-                   "serving_window")
+                   "serving_window",
+                   # serving fleet (ISSUE 20): the per-window fleet
+                   # record the doctor's fleet-degraded rule reads, plus
+                   # the supervision/promotion lifecycle events
+                   "fleet_window", "fleet_replica_quarantined",
+                   "fleet_promote_hold", "fleet.serving_stale")
 KEEP_PER_NAME = 16
 # serving window records retained per rank (one per window cadence — a
 # day at 30s windows is ~3k records; cap keeps pathological streams
@@ -124,6 +129,7 @@ def read_stream(root: str, trace_out: "dict | None" = None) -> dict:
     files = discover_stream_files(root)
     flights: list[dict] = []
     servings: list[dict] = []
+    fleets: list[dict] = []
     errors: list[str] = []
     event_counts: dict[str, int] = {}
     evidence: dict[str, list[dict]] = {}
@@ -168,6 +174,8 @@ def read_stream(root: str, trace_out: "dict | None" = None) -> dict:
                     errs = flight.validate_flight_record(rec)
                 elif typ == "serving_record":
                     errs = flight.validate_serving_record(rec)
+                elif typ == "fleet_record":
+                    errs = flight.validate_fleet_record(rec)
                 else:
                     errs = flight.validate_event(rec)
                 for e in errs:
@@ -177,6 +185,9 @@ def read_stream(root: str, trace_out: "dict | None" = None) -> dict:
             elif typ == "serving_record" \
                     and len(servings) < MAX_SERVING_RECORDS:
                 servings.append(rec)
+            elif typ == "fleet_record" \
+                    and len(fleets) < MAX_SERVING_RECORDS:
+                fleets.append(rec)
             if rec.get("thread"):
                 threads.add(rec["thread"])
             if isinstance(name, str):
@@ -187,8 +198,10 @@ def read_stream(root: str, trace_out: "dict | None" = None) -> dict:
                         kept.append(rec)
     flights.sort(key=lambda r: (r.get("pass_id") or 0, r.get("ts") or 0))
     servings.sort(key=lambda r: r.get("ts") or 0)
+    fleets.sort(key=lambda r: r.get("ts") or 0)
     return {"root": root, "files": files, "events": n,
             "flight_records": flights, "serving_records": servings,
+            "fleet_records": fleets,
             "errors": errors,
             "event_counts": event_counts, "evidence": evidence,
             "threads": sorted(threads)}
@@ -425,5 +438,11 @@ def _world_view(streams: "list[dict]", labels: "list[int]",
         "serving_records": sorted(
             (sr for st in streams
              for sr in st.get("serving_records", ())),
+            key=lambda r: r.get("ts") or 0),
+        # fleet plane (ISSUE 20): every host's fleet window records,
+        # merged in time order — what the fleet-degraded rule reads
+        "fleet_records": sorted(
+            (fr for st in streams
+             for fr in st.get("fleet_records", ())),
             key=lambda r: r.get("ts") or 0),
     }

@@ -64,7 +64,7 @@ class DoctorContext:
 
     def __init__(self, flights=None, counters=None, evidence=None,
                  world=None, detail=None, sink_health=None,
-                 servings=None):
+                 servings=None, fleets=None):
         self.flights = sorted(flights or [],
                               key=lambda fr: (fr.get("pass_id") or 0))
         self.counters = dict(counters or {})
@@ -89,6 +89,21 @@ class DoctorContext:
             w["ts"] = r.get("ts") or f.get("ts") or 0
             self.servings.append(w)
         self.servings.sort(key=lambda w: w["ts"])
+        # fleet plane (ISSUE 20): per-window fleet records, flattened the
+        # same way — explicit ``fleets`` (the aggregate's fleet_records)
+        # wins, retained fleet_window evidence is the fallback
+        raw_f = fleets if fleets is not None \
+            else (self.evidence.get("fleet_window") or [])
+        self.fleets = []
+        for r in raw_f:
+            if not isinstance(r, dict):
+                continue
+            f = r.get("fields") if isinstance(r.get("fields"), dict) \
+                else r
+            w = dict(f)
+            w["ts"] = r.get("ts") or f.get("ts") or 0
+            self.fleets.append(w)
+        self.fleets.sort(key=lambda w: w["ts"])
         self.attribution = cp_lib.attribute_records(self.flights)
 
     def pass_deltas(self, key: str) -> "list[tuple[int, float]]":
@@ -873,6 +888,67 @@ class SwapRegressionRule(Rule):
         return "quiet", None        # windows exist, no assessable swap
 
 
+class FleetDegradedRule(Rule):
+    id = "fleet-degraded"
+    doc = "the serving fleet is running degraded (dead or quarantined "\
+          "replicas, shed traffic, promotion held)"
+    incident = ("ISSUE 20: one replica crash-looping on a torn version "
+                "took a whole host out of rotation because nothing "
+                "distinguished 'one replica down, router covering' from "
+                "'fleet down' — the fleet window record carries healthy/"
+                "quarantined counts and the router's shed/retry/hedge "
+                "accounting so the doctor states WHICH it is")
+    SHED_RATE = 0.01    # shed fraction of offered traffic that fires
+
+    def evaluate(self, ctx):
+        wins = ctx.fleets
+        if not wins:
+            return "no-data", None
+        latest = wins[-1]
+        replicas = latest.get("replicas")
+        healthy = latest.get("healthy")
+        if not isinstance(replicas, int) or not isinstance(healthy, int):
+            return "no-data", None
+        quarantined = int(latest.get("quarantined") or 0)
+        sheds = int(latest.get("sheds") or 0)
+        requests = int(latest.get("requests") or 0)
+        offered = requests + sheds
+        shed_rate = sheds / offered if offered else 0.0
+        holds = int(latest.get("promote_holds") or 0)
+        down = healthy < replicas
+        if not down and not quarantined and shed_rate <= self.SHED_RATE \
+                and not holds:
+            return "quiet", None
+        sev = "critical" if healthy == 0 else "warn"
+        what = []
+        if down:
+            what.append(f"{replicas - healthy}/{replicas} replica(s) "
+                        f"out of rotation")
+        if quarantined:
+            what.append(f"{quarantined} quarantined")
+        if shed_rate > self.SHED_RATE:
+            what.append(f"shedding {shed_rate:.1%} of traffic")
+        if holds:
+            what.append(f"{holds} promotion hold(s)")
+        return "fired", Finding(
+            self.id, sev,
+            "serving fleet degraded: " + ", ".join(what),
+            {"replicas": replicas, "healthy": healthy,
+             "quarantined": quarantined, "sheds": sheds,
+             "requests": requests, "shed_rate": round(shed_rate, 4),
+             "restarts": latest.get("restarts"),
+             "retries": latest.get("retries"),
+             "hedges_won": latest.get("hedges_won"),
+             "promote_holds": holds, "window_ts": latest.get("ts")},
+            "triage the quarantined replica's last_error (fleet CLI "
+            "status names it) — a crash-loop on ONE version means a bad "
+            "artifact: quarantine the version and republish; healthy < "
+            "replicas with restarts climbing means the backoff is "
+            "cycling (check replica stderr); promotion holds mean the "
+            "version-regression verdict fired — inspect that finding "
+            "before touching flags.serving_auto_promote")
+
+
 ALL_RULES: "tuple[type[Rule], ...]" = (
     BoundaryWallRule,
     ExchangeOverflowRule,
@@ -887,6 +963,7 @@ ALL_RULES: "tuple[type[Rule], ...]" = (
     VersionRegressionRule,
     P99BurnRule,
     SwapRegressionRule,
+    FleetDegradedRule,
 )
 
 _SEV_ORDER = {"critical": 0, "warn": 1, "info": 2}
@@ -897,13 +974,21 @@ _SEV_ORDER = {"critical": 0, "warn": 1, "info": 2}
 # ---------------------------------------------------------------------------
 
 def diagnose(flights=None, counters=None, evidence=None, world=None,
-             detail=None, sink_health=None, servings=None,
-             inputs=None) -> dict:
+             detail=None, sink_health=None, servings=None, fleets=None,
+             inputs=None, quarantined_rules=None) -> dict:
     """Evaluate every rule over the given telemetry; returns the report
-    (validate with :func:`validate_report`)."""
+    (validate with :func:`validate_report`).
+
+    ``quarantined_rules`` (ISSUE 20 satellite): rule ids the remediation
+    parity guard quarantined this run — a quarantined rule's applied
+    action changed model bits, which is evidence its suggestion is wrong
+    for this workload. Its findings still appear (the symptom is real)
+    but downgraded to ``info`` with the suggestion suppressed, and the
+    report surfaces ``quarantined_rules`` so the operator sees WHY."""
     ctx = DoctorContext(flights=flights, counters=counters,
                         evidence=evidence, world=world, detail=detail,
-                        sink_health=sink_health, servings=servings)
+                        sink_health=sink_health, servings=servings,
+                        fleets=fleets)
     rules = []
     findings = []
     for rule_cls in ALL_RULES:
@@ -918,6 +1003,18 @@ def diagnose(flights=None, counters=None, evidence=None, world=None,
         rules.append({"rule": rule.id, "status": status})
         if finding is not None:
             findings.append(finding)
+    quarantined = sorted({str(r) for r in (quarantined_rules or ())})
+    for f in findings:
+        if f["rule"] in quarantined:
+            # remediation-history feedback: the parity guard reverted
+            # this rule's action — keep the symptom visible, drop the
+            # (discredited) advice out of the actionable severities
+            f["severity"] = "info"
+            f["suggestion"] = ("suggestion suppressed: this rule's "
+                               "applied remediation was reverted by the "
+                               "parity guard this run — its advice is "
+                               "wrong for this workload (original: "
+                               + f["suggestion"] + ")")
     findings.sort(key=lambda f: _SEV_ORDER.get(f["severity"], 9))
     report = {
         "type": "doctor_report",
@@ -930,6 +1027,8 @@ def diagnose(flights=None, counters=None, evidence=None, world=None,
         "verdict": ("healthy" if not findings
                     else f"findings:{len(findings)}"),
     }
+    if quarantined:
+        report["quarantined_rules"] = quarantined
     if world is not None:
         report["world"] = {
             "world_size": world.get("world_size"),
@@ -976,6 +1075,10 @@ def validate_report(report: dict) -> "list[str]":
         for k in ("rule", "severity", "summary", "evidence", "suggestion"):
             if k not in f:
                 errs.append(f"finding missing {k!r}")
+    q = report.get("quarantined_rules")
+    if q is not None and (not isinstance(q, list)
+                          or not all(isinstance(r, str) for r in q)):
+        errs.append("quarantined_rules is not a list of rule ids")
     return errs
 
 
@@ -983,7 +1086,7 @@ def validate_report(report: dict) -> "list[str]":
 # live mode (flags.doctor_live — called by TelemetryHub.end_pass)
 # ---------------------------------------------------------------------------
 
-def diagnose_hub(hub, detail=None) -> dict:
+def diagnose_hub(hub, detail=None, quarantined_rules=None) -> dict:
     """Diagnose a live hub's in-memory state (flight-record ring, the
     cumulative counter registry, this session's sink health) — the ONE
     assembly run_live, the bench artifact embed, and the example all
@@ -991,7 +1094,8 @@ def diagnose_hub(hub, detail=None) -> dict:
     return diagnose(flights=hub.flight_records(),
                     counters=STATS.snapshot(),
                     sink_health=hub.sink_health(),
-                    detail=detail)
+                    detail=detail,
+                    quarantined_rules=quarantined_rules)
 
 
 def run_live(hub) -> "list[dict]":
@@ -1034,6 +1138,10 @@ def render_text(report: dict) -> str:
             f"{summary.get('overlap_headroom_seconds', 0):.1f}s)")
     lines.append("rules: " + " ".join(
         f"{r['rule']}={r['status']}" for r in report["rules"]))
+    if report.get("quarantined_rules"):
+        lines.append("quarantined (parity guard — suggestions "
+                     "suppressed): "
+                     + " ".join(report["quarantined_rules"]))
     for f in report["findings"]:
         lines.append(f"[{f['severity'].upper()}] {f['rule']}: "
                      f"{f['summary']}")
@@ -1111,6 +1219,7 @@ def main(argv: "list[str] | None" = None) -> int:
                       world=world if len(roots) > 1 else None,
                       detail=detail,
                       servings=world.get("serving_records"),
+                      fleets=world.get("fleet_records"),
                       inputs=roots)
     if detail:
         report["world_trace"] = detail["world_trace"]

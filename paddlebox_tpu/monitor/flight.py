@@ -235,6 +235,56 @@ def validate_serving_record(rec: dict) -> list[str]:
     return errs
 
 
+# fleet-window record fields (serving/fleet.py, under rec["fields"]) —
+# the replica-fleet plane's flight record (ISSUE 20): fleet health
+# (healthy/quarantined replica counts), router traffic accounting
+# (sheds/retries/hedges), supervision (restarts), promotion governance
+# (promote holds), and the fleet-wide latency tail
+FLEET_REQUIRED_FIELDS = {
+    "window_s": numbers.Real,
+    "replicas": numbers.Integral,
+    "healthy": numbers.Integral,
+    "quarantined": numbers.Integral,
+    "requests": numbers.Integral,
+    "sheds": numbers.Integral,
+    "retries": numbers.Integral,
+    "hedges": numbers.Integral,
+    "hedges_won": numbers.Integral,
+    "restarts": numbers.Integral,
+    "promote_holds": numbers.Integral,
+    "p50_ms": numbers.Real,
+    "p99_ms": numbers.Real,
+}
+
+
+def validate_fleet_record(rec: dict) -> list[str]:
+    """Schema errors for a fleet window record (ISSUE 20).
+
+    The record is a hub event (``type="fleet_record"``, name
+    ``fleet_window``) whose payload lives under ``fields`` — the
+    replica-fleet counterpart of the serving window record: replica
+    health counts, router shed/retry/hedge accounting, restart and
+    promote-hold counts, and the fleet-wide p50/p99."""
+    errs = validate_event(rec)
+    if rec.get("type") != "fleet_record":
+        errs.append(f"type is {rec.get('type')!r}, not 'fleet_record'")
+    f = rec.get("fields")
+    if not isinstance(f, dict):
+        return errs + [f"fields is {type(f).__name__}, not an object"]
+    for k, want in FLEET_REQUIRED_FIELDS.items():
+        if k not in f:
+            errs.append(f"missing field {k!r}")
+        elif not isinstance(f[k], want) or isinstance(f[k], bool):
+            errs.append(f"fields[{k!r}] is {type(f[k]).__name__}, want "
+                        f"{want.__name__}")
+    if f.get("healthy", 0) and f.get("replicas") is not None \
+            and isinstance(f.get("healthy"), numbers.Integral) \
+            and isinstance(f.get("replicas"), numbers.Integral) \
+            and f["healthy"] > f["replicas"]:
+        errs.append("fields['healthy'] exceeds fields['replicas']")
+    return errs
+
+
 def validate_events_file(path: str) -> dict:
     """Validate a JSONL event stream end to end.
 
@@ -262,6 +312,8 @@ def validate_events_file(path: str) -> dict:
                 errs = validate_flight_record(rec)
             elif rec.get("type") == "serving_record":
                 errs = validate_serving_record(rec)
+            elif rec.get("type") == "fleet_record":
+                errs = validate_fleet_record(rec)
             else:
                 errs = validate_event(rec)
             for e in errs:

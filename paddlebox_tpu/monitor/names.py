@@ -70,6 +70,21 @@ EVENT_NAMES: tuple[str, ...] = (
     # the per-window serving flight record — requests, per-version
     # p50/p99 + score stats, version lag, swap count, replica-cache hits
     "serving_window",
+    # serving fleet (serving/fleet.py + serving/router.py, ISSUE 20):
+    # replica supervision (restart with backoff, crash-loop quarantine),
+    # the shared staging lease (expiry retake), the router's all-stale
+    # degrade, and verdict-guarded auto-promotion (promote after K clean
+    # windows / HOLD + version quarantine on a critical verdict). The
+    # per-window fleet flight record rides fleet_window.
+    "fleet_window",
+    "fleet_replica_restart",
+    "fleet_replica_quarantined",
+    "fleet_lease_retaken",
+    "fleet.serving_stale",
+    "fleet_promoted",
+    "fleet_promote_hold",
+    "fleet_version_quarantined",
+    "fleet_supervise_error",
     # fleet / donefile discipline
     "donefile_compacted",
     "donefile_repaired",

@@ -229,7 +229,12 @@ class RemediationController:
             f, self._external_findings = self._external_findings, None
             return f
         from paddlebox_tpu.monitor import doctor
-        return doctor.diagnose_hub(monitor.hub())["findings"]
+        # remediation-history feedback (ISSUE 20 satellite): rules this
+        # controller quarantined ride into the report, which downgrades
+        # their findings to info and suppresses the discredited advice
+        return doctor.diagnose_hub(
+            monitor.hub(),
+            quarantined_rules=self.quarantined)["findings"]
 
     def feed_report(self, report: dict) -> None:
         """Feed a doctor report produced from the live world-view

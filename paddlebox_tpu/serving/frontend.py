@@ -67,6 +67,8 @@ class BatchingFrontend:
         self._batches = 0
         self._batched_reqs = 0
         self._failures = 0
+        self._inflight = 0                 # submitted, not yet resolved
+        self._inflight_lock = threading.Lock()
         self._thread: threading.Thread | None = None
         self._stopping = False
 
@@ -82,6 +84,14 @@ class BatchingFrontend:
         r = _Request(np.asarray(ids), np.asarray(mask, bool),
                      None if dense is None else np.asarray(dense,
                                                            np.float32))
+        # inflight accounting rides the future's done-callback (fires
+        # exactly once however the future resolves — result, exception,
+        # or the stop()-drain failsafe), so the router's least-loaded
+        # signal can never leak on a failure path. Registered BEFORE the
+        # put: dispatch may resolve the future first.
+        with self._inflight_lock:
+            self._inflight += 1
+        r.future.add_done_callback(self._dec_inflight)
         self._q.put(r)
         # stop() may have drained the queue between the thread check and
         # the put — a request landing in a dead queue would leave the
@@ -100,6 +110,16 @@ class BatchingFrontend:
     def score(self, ids, mask, dense=None, timeout: float = 30.0):
         """Blocking convenience wrapper around :meth:`submit`."""
         return self.submit(ids, mask, dense).result(timeout=timeout)
+
+    def _dec_inflight(self, _f) -> None:
+        with self._inflight_lock:
+            self._inflight -= 1
+
+    @property
+    def inflight(self) -> int:
+        """Requests submitted but not yet resolved — the load signal the
+        fleet router's two-choice least-loaded dispatch compares."""
+        return self._inflight
 
     # ---- dispatcher ------------------------------------------------------
 
@@ -180,6 +200,15 @@ class BatchingFrontend:
                     self._dispatch(group)
 
     def _dispatch(self, batch: list[_Request]) -> None:
+        # claim each future before scoring (executor-style): a fleet
+        # router's hedge loser cancelled while still QUEUED here is a
+        # PENDING future whose cancel() succeeded — fulfilling it would
+        # raise InvalidStateError out of the dispatch thread. Claiming
+        # drops it from the batch and makes any later cancel() a no-op.
+        batch = [r for r in batch
+                 if r.future.set_running_or_notify_cancel()]
+        if not batch:
+            return
         n = len(batch)
         try:
             ids = np.stack([r.ids for r in batch])

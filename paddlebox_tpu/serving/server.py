@@ -116,10 +116,15 @@ class ServingServer:
                  staging_dir: str | None = None,
                  fetch_attempts: int = 3, fetch_backoff_s: float = 0.25,
                  stale_pass_lag: int = 2, stale_after_s: float = 600.0,
-                 health_port: int | None = None):
+                 health_port: int | None = None,
+                 staging_cache=None):
         self._remote = fs_lib.is_remote(root)
         self.root = root if self._remote else fs_lib.resolve(root)[1]
         self._fs = fs_lib.resolve(root)[0]
+        # fleet mode (serving/fleet.py): replicas on one host share ONE
+        # download+verify per version through this cache instead of each
+        # staging its own copy
+        self._staging_cache = staging_cache
         self._fleet = FleetUtil(root)   # donefile discovery (torn-line safe)
         self.poll_s = float(poll_s)
         self._staging = staging_dir
@@ -148,6 +153,7 @@ class ServingServer:
         self._score_n = 0                      # serve/score sampling
         self._win_failures0 = 0                # counters at last commit
         self._win_swaps0 = 0
+        self._building = False                 # a version is rebuilding
         self._stop = threading.Event()
         self._thread: threading.Thread | None = None
         self._http: Any = None
@@ -247,7 +253,9 @@ class ServingServer:
                                f" — waiting for the next base")
                     continue
             staged = None
-            try:
+            self._building = True      # swap-aware draining: a fleet
+            try:                       # router pulls a rebuilding
+                # replica out of rotation off this health() bit
                 loaded, staged = self._fetch(path)
                 model = self._build(loaded, e)
             except Exception as err:   # noqa: BLE001 — keep serving
@@ -255,6 +263,7 @@ class ServingServer:
                                     f"{err!r}")
                 continue
             finally:
+                self._building = False
                 # the build consumed the staged download (arrays are in
                 # memory, dense_file loaded) — a long-running remote
                 # tailer must not accumulate one artifact per publish
@@ -324,6 +333,15 @@ class ServingServer:
         fetches get ``fetch_attempts`` tries with exponential backoff;
         the partial download is removed before each retry and on
         exhaustion."""
+        if self._staging_cache is not None:
+            # fleet replicas: one lease-guarded download+CRC-verify per
+            # version per HOST (serving/fleet.py SharedStagingCache);
+            # the materialized copy was verified under the lease, so the
+            # per-replica re-verify is intentionally skipped — that one
+            # verify IS the host's verification budget. The shared copy
+            # outlives this build (other replicas read it): staged=None.
+            local = self._staging_cache.materialize(path)
+            return art.read_artifact(local, verify=False), None
         if not self._remote and os.path.isdir(path):
             return art.read_artifact(path, verify=True), None
         if self._staging is None:
@@ -658,6 +676,7 @@ class ServingServer:
                 "age_seconds": round(
                     now - (vm.published_ts or vm.loaded_ts), 1)}
         return {"status": status,
+                "building": self._building,
                 "active_version": m.version if m else None,
                 "active_pass": m.pass_id if m else None,
                 "active_kind": m.kind if m else None,

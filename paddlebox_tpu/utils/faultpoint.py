@@ -162,6 +162,21 @@ POINTS: tuple[str, ...] = (
     # incumbent mid-rebind) must leave the surviving generation
     # trainable and bit-consistent.
     "elastic.ownership.rebind.pre",
+    # serving/fleet.py + serving/router.py (ISSUE 20): the serving-fleet
+    # crash windows. lease.pre_verify = a replica holds the shared-
+    # staging download lease, the artifact bytes are staged but the CRC
+    # verify + atomic rename have not run — dying here must leave the
+    # lease expirable so a peer replica retakes it, and the host must
+    # end with exactly ONE verified staging copy (never a torn copy
+    # under the final name). replica.pre_build = a replica is about to
+    # build/apply a fetched version (the hot-swap window) — a kill here
+    # must drop only that replica: the router routes around it and the
+    # supervisor restarts it with backoff. router.pre_dispatch = a
+    # scoring request is about to dispatch to a chosen replica — the
+    # ioerror leg of the router's retry-on-another-replica contract.
+    "serving.fleet.lease.pre_verify",
+    "serving.fleet.replica.pre_build",
+    "serving.fleet.router.pre_dispatch",
 )
 
 # Points that fire only inside the elastic re-formation window: the
@@ -211,6 +226,17 @@ EXCHANGE_POINTS: tuple[str, ...] = (
 # are covered by the ioerror tests in tests/test_doctor.py instead.
 MONITOR_POINTS: tuple[str, ...] = (
     "telemetry.rotate.pre",
+)
+
+# Points that fire only inside the serving FLEET (replica supervision,
+# shared staging, router dispatch): the training kill→resume matrices
+# never run a replica fleet — they are covered by the fleet kill matrix
+# (tests/test_fleet.py) instead, which carries its own closed-registry
+# guard (all names prefixed "serving.fleet.").
+FLEET_POINTS: tuple[str, ...] = (
+    "serving.fleet.lease.pre_verify",
+    "serving.fleet.replica.pre_build",
+    "serving.fleet.router.pre_dispatch",
 )
 
 

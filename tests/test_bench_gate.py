@@ -116,6 +116,56 @@ def test_collect_gate_metrics_serving_split_point(bench):
     assert not any(k.startswith("serving_split.") for k in m2)
 
 
+def test_collect_gate_metrics_serving_fleet_point(bench):
+    """The fleet drill gates exactly p99_ms + swap_convergence_s
+    (ISSUE 20) — the hedge/governor attribution rides the artifact, not
+    the gate; a failed drill contributes nothing."""
+    detail = {"matrix": {"serving_fleet": {
+        "p99_ms": 12.5, "swap_convergence_s": 0.4, "p50_ms": 3.0,
+        "hedges": 9, "hedges_won": 9, "promote_decision": "hold",
+        "requests": 128}}}
+    m = bench.collect_gate_metrics(1.0, detail)
+    assert m["serving_fleet.p99_ms"] == 12.5
+    assert m["serving_fleet.swap_convergence_s"] == 0.4
+    assert not any(k.startswith("serving_fleet.") for k in m
+                   if k not in ("serving_fleet.p99_ms",
+                                "serving_fleet.swap_convergence_s"))
+    m2 = bench.collect_gate_metrics(
+        1.0, {"matrix": {"serving_fleet": {"error": "boom"}}})
+    assert not any(k.startswith("serving_fleet.") for k in m2)
+
+
+def test_gate_bare_s_is_lower_is_better_but_per_s_is_not(bench):
+    """Bare ``_s`` metrics (the fleet's swap convergence) gate in the
+    latency direction while ``_per_s`` stays throughput: a slower
+    convergence regresses, and a FASTER fetch rate must not read as a
+    regression through the suffix test."""
+    best = {"device_kind": None, "threshold": 0.10,
+            "metrics": {"serving_fleet.swap_convergence_s": 2.0,
+                        "spill_10x.fetch_keys_per_s": 5000.0}}
+    g = bench.apply_regression_gate(
+        {"serving_fleet.swap_convergence_s": 8.0,
+         "spill_10x.fetch_keys_per_s": 9000.0}, best, "cpu")
+    assert not g["ok"]
+    assert g["regressed"] == ["serving_fleet.swap_convergence_s"]
+    assert g["lines"]["spill_10x.fetch_keys_per_s"].startswith("ok(+80%")
+    g2 = bench.apply_regression_gate(
+        {"serving_fleet.swap_convergence_s": 0.5,
+         "spill_10x.fetch_keys_per_s": 2000.0}, best, "cpu")
+    assert g2["regressed"] == ["spill_10x.fetch_keys_per_s"]
+    assert g2["lines"][
+        "serving_fleet.swap_convergence_s"].startswith("ok(+300%")
+    # sub-floor convergence walls clamp like the other latency points:
+    # a 3x swing under 0.05s is timer noise, not a regression
+    g3 = bench.apply_regression_gate(
+        {"serving_fleet.swap_convergence_s": 0.03,
+         "spill_10x.fetch_keys_per_s": 5000.0},
+        {"device_kind": None,
+         "metrics": {"serving_fleet.swap_convergence_s": 0.01,
+                     "spill_10x.fetch_keys_per_s": 5000.0}}, "cpu")
+    assert g3["ok"]
+
+
 def test_gate_latency_metrics_are_lower_is_better(bench):
     """Metrics named *_ms / *_seconds gate in the latency direction: a
     HIGHER current value regresses, a lower one is an improvement —
@@ -206,6 +256,19 @@ def test_bench_dryrun_smoke():
     assert out["serving_split"]["score_kl"] >= 0
     assert set(out["serving_split"]["doctor_rules"]) == {
         "version-regression", "p99-burn", "swap-regression"}
+    # the fleet point must exist with its acceptance property
+    # (ISSUE 20): routed tail held UNDER the injected slow replica by
+    # hedging, fleet-wide swap convergence timed, the governor's hold
+    # recorded, and the fleet-degraded rule fired off that hold — so
+    # serving_fleet enters the BENCH_BEST gate from day one
+    assert out["checks"]["fleet_fields"], out.get("serving_fleet")
+    assert out["checks"]["convergence_gate_trips_lower_is_better"]
+    sf = out["serving_fleet"]
+    assert 0 < sf["p99_ms"] < 150.0
+    assert sf["swap_convergence_s"] > 0
+    assert sf["hedges_won"] >= 1
+    assert sf["promote_decision"] == "hold"
+    assert sf["doctor_rules"] == {"fleet-degraded": "fired"}
     # the sharded-exchange matrix points must exist with their identity
     # fields (ISSUE 10): table_layout/exchange_wire/shard count recorded,
     # dedup ratio measured — so sharded points enter the BENCH_BEST gate

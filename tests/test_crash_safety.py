@@ -172,7 +172,8 @@ def test_kill_resume_smoke(tmp_path, golden):
                           and p not in faultpoint.ADMIT_POINTS
                           and p not in faultpoint.SERVING_POINTS
                           and p not in faultpoint.EXCHANGE_POINTS
-                          and p not in faultpoint.MONITOR_POINTS])
+                          and p not in faultpoint.MONITOR_POINTS
+                          and p not in faultpoint.FLEET_POINTS])
 def test_kill_resume_matrix(point, tmp_path, golden):
     """Every registered fault point: kill there, resume, prove bit-identical
     dense params + table rows + metric state vs the uninterrupted run. The
@@ -286,18 +287,22 @@ def test_every_point_has_a_matrix_entry():
     training state — and are covered by tests/test_doctor.py; the elastic
     ADMIT (world-grow) points fire only in ElasticWorld.admit / the
     post-grow ownership rebind and are covered by the grow kill matrix
-    (tests/test_elastic.py + tests/grow_worker.py). All carry the same
-    closed-registry guard."""
+    (tests/test_elastic.py + tests/grow_worker.py); the serving-fleet
+    points fire only inside the replica-fleet lease/build/dispatch paths
+    and are covered by the fleet kill matrix (tests/test_fleet.py). All
+    carry the same closed-registry guard."""
     assert (set(POINT_AFTER) | set(faultpoint.ELASTIC_POINTS)
             | set(faultpoint.ADMIT_POINTS)
             | set(faultpoint.SERVING_POINTS)
             | set(faultpoint.EXCHANGE_POINTS)
-            | set(faultpoint.MONITOR_POINTS) == set(faultpoint.POINTS))
+            | set(faultpoint.MONITOR_POINTS)
+            | set(faultpoint.FLEET_POINTS) == set(faultpoint.POINTS))
     assert not set(POINT_AFTER) & (set(faultpoint.ELASTIC_POINTS)
                                    | set(faultpoint.ADMIT_POINTS)
                                    | set(faultpoint.SERVING_POINTS)
                                    | set(faultpoint.EXCHANGE_POINTS)
-                                   | set(faultpoint.MONITOR_POINTS))
+                                   | set(faultpoint.MONITOR_POINTS)
+                                   | set(faultpoint.FLEET_POINTS))
 
 
 # ---------------------------------------------------------------------------

@@ -74,6 +74,27 @@ def make_serving_window(ts, requests=100, failures=0, swaps=0,
     return rec
 
 
+def make_fleet_window(ts, replicas=2, healthy=2, quarantined=0,
+                      requests=500, sheds=0, retries=0, hedges=0,
+                      hedges_won=0, restarts=0, promote_holds=0,
+                      p50_ms=2.0, p99_ms=8.0, **extra):
+    """One schema-valid fleet window record (ISSUE 20) — the replica
+    fleet's make_serving_window."""
+    rec = {
+        "ts": float(ts), "type": "fleet_record", "name": "fleet_window",
+        "pass_id": None, "step": None, "phase": -1, "thread": "fleet",
+        "fields": dict({"window_s": 10.0, "replicas": replicas,
+                        "healthy": healthy, "quarantined": quarantined,
+                        "requests": requests, "sheds": sheds,
+                        "retries": retries, "hedges": hedges,
+                        "hedges_won": hedges_won, "restarts": restarts,
+                        "promote_holds": promote_holds, "p50_ms": p50_ms,
+                        "p99_ms": p99_ms}, **extra),
+    }
+    assert flight.validate_fleet_record(rec) == []
+    return rec
+
+
 # Per-rule (fire_kwargs, quiet_kwargs) for doctor.diagnose — the
 # closed-registry discipline: a new rule cannot ship without BOTH a
 # firing and a quiet synthetic fixture registered here (the coverage
@@ -239,6 +260,13 @@ RULE_FIXTURES: dict = {
             make_serving_window(130.0, p99_ms=6.5, swaps=1,
                                 active_version=7)]),
     ),
+    "fleet-degraded": (
+        # one replica out of rotation after a crash-loop quarantine;
+        # quiet: full fleet, no sheds, no promotion holds
+        dict(fleets=[make_fleet_window(
+            100.0, healthy=1, quarantined=1, restarts=4, retries=3)]),
+        dict(fleets=[make_fleet_window(100.0)]),
+    ),
 }
 
 
@@ -267,6 +295,28 @@ def test_every_rule_fires_and_stays_quiet(rule_cls):
     status_q = {r["rule"]: r["status"] for r in rep_q["rules"]}
     assert status_q[rule_cls.id] == "quiet", (rule_cls.id, status_q)
     assert all(f["rule"] != rule_cls.id for f in rep_q["findings"])
+
+
+def test_quarantined_rule_downgrades_to_info_and_is_surfaced():
+    """ISSUE 20 satellite (remediation-history feedback): a rule whose
+    applied remediation the parity guard reverted still REPORTS its
+    symptom, but as info with the discredited suggestion suppressed —
+    and the report names the quarantined rule ids."""
+    fire_kw, _ = RULE_FIXTURES["fleet-degraded"]
+    rep = doctor.diagnose(**fire_kw,
+                          quarantined_rules=["fleet-degraded"])
+    assert doctor.validate_report(rep) == []
+    assert rep["quarantined_rules"] == ["fleet-degraded"]
+    f = next(f for f in rep["findings"] if f["rule"] == "fleet-degraded")
+    assert f["severity"] == "info"              # symptom stays visible,
+    assert "suggestion suppressed" in f["suggestion"]   # advice doesn't
+    assert "original:" in f["suggestion"]       # ...but stays auditable
+    # the SAME evidence un-quarantined is actionable (warn)
+    rep2 = doctor.diagnose(**fire_kw)
+    f2 = next(f for f in rep2["findings"]
+              if f["rule"] == "fleet-degraded")
+    assert f2["severity"] == "warn"
+    assert "quarantined_rules" not in rep2
 
 
 def test_push_floor_suggestion_names_concrete_engine():

@@ -1,0 +1,611 @@
+"""Serving fleet resilience (ISSUE 20): shared staging, health-aware
+router, replica supervision, verdict-guarded auto-promotion — and the
+fleet kill matrix.
+
+The acceptance bar, each leg on REAL processes where a process boundary
+is the claim:
+
+- a replica hard-killed mid-swap (``serving.fleet.replica.pre_build``)
+  drops out of rotation with ZERO failed requests; the supervisor
+  restarts it and the fleet converges on the new version;
+- a lease-holder hard-killed mid-download
+  (``serving.fleet.lease.pre_verify``) leaves an expirable lease; a peer
+  retakes it and the host ends with exactly ONE verified staging copy;
+- a worse candidate publish is HELD fleet-wide by the doctor's
+  version-regression verdict — auto-promotion never promotes it.
+
+Router edge cases (satellite): shed is a named counted refusal (never a
+hang); the one retry never lands on the replica that just timed out; a
+hedge loser's result is discarded even when it completes after cancel;
+an all-stale fleet degrades to the freshest replica with a
+``fleet.serving_stale`` event.
+"""
+
+import json
+import os
+import subprocess
+import sys
+import threading
+import time
+from concurrent.futures import Future
+
+import numpy as np
+import pytest
+
+from paddlebox_tpu import monitor
+from paddlebox_tpu.config import flags, set_flags
+from paddlebox_tpu.monitor import flight
+from paddlebox_tpu.serving.fleet import (FleetReplicaServer, LocalReplica,
+                                         PromotionGovernor, ReplicaFleet,
+                                         SharedStagingCache)
+from paddlebox_tpu.serving.router import (Router, RouterShedError,
+                                          RouterTimeoutError)
+from paddlebox_tpu.embedding import EmbeddingConfig, HostEmbeddingStore
+from paddlebox_tpu.fleet import BoxPS
+from paddlebox_tpu.models import DeepFMModel
+from paddlebox_tpu.parallel import make_mesh
+from paddlebox_tpu.serving import ServingPublisher
+from paddlebox_tpu.train import Trainer, TrainerConfig
+from paddlebox_tpu.utils import checkpoint as ckpt_lib
+from paddlebox_tpu.utils import faultpoint
+from paddlebox_tpu.utils.checkpoint import CheckpointCorruptError
+
+from test_serving import _WorsePredictor, _req_batch, job   # noqa: F401
+from test_train_e2e import NUM_SLOTS, synth_dataset
+
+
+@pytest.fixture(autouse=True)
+def _disarm():
+    yield
+    faultpoint.disarm()
+
+
+@pytest.fixture()
+def events():
+    ms = monitor.MemorySink()
+    monitor.hub().enable(ms)
+    yield ms
+    monitor.hub().disable()
+
+
+@pytest.fixture()
+def _fleet_flags():
+    keys = ("serving_shadow", "serving_split_fraction", "serving_window_s",
+            "serving_auto_promote", "serving_promote_windows",
+            "serving_hedge_factor", "serving_fleet_replicas")
+    saved = {k: flags.get(k) for k in keys}
+    yield set_flags
+    for k, v in saved.items():
+        flags.set(k, v)
+
+
+# ------------------------------------------------------------ registry
+
+
+def test_fleet_points_closed_registry():
+    """The fleet's crash windows are a closed, prefixed registry: a new
+    point cannot appear without this matrix covering it."""
+    assert set(faultpoint.FLEET_POINTS) <= set(faultpoint.POINTS)
+    assert all(p.startswith("serving.fleet.")
+               for p in faultpoint.FLEET_POINTS)
+    assert set(faultpoint.FLEET_POINTS) == {
+        "serving.fleet.lease.pre_verify",
+        "serving.fleet.replica.pre_build",
+        "serving.fleet.router.pre_dispatch"}
+    assert not set(faultpoint.FLEET_POINTS) & (
+        set(faultpoint.ELASTIC_POINTS) | set(faultpoint.ADMIT_POINTS)
+        | set(faultpoint.SERVING_POINTS)
+        | set(faultpoint.EXCHANGE_POINTS)
+        | set(faultpoint.MONITOR_POINTS))
+
+
+# ------------------------------------------------------------ staging
+
+
+def _make_artifact(dirpath: str, payload: bytes = b"model-bytes") -> str:
+    """A minimal manifest-committed artifact dir (the staging cache only
+    cares about verify_manifest, not the member shapes)."""
+    os.makedirs(dirpath, exist_ok=True)
+    member = os.path.join(dirpath, "payload.bin")
+    with ckpt_lib.atomic_file(member) as tmp:
+        with open(tmp, "wb") as f:
+            f.write(payload)
+    ckpt_lib.write_manifest(
+        dirpath, {"payload.bin": ckpt_lib.file_entry(member)})
+    return dirpath
+
+
+def _staged_versions(cache: SharedStagingCache) -> list[str]:
+    return sorted(os.listdir(cache.versions_dir))
+
+
+def test_staging_one_download_per_host(tmp_path):
+    """N replicas (their own cache instances, one shared root) racing for
+    the same version produce exactly ONE copy + verify."""
+    src = _make_artifact(str(tmp_path / "pub" / "v-000001"))
+    root = str(tmp_path / "staging")
+    caches = [SharedStagingCache(root) for _ in range(4)]
+    outs: list[str] = []
+
+    def _go(c):
+        outs.append(c.materialize(src))
+
+    threads = [threading.Thread(target=_go, args=(c,)) for c in caches]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=30)
+    assert len(set(outs)) == 1 and os.path.isdir(outs[0])
+    ckpt_lib.verify_manifest(outs[0])
+    assert sum(c.downloads for c in caches) == 1
+    assert _staged_versions(caches[0]) == ["v-000001"]   # no tmp orphans
+    # a later ask is a pure cache hit — no lease traffic
+    before = caches[1].cache_hits
+    assert caches[1].materialize(src) == outs[0]
+    assert caches[1].cache_hits == before + 1
+
+
+def test_staging_refuses_corrupt_artifact_and_releases_lease(tmp_path):
+    src = _make_artifact(str(tmp_path / "pub" / "v-000002"))
+    with open(os.path.join(src, "payload.bin"), "ab") as f:
+        f.write(b"rot")                    # CRC mismatch vs manifest
+    cache = SharedStagingCache(str(tmp_path / "staging"))
+    with pytest.raises(CheckpointCorruptError):
+        cache.materialize(src)
+    assert _staged_versions(cache) == []   # nothing under the final name
+    assert os.listdir(cache.leases_dir) == []   # lease released
+
+
+def test_staging_stale_lease_expires_and_is_retaken(tmp_path, events):
+    """A lease whose holder died (mtime frozen) is retaken after the
+    TTL; the retaker materializes and the event names the takeover."""
+    src = _make_artifact(str(tmp_path / "pub" / "v-000003"))
+    cache = SharedStagingCache(str(tmp_path / "staging"),
+                               lease_ttl_s=0.2)
+    lease = cache._lease_path("v-000003")
+    with open(lease, "w") as f:
+        f.write("{}")                      # a dead holder's lease
+    old = time.time() - 10
+    os.utime(lease, (old, old))
+    out = cache.materialize(src)
+    ckpt_lib.verify_manifest(out)
+    assert cache.lease_retakes == 1 and cache.downloads == 1
+    retaken = events.find("fleet_lease_retaken")
+    assert retaken and retaken[-1]["fields"]["version"] == "v-000003"
+
+
+def test_lease_holder_killed_mid_download_is_retaken(tmp_path, events):
+    """Kill-matrix leg: a REAL stager process dies at
+    ``serving.fleet.lease.pre_verify`` (bytes staged, verify+rename not
+    run). Its lease goes stale, a peer retakes it, and the host ends
+    with exactly one verified copy and no torn bytes under the final
+    name."""
+    src = _make_artifact(str(tmp_path / "pub" / "v-000004"))
+    staging = str(tmp_path / "staging")
+    env = dict(os.environ)
+    env.update({"PBTPU_FAULTPOINT": "serving.fleet.lease.pre_verify",
+                "PBTPU_FAULTPOINT_ACTION": "kill",
+                "JAX_PLATFORMS": "cpu"})
+    proc = subprocess.run(
+        [sys.executable, "-m", "paddlebox_tpu.serving.fleet",
+         "unused-root", "--stage", src, "--staging-root", staging],
+        env=env, capture_output=True, timeout=120)
+    assert proc.returncode == 137, proc.stderr.decode()[-400:]
+    assert b"FAULTPOINT KILL serving.fleet.lease.pre_verify" \
+        in proc.stderr
+    cache = SharedStagingCache(staging, lease_ttl_s=0.3)
+    # the dead holder left its lease and its partial tmp behind
+    assert os.path.exists(cache._lease_path("v-000004"))
+    assert any(e.startswith(".tmp.v-000004.")
+               for e in os.listdir(cache.versions_dir))
+    time.sleep(0.35)                       # age the lease past the TTL
+    out = cache.materialize(src)
+    ckpt_lib.verify_manifest(out)
+    assert cache.lease_retakes >= 1
+    # exactly ONE verified copy; the orphaned tmp was swept
+    assert _staged_versions(cache) == ["v-000004"]
+    assert os.listdir(cache.leases_dir) == []
+    assert events.find("fleet_lease_retaken")
+
+
+# ------------------------------------------------------------ router
+
+
+class _FakeReplica:
+    """A scriptable replica handle: health + latency + result/failure."""
+
+    def __init__(self, name, *, status="ok", building=False,
+                 active_version=1, age_seconds=1.0, latency_s=0.0,
+                 result=1.0, fail=None, hang=False, inflight=0):
+        self.name = name
+        self.quarantined = False
+        self.status = status
+        self.building = building
+        self.active_version = active_version
+        self.age_seconds = age_seconds
+        self.latency_s = latency_s
+        self.result = result
+        self.fail = fail
+        self.hang = hang
+        self.inflight = inflight
+        self.calls = 0
+
+    def health(self):
+        if self.status == "unreachable":
+            raise ConnectionError(f"{self.name} is down")
+        return {"status": self.status, "building": self.building,
+                "active_version": self.active_version,
+                "age_seconds": self.age_seconds}
+
+    def submit(self, ids, mask, dense=None) -> Future:
+        self.calls += 1
+        fut: Future = Future()
+        if self.hang:
+            return fut                     # never resolves (cancellable)
+
+        def _resolve():
+            if self.fail is not None:
+                fut.set_exception(self.fail)
+            else:
+                fut.set_result(self.result)
+        if self.latency_s > 0:
+            # the request is already in flight: cancel() must fail, so
+            # the router's discard contract (late loser counted, never
+            # surfaced) is what gets exercised
+            fut.set_running_or_notify_cancel()
+            threading.Timer(self.latency_s, _resolve).start()
+        else:
+            _resolve()
+        return fut
+
+
+def test_router_shed_is_named_counted_and_never_hangs(tmp_path):
+    reps = [_FakeReplica("a", status="empty"),
+            _FakeReplica("b", status="unreachable")]
+    r = Router(reps, timeout_s=1.0, health_ttl_s=0.0)
+    t0 = time.monotonic()
+    with pytest.raises(RouterShedError, match="no serviceable replica"):
+        r.score([1], [True])
+    assert time.monotonic() - t0 < 2.0     # refusal, not a hang
+    s = r.stats()
+    assert s["sheds"] == 1 and s["requests"] == 1
+    assert reps[0].calls == reps[1].calls == 0
+
+
+def test_router_retry_never_lands_on_the_timed_out_replica():
+    slow = _FakeReplica("slow", hang=True, inflight=0)
+    fast = _FakeReplica("fast", result=7.0, inflight=5)
+    r = Router([slow, fast], timeout_s=0.2, health_ttl_s=10.0)
+    out = r.score([1], [True])             # least-loaded picks `slow`
+    assert out == 7.0
+    assert slow.calls == 1 and fast.calls == 1
+    s = r.stats()
+    assert s["timeouts"] == 1 and s["retries"] == 1
+    assert s["failures"] == 0
+
+
+def test_router_drains_a_building_replica():
+    building = _FakeReplica("building", building=True, result=0.0)
+    serving = _FakeReplica("serving", result=3.0)
+    r = Router([building, serving], health_ttl_s=10.0)
+    for _ in range(10):
+        assert r.score([1], [True]) == 3.0
+    assert building.calls == 0 and serving.calls == 10
+
+
+def test_router_all_stale_degrades_to_freshest_with_event(events):
+    older = _FakeReplica("older", status="stale", age_seconds=100.0,
+                         result=1.0)
+    fresher = _FakeReplica("fresher", status="stale", age_seconds=5.0,
+                           result=2.0)
+    r = Router([older, fresher], health_ttl_s=10.0)
+    assert r.score([1], [True]) == 2.0     # freshest stale replica
+    assert fresher.calls == 1 and older.calls == 0
+    assert r.stats()["degraded_dispatches"] == 1
+    ev = events.find("fleet.serving_stale")
+    assert ev and ev[-1]["fields"]["chosen"] == "fresher"
+
+
+def test_router_all_building_falls_back_instead_of_shedding():
+    """Draining is a preference: when EVERY replica is mid-build, the
+    freshest one (its active version still serves; swap is atomic) takes
+    the request — a shed here would fail traffic the fleet can answer."""
+    b1 = _FakeReplica("b1", building=True, result=1.0, age_seconds=2.0)
+    b2 = _FakeReplica("b2", building=True, result=2.0, age_seconds=9.0)
+    r = Router([b1, b2], health_ttl_s=10.0)
+    assert r.score([1], [True]) == 1.0
+    s = r.stats()
+    assert s["degraded_dispatches"] == 1 and s["sheds"] == 0
+
+
+def test_router_hedge_first_wins_loser_cancelled_and_discarded():
+    slow = _FakeReplica("slow", latency_s=0.5, result=1.0, inflight=0)
+    fast = _FakeReplica("fast", latency_s=0.01, result=2.0, inflight=3)
+    r = Router([slow, fast], timeout_s=5.0, health_ttl_s=10.0,
+               hedge_factor=1.0, hedge_min_count=5)
+    for _ in range(10):                    # seed the p99 the threshold
+        r._lat_svc.add(10.0)               # derives from (~10ms)
+    out = r.score([1], [True])
+    # primary went to `slow` (least loaded); the hedge fired past the
+    # threshold, landed on `fast`, and its answer won
+    assert out == 2.0
+    assert slow.calls == 1 and fast.calls == 1
+    s = r.stats()
+    assert s["hedges"] == 1 and s["hedges_won"] == 1
+    assert s["retries"] == 0 and s["failures"] == 0
+    # the loser completes AFTER cancel — its late result is discarded
+    # (counted), never surfaced to any caller
+    deadline = time.monotonic() + 2.0
+    while (r.stats()["hedge_discards"] < 1
+           and time.monotonic() < deadline):
+        time.sleep(0.02)
+    assert r.stats()["hedge_discards"] == 1
+
+
+def test_router_pre_dispatch_ioerror_retries_elsewhere():
+    """The ioerror leg of serving.fleet.router.pre_dispatch: a faulted
+    primary dispatch is retried on a DIFFERENT replica, the caller sees
+    only the answer."""
+    a = _FakeReplica("a", result=1.0)
+    b = _FakeReplica("b", result=1.0)
+    r = Router([a, b], health_ttl_s=10.0)
+    faultpoint.arm("serving.fleet.router.pre_dispatch", "ioerror")
+    try:
+        assert r.score([1], [True]) == 1.0
+    finally:
+        faultpoint.disarm()
+    # the fault fired BEFORE the submit — the primary target got no
+    # request; the retry landed on the other replica
+    assert a.calls + b.calls == 1
+    s = r.stats()
+    assert s["retries"] == 1 and s["failures"] == 0
+
+
+# --------------------------------------------------- fleet flight record
+
+
+def _fleet_fields(**over):
+    fields = {"window_s": 10.0, "replicas": 2, "healthy": 2,
+              "quarantined": 0, "requests": 100, "sheds": 0,
+              "retries": 1, "hedges": 3, "hedges_won": 2, "restarts": 0,
+              "promote_holds": 0, "p50_ms": 2.0, "p99_ms": 9.0}
+    fields.update(over)
+    return fields
+
+
+def test_fleet_record_schema_negatives(events):
+    monitor.event("fleet_window", type="fleet_record", **_fleet_fields())
+    rec = events.find("fleet_window")[-1]
+    assert flight.validate_fleet_record(rec) == []
+    bad = dict(rec)
+    bad["fields"] = {k: v for k, v in rec["fields"].items()
+                     if k != "healthy"}
+    assert any("healthy" in e for e in flight.validate_fleet_record(bad))
+    bad = dict(rec, fields=dict(rec["fields"], retries="three"))
+    assert any("retries" in e for e in flight.validate_fleet_record(bad))
+    bad = dict(rec, fields=dict(rec["fields"], sheds=True))
+    assert any("sheds" in e for e in flight.validate_fleet_record(bad))
+    # cross-field: more healthy replicas than replicas is nonsense
+    bad = dict(rec, fields=dict(rec["fields"], healthy=5))
+    assert any("healthy" in e for e in flight.validate_fleet_record(bad))
+
+
+def test_fleet_record_rides_events_file_validation(tmp_path):
+    envelope = {"ts": 1.0, "name": "fleet_window", "type": "fleet_record",
+                "pass_id": None, "step": None, "phase": None,
+                "thread": "MainThread"}
+    good = dict(envelope, fields=_fleet_fields())
+    bad = dict(envelope,
+               fields={k: v for k, v in _fleet_fields().items()
+                       if k != "p99_ms"})
+    p = tmp_path / "events.jsonl"
+    p.write_text(json.dumps(good) + "\n" + json.dumps(bad) + "\n")
+    out = flight.validate_events_file(str(p))
+    assert out["events"] == 2
+    assert len(out["errors"]) == 1 and "p99_ms" in out["errors"][0]
+
+
+# ------------------------------------------- verdict-guarded promotion
+
+
+def _window_with_labels(srv, ids, mask, dense):
+    """Serve a batch, join delayed labels that perfectly separate the
+    STABLE scores, and commit the window (the test_serving shadow
+    pattern: identical candidate → identical AUC; worse candidate →
+    anti-correlated scores → AUC gap)."""
+    served = srv.predict(ids, mask, dense)
+    labels = (np.asarray(served) >
+              np.median(served)).astype(np.float64).reshape(-1)
+    srv.observe_labels(labels)
+    return srv.commit_window(force=True)
+
+
+def test_governor_disabled_and_no_candidate(_fleet_flags):
+    gov = PromotionGovernor([])
+    assert gov.observe({"candidate_version": 2}) == "disabled"
+    _fleet_flags(serving_auto_promote=True)
+    assert gov.observe({}) == "no-candidate"
+
+
+def test_governor_holds_worse_candidate_fleet_wide(job, _fleet_flags,
+                                                   events):
+    """Kill-matrix leg: an injected-WORSE candidate's window fires the
+    doctor's version-regression verdict critical — the governor HOLDS it
+    fleet-wide and quarantines the version; no replica ever promotes."""
+    ds, schema, store, model, tr, box, pub, root = job
+    box.end_pass(trainer=tr, publisher=pub)            # v1 (stable)
+    _fleet_flags(serving_shadow=True, serving_auto_promote=True,
+                 serving_promote_windows=2)
+    servers = [FleetReplicaServer(root) for _ in range(2)]
+    for s in servers:
+        s.poll_once()
+    pub.publish(store, tr.eval_params(), pass_id=1)    # v2 candidate
+    for s in servers:
+        assert s.poll_once() == 1 and s.candidate.version == 2
+    gov = PromotionGovernor(
+        [LocalReplica(f"r{i}", s, None) for i, s in enumerate(servers)])
+    lead = servers[0]
+    lead._candidate.predictor = _WorsePredictor(lead._candidate.predictor)
+    ids, mask, dense = _req_batch(ds)
+    fields = _window_with_labels(lead, ids, mask, dense)
+    assert gov.observe(fields) == "hold"
+    assert gov.held_versions == {2} and gov.promote_holds == 1
+    # a later clean-looking window cannot resurrect a quarantined
+    # version — the hold is checked before the rule ever runs again
+    assert gov.observe(fields) == "held"
+    for s in servers:
+        assert s.active.version == 1 and s.candidate is not None
+    hold = events.find("fleet_promote_hold")
+    assert hold and hold[-1]["fields"]["version"] == 2
+    assert hold[-1]["fields"]["rule"] == "version-regression"
+    quar = events.find("fleet_version_quarantined")
+    assert quar and quar[-1]["fields"]["version"] == 2
+    assert not events.find("fleet_promoted")
+
+
+def test_governor_promotes_after_k_clean_windows(job, _fleet_flags,
+                                                 events):
+    """The positive path: a byte-identical candidate scores K = 2
+    consecutive clean windows and the governor promotes it on EVERY
+    replica (one clean window must not suffice)."""
+    ds, schema, store, model, tr, box, pub, root = job
+    box.end_pass(trainer=tr, publisher=pub)            # v1
+    _fleet_flags(serving_shadow=True, serving_auto_promote=True,
+                 serving_promote_windows=2)
+    servers = [FleetReplicaServer(root) for _ in range(2)]
+    for s in servers:
+        s.poll_once()
+    pub.publish(store, tr.eval_params(), pass_id=1)    # identical v2
+    for s in servers:
+        assert s.poll_once() == 1
+    gov = PromotionGovernor(
+        [LocalReplica(f"r{i}", s, None) for i, s in enumerate(servers)])
+    lead = servers[0]
+    ids, mask, dense = _req_batch(ds)
+    assert gov.observe(_window_with_labels(lead, ids, mask, dense)) \
+        == "clean"
+    for s in servers:                  # one clean window: nothing moves
+        assert s.active.version == 1
+    assert gov.observe(_window_with_labels(lead, ids, mask, dense)) \
+        == "promoted"
+    for s in servers:
+        assert s.active.version == 2 and s.candidate is None
+    promoted = events.find("fleet_promoted")
+    assert promoted and promoted[-1]["fields"]["version"] == 2
+    assert promoted[-1]["fields"]["replicas_promoted"] == 2
+    assert gov.promote_holds == 0 and not events.find("fleet_promote_hold")
+
+
+# --------------------------------------- the fleet kill matrix (leg 1)
+
+
+def _rh(rep) -> dict:
+    try:
+        return rep.health()
+    except Exception:   # noqa: BLE001 — "unreachable" during the wait
+        return {}
+
+
+def _wait(cond, timeout_s: float, what: str):
+    deadline = time.monotonic() + timeout_s
+    while time.monotonic() < deadline:
+        if cond():
+            return
+        time.sleep(0.05)
+    raise AssertionError(f"timed out after {timeout_s}s waiting for {what}")
+
+
+@pytest.fixture()
+def fleet_job(tmp_path):
+    """One trained pass publishing EVERY version as a base, so a
+    restarted replica cold-starts straight onto the newest version (one
+    pre_build window) instead of replaying a delta chain through the
+    very window that killed it."""
+    ds, schema = synth_dataset(256)
+    store = HostEmbeddingStore(EmbeddingConfig(dim=8, learning_rate=0.15))
+    model = DeepFMModel(num_slots=NUM_SLOTS, emb_dim=8, dense_dim=1,
+                        hidden=(16,))
+    tr = Trainer(model, store, schema, make_mesh(1),
+                 TrainerConfig(global_batch_size=64, dense_lr=3e-3))
+    box = BoxPS(store)
+    root = str(tmp_path / "serve")
+    pub = ServingPublisher(root, model, schema, publish_base_every=1,
+                           quant="f32", hot_top_k=16)
+    box.begin_pass()
+    tr.train_pass(ds)
+    return ds, tr, box, pub, root
+
+
+@pytest.mark.slow
+def test_replica_killed_mid_swap_routes_around(fleet_job, tmp_path,
+                                               events):
+    """Kill-matrix leg: replica 0 (a REAL subprocess) is hard-killed at
+    ``serving.fleet.replica.pre_build`` when v2 arrives. The router
+    routes around it — ZERO failed requests under continuous load — the
+    supervisor restarts it, and the fleet converges on v2 with exactly
+    one verified staging copy per version on the host."""
+    ds, tr, box, pub, root = fleet_job
+    box.end_pass(trainer=tr, publisher=pub)            # v1 (base)
+    kill_env = {"PBTPU_FAULTPOINT": "serving.fleet.replica.pre_build",
+                "PBTPU_FAULTPOINT_AFTER": "1",     # hit #1 = the v1
+                "JAX_PLATFORMS": "cpu"}            # build; #2 = v2 kills
+    fleet = ReplicaFleet(
+        root, replicas=2, workdir=str(tmp_path / "fw"),
+        staging_root=str(tmp_path / "fw" / "staging"),
+        poll_s=0.1, backoff0_s=0.2, supervise_tick_s=0.05, window_s=0,
+        replica_env=lambda i: (kill_env if i == 0
+                               else {"JAX_PLATFORMS": "cpu"}))
+    router = Router(fleet.replicas, timeout_s=120.0, health_ttl_s=0.5)
+    fleet.attach_router(router)
+    fleet.start()
+    errors: list = []
+    stop = threading.Event()
+    try:
+        _wait(lambda: all(_rh(r).get("active_version") == 1
+                          for r in fleet.replicas),
+              120, "both replicas serving v1")
+        ids, mask, dense = _req_batch(ds)
+        router.score(ids, mask, dense)     # warm both ends' compile
+
+        def hammer():
+            while not stop.is_set():
+                try:
+                    router.score(ids, mask, dense)
+                except Exception as e:   # noqa: BLE001 — the assertion
+                    errors.append(e)     # target: must stay empty
+
+        threads = [threading.Thread(target=hammer) for _ in range(4)]
+        [t.start() for t in threads]
+        try:
+            box.begin_pass()
+            tr.train_pass(ds)
+            box.end_pass(trainer=tr, publisher=pub)    # v2: replica 0
+            _wait(lambda: 137 in fleet.replicas[0].exits,   # dies here
+                  120, "replica-0 faultpoint kill")
+            _wait(lambda: all(r.alive()
+                              and _rh(r).get("active_version") == 2
+                              and _rh(r).get("status") == "ok"
+                              for r in fleet.replicas),
+                  180, "fleet convergence on v2")
+            router.score(ids, mask, dense)
+        finally:
+            stop.set()
+            [t.join(timeout=60) for t in threads]
+        assert not errors, errors[:3]
+        assert fleet.restarts >= 1
+        assert not fleet.replicas[0].quarantined
+        assert events.find("fleet_replica_restart")
+        # one verified staging copy per version, no tmp orphans
+        staged = sorted(os.listdir(
+            os.path.join(fleet.staging_root, "versions")))
+        assert staged == ["v-000001", "v-000002"]
+        rs = router.stats()
+        assert rs["requests"] > 0
+        assert rs["failures"] == 0 and rs["sheds"] == 0
+        fields = fleet.commit_window(force=True)
+        assert fields["healthy"] == 2 and fields["restarts"] >= 1
+        rec = events.find("fleet_window")[-1]
+        assert flight.validate_fleet_record(rec) == []
+    finally:
+        stop.set()
+        fleet.stop()

@@ -184,8 +184,16 @@ def test_two_host_election_smoke(tmp_path, golden):
                           if p not in ("pass_ckpt.pre_manifest",
                                        "remote_ckpt.download.pre")
                           and p not in faultpoint.ELASTIC_POINTS
+                          # the fixed 2-rank crash worker never calls
+                          # ElasticWorld.admit or rebinds ownership —
+                          # the admit/grow windows are covered by the
+                          # grow kill matrix (test_elastic.py +
+                          # grow_worker.py); a leg here would KeyError
+                          # on POINT_AFTER and could never fire anyway
+                          and p not in faultpoint.ADMIT_POINTS
                           and p not in faultpoint.SERVING_POINTS
                           and p not in faultpoint.MONITOR_POINTS
+                          and p not in faultpoint.FLEET_POINTS
                           # the multi-host worker trains a plain
                           # 1-shard in-RAM store: the sharded-save and
                           # spill-tier windows never execute here —
